@@ -1,0 +1,61 @@
+"""Benchmark: LightGBMClassifier.fit wall-clock on a HIGGS-like synthetic dataset.
+
+North star (BASELINE.json): HIGGS-11M fit on v5e-16 matching single-H100 lightgbm-gpu
+at AUC parity. This bench runs a scaled-down slice (1M x 28, 100 iterations, 64 bins)
+on whatever single chip is available and reports training throughput.
+
+Baseline for vs_baseline: upstream lightgbm-gpu trains HIGGS (11M x 28, 100 iters)
+in ~40s on a modern GPU => ~27.5M rows*iter/s. The metric here is the same unit
+(rows * iterations / second, binning included), so vs_baseline = value / 27.5e6.
+
+Prints ONE JSON line: {"metric","value","unit","vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+    n, f, iters = 1_000_000, 28, 100
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    coef = rng.normal(size=f)
+    y = ((x @ coef + 0.5 * x[:, 0] * x[:, 1]
+          + rng.normal(scale=1.0, size=n)) > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+
+    clf = LightGBMClassifier(numIterations=iters, numLeaves=31, maxBin=64,
+                             histChunk=2048, numTasks=1)
+    # warm-up compile on a small slice so the timed run measures execution
+    clf.copy({"numIterations": 2}).fit(
+        DataFrame({"features": x[:4096], "label": y[:4096]}))
+
+    t0 = time.time()
+    model = clf.fit(df)
+    wall = time.time() - t0
+
+    from sklearn.metrics import roc_auc_score
+    idx = rng.choice(n, 100_000, replace=False)
+    proba = model.booster.score(x[idx])
+    auc = roc_auc_score(y[idx], proba)
+
+    value = n * iters / wall
+    baseline = 27.5e6  # rows*iter/s, single-GPU lightgbm on HIGGS-class data
+    print(json.dumps({
+        "metric": "gbdt_fit_rows_iter_per_s_1Mx28",
+        "value": round(value, 1),
+        "unit": "rows*iter/s",
+        "vs_baseline": round(value / baseline, 4),
+        "extra": {"wall_s": round(wall, 2), "train_auc_sample": round(auc, 4),
+                  "device": str(jax.devices()[0])},
+    }))
+
+
+if __name__ == "__main__":
+    main()

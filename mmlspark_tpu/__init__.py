@@ -1,0 +1,14 @@
+"""mmlspark_tpu — a TPU-native ML framework with the capabilities of MMLSpark.
+
+The reference (anusharamesh/mmlspark) composes SparkML estimators/transformers over
+DataFrames with JNI-wrapped C++ engines per executor; this framework keeps the
+pipeline-composition surface but runs every heavy path as JAX/XLA/Pallas programs over a
+`jax.sharding.Mesh` of TPU chips. See SURVEY.md for the layer-by-layer mapping.
+"""
+
+__version__ = "0.1.0"
+
+from .core.dataframe import DataFrame
+from .core.params import Param, Params
+from .core.pipeline import (Estimator, Evaluator, Model, Pipeline,
+                            PipelineModel, PipelineStage, Transformer)

@@ -1,0 +1,255 @@
+"""Columnar DataFrame — the host-side data plane.
+
+The reference framework composes everything over Spark ``DataFrame``s (SURVEY.md §0); its unit
+of distribution is the Spark partition. In the TPU-native design the host data plane is a plain
+columnar table (numpy-backed, Arrow-convertible) and *device sharding via jax.sharding replaces
+partitioning* — so this class is deliberately single-host and simple. Heavy compute never happens
+here; estimators move columns into HBM as jax arrays and shard them over the mesh
+(see mmlspark_tpu.parallel).
+
+Reference analogue: org.apache.spark.sql.DataFrame as used by
+src/main/scala/com/microsoft/ml/spark/** (e.g. lightgbm/LightGBMBase.scala:70-132 column
+casting / repartitioning — here replaced by `cast_column` and device sharding).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+
+def _as_column(values: Any) -> np.ndarray:
+    """Coerce arbitrary input into a numpy column (1-D scalars or 2-D vectors)."""
+    if isinstance(values, np.ndarray):
+        return values
+    if isinstance(values, (list, tuple)):
+        if len(values) > 0 and isinstance(values[0], (list, tuple, np.ndarray)):
+            try:
+                arr = np.asarray(values)
+                if arr.dtype != object:
+                    return arr
+            except ValueError:
+                pass
+            out = np.empty(len(values), dtype=object)
+            for i, v in enumerate(values):
+                out[i] = v
+            return out
+        arr = np.asarray(values)
+        if arr.dtype.kind == "U":
+            return arr.astype(object)
+        return arr
+    # jax arrays and other array-likes
+    return np.asarray(values)
+
+
+class DataFrame:
+    """An ordered, named collection of equal-length columns.
+
+    Columns are numpy arrays: 1-D for scalar columns, 2-D for dense vector columns,
+    object-dtype for strings / ragged values. Per-column ``metadata`` carries schema
+    annotations (categorical levels, ML attribute names) the way Spark ML metadata does
+    (reference: core/schema/SparkSchema.scala, core/schema/Categoricals.scala).
+    """
+
+    def __init__(self, data: Optional[Mapping[str, Any]] = None,
+                 metadata: Optional[Dict[str, Dict[str, Any]]] = None):
+        self._cols: Dict[str, np.ndarray] = {}
+        self._meta: Dict[str, Dict[str, Any]] = dict(metadata or {})
+        if data:
+            n = None
+            for name, values in data.items():
+                col = _as_column(values)
+                if n is None:
+                    n = len(col)
+                elif len(col) != n:
+                    raise ValueError(
+                        f"column {name!r} has length {len(col)}, expected {n}")
+                self._cols[name] = col
+
+    # ---------------------------------------------------------------- basics
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols.keys())
+
+    def __len__(self) -> int:
+        if not self._cols:
+            return 0
+        return len(next(iter(self._cols.values())))
+
+    count = __len__
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if name not in self._cols:
+            raise KeyError(f"no column {name!r}; have {self.columns}")
+        return self._cols[name]
+
+    def column(self, name: str) -> np.ndarray:
+        return self[name]
+
+    def metadata(self, name: str) -> Dict[str, Any]:
+        return self._meta.get(name, {})
+
+    def with_metadata(self, name: str, meta: Dict[str, Any]) -> "DataFrame":
+        out = self._shallow_copy()
+        out._meta[name] = dict(meta)
+        return out
+
+    def schema(self) -> Dict[str, str]:
+        return {k: str(v.dtype) + ("" if v.ndim == 1 else f"[{v.shape[1]}]")
+                for k, v in self._cols.items()}
+
+    def _shallow_copy(self) -> "DataFrame":
+        out = DataFrame()
+        out._cols = dict(self._cols)
+        out._meta = {k: dict(v) for k, v in self._meta.items()}
+        return out
+
+    # ------------------------------------------------------------ transforms
+    def select(self, *names: str) -> "DataFrame":
+        flat: List[str] = []
+        for n in names:
+            flat.extend(n if isinstance(n, (list, tuple)) else [n])
+        out = DataFrame()
+        for n in flat:
+            out._cols[n] = self[n]
+            if n in self._meta:
+                out._meta[n] = dict(self._meta[n])
+        return out
+
+    def drop(self, *names: str) -> "DataFrame":
+        dropset = set(names)
+        out = self._shallow_copy()
+        for n in dropset:
+            out._cols.pop(n, None)
+            out._meta.pop(n, None)
+        return out
+
+    def with_column(self, name: str, values: Any,
+                    metadata: Optional[Dict[str, Any]] = None) -> "DataFrame":
+        col = _as_column(values)
+        if self._cols and len(col) != len(self):
+            raise ValueError(
+                f"new column {name!r} has length {len(col)}, expected {len(self)}")
+        out = self._shallow_copy()
+        out._cols[name] = col
+        if metadata is not None:
+            out._meta[name] = dict(metadata)
+        return out
+
+    withColumn = with_column
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        out = DataFrame()
+        for n, c in self._cols.items():
+            key = new if n == old else n
+            out._cols[key] = c
+            if n in self._meta:
+                out._meta[key] = dict(self._meta[n])
+        return out
+
+    def filter(self, mask_or_fn) -> "DataFrame":
+        if callable(mask_or_fn):
+            mask = np.fromiter((bool(mask_or_fn(r)) for r in self.rows()),
+                               dtype=bool, count=len(self))
+        else:
+            mask = np.asarray(mask_or_fn, dtype=bool)
+        return self.take(np.nonzero(mask)[0])
+
+    def take(self, indices) -> "DataFrame":
+        idx = np.asarray(indices)
+        out = DataFrame()
+        for n, c in self._cols.items():
+            out._cols[n] = c[idx]
+        out._meta = {k: dict(v) for k, v in self._meta.items()}
+        return out
+
+    def head(self, n: int = 5) -> "DataFrame":
+        return self.take(np.arange(min(n, len(self))))
+
+    def sort(self, *names: str, ascending: bool = True) -> "DataFrame":
+        keys = [self[n] for n in reversed(names)]
+        order = np.lexsort(keys)
+        if not ascending:
+            order = order[::-1]
+        return self.take(order)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if set(self.columns) != set(other.columns):
+            raise ValueError("union requires identical column sets")
+        out = DataFrame()
+        for n in self.columns:
+            a, b = self._cols[n], other._cols[n]
+            out._cols[n] = np.concatenate([a, b], axis=0)
+        out._meta = {k: dict(v) for k, v in self._meta.items()}
+        return out
+
+    def random_split(self, weights: Sequence[float], seed: int = 0
+                     ) -> List["DataFrame"]:
+        """Reference: Dataset.randomSplit used by LightGBMBase.scala:29-50 batch split."""
+        w = np.asarray(weights, dtype=np.float64)
+        w = w / w.sum()
+        rng = np.random.default_rng(seed)
+        n = len(self)
+        perm = rng.permutation(n)
+        bounds = np.floor(np.cumsum(w) * n).astype(int)
+        bounds[-1] = n  # fp cumsum can land below 1.0 and silently drop rows
+        out, start = [], 0
+        for b in bounds:
+            out.append(self.take(np.sort(perm[start:b])))
+            start = b
+        return out
+
+    randomSplit = random_split
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        rng = np.random.default_rng(seed)
+        mask = rng.random(len(self)) < fraction
+        return self.take(np.nonzero(mask)[0])
+
+    def cast_column(self, name: str, dtype) -> "DataFrame":
+        return self.with_column(name, self[name].astype(dtype),
+                                metadata=self.metadata(name) or None)
+
+    # -------------------------------------------------------------- row view
+    def rows(self) -> Iterable[Dict[str, Any]]:
+        cols = self._cols
+        for i in range(len(self)):
+            yield {n: c[i] for n, c in cols.items()}
+
+    def collect(self) -> List[Dict[str, Any]]:
+        return list(self.rows())
+
+    def to_pandas(self):
+        import pandas as pd
+        data = {}
+        for n, c in self._cols.items():
+            data[n] = list(c) if c.ndim > 1 else c
+        return pd.DataFrame(data)
+
+    toPandas = to_pandas
+
+    @staticmethod
+    def from_pandas(pdf, vector_cols: Sequence[str] = ()) -> "DataFrame":
+        data = {}
+        for n in pdf.columns:
+            v = pdf[n].to_numpy()
+            if n in vector_cols or (len(v) and isinstance(v[0], (list, np.ndarray))):
+                v = np.stack([np.asarray(x) for x in v])
+            data[n] = v
+        return DataFrame(data)
+
+    def __repr__(self) -> str:
+        return f"DataFrame[{len(self)} rows x {len(self._cols)} cols: {self.schema()}]"
+
+    def show(self, n: int = 10) -> None:
+        print(self.head(n).to_pandas().to_string())
+
+
+def concat_dataframes(dfs: Sequence[DataFrame]) -> DataFrame:
+    out = dfs[0]
+    for d in dfs[1:]:
+        out = out.union(d)
+    return out

@@ -1,0 +1,202 @@
+"""Param / Params system — single source of truth for every stage's configuration.
+
+Reference analogue: SparkML ``Params`` extended by the MMLSpark param-trait library
+(core/contracts/Params.scala:15-216 — `Wrappable`, `Has*Col` traits) and the 19 custom
+ComplexParam types (org/apache/spark/ml/param/*). As in the reference, the same Param registry
+drives (a) runtime configuration, (b) save/load serialization, and (c) API-surface generation
+(mmlspark_tpu.utils.codegen), so there is exactly one place a knob is declared.
+"""
+
+from __future__ import annotations
+
+import copy
+import numpy as np
+from typing import Any, Callable, Dict, List, Optional, Type
+
+
+class Param:
+    """A named, documented, typed parameter declared on a Params class."""
+
+    def __init__(self, name: str, doc: str = "", default: Any = None,
+                 converter: Optional[Callable[[Any], Any]] = None,
+                 complex: bool = False):
+        self.name = name
+        self.doc = doc
+        self.default = default
+        self.converter = converter
+        # complex params hold values that can't be JSON-serialized (arrays, models,
+        # nested stages) — analogue of ComplexParam (core/serialize/ComplexParam.scala:13)
+        self.complex = complex
+
+    def __repr__(self):
+        return f"Param({self.name!r})"
+
+
+class Params:
+    """Base for every pipeline stage; holds the param registry and value maps.
+
+    Subclasses declare params as class attributes of type Param. Instances get
+    camelCase set/get accessors synthesized automatically (setFoo/getFoo), mirroring
+    the codegen'd wrapper surface of the reference (codegen/PySparkWrapper.scala).
+    """
+
+    _uid_counter = 0
+
+    def __init__(self, **kwargs):
+        cls = type(self)
+        Params._uid_counter += 1
+        self.uid = f"{cls.__name__}_{Params._uid_counter:08x}"
+        self._paramMap: Dict[str, Any] = {}
+        self._set(**kwargs)
+
+    # ------------------------------------------------------------ registry
+    @classmethod
+    def params(cls) -> Dict[str, Param]:
+        out: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    out[v.name] = v
+        return out
+
+    @classmethod
+    def has_param(cls, name: str) -> bool:
+        return name in cls.params()
+
+    # ------------------------------------------------------------ get / set
+    def _set(self, **kwargs) -> "Params":
+        registry = self.params()
+        for name, value in kwargs.items():
+            if value is None and name not in registry:
+                continue
+            if name not in registry:
+                raise ValueError(
+                    f"{type(self).__name__} has no param {name!r}; "
+                    f"known: {sorted(registry)}")
+            p = registry[name]
+            if p.converter is not None and value is not None:
+                value = p.converter(value)
+            self._paramMap[name] = value
+        return self
+
+    def set(self, name: str, value: Any) -> "Params":
+        return self._set(**{name: value})
+
+    def get(self, name: str) -> Any:
+        registry = self.params()
+        if name not in registry:
+            raise ValueError(f"{type(self).__name__} has no param {name!r}")
+        if name in self._paramMap:
+            return self._paramMap[name]
+        return registry[name].default
+
+    def get_or_default(self, name: str) -> Any:
+        return self.get(name)
+
+    def is_set(self, name: str) -> bool:
+        return name in self._paramMap
+
+    def explain_params(self) -> str:
+        lines = []
+        for name, p in sorted(self.params().items()):
+            cur = self._paramMap.get(name, p.default)
+            lines.append(f"{name}: {p.doc} (default: {p.default!r}, current: {cur!r})")
+        return "\n".join(lines)
+
+    def copy(self, extra: Optional[Dict[str, Any]] = None) -> "Params":
+        out = copy.copy(self)
+        out._paramMap = dict(self._paramMap)
+        Params._uid_counter += 1
+        out.uid = f"{type(self).__name__}_{Params._uid_counter:08x}"
+        if extra:
+            out._set(**extra)
+        return out
+
+    # ------------------------------------------------- camelCase accessors
+    def __getattr__(self, attr: str):
+        # synthesized setX/getX accessors (wrapper-surface parity with reference codegen)
+        if attr.startswith("set") and len(attr) > 3:
+            name = attr[3].lower() + attr[4:]
+            if self.has_param(name):
+                def setter(value, _name=name):
+                    self._set(**{_name: value})
+                    return self
+                return setter
+        if attr.startswith("get") and len(attr) > 3:
+            name = attr[3].lower() + attr[4:]
+            if self.has_param(name):
+                return lambda _name=name: self.get(_name)
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {attr!r}")
+
+    def __repr__(self):
+        set_params = {k: v for k, v in self._paramMap.items()
+                      if not isinstance(v, (np.ndarray,))}
+        return f"{type(self).__name__}(uid={self.uid}, {set_params})"
+
+
+# --------------------------------------------------------------------------
+# Shared param traits (reference: core/contracts/Params.scala Has*Col traits)
+# --------------------------------------------------------------------------
+
+class HasInputCol(Params):
+    inputCol = Param("inputCol", "name of the input column", "input")
+
+
+class HasInputCols(Params):
+    inputCols = Param("inputCols", "names of the input columns", None)
+
+
+class HasOutputCol(Params):
+    outputCol = Param("outputCol", "name of the output column", "output")
+
+
+class HasOutputCols(Params):
+    outputCols = Param("outputCols", "names of the output columns", None)
+
+
+class HasLabelCol(Params):
+    labelCol = Param("labelCol", "name of the label column", "label")
+
+
+class HasFeaturesCol(Params):
+    featuresCol = Param("featuresCol", "name of the features column", "features")
+
+
+class HasPredictionCol(Params):
+    predictionCol = Param("predictionCol", "name of the prediction column", "prediction")
+
+
+class HasRawPredictionCol(Params):
+    rawPredictionCol = Param("rawPredictionCol",
+                             "raw (margin) prediction column", "rawPrediction")
+
+
+class HasProbabilityCol(Params):
+    probabilityCol = Param("probabilityCol", "class-probability column", "probability")
+
+
+class HasWeightCol(Params):
+    weightCol = Param("weightCol", "instance weight column", None)
+
+
+class HasValidationIndicatorCol(Params):
+    validationIndicatorCol = Param(
+        "validationIndicatorCol",
+        "boolean column marking rows held out for early-stopping validation", None)
+
+
+class HasInitScoreCol(Params):
+    initScoreCol = Param("initScoreCol", "initial (warm-start) margin column", None)
+
+
+class HasGroupCol(Params):
+    groupCol = Param("groupCol", "query-group column for ranking", None)
+
+
+class HasSeed(Params):
+    seed = Param("seed", "random seed", 0, int)
+
+
+class HasBatchSize(Params):
+    batchSize = Param("batchSize", "mini-batch size", 1024, int)

@@ -1,0 +1,224 @@
+"""Estimator / Transformer / Pipeline — the unit of composition.
+
+Reference analogue: SparkML ``Estimator``/``Transformer``/``PipelineModel`` as used by every
+MMLSpark stage (SURVEY.md §0: "The unit of composition everywhere is the SparkML
+Estimator/Transformer over a DataFrame"). Save/load mirrors ComplexParamsWritable
+(core/serialize/ComplexParam.scala, ConstructorWriter.scala:90): simple params go to JSON,
+complex params (arrays, nested stages, fitted state) to sidecar files.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import pickle
+import numpy as np
+from typing import Any, Dict, List, Optional, Sequence
+
+from .dataframe import DataFrame
+from .params import Param, Params
+
+
+class PipelineStage(Params):
+    """Base of every stage. Provides save/load; subclasses implement fit/transform."""
+
+    # ------------------------------------------------------------ save/load
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        simple: Dict[str, Any] = {}
+        arrays: Dict[str, np.ndarray] = {}
+        complex_meta: Dict[str, Any] = {}
+        for name, value in self._paramMap.items():
+            kind, payload = _encode_value(value, name, path)
+            if kind == "json":
+                simple[name] = payload
+            elif kind == "array":
+                arrays[name] = payload
+                complex_meta[name] = {"kind": "array"}
+            else:
+                complex_meta[name] = payload
+        meta = {
+            "class": f"{type(self).__module__}.{type(self).__name__}",
+            "uid": self.uid,
+            "params": simple,
+            "complex": complex_meta,
+            "format_version": 1,
+        }
+        extra = self._save_extra(path)
+        if extra:
+            meta["extra"] = extra
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        if arrays:
+            np.savez(os.path.join(path, "params.npz"), **arrays)
+
+    def _save_extra(self, path: str) -> Optional[Dict[str, Any]]:
+        """Hook for subclasses to persist non-param fitted state."""
+        return None
+
+    def _load_extra(self, path: str, extra: Dict[str, Any]) -> None:
+        pass
+
+    @staticmethod
+    def load(path: str) -> "PipelineStage":
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        module, _, clsname = meta["class"].rpartition(".")
+        cls = getattr(importlib.import_module(module), clsname)
+        stage = cls.__new__(cls)
+        Params.__init__(stage)
+        stage.uid = meta["uid"]
+        registry = cls.params()
+        for name, value in meta["params"].items():
+            if name in registry:
+                stage._paramMap[name] = _decode_json_value(value)
+        arrays = None
+        npz_path = os.path.join(path, "params.npz")
+        if os.path.exists(npz_path):
+            arrays = np.load(npz_path, allow_pickle=False)
+        for name, info in meta.get("complex", {}).items():
+            stage._paramMap[name] = _decode_complex(info, name, path, arrays)
+        stage._load_extra(path, meta.get("extra") or {})
+        return stage
+
+    write = save  # SparkML-surface aliases
+
+
+class Transformer(PipelineStage):
+    def transform(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+    def __call__(self, df: DataFrame) -> DataFrame:
+        return self.transform(df)
+
+
+class Estimator(PipelineStage):
+    def fit(self, df: DataFrame, params: Optional[Dict[str, Any]] = None
+            ) -> "Transformer":
+        if params:
+            return self.copy(params)._fit(df)
+        return self._fit(df)
+
+    def _fit(self, df: DataFrame) -> "Transformer":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer produced by an Estimator."""
+
+
+class Evaluator(Params):
+    """Reference analogue: org.apache.spark.ml.evaluation.Evaluator (used by AutoML)."""
+
+    def evaluate(self, df: DataFrame) -> float:
+        raise NotImplementedError
+
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class Pipeline(Estimator):
+    """Chain of stages; fitting fits estimators in order, threading transforms through.
+
+    Reference analogue: org.apache.spark.ml.Pipeline + NamespaceInjections.pipelineModel
+    (org/apache/spark/ml/NamespaceInjections.scala:15-21).
+    """
+
+    stages = Param("stages", "ordered pipeline stages", None, complex=True)
+
+    def __init__(self, stages: Optional[Sequence[PipelineStage]] = None, **kw):
+        super().__init__(**kw)
+        if stages is not None:
+            self._set(stages=list(stages))
+
+    def _fit(self, df: DataFrame) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        cur = df
+        for stage in self.get("stages") or []:
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                cur = model.transform(cur)
+            else:
+                fitted.append(stage)
+                cur = stage.transform(cur)
+        return PipelineModel(stages=fitted)
+
+
+class PipelineModel(Model):
+    stages = Param("stages", "fitted pipeline stages", None, complex=True)
+
+    def __init__(self, stages: Optional[Sequence[Transformer]] = None, **kw):
+        super().__init__(**kw)
+        if stages is not None:
+            self._set(stages=list(stages))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        cur = df
+        for stage in self.get("stages") or []:
+            cur = stage.transform(cur)
+        return cur
+
+
+# --------------------------------------------------------------------------
+# Complex-value codecs (reference: ComplexParam serialization, Serializer.scala)
+# --------------------------------------------------------------------------
+
+_JSON_TYPES = (bool, int, float, str, type(None))
+
+
+def _is_jsonable(v: Any) -> bool:
+    if isinstance(v, _JSON_TYPES):
+        return True
+    if isinstance(v, (list, tuple)):
+        return all(_is_jsonable(x) for x in v)
+    if isinstance(v, dict):
+        return all(isinstance(k, str) and _is_jsonable(x) for k, x in v.items())
+    return False
+
+
+def _encode_value(value: Any, name: str, path: str):
+    if isinstance(value, np.integer):
+        return "json", int(value)
+    if isinstance(value, np.floating):
+        return "json", float(value)
+    if _is_jsonable(value):
+        return "json", list(value) if isinstance(value, tuple) else value
+    if isinstance(value, np.ndarray) and value.dtype != object:
+        return "array", value
+    if isinstance(value, PipelineStage):
+        sub = os.path.join(path, f"param_{name}")
+        value.save(sub)
+        return "complex", {"kind": "stage", "dir": f"param_{name}"}
+    if isinstance(value, (list, tuple)) and value and all(
+            isinstance(s, PipelineStage) for s in value):
+        dirs = []
+        for i, s in enumerate(value):
+            d = f"param_{name}_{i}"
+            s.save(os.path.join(path, d))
+            dirs.append(d)
+        return "complex", {"kind": "stage_list", "dirs": dirs}
+    # fallback: pickle (python-side UDFs, custom objects) — analogue of UDFParam
+    fname = f"param_{name}.pkl"
+    with open(os.path.join(path, fname), "wb") as f:
+        pickle.dump(value, f)
+    return "complex", {"kind": "pickle", "file": fname}
+
+
+def _decode_json_value(v: Any) -> Any:
+    return v
+
+
+def _decode_complex(info: Dict[str, Any], name: str, path: str, arrays) -> Any:
+    kind = info["kind"]
+    if kind == "array":
+        return arrays[name]
+    if kind == "stage":
+        return PipelineStage.load(os.path.join(path, info["dir"]))
+    if kind == "stage_list":
+        return [PipelineStage.load(os.path.join(path, d)) for d in info["dirs"]]
+    if kind == "pickle":
+        with open(os.path.join(path, info["file"]), "rb") as f:
+            return pickle.load(f)
+    raise ValueError(f"unknown complex param kind {kind!r}")

@@ -1,0 +1,4 @@
+from .base import LightGBMModelBase, LightGBMParamsBase
+from .booster import Booster
+from .classifier import LightGBMClassificationModel, LightGBMClassifier
+from .regressor import LightGBMRegressionModel, LightGBMRegressor

@@ -1,0 +1,343 @@
+"""Serializable GBDT booster: fitted trees + binner + prediction programs.
+
+Reference analogue: `LightGBMBooster` (lightgbm/LightGBMBooster.scala:12-339) — the
+serializable model-string wrapper with score/predictLeaf/featureImportance entry points.
+Two deliberate departures, per the TPU-first design:
+- prediction is a batched jit program over all rows (the reference scores row-by-row
+  through JNI `LGBM_BoosterPredictForMatSingle`, LightGBMBooster.scala:258-275 — a pattern
+  SURVEY.md §3.1 flags as the thing to replace);
+- the model also exports to the LightGBM text format (`saveNativeModel`,
+  LightGBMBooster.scala:277-296) so parity against upstream tooling stays checkable.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.binning import BinMapper
+from ...ops.boosting import Tree, tree_apply_raw
+from ...ops.objectives import get_objective
+
+
+class Booster:
+    """Fitted gradient-boosting model.
+
+    trees: Tree namedtuple of numpy arrays stacked [T, ...] (single-output) or
+    [T, K, ...] (multiclass). thresholds: real-valued split thresholds of the same
+    leading shape as trees.split_bin.
+    """
+
+    def __init__(self, trees: Tree, thresholds: np.ndarray, init_score: np.ndarray,
+                 objective: str, num_class: int, num_features: int,
+                 bin_mapper: Optional[BinMapper] = None,
+                 feature_names: Optional[List[str]] = None,
+                 best_iteration: Optional[int] = None,
+                 learning_rate: float = 0.1,
+                 average_output: bool = False):
+        self.trees = Tree(*[np.asarray(a) for a in trees])
+        self.thresholds = np.asarray(thresholds)
+        self.init_score = np.asarray(init_score, dtype=np.float32)
+        self.objective = objective
+        self.num_class = num_class
+        self.num_features = num_features
+        self.bin_mapper = bin_mapper
+        self.feature_names = feature_names or [f"Column_{i}"
+                                               for i in range(num_features)]
+        self.best_iteration = best_iteration
+        self.learning_rate = learning_rate
+        # rf mode: prediction is the average of tree outputs, not the sum
+        # (LightGBM model-file `average_output` flag)
+        self.average_output = average_output
+
+    # ------------------------------------------------------------ properties
+    @property
+    def multiclass(self) -> bool:
+        return self.trees.split_slot.ndim == 3
+
+    @property
+    def num_iterations(self) -> int:
+        return self.trees.split_slot.shape[0]
+
+    def _used_iters(self) -> int:
+        return (self.best_iteration if self.best_iteration is not None
+                else self.num_iterations)
+
+    # ------------------------------------------------------------ prediction
+    def raw_predict(self, x: np.ndarray) -> np.ndarray:
+        """Margin scores: [N] (single-output) or [N, K]. Batched jit traversal."""
+        x = jnp.asarray(np.asarray(x, np.float32))
+        t_used = self._used_iters()
+        trees = Tree(*[jnp.asarray(a[:t_used]) for a in self.trees])
+        thr = jnp.asarray(self.thresholds[:t_used])
+        init = jnp.asarray(self.init_score)
+        raw = np.asarray(_raw_predict_jit(trees, thr, init, x,
+                                          self.multiclass))
+        if self.average_output and t_used > 0:
+            raw = np.asarray(self.init_score) + (
+                raw - np.asarray(self.init_score)) / t_used
+        return raw
+
+    def score(self, x: np.ndarray) -> np.ndarray:
+        """Prediction-space output (probability / mean), matching
+        LightGBMBooster.score semantics (LightGBMBooster.scala:195-228)."""
+        obj = get_objective(self.objective, self.num_class)
+        raw = self.raw_predict(x)
+        return np.asarray(obj.link(jnp.asarray(raw)))
+
+    def predict_leaf(self, x: np.ndarray) -> np.ndarray:
+        """Leaf index per tree: [N, T] or [N, T*K] (predictLeaf,
+        LightGBMBooster.scala:216-228)."""
+        x = jnp.asarray(np.asarray(x, np.float32))
+        t_used = self._used_iters()
+        trees = Tree(*[jnp.asarray(a[:t_used]) for a in self.trees])
+        thr = jnp.asarray(self.thresholds[:t_used])
+        leaves = _predict_leaf_jit(trees, thr, x, self.multiclass)
+        out = np.asarray(leaves)
+        if out.ndim == 3:  # [T,K,N] -> [N, T*K]
+            return out.transpose(2, 0, 1).reshape(x.shape[0], -1)
+        return out.T
+
+    # -------------------------------------------------------- introspection
+    def feature_importances(self, importance_type: str = "split") -> np.ndarray:
+        """Reference: LightGBMBooster.featureImportances (LightGBMBooster.scala:303-310),
+        `LGBM_BoosterFeatureImportance` split/gain modes."""
+        feats = self.trees.split_feat.reshape(-1)
+        valid = self.trees.split_valid.reshape(-1)
+        gains = self.trees.split_gain.reshape(-1)
+        out = np.zeros(self.num_features, np.float64)
+        if importance_type == "split":
+            np.add.at(out, feats[valid], 1.0)
+        elif importance_type == "gain":
+            np.add.at(out, feats[valid], gains[valid])
+        else:
+            raise ValueError("importance_type must be 'split' or 'gain'")
+        return out
+
+    # --------------------------------------------------------- serialization
+    def to_dict(self) -> dict:
+        return {
+            "objective": self.objective,
+            "num_class": self.num_class,
+            "num_features": self.num_features,
+            "feature_names": self.feature_names,
+            "best_iteration": self.best_iteration,
+            "learning_rate": self.learning_rate,
+            "init_score": self.init_score.tolist(),
+            "average_output": self.average_output,
+        }
+
+    def save_arrays(self) -> dict:
+        arrays = {f"tree_{f}": np.asarray(getattr(self.trees, f))
+                  for f in Tree._fields}
+        arrays["thresholds"] = self.thresholds
+        if self.bin_mapper is not None:
+            arrays["bin_edges"] = self.bin_mapper.edges
+        return arrays
+
+    @staticmethod
+    def from_parts(meta: dict, arrays: dict) -> "Booster":
+        trees = Tree(*[arrays[f"tree_{f}"] for f in Tree._fields])
+        bm = (BinMapper(arrays["bin_edges"]) if "bin_edges" in arrays else None)
+        return Booster(trees, arrays["thresholds"],
+                       np.asarray(meta["init_score"], np.float32),
+                       meta["objective"], meta["num_class"],
+                       meta["num_features"], bm, meta["feature_names"],
+                       meta["best_iteration"], meta["learning_rate"],
+                       meta.get("average_output", False))
+
+    # ------------------------------------------------- LightGBM text format
+    def save_native_model(self, path: str) -> None:
+        """Write LightGBM-compatible text model (saveNativeModel,
+        LightGBMBooster.scala:277-290)."""
+        with open(path, "w") as f:
+            f.write(self.model_string())
+
+    def model_string(self) -> str:
+        t_used = self._used_iters()
+        num_tree_per_it = self.num_class if self.multiclass else 1
+        obj_str = {"binary": "binary sigmoid:1",
+                   "multiclass": f"multiclass num_class:{self.num_class}",
+                   }.get(self.objective, self.objective)
+        out = io.StringIO()
+        out.write("tree\n")
+        out.write("version=v3\n")
+        out.write(f"num_class={self.num_class if self.multiclass else 1}\n")
+        out.write(f"num_tree_per_iteration={num_tree_per_it}\n")
+        out.write("label_index=0\n")
+        out.write(f"max_feature_idx={self.num_features - 1}\n")
+        out.write(f"objective={obj_str}\n")
+        out.write("feature_names=" + " ".join(self.feature_names) + "\n")
+        out.write("feature_infos=" + " ".join(
+            ["[-inf:inf]"] * self.num_features) + "\n")
+        out.write("\n")
+        tree_id = 0
+        for t in range(t_used):
+            for k in range(num_tree_per_it):
+                if self.multiclass:
+                    tree = Tree(*[np.asarray(a[t, k]) for a in self.trees])
+                    thr = self.thresholds[t, k]
+                else:
+                    tree = Tree(*[np.asarray(a[t]) for a in self.trees])
+                    thr = self.thresholds[t]
+                shift = (float(self.init_score if not self.multiclass
+                               else self.init_score[k])
+                         / max(t_used, 1))
+                out.write(_tree_to_text(tree, thr, tree_id, shift))
+                tree_id += 1
+        out.write("end of trees\n\n")
+        fi = self.feature_importances("split")
+        pairs = sorted([(self.feature_names[i], int(v))
+                        for i, v in enumerate(fi) if v > 0],
+                       key=lambda p: -p[1])
+        out.write("feature importances:\n")
+        for name, v in pairs:
+            out.write(f"{name}={v}\n")
+        out.write("\nparameters:\n[boosting: gbdt]\n"
+                  f"[objective: {self.objective}]\n"
+                  f"[learning_rate: {self.learning_rate}]\n"
+                  "end of parameters\n")
+        return out.getvalue()
+
+
+def concat_boosters(a: "Booster", b: "Booster") -> "Booster":
+    """Append b's trees after a's (continued/batch training,
+    LightGBMBase.scala:29-50 + LGBM_BoosterMerge in TrainUtils.scala:165-168).
+    b must have been trained with a's predictions as init margins; the merged
+    init score is a's."""
+    if a.multiclass != b.multiclass or a.num_features != b.num_features:
+        raise ValueError("cannot merge boosters with different shapes")
+    la = a.trees.leaf_value.shape[-1]
+    lb = b.trees.leaf_value.shape[-1]
+    lcap = max(la, lb)
+
+    def pad_arr(arr, n_extra):
+        widths = [(0, 0)] * (arr.ndim - 1) + [(0, n_extra)]
+        return np.pad(np.asarray(arr), widths)
+
+    def pad(tree: Tree, thr, l_from):
+        extra = lcap - l_from
+        if extra == 0:
+            return tree, thr
+        return Tree(
+            pad_arr(tree.split_slot, extra), pad_arr(tree.split_feat, extra),
+            pad_arr(tree.split_bin, extra), pad_arr(tree.split_valid, extra),
+            pad_arr(tree.split_gain, extra), pad_arr(tree.leaf_value, extra),
+        ), pad_arr(thr, extra)
+
+    ta, tha = pad(a.trees, a.thresholds, la)
+    tb, thb = pad(b.trees, b.thresholds, lb)
+    trees = Tree(*[np.concatenate([np.asarray(x), np.asarray(y)], axis=0)
+                   for x, y in zip(ta, tb)])
+    thr = np.concatenate([tha, thb], axis=0)
+    return Booster(trees, thr, a.init_score, a.objective, a.num_class,
+                   a.num_features, b.bin_mapper or a.bin_mapper,
+                   a.feature_names, None, b.learning_rate, a.average_output)
+
+
+def _slots_to_nodes(tree: Tree, thresholds: np.ndarray):
+    """Convert slot/replay representation to LightGBM node arrays.
+
+    Slot numbering deliberately matches LightGBM's leaf numbering (new right child
+    gets leaf index = current leaf count), so leaves map 1:1.
+    Returns (split_feature, threshold, left_child, right_child, leaf_value) with
+    LightGBM child conventions: >=0 internal node id, <0 means ~leaf_index.
+    """
+    valid = np.asarray(tree.split_valid)
+    n_splits = int(valid.sum())
+    if n_splits == 0:
+        return (np.zeros(0, int), np.zeros(0), np.zeros(0, int),
+                np.zeros(0, int), np.asarray([tree.leaf_value[0]]))
+    split_feature = np.zeros(n_splits, int)
+    threshold = np.zeros(n_splits)
+    left_child = np.zeros(n_splits, int)
+    right_child = np.zeros(n_splits, int)
+    # pointer[slot] = (node, side) edge currently leading to that leaf slot.
+    # When a slot is split at step s it becomes internal node s: the edge that led
+    # to it is rewired to node s, and the two child edges take over the pointers.
+    pointer = {0: None}
+    for s in range(n_splits):
+        slot = int(tree.split_slot[s])
+        split_feature[s] = int(tree.split_feat[s])
+        threshold[s] = float(thresholds[s])
+        p = pointer[slot]
+        if p is not None:
+            node, side = p
+            (left_child if side == 0 else right_child)[node] = s
+        pointer[slot] = (s, 0)
+        pointer[s + 1] = (s, 1)
+    # every surviving pointer entry is a leaf edge
+    for slot, p in pointer.items():
+        if p is None:
+            continue
+        node, side = p
+        (left_child if side == 0 else right_child)[node] = ~slot
+    leaf_value = np.asarray(tree.leaf_value[:n_splits + 1], np.float64)
+    return split_feature, threshold, left_child, right_child, leaf_value
+
+
+def _tree_to_text(tree: Tree, thresholds: np.ndarray, tree_id: int,
+                  value_shift: float) -> str:
+    sf, thr, lc, rc, lv = _slots_to_nodes(tree, thresholds)
+    n_leaves = len(lv)
+    out = io.StringIO()
+    out.write(f"Tree={tree_id}\n")
+    out.write(f"num_leaves={n_leaves}\n")
+    out.write("num_cat=0\n")
+    if len(sf):
+        out.write("split_feature=" + " ".join(map(str, sf)) + "\n")
+        out.write("split_gain=" + " ".join(
+            f"{g:g}" for g in np.asarray(tree.split_gain[:len(sf)])) + "\n")
+        out.write("threshold=" + " ".join(f"{t:.17g}" for t in thr) + "\n")
+        out.write("decision_type=" + " ".join(["2"] * len(sf)) + "\n")
+        out.write("left_child=" + " ".join(map(str, lc)) + "\n")
+        out.write("right_child=" + " ".join(map(str, rc)) + "\n")
+    out.write("leaf_value=" + " ".join(
+        f"{v + value_shift:.17g}" for v in lv) + "\n")
+    out.write("shrinkage=1\n\n")
+    return out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# jit prediction programs
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("multiclass",))
+def _raw_predict_jit(trees: Tree, thresholds, init, x, multiclass: bool):
+    def one_tree(tree, thr):
+        slot = tree_apply_raw(tree, x, thr)
+        return tree.leaf_value[slot]
+
+    if multiclass:
+        def per_iter(acc, tk):
+            tree, thr = tk
+            vals = jax.vmap(one_tree)(tree, thr)   # [K, N]
+            return acc + vals.T, None
+        k = trees.split_slot.shape[1]
+        acc0 = jnp.broadcast_to(init[None, :], (x.shape[0], k)).astype(jnp.float32)
+        out, _ = jax.lax.scan(per_iter, acc0, (trees, thresholds))
+        return out
+    else:
+        def per_iter(acc, tk):
+            tree, thr = tk
+            return acc + one_tree(tree, thr), None
+        acc0 = jnp.full((x.shape[0],), init, jnp.float32)
+        out, _ = jax.lax.scan(per_iter, acc0, (trees, thresholds))
+        return out
+
+
+@partial(jax.jit, static_argnames=("multiclass",))
+def _predict_leaf_jit(trees: Tree, thresholds, x, multiclass: bool):
+    def one_tree(tree, thr):
+        return tree_apply_raw(tree, x, thr)
+
+    if multiclass:
+        return jax.lax.map(lambda tk: jax.vmap(one_tree)(tk[0], tk[1]),
+                           (trees, thresholds))
+    return jax.lax.map(lambda tk: one_tree(tk[0], tk[1]), (trees, thresholds))

@@ -1,0 +1,129 @@
+"""Parser for the LightGBM text model format -> Booster.
+
+Reference analogue: `loadNativeModelFromFile/String`
+(lightgbm/LightGBMClassifier.scala:178-195, LightGBMBooster model-string constructor
+LightGBMBooster.scala:12-37). Enables interchange with upstream LightGBM: models trained
+here export via Booster.model_string() and models trained by LightGBM load here.
+
+Node trees are converted to the slot/replay representation used by the jit prediction
+programs (ops/boosting.py `Tree`): BFS over internal nodes guarantees parents are replayed
+before children, and each step's right child takes slot step+1.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List
+
+import numpy as np
+
+from ...ops.boosting import Tree
+from .booster import Booster
+
+
+def _parse_tree_block(lines: Dict[str, str]):
+    num_leaves = int(lines["num_leaves"])
+    if num_leaves == 1:
+        lv = np.array([float(v) for v in lines["leaf_value"].split()])
+        return num_leaves, (np.zeros(0, int), np.zeros(0), np.zeros(0, int),
+                            np.zeros(0, int), lv)
+    sf = np.array([int(v) for v in lines["split_feature"].split()])
+    thr = np.array([float(v) for v in lines["threshold"].split()])
+    lc = np.array([int(v) for v in lines["left_child"].split()])
+    rc = np.array([int(v) for v in lines["right_child"].split()])
+    lv = np.array([float(v) for v in lines["leaf_value"].split()])
+    return num_leaves, (sf, thr, lc, rc, lv)
+
+
+def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int):
+    """Convert LightGBM node arrays to padded slot/replay arrays."""
+    sf, thr, lc, rc, lv = arrays
+    n_splits = len(sf)
+    lcap = max_leaves
+    split_slot = np.zeros(lcap - 1, np.int32)
+    split_feat = np.zeros(lcap - 1, np.int32)
+    split_bin = np.zeros(lcap - 1, np.int32)
+    split_valid = np.zeros(lcap - 1, bool)
+    split_gain = np.zeros(lcap - 1, np.float32)
+    thresholds = np.zeros(lcap - 1, np.float64)
+    leaf_value = np.zeros(lcap, np.float32)
+
+    if n_splits == 0:
+        leaf_value[0] = lv[0]
+        return Tree(split_slot, split_feat, split_bin, split_valid, split_gain,
+                    leaf_value), thresholds
+
+    slot_of_node = {0: 0}
+    step = 0
+    queue = deque([0])
+    while queue:
+        node = queue.popleft()
+        slot = slot_of_node[node]
+        split_slot[step] = slot
+        split_feat[step] = sf[node]
+        thresholds[step] = thr[node]
+        split_valid[step] = True
+        new_slot = step + 1
+        left, right = lc[node], rc[node]
+        if left >= 0:
+            slot_of_node[left] = slot
+            queue.append(left)
+        else:
+            leaf_value[slot] = lv[~left]
+        if right >= 0:
+            slot_of_node[right] = new_slot
+            queue.append(right)
+        else:
+            leaf_value[new_slot] = lv[~right]
+        step += 1
+    return Tree(split_slot, split_feat, split_bin, split_valid, split_gain,
+                leaf_value), thresholds
+
+
+def parse_model_string(s: str) -> Booster:
+    header: Dict[str, str] = {}
+    tree_blocks: List[Dict[str, str]] = []
+    cur: Dict[str, str] = header
+    for line in s.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("Tree="):
+            cur = {}
+            tree_blocks.append(cur)
+            continue
+        if line.startswith("end of trees"):
+            cur = {}
+            continue
+        if "=" in line:
+            k, _, v = line.partition("=")
+            cur[k] = v
+
+    num_class = int(header.get("num_class", "1"))
+    ntpi = int(header.get("num_tree_per_iteration", "1"))
+    num_features = int(header.get("max_feature_idx", "0")) + 1
+    obj_raw = header.get("objective", "regression")
+    objective = obj_raw.split()[0]
+    feature_names = header.get("feature_names", "").split() or None
+
+    parsed = [_parse_tree_block(tb) for tb in tree_blocks]
+    max_leaves = max((p[0] for p in parsed), default=1)
+    max_leaves = max(max_leaves, 2)
+    slot_trees = [_nodes_to_slots(nl, arrs, max_leaves) for nl, arrs in parsed]
+
+    trees = Tree(*[np.stack([np.asarray(getattr(t, f)) for t, _ in slot_trees])
+                   for f in Tree._fields])
+    thresholds = np.stack([thr for _, thr in slot_trees])
+
+    multiclass = ntpi > 1
+    if multiclass:
+        t = len(slot_trees) // ntpi
+        trees = Tree(*[a.reshape(t, ntpi, *a.shape[1:]) for a in trees])
+        thresholds = thresholds.reshape(t, ntpi, -1)
+        init = np.zeros(ntpi, np.float32)
+    else:
+        init = np.float32(0.0)
+
+    return Booster(trees, thresholds, init, objective,
+                   num_class if multiclass else 1, num_features,
+                   bin_mapper=None, feature_names=feature_names)

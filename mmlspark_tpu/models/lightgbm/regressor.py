@@ -1,0 +1,56 @@
+"""LightGBMRegressor / LightGBMRegressionModel.
+
+Reference: lightgbm/LightGBMRegressor.scala:29-139 — objectives incl. quantile
+(`alpha`) and tweedie (`tweedieVariancePower`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core import params as _p
+from ...core.dataframe import DataFrame
+from .base import LightGBMModelBase, LightGBMParamsBase
+
+
+class LightGBMRegressor(LightGBMParamsBase):
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        if not self.is_set("objective"):
+            self.set("objective", "regression")
+
+    def _objective_name(self) -> str:
+        return self.get("objective")
+
+    def _fit(self, df: DataFrame) -> "LightGBMRegressionModel":
+        x, y, w, is_valid, init_score = self._extract_xyw(df)
+        booster = self._train_booster(x, np.asarray(y, np.float64), w,
+                                      is_valid, 1, init_score=init_score)
+        model = LightGBMRegressionModel(booster=booster)
+        for p in ("featuresCol", "predictionCol"):
+            model.set(p, self.get(p))
+        return model
+
+
+class LightGBMRegressionModel(LightGBMModelBase):
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        x = np.asarray(df[self.get("featuresCol")], np.float32)
+        pred = self.booster.score(x)
+        return df.with_column(self.get("predictionCol"),
+                              np.asarray(pred, np.float64))
+
+    @staticmethod
+    def load_native_model_from_file(path: str) -> "LightGBMRegressionModel":
+        from .native_format import parse_model_string
+        with open(path) as f:
+            return LightGBMRegressionModel(booster=parse_model_string(f.read()))
+
+    @staticmethod
+    def load_native_model_from_string(s: str) -> "LightGBMRegressionModel":
+        from .native_format import parse_model_string
+        return LightGBMRegressionModel(booster=parse_model_string(s))
+
+    loadNativeModelFromFile = load_native_model_from_file
+    loadNativeModelFromString = load_native_model_from_string

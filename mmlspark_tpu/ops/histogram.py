@@ -1,0 +1,107 @@
+"""Gradient/hessian histogram construction — the GBDT hot kernel.
+
+Reference analogue: the histogram build inside `LGBM_BoosterUpdateOneIter`
+(lightgbm/TrainUtils.scala:220-315 drives it; the C++ core builds per-leaf per-feature
+histograms and allreduces them over its socket ring in `data_parallel` mode,
+lightgbm/LightGBMParams.scala:13-18).
+
+TPU-first design: scatter-add is hostile to the VPU, so the histogram is computed as a
+chunked one-hot contraction that lands on the MXU:
+
+    hist[f, b, c] = sum_n onehot(bin[n, f] == b) * gh[n, c]
+
+with rows chunked by `lax.scan` so the one-hot block stays VMEM-sized. `gh` packs
+(grad, hess, count-mask) as 3 channels so one contraction produces all three histograms.
+A Pallas kernel variant (mmlspark_tpu.ops.pallas_kernels) implements the same contraction
+with explicit VMEM accumulation; `scatter` mode (jnp .at[].add) is kept as a cross-check
+oracle for tests.
+
+Distribution: callers wrap this in shard_map and `psum` the result over the data axis —
+the ICI replacement for LightGBM's `LGBM_NetworkInit` TCP ring (TrainUtils.scala:496-512).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_rows(binned, gh, chunk):
+    n = binned.shape[0]
+    pad = (-n) % chunk
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        gh = jnp.pad(gh, ((0, pad), (0, 0)))
+    return binned, gh
+
+
+def hist_onehot(binned: jax.Array, gh: jax.Array, num_bins: int,
+                chunk: int = 512, dtype: str = "f32") -> jax.Array:
+    """One-hot/MXU histogram. binned [N,F] int, gh [N,C] float -> [F, B, C] float32.
+
+    dtype: 'f32' runs the contraction at Precision.HIGHEST (exact but 3-6 MXU
+    passes); 'bf16' casts operands to bfloat16 with f32 accumulation — the one-hot
+    side is exact in bf16 (0/1), gradients round to ~3 decimal digits, which is
+    statistically immaterial for million-row histogram sums and ~3-6x faster.
+    """
+    f = binned.shape[1]
+    c = gh.shape[1]
+    binned, gh = _pad_rows(binned, gh, chunk)
+    n_chunks = binned.shape[0] // chunk
+    bins_c = binned.reshape(n_chunks, chunk, f)
+    gh_c = gh.reshape(n_chunks, chunk, c)
+
+    bin_iota = jnp.arange(num_bins, dtype=jnp.int32)
+    op_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
+    precision = (None if dtype == "bf16" else jax.lax.Precision.HIGHEST)
+
+    def body(acc, xs):
+        bins_t, gh_t = xs
+        onehot = (bins_t[:, :, None] == bin_iota[None, None, :])
+        onehot = onehot.astype(op_dtype).reshape(chunk, f * num_bins)
+        acc = acc + jnp.dot(onehot.T, gh_t.astype(op_dtype),
+                            preferred_element_type=jnp.float32,
+                            precision=precision)
+        return acc, None
+
+    acc0 = jnp.zeros((f * num_bins, c), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (bins_c, gh_c))
+    return acc.reshape(f, num_bins, c)
+
+
+def hist_scatter(binned: jax.Array, gh: jax.Array, num_bins: int) -> jax.Array:
+    """Scatter-add histogram (XLA scatter); test oracle + small-data path."""
+    n, f = binned.shape
+    c = gh.shape[1]
+    feat_iota = jnp.arange(f, dtype=jnp.int32)
+    flat_idx = (feat_iota[None, :] * num_bins + binned.astype(jnp.int32)).reshape(-1)
+    contrib = jnp.broadcast_to(gh[:, None, :].astype(jnp.float32),
+                               (n, f, c)).reshape(-1, c)
+    out = jnp.zeros((f * num_bins, c), jnp.float32).at[flat_idx].add(contrib)
+    return out.reshape(f, num_bins, c)
+
+
+def resolve_hist_method(method: str) -> str:
+    """'auto' picks per backend: the one-hot contraction exists for the MXU; on CPU
+    (tests, virtual meshes) XLA's native scatter-add is far cheaper."""
+    if method == "auto":
+        return "onehot" if jax.default_backend() not in ("cpu",) else "scatter"
+    return method
+
+
+def build_histogram(binned: jax.Array, gh: jax.Array, num_bins: int,
+                    method: str = "auto", chunk: int = 512,
+                    dtype: str = "bf16") -> jax.Array:
+    """Dispatch histogram build. gh channels: [grad, hess, mask]."""
+    method = resolve_hist_method(method)
+    if method == "onehot":
+        return hist_onehot(binned, gh, num_bins, chunk, dtype)
+    if method == "scatter":
+        return hist_scatter(binned, gh, num_bins)
+    if method == "pallas":
+        from .pallas_kernels import hist_pallas
+        return hist_pallas(binned, gh, num_bins)
+    raise ValueError(f"unknown histogram method {method!r}")

@@ -1,0 +1,120 @@
+"""Device-mesh topology discovery and construction.
+
+Replaces the reference's driver-coordinated cluster topology machinery wholesale:
+- ClusterUtil executor/task-count discovery (core/utils/ClusterUtil.scala:13-177)
+- LightGBM socket rendezvous + NetworkInit ring (lightgbm/LightGBMUtils.scala:108-185,
+  TrainUtils.scala:410-512)
+- VW spanning-tree allreduce bootstrap (vw/VowpalWabbitBase.scala:401-429)
+
+In the TPU-native design there are no sockets and no rendezvous protocol: multi-host SPMD
+launch is inherently gang-scheduled (the analogue of Spark barrier mode,
+lightgbm/LightGBMBase.scala:224-231), `jax.distributed.initialize` + the JAX coordination
+service replace the driver ServerSocket, and collectives ride ICI intra-slice / DCN across
+slices via named mesh axes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"    # row/batch sharding (the universal strategy — SURVEY.md §2.2)
+MODEL_AXIS = "model"  # tensor/feature sharding for deep models
+
+
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap. Replaces driver rendezvous (LightGBMUtils.scala:116-185):
+    the JAX coordination service plays the driver's ServerSocket role, with retries and
+    timeouts handled inside the runtime instead of hand-rolled socket loops."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def get_mesh(n_devices: Optional[int] = None,
+             axis_names: Sequence[str] = (DATA_AXIS,),
+             shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Construct a mesh over available devices.
+
+    Default is a 1-D data mesh (the reference's only strategy is data parallelism over
+    partitions — SURVEY.md §2.2). Pass a 2-D ``shape`` + two axis names for data x model
+    sharding of deep models.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if shape is None:
+        shape = (n,) if len(axis_names) == 1 else _factor(n, len(axis_names))
+    arr = np.array(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def _factor(n: int, ndims: int) -> Tuple[int, ...]:
+    """Split n devices into ndims mesh dims, biggest dim first."""
+    dims = [n] + [1] * (ndims - 1)
+    for i in range(1, ndims):
+        for f in (2, 3, 5, 7):
+            while dims[0] % f == 0 and dims[i] * f <= dims[0] // f:
+                dims[0] //= f
+                dims[i] *= f
+    return tuple(dims)
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Rows sharded over the data axis, everything else replicated."""
+    spec = [None] * ndim
+    spec[0] = DATA_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0,
+                    fill=0) -> Tuple[np.ndarray, int]:
+    """Pad along axis to a multiple; returns (padded, original_length).
+
+    Padding/masking is the TPU-native answer to the reference's empty/skewed-partition
+    defenses (empty-partition "ignore" protocol, TrainUtils.scala:463-471): shards are
+    always equal-sized, padded rows carry zero weight.
+    """
+    n = arr.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad_widths = [(0, 0)] * arr.ndim
+    pad_widths[axis] = (0, rem)
+    return np.pad(arr, pad_widths, constant_values=fill), n
+
+
+def shard_rows(mesh: Mesh, *arrays: np.ndarray):
+    """Pad row dimension to the mesh data-axis size and device_put with row sharding.
+
+    Returns (sharded_arrays..., valid_mask) where valid_mask is 1.0 for real rows and
+    0.0 for padding — the masking discipline replacing StratifiedRepartition-style
+    partition invariants (SURVEY.md §7 hard parts).
+    """
+    ndev = mesh.shape[DATA_AXIS]
+    n = arrays[0].shape[0]
+    out = []
+    for a in arrays:
+        padded, _ = pad_to_multiple(np.asarray(a), ndev, axis=0)
+        out.append(jax.device_put(padded, data_sharding(mesh, padded.ndim)))
+    mask_host, _ = pad_to_multiple(np.ones(n, np.float32), ndev, axis=0)
+    mask = jax.device_put(mask_host, data_sharding(mesh, 1))
+    return (*out, mask)

@@ -1,0 +1,245 @@
+"""GBDT tests: histogram kernel correctness, tree building, classifier/regressor
+accuracy, distributed == serial parity, early stopping, native-format roundtrip.
+
+Mirrors the reference test strategy (SURVEY.md §4): accuracy gates with tolerances
+(benchmarks_VerifyLightGBMClassifier.csv analogues) + distributed-mode suites
+(VerifyLightGBMClassifier barrier/parallelism tests) on a virtual multi-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import (LightGBMClassificationModel,
+                                          LightGBMClassifier,
+                                          LightGBMRegressionModel,
+                                          LightGBMRegressor)
+from mmlspark_tpu.ops.binning import BinMapper, apply_bins, compute_bin_edges
+from mmlspark_tpu.ops.histogram import hist_onehot, hist_scatter
+
+from conftest import auc
+
+
+class TestBinning:
+    def test_edges_monotone(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(5000, 4))
+        edges = compute_bin_edges(x, max_bins=16)
+        finite = edges[np.isfinite(edges)]
+        assert finite.size > 0
+        for row in edges:
+            fr = row[np.isfinite(row)]
+            assert (np.diff(fr) >= 0).all()
+
+    def test_bins_in_range_and_balanced(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(5000, 3))
+        bm = BinMapper.fit(x, max_bins=32)
+        b = bm.transform(x)
+        assert b.min() >= 0 and b.max() < 32
+        counts = np.bincount(b[:, 0], minlength=32)
+        # quantile bins ≈ equal mass
+        assert counts[counts > 0].min() > 5000 / 32 * 0.5
+
+    def test_few_distinct_values_exact(self):
+        x = np.repeat(np.array([[0.0], [1.0], [5.0]]), 100, axis=0)
+        bm = BinMapper.fit(x, max_bins=8)
+        b = bm.transform(x)
+        assert len(np.unique(b)) == 3
+
+    def test_nan_goes_to_bin0(self):
+        x = np.array([[np.nan], [1.0], [2.0], [3.0]])
+        bm = BinMapper.fit(x, max_bins=4)
+        assert bm.transform(x)[0, 0] == 0
+
+
+class TestHistogram:
+    def test_onehot_matches_scatter(self):
+        rng = np.random.default_rng(1)
+        n, f, b = 1000, 5, 16
+        binned = jnp.asarray(rng.integers(0, b, size=(n, f)))
+        gh = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+        h1 = hist_onehot(binned, gh, b, chunk=128)
+        h2 = hist_scatter(binned, gh, b)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(2)
+        n, f, b = 500, 3, 8
+        binned = rng.integers(0, b, size=(n, f))
+        g = rng.normal(size=n).astype(np.float32)
+        gh = np.stack([g, np.abs(g), np.ones(n, np.float32)], axis=1)
+        h = np.asarray(hist_onehot(jnp.asarray(binned), jnp.asarray(gh), b))
+        for j in range(f):
+            for bb in range(b):
+                mask = binned[:, j] == bb
+                np.testing.assert_allclose(h[j, bb, 0], g[mask].sum(),
+                                           rtol=1e-3, atol=1e-3)
+                np.testing.assert_allclose(h[j, bb, 2], mask.sum(),
+                                           rtol=1e-5)
+
+
+class TestClassifier:
+    def test_binary_auc(self, binary_df):
+        model = LightGBMClassifier(numIterations=50, numLeaves=15,
+                                   numTasks=1).fit(binary_df)
+        out = model.transform(binary_df)
+        score = np.stack(out["probability"])[:, 1]
+        a = auc(binary_df["label"], score)
+        assert a > 0.95, f"train AUC {a}"
+        assert set(np.unique(out["prediction"])) <= {0.0, 1.0}
+        raw = np.stack(out["rawPrediction"])
+        assert raw.shape[1] == 2
+
+    def test_generalization(self, binary_df):
+        train, test = binary_df.random_split([0.8, 0.2], seed=3)
+        model = LightGBMClassifier(numIterations=60, numTasks=1).fit(train)
+        out = model.transform(test)
+        a = auc(test["label"], np.stack(out["probability"])[:, 1])
+        assert a > 0.85, f"test AUC {a}"
+
+    def test_distributed_matches_serial(self, binary_df):
+        serial = LightGBMClassifier(numIterations=10, numLeaves=7, numTasks=1,
+                                    seed=5).fit(binary_df)
+        dist = LightGBMClassifier(numIterations=10, numLeaves=7, numTasks=8,
+                                  seed=5).fit(binary_df)
+        x = np.asarray(binary_df["features"])
+        np.testing.assert_allclose(serial.booster.raw_predict(x),
+                                   dist.booster.raw_predict(x),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_multiclass(self, multiclass_df):
+        model = LightGBMClassifier(numIterations=30, numLeaves=15,
+                                   numTasks=1).fit(multiclass_df)
+        out = model.transform(multiclass_df)
+        acc = (out["prediction"] == multiclass_df["label"]).mean()
+        assert acc > 0.9, f"multiclass train acc {acc}"
+        probs = np.stack(out["probability"])
+        assert probs.shape[1] == 3
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+    def test_weights(self, binary_df):
+        w = np.where(binary_df["label"] > 0, 10.0, 1.0).astype(np.float32)
+        df = binary_df.with_column("w", w)
+        model = LightGBMClassifier(numIterations=10, weightCol="w",
+                                   numTasks=1).fit(df)
+        out = model.transform(df)
+        # heavily weighting positives shifts predictions positive
+        assert out["prediction"].mean() >= binary_df["label"].mean() - 0.05
+
+    def test_early_stopping(self, binary_df):
+        n = len(binary_df)
+        rng = np.random.default_rng(9)
+        is_val = rng.random(n) < 0.25
+        df = binary_df.with_column("val", is_val)
+        model = LightGBMClassifier(numIterations=40, numLeaves=31,
+                                   validationIndicatorCol="val",
+                                   earlyStoppingRound=5, numTasks=1).fit(df)
+        assert model.booster.best_iteration is not None
+        assert 1 <= model.booster.best_iteration <= 40
+
+    def test_feature_importances(self, binary_df):
+        model = LightGBMClassifier(numIterations=10, numTasks=1).fit(binary_df)
+        fi = model.get_feature_importances("split")
+        assert fi.shape == (10,) and fi.sum() > 0
+        gains = model.get_feature_importances("gain")
+        assert (gains >= 0).all() and gains.sum() > 0
+
+    def test_predict_leaf(self, binary_df):
+        model = LightGBMClassifier(numIterations=5, numLeaves=7,
+                                   numTasks=1).fit(binary_df)
+        leaves = model.predict_leaf(np.asarray(binary_df["features"])[:20])
+        assert leaves.shape == (20, 5)
+        assert (leaves >= 0).all() and (leaves < 7).all()
+
+
+class TestRegressor:
+    def test_l2(self, regression_df):
+        model = LightGBMRegressor(numIterations=80, numTasks=1).fit(regression_df)
+        out = model.transform(regression_df)
+        mse = np.mean((out["prediction"] - regression_df["label"]) ** 2)
+        var = np.var(regression_df["label"])
+        assert mse < 0.2 * var, f"mse {mse} vs var {var}"
+
+    def test_quantile(self, regression_df):
+        model = LightGBMRegressor(objective="quantile", alpha=0.9,
+                                  numIterations=60, numTasks=1).fit(regression_df)
+        out = model.transform(regression_df)
+        frac_below = (regression_df["label"] <= out["prediction"]).mean()
+        assert 0.75 < frac_below <= 1.0, f"quantile coverage {frac_below}"
+
+    def test_tweedie(self):
+        rng = np.random.default_rng(21)
+        n = 1500
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        mu = np.exp(0.5 * x[:, 0] - 0.3 * x[:, 1])
+        y = rng.poisson(mu).astype(np.float64)
+        df = DataFrame({"features": x, "label": y})
+        model = LightGBMRegressor(objective="tweedie", numIterations=50,
+                                  numTasks=1).fit(df)
+        pred = model.transform(df)["prediction"]
+        assert (pred >= 0).all()
+        assert np.corrcoef(pred, mu)[0, 1] > 0.7
+
+    def test_distributed_matches_serial(self, regression_df):
+        serial = LightGBMRegressor(numIterations=8, numTasks=1,
+                                   seed=5).fit(regression_df)
+        dist = LightGBMRegressor(numIterations=8, numTasks=8,
+                                 seed=5).fit(regression_df)
+        x = np.asarray(regression_df["features"])
+        np.testing.assert_allclose(serial.booster.raw_predict(x),
+                                   dist.booster.raw_predict(x),
+                                   rtol=1e-3, atol=1e-3)
+
+
+class TestModelPersistence:
+    def test_save_load(self, binary_df, tmp_path):
+        from mmlspark_tpu import PipelineStage
+        model = LightGBMClassifier(numIterations=10, numTasks=1).fit(binary_df)
+        path = str(tmp_path / "lgbm")
+        model.save(path)
+        loaded = PipelineStage.load(path)
+        x = np.asarray(binary_df["features"])
+        np.testing.assert_allclose(loaded.booster.raw_predict(x),
+                                   model.booster.raw_predict(x), rtol=1e-6)
+
+    def test_native_format_roundtrip(self, binary_df, tmp_path):
+        model = LightGBMClassifier(numIterations=10, numLeaves=15,
+                                   numTasks=1).fit(binary_df)
+        path = str(tmp_path / "model.txt")
+        model.save_native_model(path)
+        loaded = LightGBMClassificationModel.load_native_model_from_file(path)
+        x = np.asarray(binary_df["features"])
+        orig = model.booster.raw_predict(x)
+        back = loaded.booster.raw_predict(x)
+        np.testing.assert_allclose(orig, back, rtol=1e-4, atol=1e-4)
+
+    def test_native_format_multiclass(self, multiclass_df, tmp_path):
+        model = LightGBMClassifier(numIterations=6, numLeaves=7,
+                                   numTasks=1).fit(multiclass_df)
+        path = str(tmp_path / "mc.txt")
+        model.save_native_model(path)
+        loaded = LightGBMClassificationModel.load_native_model_from_file(path)
+        x = np.asarray(multiclass_df["features"])
+        np.testing.assert_allclose(model.booster.raw_predict(x),
+                                   loaded.booster.raw_predict(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bagging_and_feature_fraction(self, binary_df):
+        model = LightGBMClassifier(numIterations=20, baggingFraction=0.7,
+                                   baggingFreq=1, featureFraction=0.6,
+                                   numTasks=1, seed=3).fit(binary_df)
+        out = model.transform(binary_df)
+        a = auc(binary_df["label"], np.stack(out["probability"])[:, 1])
+        assert a > 0.9
+
+    def test_goss(self, binary_df):
+        model = LightGBMClassifier(numIterations=20, boostingType="goss",
+                                   numTasks=1).fit(binary_df)
+        out = model.transform(binary_df)
+        a = auc(binary_df["label"], np.stack(out["probability"])[:, 1])
+        assert a > 0.9

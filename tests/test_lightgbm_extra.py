@@ -1,0 +1,118 @@
+"""Tests for the wider LightGBM param surface: maxDepth, rf/dart modes, warm start
+(modelString), batch training (numBatches), initScoreCol, pallas histogram kernel.
+
+Reference behaviors: batch/continued training LightGBMBase.scala:28-50; init scores
+TrainUtils.scala:57-129; boosting types LightGBMParams.scala.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier, LightGBMRegressor
+from conftest import auc
+
+
+def test_max_depth_limits_tree(binary_df):
+    deep = LightGBMClassifier(numIterations=5, numLeaves=31, numTasks=1,
+                              seed=1).fit(binary_df)
+    shallow = LightGBMClassifier(numIterations=5, numLeaves=31, maxDepth=2,
+                                 numTasks=1, seed=1).fit(binary_df)
+    # depth-2 trees can have at most 4 leaves = 3 splits
+    n_splits_shallow = int(shallow.booster.trees.split_valid.sum(axis=1).max())
+    n_splits_deep = int(deep.booster.trees.split_valid.sum(axis=1).max())
+    assert n_splits_shallow <= 3
+    assert n_splits_deep > n_splits_shallow
+
+
+def test_rf_mode(binary_df):
+    model = LightGBMClassifier(boostingType="rf", numIterations=20,
+                               baggingFraction=0.6, baggingFreq=1,
+                               numTasks=1).fit(binary_df)
+    assert model.booster.average_output
+    out = model.transform(binary_df)
+    a = auc(binary_df["label"], np.stack(out["probability"])[:, 1])
+    assert a > 0.85, f"rf AUC {a}"
+    # averaged probabilities must not collapse to extremes
+    probs = np.stack(out["probability"])[:, 1]
+    assert 0.0 < probs.min() and probs.max() < 1.0
+
+
+def test_rf_requires_bagging(binary_df):
+    import pytest
+    with pytest.raises(ValueError, match="rf"):
+        LightGBMClassifier(boostingType="rf", numTasks=1).fit(binary_df)
+
+
+def test_dart_mode(binary_df):
+    model = LightGBMClassifier(boostingType="dart", numIterations=15,
+                               numTasks=1, seed=4).fit(binary_df)
+    out = model.transform(binary_df)
+    a = auc(binary_df["label"], np.stack(out["probability"])[:, 1])
+    assert a > 0.9, f"dart AUC {a}"
+
+
+def test_warm_start_model_string(binary_df):
+    base = LightGBMClassifier(numIterations=10, numTasks=1, seed=2)
+    m1 = base.fit(binary_df)
+    s = m1.booster.model_string()
+    cont = LightGBMClassifier(numIterations=10, numTasks=1, seed=2,
+                              modelString=s).fit(binary_df)
+    assert cont.booster.num_iterations == 20
+    x = np.asarray(binary_df["features"])
+    a1 = auc(binary_df["label"], m1.booster.raw_predict(x))
+    a2 = auc(binary_df["label"], cont.booster.raw_predict(x))
+    assert a2 >= a1 - 1e-6
+
+
+def test_num_batches(binary_df):
+    model = LightGBMClassifier(numIterations=8, numBatches=3,
+                               numTasks=1).fit(binary_df)
+    # 3 sequential batches x 8 iterations each
+    assert model.booster.num_iterations == 24
+    out = model.transform(binary_df)
+    a = auc(binary_df["label"], np.stack(out["probability"])[:, 1])
+    assert a > 0.85
+
+
+def test_init_score_col(regression_df):
+    # regressing residuals of a provided init margin should reach a similar
+    # fit to training from scratch
+    init = np.full(len(regression_df), 5.0, np.float32)
+    df = regression_df.with_column("init", init)
+    shifted = regression_df.with_column(
+        "label", regression_df["label"] + 5.0).with_column("init", init)
+    m = LightGBMRegressor(numIterations=30, initScoreCol="init",
+                          numTasks=1).fit(shifted)
+    pred = m.booster.raw_predict(np.asarray(shifted["features"]))
+    # raw_predict excludes the external margin; adding it back should match labels
+    mse = np.mean((pred + 5.0 - shifted["label"]) ** 2)
+    assert mse < 0.3 * np.var(regression_df["label"])
+
+
+def test_estimator_params_not_mutated_by_fit(binary_df, multiclass_df):
+    est = LightGBMClassifier(numIterations=3, numTasks=1)
+    est.fit(binary_df)
+    assert not est.is_set("objective") or est.get("objective") == "binary"
+    before = dict(est._paramMap)
+    est.fit(multiclass_df)
+    assert est._paramMap == before
+
+
+def test_pallas_hist_method(binary_df):
+    model = LightGBMClassifier(numIterations=5, numLeaves=7,
+                               histMethod="pallas", numTasks=1,
+                               seed=7).fit(binary_df)
+    ref = LightGBMClassifier(numIterations=5, numLeaves=7,
+                             histMethod="scatter", numTasks=1,
+                             seed=7).fit(binary_df)
+    x = np.asarray(binary_df["features"])
+    np.testing.assert_allclose(model.booster.raw_predict(x),
+                               ref.booster.raw_predict(x),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_random_split_no_row_loss():
+    df = DataFrame({"a": np.arange(2000, dtype=np.float64)})
+    parts = df.random_split([0.1] * 10, seed=0)
+    assert sum(len(p) for p in parts) == 2000
