@@ -1,4 +1,5 @@
 from .base import LightGBMModelBase, LightGBMParamsBase
 from .booster import Booster
 from .classifier import LightGBMClassificationModel, LightGBMClassifier
+from .ranker import LightGBMRanker, LightGBMRankerModel
 from .regressor import LightGBMRegressionModel, LightGBMRegressor

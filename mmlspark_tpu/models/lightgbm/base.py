@@ -89,9 +89,29 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                                    "indexes of categorical features", None)
     categoricalSlotNames = Param("categoricalSlotNames",
                                  "names of categorical features", None)
+    catSmooth = Param("catSmooth",
+                      "categorical split smoothing (LightGBM cat_smooth)", 10.0,
+                      float)
+    maxCatThreshold = Param("maxCatThreshold",
+                            "max categories on one split side", 32, int)
     alpha = Param("alpha", "quantile/huber alpha", 0.9, float)
     tweedieVariancePower = Param("tweedieVariancePower",
                                  "tweedie variance power in (1,2)", 1.5, float)
+    # prediction-output params (LightGBMPredictionParams trait in
+    # LightGBMParams.scala) — propagated onto the fitted model
+    leafPredictionCol = Param(
+        "leafPredictionCol",
+        "output column for per-tree leaf indices (empty = off)", "")
+    featuresShapCol = Param(
+        "featuresShapCol",
+        "output column for SHAP contributions (empty = off)", "")
+
+    def _propagate_model_params(self, model):
+        for p in ("featuresCol", "predictionCol", "leafPredictionCol",
+                  "featuresShapCol"):
+            if p in model.params():
+                model.set(p, self.get(p))
+        return model
 
     # ------------------------------------------------------------------ fit
     def _objective_name(self) -> str:
@@ -151,13 +171,27 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             bagging_seed=self.get("baggingSeed"),
             hist_method=self.get("histMethod"),
             hist_chunk=self.get("histChunk"),
+            categorical_features=tuple(self._categorical_indexes()),
+            cat_smooth=self.get("catSmooth"),
+            max_cat_threshold=self.get("maxCatThreshold"),
             axis_name=axis_name,
         )
+
+    def _categorical_indexes(self):
+        """Resolve categorical feature indexes from index/name params
+        (LightGBMUtils.getCategoricalIndexes, LightGBMUtils.scala:74-106)."""
+        idx = list(self.get("categoricalSlotIndexes") or [])
+        names = self.get("categoricalSlotNames")
+        slots = self.get("slotNames")
+        if names and slots:
+            idx += [i for i, s in enumerate(slots) if s in set(names)]
+        return sorted(set(int(i) for i in idx))
 
     def _train_booster(self, x: np.ndarray, y: np.ndarray, w: np.ndarray,
                        is_valid: np.ndarray, num_class: int,
                        objective: Optional[str] = None,
-                       init_score: Optional[np.ndarray] = None) -> Booster:
+                       init_score: Optional[np.ndarray] = None,
+                       groups: Optional[np.ndarray] = None) -> Booster:
         """Full training entry: handles warm start (modelString) and batch
         training (numBatches, LightGBMBase.scala:28-50) by folding previous
         boosters' margins into the next run's init scores, then merging trees."""
@@ -178,20 +212,23 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     x[part], y[part], w[part], is_valid[part], num_class,
                     objective,
                     init_score[part] if init_score is not None else None,
-                    booster)
+                    booster,
+                    groups[part] if groups is not None else None)
             return booster
         return self._train_booster_once(x, y, w, is_valid, num_class,
-                                        objective, init_score, prev)
+                                        objective, init_score, prev, groups)
 
     def _train_booster_once(self, x: np.ndarray, y: np.ndarray, w: np.ndarray,
                             is_valid: np.ndarray, num_class: int,
                             objective: str,
                             init_score: Optional[np.ndarray],
-                            prev: Optional[Booster]) -> Booster:
+                            prev: Optional[Booster],
+                            groups: Optional[np.ndarray] = None) -> Booster:
         n, f = x.shape
         k = num_class if num_class > 1 else 1
         bm = BinMapper.fit(x, self.get("maxBin"), self.get("binSampleCount"),
-                           self.get("seed"))
+                           self.get("seed"),
+                           categorical=tuple(self._categorical_indexes()))
         binned = bm.transform(x)
 
         # assemble per-row init margins: user initScoreCol + previous booster
@@ -213,9 +250,46 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         if serial:
             cfg = self._make_config(num_class, None, objective, has_init)
             train = jax.jit(make_train_fn(cfg))
-            result = train(jnp.asarray(binned), jnp.asarray(y),
-                           jnp.asarray(w), jnp.asarray(is_train),
-                           jnp.asarray(margin), key)
+            if groups is not None:
+                from ...ops.ranking import make_group_layout
+                layout = make_group_layout(groups)
+                result = train(jnp.asarray(binned), jnp.asarray(y),
+                               jnp.asarray(w), jnp.asarray(is_train),
+                               jnp.asarray(margin), key,
+                               jnp.asarray(layout.group_idx))
+            else:
+                result = train(jnp.asarray(binned), jnp.asarray(y),
+                               jnp.asarray(w), jnp.asarray(is_train),
+                               jnp.asarray(margin), key)
+        elif groups is not None:
+            # group-aligned sharding: whole query groups per device
+            # (repartitionByGroupingColumn equivalent, LightGBMRanker.scala:77+)
+            from ...ops.ranking import make_sharded_group_layout
+            cfg = self._make_config(num_class, meshlib.DATA_AXIS, objective,
+                                    has_init)
+            m = meshlib.get_mesh(ndev)
+            nd = m.shape[meshlib.DATA_AXIS]
+            lay = make_sharded_group_layout(groups, nd)
+
+            def take_pad(arr, fill=0.0):
+                out = np.zeros((lay.order.shape[0],) + arr.shape[1:], arr.dtype)
+                ok = lay.order >= 0
+                out[ok] = arr[lay.order[ok]]
+                return out
+
+            train = make_train_fn(cfg)
+            sharded = jax.shard_map(
+                train, mesh=m,
+                in_specs=(P(meshlib.DATA_AXIS),) * 5
+                + (P(), P(meshlib.DATA_AXIS)),
+                out_specs=P(), check_vma=False)
+            w_pad = take_pad(w)  # padding rows (order == -1) get weight 0
+            result = jax.jit(sharded)(
+                jnp.asarray(take_pad(binned)),
+                jnp.asarray(take_pad(np.asarray(y, np.float64))),
+                jnp.asarray(w_pad), jnp.asarray(take_pad(is_train)),
+                jnp.asarray(take_pad(margin)), key,
+                jnp.asarray(lay.group_idx))
         else:
             cfg = self._make_config(num_class, meshlib.DATA_AXIS, objective,
                                     has_init)
@@ -288,14 +362,38 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 class LightGBMModelBase(Model, _p.HasFeaturesCol, _p.HasPredictionCol):
     """Shared fitted-model surface (LightGBMModelMethods.scala:1-66)."""
 
+    leafPredictionCol = _p.Param(
+        "leafPredictionCol",
+        "output column for per-tree leaf indices (empty = off)", "")
+    featuresShapCol = _p.Param(
+        "featuresShapCol",
+        "output column for SHAP contributions (empty = off)", "")
+
     def __init__(self, booster: Optional[Booster] = None, **kw):
         super().__init__(**kw)
         self.booster = booster
+
+    def _add_optional_cols(self, df: DataFrame, x: np.ndarray) -> DataFrame:
+        """Leaf-index / SHAP output columns (LightGBMClassifier.scala:100-142
+        leaf + SHAP UDFs — batched here instead of per-row JNI)."""
+        leaf_col = self.get("leafPredictionCol")
+        if leaf_col:
+            df = df.with_column(leaf_col,
+                                self.booster.predict_leaf(x).astype(np.float64))
+        shap_col = self.get("featuresShapCol")
+        if shap_col:
+            df = df.with_column(shap_col, self.booster.features_shap(x))
+        return df
 
     def get_feature_importances(self, importance_type: str = "split"):
         return self.booster.feature_importances(importance_type)
 
     getFeatureImportances = get_feature_importances
+
+    def get_feature_shaps(self, x: np.ndarray) -> np.ndarray:
+        return self.booster.features_shap(np.atleast_2d(np.asarray(x)))
+
+    getFeatureShaps = get_feature_shaps
 
     def save_native_model(self, path: str) -> None:
         self.booster.save_native_model(path)

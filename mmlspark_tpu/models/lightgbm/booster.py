@@ -104,6 +104,36 @@ class Booster:
             return out.transpose(2, 0, 1).reshape(x.shape[0], -1)
         return out.T
 
+    def features_shap(self, x: np.ndarray) -> np.ndarray:
+        """Per-feature SHAP contributions (featuresShap, LightGBMBooster.scala:218-228,
+        C++ `C_API_PREDICT_CONTRIB`). [N, F+1] or [N, K*(F+1)]; last column per
+        class block is the expected value."""
+        from .shap import tree_shap
+        x = np.asarray(x, np.float64)
+        t_used = self._used_iters()
+        fp1 = self.num_features + 1
+        if self.multiclass:
+            out = np.zeros((x.shape[0], self.num_class * fp1))
+            for k in range(self.num_class):
+                trees_k = [Tree(*[np.asarray(a[t, k]) for a in self.trees])
+                           for t in range(t_used)]
+                thr_k = [np.asarray(self.thresholds[t, k])
+                         for t in range(t_used)]
+                out[:, k * fp1:(k + 1) * fp1] = tree_shap(
+                    trees_k, thr_k, x, self.num_features,
+                    float(self.init_score[k]))
+            return out
+        trees = [Tree(*[np.asarray(a[t]) for a in self.trees])
+                 for t in range(t_used)]
+        thrs = [np.asarray(self.thresholds[t]) for t in range(t_used)]
+        phi = tree_shap(trees, thrs, x, self.num_features,
+                        float(self.init_score))
+        if self.average_output and t_used > 0:
+            base = float(self.init_score)
+            phi[:, :-1] /= t_used
+            phi[:, -1] = base + (phi[:, -1] - base) / t_used
+        return phi
+
     # -------------------------------------------------------- introspection
     def feature_importances(self, importance_type: str = "split") -> np.ndarray:
         """Reference: LightGBMBooster.featureImportances (LightGBMBooster.scala:303-310),
@@ -131,6 +161,8 @@ class Booster:
             "learning_rate": self.learning_rate,
             "init_score": self.init_score.tolist(),
             "average_output": self.average_output,
+            "categorical": list(self.bin_mapper.categorical
+                                if self.bin_mapper else ()),
         }
 
     def save_arrays(self) -> dict:
@@ -144,7 +176,9 @@ class Booster:
     @staticmethod
     def from_parts(meta: dict, arrays: dict) -> "Booster":
         trees = Tree(*[arrays[f"tree_{f}"] for f in Tree._fields])
-        bm = (BinMapper(arrays["bin_edges"]) if "bin_edges" in arrays else None)
+        bm = (BinMapper(arrays["bin_edges"],
+                        tuple(meta.get("categorical", ())))
+              if "bin_edges" in arrays else None)
         return Booster(trees, arrays["thresholds"],
                        np.asarray(meta["init_score"], np.float32),
                        meta["objective"], meta["num_class"],
@@ -216,6 +250,7 @@ def concat_boosters(a: "Booster", b: "Booster") -> "Booster":
     la = a.trees.leaf_value.shape[-1]
     lb = b.trees.leaf_value.shape[-1]
     lcap = max(la, lb)
+    wcap = max(a.trees.split_mask.shape[-1], b.trees.split_mask.shape[-1])
 
     def pad_arr(arr, n_extra):
         widths = [(0, 0)] * (arr.ndim - 1) + [(0, n_extra)]
@@ -223,13 +258,17 @@ def concat_boosters(a: "Booster", b: "Booster") -> "Booster":
 
     def pad(tree: Tree, thr, l_from):
         extra = lcap - l_from
-        if extra == 0:
-            return tree, thr
-        return Tree(
-            pad_arr(tree.split_slot, extra), pad_arr(tree.split_feat, extra),
-            pad_arr(tree.split_bin, extra), pad_arr(tree.split_valid, extra),
-            pad_arr(tree.split_gain, extra), pad_arr(tree.leaf_value, extra),
-        ), pad_arr(thr, extra)
+        fields = {}
+        for name, arr in zip(Tree._fields, tree):
+            arr = np.asarray(arr)
+            if name == "split_mask":
+                # leaf axis is -2 here; also unify category-mask widths
+                widths = ([(0, 0)] * (arr.ndim - 2)
+                          + [(0, extra), (0, wcap - arr.shape[-1])])
+                fields[name] = np.pad(arr, widths)
+            else:
+                fields[name] = pad_arr(arr, extra)
+        return Tree(**fields), pad_arr(thr, extra)
 
     ta, tha = pad(a.trees, a.thresholds, la)
     tb, thb = pad(b.trees, b.thresholds, lb)
@@ -253,7 +292,8 @@ def _slots_to_nodes(tree: Tree, thresholds: np.ndarray):
     n_splits = int(valid.sum())
     if n_splits == 0:
         return (np.zeros(0, int), np.zeros(0), np.zeros(0, int),
-                np.zeros(0, int), np.asarray([tree.leaf_value[0]]))
+                np.zeros(0, int), np.asarray([tree.leaf_value[0]]),
+                np.asarray([tree.leaf_count[0]]))
     split_feature = np.zeros(n_splits, int)
     threshold = np.zeros(n_splits)
     left_child = np.zeros(n_splits, int)
@@ -279,27 +319,58 @@ def _slots_to_nodes(tree: Tree, thresholds: np.ndarray):
         node, side = p
         (left_child if side == 0 else right_child)[node] = ~slot
     leaf_value = np.asarray(tree.leaf_value[:n_splits + 1], np.float64)
-    return split_feature, threshold, left_child, right_child, leaf_value
+    leaf_count = np.asarray(tree.leaf_count[:n_splits + 1], np.float64)
+    return (split_feature, threshold, left_child, right_child, leaf_value,
+            leaf_count)
 
 
 def _tree_to_text(tree: Tree, thresholds: np.ndarray, tree_id: int,
                   value_shift: float) -> str:
-    sf, thr, lc, rc, lv = _slots_to_nodes(tree, thresholds)
+    sf, thr, lc, rc, lv, lcnt = _slots_to_nodes(tree, thresholds)
     n_leaves = len(lv)
+    n_splits = len(sf)
+    is_cat = np.asarray(tree.split_is_cat[:n_splits]).astype(bool)
+    num_cat = int(is_cat.sum())
     out = io.StringIO()
     out.write(f"Tree={tree_id}\n")
     out.write(f"num_leaves={n_leaves}\n")
-    out.write("num_cat=0\n")
-    if len(sf):
+    out.write(f"num_cat={num_cat}\n")
+    if n_splits:
+        # categorical splits use LightGBM bitset encoding: threshold = index
+        # into cat_boundaries; cat_threshold bit c set => category c goes left
+        dec = np.where(is_cat, 1, 2)
+        thr_out = thr.astype(np.float64).copy()
+        cat_boundaries = [0]
+        cat_words: list = []
+        bm = tree.split_mask.shape[-1]
+        n_words = max((bm + 31) // 32, 1)
+        ci = 0
+        for s in range(n_splits):
+            if not is_cat[s]:
+                continue
+            thr_out[s] = ci
+            mask = np.asarray(tree.split_mask[s]).astype(bool)
+            words = np.zeros(n_words, np.uint32)
+            for c in np.flatnonzero(mask):
+                words[c // 32] |= np.uint32(1 << (c % 32))
+            cat_words.extend(int(wd) for wd in words)
+            cat_boundaries.append(cat_boundaries[-1] + n_words)
+            ci += 1
         out.write("split_feature=" + " ".join(map(str, sf)) + "\n")
         out.write("split_gain=" + " ".join(
-            f"{g:g}" for g in np.asarray(tree.split_gain[:len(sf)])) + "\n")
-        out.write("threshold=" + " ".join(f"{t:.17g}" for t in thr) + "\n")
-        out.write("decision_type=" + " ".join(["2"] * len(sf)) + "\n")
+            f"{g:g}" for g in np.asarray(tree.split_gain[:n_splits])) + "\n")
+        out.write("threshold=" + " ".join(f"{t:.17g}" for t in thr_out) + "\n")
+        out.write("decision_type=" + " ".join(map(str, dec)) + "\n")
         out.write("left_child=" + " ".join(map(str, lc)) + "\n")
         out.write("right_child=" + " ".join(map(str, rc)) + "\n")
+        if num_cat:
+            out.write("cat_boundaries=" + " ".join(map(str, cat_boundaries))
+                      + "\n")
+            out.write("cat_threshold=" + " ".join(map(str, cat_words)) + "\n")
     out.write("leaf_value=" + " ".join(
         f"{v + value_shift:.17g}" for v in lv) + "\n")
+    out.write("leaf_count=" + " ".join(
+        str(int(round(c))) for c in lcnt) + "\n")
     out.write("shrinkage=1\n\n")
     return out.getvalue()
 

@@ -42,10 +42,9 @@ class LightGBMClassifier(LightGBMParamsBase, _p.HasProbabilityCol,
             w, is_valid, num_class if num_class > 2 else 1,
             objective=objective, init_score=init_score)
         model = LightGBMClassificationModel(booster=booster, num_class=num_class)
-        for p in ("featuresCol", "predictionCol", "probabilityCol",
-                  "rawPredictionCol"):
+        for p in ("probabilityCol", "rawPredictionCol"):
             model.set(p, self.get(p))
-        return model
+        return self._propagate_model_params(model)
 
 
 class LightGBMClassificationModel(LightGBMModelBase, _p.HasProbabilityCol,
@@ -69,9 +68,10 @@ class LightGBMClassificationModel(LightGBMModelBase, _p.HasProbabilityCol,
             probs = e / e.sum(axis=1, keepdims=True)
             raws = raw
         pred = probs.argmax(axis=1).astype(np.float64)
-        return (df.with_column(self.get("rawPredictionCol"), raws)
-                  .with_column(self.get("probabilityCol"), probs)
-                  .with_column(self.get("predictionCol"), pred))
+        out = (df.with_column(self.get("rawPredictionCol"), raws)
+                 .with_column(self.get("probabilityCol"), probs)
+                 .with_column(self.get("predictionCol"), pred))
+        return self._add_optional_cols(out, x)
 
     # loaders — reference: LightGBMClassifier.scala:178-195
     @staticmethod

@@ -25,19 +25,49 @@ def _parse_tree_block(lines: Dict[str, str]):
     num_leaves = int(lines["num_leaves"])
     if num_leaves == 1:
         lv = np.array([float(v) for v in lines["leaf_value"].split()])
+        lcnt = (np.array([float(v) for v in lines["leaf_count"].split()])
+                if "leaf_count" in lines else np.zeros(1))
         return num_leaves, (np.zeros(0, int), np.zeros(0), np.zeros(0, int),
-                            np.zeros(0, int), lv)
+                            np.zeros(0, int), lv, lcnt,
+                            np.zeros(0, bool), np.zeros((0, 1), bool))
     sf = np.array([int(v) for v in lines["split_feature"].split()])
     thr = np.array([float(v) for v in lines["threshold"].split()])
     lc = np.array([int(v) for v in lines["left_child"].split()])
     rc = np.array([int(v) for v in lines["right_child"].split()])
     lv = np.array([float(v) for v in lines["leaf_value"].split()])
-    return num_leaves, (sf, thr, lc, rc, lv)
+    lcnt = (np.array([float(v) for v in lines["leaf_count"].split()])
+            if "leaf_count" in lines else np.zeros(len(lv)))
+    # categorical decision nodes: decision_type bit 0 set => bitset split
+    # (LightGBM model format; decision_type "2" = numeric default-left)
+    dec = (np.array([int(v) for v in lines["decision_type"].split()])
+           if "decision_type" in lines else np.full(len(sf), 2))
+    is_cat = (dec & 1).astype(bool)
+    n_splits = len(sf)
+    if is_cat.any():
+        cb = np.array([int(v) for v in lines["cat_boundaries"].split()])
+        cw = np.array([int(v) for v in lines["cat_threshold"].split()],
+                      dtype=np.uint64)
+        n_words = int((cb[1:] - cb[:-1]).max()) if len(cb) > 1 else 1
+        width = n_words * 32
+        masks = np.zeros((n_splits, width), bool)
+        for s in range(n_splits):
+            if not is_cat[s]:
+                continue
+            ci = int(thr[s])
+            words = cw[cb[ci]:cb[ci + 1]]
+            for wi, word in enumerate(words):
+                for bit in range(32):
+                    if int(word) >> bit & 1:
+                        masks[s, wi * 32 + bit] = True
+    else:
+        masks = np.zeros((n_splits, 1), bool)
+    return num_leaves, (sf, thr, lc, rc, lv, lcnt, is_cat, masks)
 
 
-def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int):
+def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int,
+                    mask_width: int = 1):
     """Convert LightGBM node arrays to padded slot/replay arrays."""
-    sf, thr, lc, rc, lv = arrays
+    sf, thr, lc, rc, lv, lcnt, node_cat, node_masks = arrays
     n_splits = len(sf)
     lcap = max_leaves
     split_slot = np.zeros(lcap - 1, np.int32)
@@ -45,13 +75,18 @@ def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int):
     split_bin = np.zeros(lcap - 1, np.int32)
     split_valid = np.zeros(lcap - 1, bool)
     split_gain = np.zeros(lcap - 1, np.float32)
+    split_is_cat = np.zeros(lcap - 1, bool)
+    split_mask = np.zeros((lcap - 1, mask_width), bool)
     thresholds = np.zeros(lcap - 1, np.float64)
     leaf_value = np.zeros(lcap, np.float32)
+    leaf_count = np.zeros(lcap, np.float32)
 
     if n_splits == 0:
         leaf_value[0] = lv[0]
+        leaf_count[0] = lcnt[0]
         return Tree(split_slot, split_feat, split_bin, split_valid, split_gain,
-                    leaf_value), thresholds
+                    leaf_value, leaf_count, split_is_cat,
+                    split_mask), thresholds
 
     slot_of_node = {0: 0}
     step = 0
@@ -63,6 +98,12 @@ def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int):
         split_feat[step] = sf[node]
         thresholds[step] = thr[node]
         split_valid[step] = True
+        if node_cat[node]:
+            split_is_cat[step] = True
+            w = min(node_masks.shape[1], mask_width)
+            split_mask[step, :w] = node_masks[node][:w]
+            # categorical threshold is a cat-table index, meaningless as a value
+            thresholds[step] = 0.0
         new_slot = step + 1
         left, right = lc[node], rc[node]
         if left >= 0:
@@ -70,14 +111,16 @@ def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int):
             queue.append(left)
         else:
             leaf_value[slot] = lv[~left]
+            leaf_count[slot] = lcnt[~left]
         if right >= 0:
             slot_of_node[right] = new_slot
             queue.append(right)
         else:
             leaf_value[new_slot] = lv[~right]
+            leaf_count[new_slot] = lcnt[~right]
         step += 1
     return Tree(split_slot, split_feat, split_bin, split_valid, split_gain,
-                leaf_value), thresholds
+                leaf_value, leaf_count, split_is_cat, split_mask), thresholds
 
 
 def parse_model_string(s: str) -> Booster:
@@ -109,7 +152,9 @@ def parse_model_string(s: str) -> Booster:
     parsed = [_parse_tree_block(tb) for tb in tree_blocks]
     max_leaves = max((p[0] for p in parsed), default=1)
     max_leaves = max(max_leaves, 2)
-    slot_trees = [_nodes_to_slots(nl, arrs, max_leaves) for nl, arrs in parsed]
+    mask_width = max((arrs[7].shape[1] for _, arrs in parsed), default=1)
+    slot_trees = [_nodes_to_slots(nl, arrs, max_leaves, mask_width)
+                  for nl, arrs in parsed]
 
     trees = Tree(*[np.stack([np.asarray(getattr(t, f)) for t, _ in slot_trees])
                    for f in Tree._fields])

@@ -27,10 +27,7 @@ class LightGBMRegressor(LightGBMParamsBase):
         x, y, w, is_valid, init_score = self._extract_xyw(df)
         booster = self._train_booster(x, np.asarray(y, np.float64), w,
                                       is_valid, 1, init_score=init_score)
-        model = LightGBMRegressionModel(booster=booster)
-        for p in ("featuresCol", "predictionCol"):
-            model.set(p, self.get(p))
-        return model
+        return self._propagate_model_params(LightGBMRegressionModel(booster))
 
 
 class LightGBMRegressionModel(LightGBMModelBase):
@@ -38,8 +35,9 @@ class LightGBMRegressionModel(LightGBMModelBase):
     def transform(self, df: DataFrame) -> DataFrame:
         x = np.asarray(df[self.get("featuresCol")], np.float32)
         pred = self.booster.score(x)
-        return df.with_column(self.get("predictionCol"),
-                              np.asarray(pred, np.float64))
+        out = df.with_column(self.get("predictionCol"),
+                             np.asarray(pred, np.float64))
+        return self._add_optional_cols(out, x)
 
     @staticmethod
     def load_native_model_from_file(path: str) -> "LightGBMRegressionModel":

@@ -1,0 +1,175 @@
+"""TreeSHAP — exact per-feature contribution values for GBDT predictions.
+
+Reference analogue: LightGBM's `C_API_PREDICT_CONTRIB` SHAP path reached through
+`featuresShapCol` (lightgbm/LightGBMBooster.scala:218-228 `featuresShap`,
+LightGBMModelMethods.scala getFeatureShaps). The C++ core implements Lundberg et al.'s
+path-dependent TreeSHAP; this is the same algorithm over the slot-tree node arrays.
+
+Output layout matches LightGBM predict(contrib=True): [N, F+1] with the expected value
+in the last column (multiclass: [N, K*(F+1)]).
+
+Host-side numpy by design: SHAP is an explanation path, not a training hot loop; trees
+are tiny (<= num_leaves nodes) so recursion cost is O(rows * leaves * depth^2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...ops.boosting import Tree
+from .booster import _slots_to_nodes
+
+
+class _NodeTree:
+    """Flat node arrays for one tree with covers filled in."""
+
+    def __init__(self, tree: Tree, thresholds: np.ndarray):
+        sf, thr, lc, rc, lv, lcnt = _slots_to_nodes(tree, thresholds)
+        self.split_feature = sf
+        self.threshold = thr
+        self.left = lc
+        self.right = rc
+        self.leaf_value = lv
+        self.leaf_count = lcnt
+        self.n_internal = len(sf)
+        # node id == split step, so categorical info maps 1:1
+        self.is_cat = np.asarray(tree.split_is_cat[:self.n_internal]).astype(bool)
+        self.cat_mask = np.asarray(tree.split_mask[:self.n_internal]).astype(bool)
+        if self.leaf_count.sum() <= 0:
+            # models parsed without leaf_count (older exports): uniform covers
+            # are the only honest prior — all-zero covers would silently zero
+            # every SHAP value
+            self.leaf_count = np.ones_like(self.leaf_count)
+        # cover per internal node = sum of leaf counts beneath it
+        self.cover = np.zeros(self.n_internal)
+        if self.n_internal:
+            self._fill_cover(0)
+        self.total = self.cover[0] if self.n_internal else float(lcnt[0])
+
+    def _fill_cover(self, node: int) -> float:
+        c = 0.0
+        for child in (self.left[node], self.right[node]):
+            if child >= 0:
+                c += self._fill_cover(child)
+            else:
+                c += float(self.leaf_count[~child])
+        self.cover[node] = c
+        return c
+
+    def child_cover(self, child: int) -> float:
+        return self.cover[child] if child >= 0 else float(
+            self.leaf_count[~child])
+
+    def goes_left(self, node: int, xv: float) -> bool:
+        if self.is_cat[node]:
+            code = int(xv) if np.isfinite(xv) else 0
+            if code < 0 or code >= self.cat_mask.shape[1]:
+                return False  # outside the bitset -> right (LightGBM semantics)
+            return bool(self.cat_mask[node, code])
+        return xv <= self.threshold[node]
+
+    def value(self, node: int) -> float:
+        """Expected leaf value of the subtree (cover-weighted)."""
+        if node < 0:
+            return float(self.leaf_value[~node])
+        lw = self.child_cover(self.left[node])
+        rw = self.child_cover(self.right[node])
+        tot = max(lw + rw, 1e-12)
+        return (lw * self.value(self.left[node])
+                + rw * self.value(self.right[node])) / tot
+
+
+def _tree_shap_row(nt: _NodeTree, x: np.ndarray, phi: np.ndarray) -> None:
+    """Path-dependent TreeSHAP (Lundberg et al. 2018, Algorithm 2) for one row."""
+    if nt.n_internal == 0:
+        return
+
+    # unique path is a list of dicts-as-arrays: d (feature), z (zero fraction),
+    # o (one fraction), w (pweight)
+    def extend(path, pz, po, pi):
+        # deep copy: the caller reuses its path for the sibling subtree
+        path = [row[:] for row in path] + [[pi, pz, po, 0.0]]
+        l = len(path)
+        path[l - 1][3] = 1.0 if l == 1 else 0.0
+        for i in range(l - 2, -1, -1):
+            path[i + 1][3] += po * path[i][3] * (i + 1) / l
+            path[i][3] = pz * path[i][3] * (l - 1 - i) / l
+        return path
+
+    def unwind(path, i):
+        l = len(path)
+        po, pz = path[i][2], path[i][1]
+        n = path[l - 1][3]
+        path = [row[:] for row in path]
+        for j in range(l - 2, -1, -1):
+            if po != 0:
+                t = path[j][3]
+                path[j][3] = n * l / ((j + 1) * po)
+                n = t - path[j][3] * pz * (l - 1 - j) / l
+            else:
+                path[j][3] = path[j][3] * l / (pz * (l - 1 - j))
+        # drop element i: d/z/o shift down one; weights keep their position
+        for j in range(i, l - 1):
+            path[j][0], path[j][1], path[j][2] = (
+                path[j + 1][0], path[j + 1][1], path[j + 1][2])
+        return path[: l - 1]
+
+    def unwound_sum(path, i):
+        l = len(path)
+        po, pz = path[i][2], path[i][1]
+        total = 0.0
+        if po != 0:
+            n = path[l - 1][3]
+            for j in range(l - 2, -1, -1):
+                t = n / ((j + 1) * po)
+                total += t
+                n = path[j][3] - t * pz * (l - 1 - j)
+        else:
+            for j in range(l - 2, -1, -1):
+                total += path[j][3] / (pz * (l - 1 - j))
+        return total * l
+
+    def recurse(node, path, pz, po, pi):
+        path = extend(path, pz, po, pi)
+        if node < 0:  # leaf
+            v = float(nt.leaf_value[~node])
+            for i in range(1, len(path)):
+                w = unwound_sum(path, i)
+                phi[path[i][0]] += w * (path[i][2] - path[i][1]) * v
+            return
+        f = int(nt.split_feature[node])
+        hot, cold = ((nt.left[node], nt.right[node])
+                     if nt.goes_left(node, x[f])
+                     else (nt.right[node], nt.left[node]))
+        iz, io_ = 1.0, 1.0
+        k = next((i for i in range(1, len(path)) if path[i][0] == f), None)
+        if k is not None:
+            iz, io_ = path[k][1], path[k][2]
+            path = unwind(path, k)
+        cov = max(nt.child_cover(nt.left[node]) +
+                  nt.child_cover(nt.right[node]), 1e-12)
+        recurse(hot, path, iz * nt.child_cover(hot) / cov, io_, f)
+        recurse(cold, path, iz * nt.child_cover(cold) / cov, 0.0, f)
+
+    recurse(0, [], 1.0, 1.0, -1)
+
+
+def tree_shap(trees_list, thresholds_list, x: np.ndarray,
+              num_features: int, init_score: float) -> np.ndarray:
+    """SHAP contributions for a stack of single-output trees.
+
+    trees_list: iterable of (Tree, thresholds) per iteration.
+    Returns [N, F+1]; column F is the expected value (base + sum of tree means).
+    """
+    x = np.asarray(x, np.float64)
+    n = x.shape[0]
+    phi = np.zeros((n, num_features + 1))
+    phi[:, -1] = init_score
+    for tree, thr in zip(trees_list, thresholds_list):
+        nt = _NodeTree(tree, np.asarray(thr))
+        phi[:, -1] += nt.value(0) if nt.n_internal else float(nt.leaf_value[0])
+        for r in range(n):
+            row_phi = np.zeros(num_features + 1)
+            _tree_shap_row(nt, x[r], row_phi)
+            phi[r, :num_features] += row_phi[:num_features]
+    return phi

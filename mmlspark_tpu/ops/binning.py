@@ -73,10 +73,17 @@ def num_used_bins(edges: np.ndarray) -> np.ndarray:
 
 
 class BinMapper:
-    """Fitted binner: edges + apply; serializable as a plain array."""
+    """Fitted binner: edges + apply; serializable as a plain array.
 
-    def __init__(self, edges: np.ndarray):
+    Categorical features (categoricalSlotIndexes, lightgbm/LightGBMParams.scala;
+    categorical index resolution in LightGBMUtils.scala:74-106) are binned by
+    integer category code directly: bin id == code, no quantile edges.
+    """
+
+    def __init__(self, edges: np.ndarray,
+                 categorical: Optional[Tuple[int, ...]] = None):
         self.edges = edges
+        self.categorical = tuple(sorted(categorical)) if categorical else ()
 
     @property
     def max_bins(self) -> int:
@@ -88,11 +95,20 @@ class BinMapper:
 
     @staticmethod
     def fit(X: np.ndarray, max_bins: int = 255, sample_count: int = 200_000,
-            seed: int = 0) -> "BinMapper":
-        return BinMapper(compute_bin_edges(X, max_bins, sample_count, seed))
+            seed: int = 0,
+            categorical: Optional[Tuple[int, ...]] = None) -> "BinMapper":
+        return BinMapper(compute_bin_edges(X, max_bins, sample_count, seed),
+                         categorical)
 
     def transform(self, X: np.ndarray) -> np.ndarray:
-        return apply_bins(X, self.edges)
+        out = apply_bins(X, self.edges)
+        if self.categorical:
+            X = np.asarray(X)
+            for j in self.categorical:
+                col = np.nan_to_num(X[:, j], nan=0.0)
+                out[:, j] = np.clip(col.astype(np.int64), 0,
+                                    self.max_bins - 1).astype(out.dtype)
+        return out
 
     def threshold_value(self, feature: int, bin_id: int) -> float:
         """Real-valued threshold for 'bin <= bin_id' splits (for model export:

@@ -60,6 +60,17 @@ class GBDTConfig(NamedTuple):
     boosting_type: str = "gbdt"  # gbdt | goss | rf | dart
     drop_rate: float = 0.1      # dart
     has_init_score: bool = False  # row init margins supplied (disables boost_from_average)
+    max_position: int = 20   # lambdarank NDCG truncation (maxPosition)
+    eval_at: int = 0         # NDCG@k for the eval metric (evalAt[0]; 0 = use
+                             # max_position)
+    sigma: float = 1.0       # lambdarank sigmoid steepness
+    max_label: int = 31      # lambdarank max relevance label (label_gain table size)
+    label_gain_table: Optional[Tuple[float, ...]] = None  # custom labelGain
+    # categorical features (LightGBM one-vs-rest sorted-subset splits;
+    # categoricalSlotIndexes in LightGBMParams.scala)
+    categorical_features: Tuple[int, ...] = ()
+    cat_smooth: float = 10.0          # denominator smoothing for g/h sort key
+    max_cat_threshold: int = 32       # max categories on the left side
     seed: int = 0
     bagging_seed: int = 3
     hist_method: str = "auto"
@@ -77,6 +88,12 @@ class Tree(NamedTuple):
     split_valid: jax.Array  # [L-1] bool
     split_gain: jax.Array   # [L-1] float32
     leaf_value: jax.Array   # [L] float32 (already includes learning-rate shrinkage)
+    leaf_count: jax.Array   # [L] float32 — training rows per leaf (global across
+                            # shards; basis for SHAP covers and leaf_count export)
+    split_is_cat: jax.Array  # [L-1] bool — categorical (bin-subset) split
+    split_mask: jax.Array    # [L-1, Bm] bool — bins going LEFT for categorical
+                             # splits (Bm = max_bins when categoricals are
+                             # configured, else 1 to keep the model tiny)
 
 
 def _split_score(g, h, lambda_l1, lambda_l2):
@@ -90,15 +107,41 @@ def _leaf_output(g, h, lambda_l1, lambda_l2):
     return -t / (h + lambda_l2 + 1e-15)
 
 
+def _cat_ratio(h3, cfg: GBDTConfig):
+    """Sort key for categorical subset splits: g/(h + cat_smooth), empty bins
+    pushed to the end. h3: [..., B, 3]. Single source of truth — the split scan
+    and the mask reconstruction in build_tree MUST order bins identically."""
+    ratio = h3[..., 0] / (h3[..., 1] + cfg.cat_smooth)
+    return jnp.where(h3[..., 2] > 0, ratio, -jnp.inf)
+
+
+def _cat_sort_order(hists, cfg: GBDTConfig):
+    """Per-(slot, feature) bin permutation for categorical splits: descending
+    g/(h + cat_smooth) — LightGBM's sorted one-vs-rest subset search."""
+    return jnp.argsort(-_cat_ratio(hists, cfg), axis=2)           # [L,F,B]
+
+
 def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
     """Vectorized split-gain scan over [L, F, B] histograms.
 
     Returns per-slot (best_gain [L], best_feat [L], best_bin [L]).
-    Reference semantics: LightGBM FeatureHistogram::FindBestThreshold (C++), driven from
-    TrainUtils.scala:220-315's update loop.
+    For categorical features `best_bin` is the (sorted-order) prefix length - 1;
+    the caller reconstructs the category subset mask.
+    Reference semantics: LightGBM FeatureHistogram::FindBestThreshold /
+    FindBestThresholdCategorical (C++), driven from TrainUtils.scala:220-315.
     """
     l, f, b, _ = hists.shape
-    cum = jnp.cumsum(hists, axis=2)              # [L,F,B,3] left stats for bin<=b
+    cat = cfg.categorical_features
+    if cat:
+        is_cat = jnp.zeros((f,), bool).at[jnp.asarray(cat)].set(True)
+        order = _cat_sort_order(hists, cfg)
+        sorted_h = jnp.take_along_axis(hists, order[..., None], axis=2)
+        scan_h = jnp.where(is_cat[None, :, None, None], sorted_h, hists)
+    else:
+        is_cat = None
+        scan_h = hists
+
+    cum = jnp.cumsum(scan_h, axis=2)             # [L,F,B,3] left stats for bin<=b
     tot = sums[:, None, None, :]                 # [L,1,1,3]
     left_g, left_h, left_n = cum[..., 0], cum[..., 1], cum[..., 2]
     tot_g, tot_h, tot_n = tot[..., 0], tot[..., 1], tot[..., 2]
@@ -113,6 +156,11 @@ def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
           & (left_h >= cfg.min_sum_hessian_in_leaf)
           & (right_h >= cfg.min_sum_hessian_in_leaf)
           & feature_mask[None, :, None])
+    if cat:
+        # categorical prefixes are capped at max_cat_threshold categories
+        prefix_len = jnp.arange(b)[None, None, :] + 1
+        ok = ok & (~is_cat[None, :, None]
+                   | (prefix_len <= cfg.max_cat_threshold))
     gain = jnp.where(ok, gain, _NEG_INF)
 
     flat = gain.reshape(l, f * b)
@@ -139,6 +187,10 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     n, f = binned.shape
     lcap = cfg.num_leaves
     b = cfg.max_bins
+    cat = cfg.categorical_features
+    bm = b if cat else 1  # split-mask width (1 keeps numeric-only models tiny)
+    is_cat_f = (jnp.zeros((f,), bool).at[jnp.asarray(cat)].set(True)
+                if cat else None)
 
     def hist(mask_gh3):
         h = build_histogram(binned, mask_gh3, b, cfg.hist_method,
@@ -160,11 +212,13 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     s_bin = jnp.zeros((lcap - 1,), jnp.int32)
     s_valid = jnp.zeros((lcap - 1,), bool)
     s_gain = jnp.zeros((lcap - 1,), jnp.float32)
+    s_is_cat = jnp.zeros((lcap - 1,), bool)
+    s_mask = jnp.zeros((lcap - 1, bm), bool)
     done = jnp.array(False)
 
     def body(s, carry):
         (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-         s_valid, s_gain, done) = carry
+         s_valid, s_gain, s_is_cat, s_mask, done) = carry
         gains, feats, bins = _best_split_per_slot(hists, sums, cfg, feature_mask)
         slot_exists = jnp.arange(lcap) <= s
         if cfg.max_depth > 0:
@@ -180,7 +234,18 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
 
         col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
         in_leaf = slot_of_row == best_slot
-        go_right = col > bin_b
+        if cat:
+            # rebuild the sorted-order prefix as an explicit category mask
+            hrow = hists[best_slot, feat_b]                      # [B,3]
+            order_b = jnp.argsort(-_cat_ratio(hrow, cfg))
+            mask = jnp.zeros((b,), bool).at[order_b].set(
+                jnp.arange(b) <= bin_b)                          # left subset
+            feat_cat = is_cat_f[feat_b]
+            go_right = jnp.where(feat_cat, ~mask[col], col > bin_b)
+        else:
+            mask = jnp.zeros((bm,), bool)
+            feat_cat = jnp.array(False)
+            go_right = col > bin_b
         slot_of_row = jnp.where(in_leaf & go_right & do, new_slot, slot_of_row)
 
         right_gh3 = gh3 * (slot_of_row == new_slot)[:, None].astype(gh3.dtype)
@@ -206,21 +271,24 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         s_bin = s_bin.at[s].set(bin_b)
         s_valid = s_valid.at[s].set(do)
         s_gain = s_gain.at[s].set(jnp.where(do, best_gain, 0.0))
+        s_is_cat = s_is_cat.at[s].set(feat_cat & do)
+        s_mask = s_mask.at[s].set(mask[:bm])
         done = done | ~do
         return (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat,
-                s_bin, s_valid, s_gain, done)
+                s_bin, s_valid, s_gain, s_is_cat, s_mask, done)
 
     carry = (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-             s_valid, s_gain, done)
+             s_valid, s_gain, s_is_cat, s_mask, done)
     carry = jax.lax.fori_loop(0, lcap - 1, body, carry)
     (hists, sums, _, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
-     _) = carry
+     s_is_cat, s_mask, _) = carry
 
     leaf_value = (_leaf_output(sums[:, 0], sums[:, 1], cfg.lambda_l1,
                                cfg.lambda_l2)
                   * jnp.float32(cfg.learning_rate))
     # slots that never received rows keep value 0 (their sums are 0)
-    tree = Tree(s_slot, s_feat, s_bin, s_valid, s_gain, leaf_value)
+    tree = Tree(s_slot, s_feat, s_bin, s_valid, s_gain, leaf_value,
+                sums[:, 2], s_is_cat, s_mask)
     return tree, slot_of_row
 
 
@@ -229,11 +297,18 @@ def tree_apply_binned(tree: Tree, binned: jax.Array) -> jax.Array:
     n = binned.shape[0]
     nsplit = tree.split_slot.shape[0]
 
+    bm = tree.split_mask.shape[-1]
+
     def body(s, slot):
         feat = tree.split_feat[s]
         col = jnp.take(binned, feat, axis=1).astype(jnp.int32)
         mask = (slot == tree.split_slot[s]) & tree.split_valid[s]
         go_right = col > tree.split_bin[s]
+        if bm > 1:
+            # LightGBM bitset semantics: categories outside the bitset go RIGHT
+            in_range = (col >= 0) & (col < bm)
+            cat_left = in_range & tree.split_mask[s][jnp.clip(col, 0, bm - 1)]
+            go_right = jnp.where(tree.split_is_cat[s], ~cat_left, go_right)
         return jnp.where(mask & go_right, s + 1, slot)
 
     slot = jax.lax.fori_loop(0, nsplit, body, jnp.zeros((n,), jnp.int32))
@@ -249,12 +324,20 @@ def tree_apply_raw(tree: Tree, x: jax.Array, thresholds: jax.Array) -> jax.Array
     NaN comparisons are False -> NaN goes left, consistent with NaN->bin 0 binning."""
     n = x.shape[0]
     nsplit = tree.split_slot.shape[0]
+    bm = tree.split_mask.shape[-1]
 
     def body(s, slot):
         feat = tree.split_feat[s]
         col = jnp.take(x, feat, axis=1)
         mask = (slot == tree.split_slot[s]) & tree.split_valid[s]
         go_right = col > thresholds[s]
+        if bm > 1:
+            # categorical: raw value IS the category code == bin id;
+            # codes outside the bitset range go RIGHT (LightGBM semantics)
+            code = jnp.nan_to_num(col, nan=0.0).astype(jnp.int32)
+            in_range = (code >= 0) & (code < bm)
+            cat_left = in_range & tree.split_mask[s][jnp.clip(code, 0, bm - 1)]
+            go_right = jnp.where(tree.split_is_cat[s], ~cat_left, go_right)
         return jnp.where(mask & go_right, s + 1, slot)
 
     return jax.lax.fori_loop(0, nsplit, body, jnp.zeros((n,), jnp.int32))
@@ -296,9 +379,16 @@ def make_train_fn(cfg: GBDTConfig):
     When cfg.axis_name is set the caller wraps this in shard_map; all inputs are
     shard-local and histograms/metrics psum over the axis.
     """
-    obj = get_objective(cfg.objective, cfg.num_class)
+    ranking = cfg.objective == "lambdarank"
+    obj = None if ranking else get_objective(cfg.objective, cfg.num_class)
     multiclass = cfg.objective == "multiclass"
     k = cfg.num_class if multiclass else 1
+    if ranking:
+        from . import ranking as _rk
+        _label_gain = jnp.asarray(
+            np.asarray(cfg.label_gain_table, np.float32)
+            if cfg.label_gain_table
+            else _rk.default_label_gain(cfg.max_label))
 
     def psum(v):
         return jax.lax.psum(v, cfg.axis_name) if cfg.axis_name else v
@@ -308,6 +398,8 @@ def make_train_fn(cfg: GBDTConfig):
 
     def metric_of(scores, y, w):
         # global (cross-shard) metric via weighted-mean decomposition
+        if ranking:
+            raise AssertionError("ranking metric is computed inside train()")
         if multiclass:
             logp = jax.nn.log_softmax(scores, axis=1)
             picked = jnp.take_along_axis(
@@ -323,16 +415,36 @@ def make_train_fn(cfg: GBDTConfig):
     if dart and multiclass:
         raise NotImplementedError("dart mode is single-output only for now")
 
-    def train(binned, y, w_all, is_train, init_margin, key):
+    def train(binned, y, w_all, is_train, init_margin, key, group_idx=None):
         """init_margin [N, K]: per-row starting margins (initScoreCol / warm
         start / batch training — LightGBMBase.scala:29-50, TrainUtils.scala:57-129).
-        Zeros when absent."""
+        Zeros when absent. group_idx [NG, G] (lambdarank only): padded
+        gather-index group layout from ops.ranking.make_group_layout."""
         n, f = binned.shape
         w = w_all * is_train           # training weight
         w_valid = w_all * (1.0 - is_train)  # validation-metric weight
         yf = y.astype(jnp.float32)
 
-        if cfg.boost_from_average and not multiclass and not cfg.has_init_score:
+        if ranking:
+            assert group_idx is not None, "lambdarank requires group_idx"
+            from .ranking import ndcg_per_group, _gather_padded
+
+            def rank_metric(scores1d, row_w):
+                """1 - weighted-mean NDCG@maxPosition (lower is better, so the
+                early-stopping machinery needs no special-casing)."""
+                val = _gather_padded(jnp.where(row_w > 0, 1.0, 0.0),
+                                     group_idx, 0.0)
+                s_g = _gather_padded(scores1d.astype(jnp.float32), group_idx, 0.0)
+                y_g = _gather_padded(yf, group_idx, 0.0)
+                ndcg, has_rel = ndcg_per_group(s_g, y_g, val, _label_gain,
+                                               cfg.eval_at or cfg.max_position)
+                g_w = (val.max(axis=1) * has_rel.astype(jnp.float32))
+                num = psum(jnp.sum(ndcg * g_w))
+                den = jnp.maximum(psum(jnp.sum(g_w)), 1e-12)
+                return 1.0 - num / den
+
+        if (cfg.boost_from_average and not multiclass and not ranking
+                and not cfg.has_init_score):
             tot_wy = psum(jnp.sum(yf * w))
             tot_w = jnp.maximum(psum(jnp.sum(w)), 1e-12)
             mean = tot_wy / tot_w
@@ -370,7 +482,14 @@ def make_train_fn(cfg: GBDTConfig):
                 kdrop = jnp.float32(0.0)
                 drop_sum = None
 
-            if multiclass:
+            if ranking:
+                from .ranking import lambdarank_grad_hess
+                g, h = lambdarank_grad_hess(
+                    grad_scores[:, 0], yf, group_idx, _label_gain,
+                    cfg.max_position, cfg.sigma,
+                    row_valid=jnp.where(w > 0, 1.0, 0.0))
+                g, h = g[:, None], h[:, None]
+            elif multiclass:
                 g, h = obj.grad_hess(grad_scores, y.astype(jnp.int32))
             else:
                 g, h = obj.grad_hess(grad_scores[:, 0], yf)
@@ -427,8 +546,12 @@ def make_train_fn(cfg: GBDTConfig):
             else:
                 eval_scores = scores
             sc = eval_scores if multiclass else eval_scores[:, 0]
-            tm = metric_of(sc, ys, w)
-            vm = metric_of(sc, ys, w_valid)
+            if ranking:
+                tm = rank_metric(sc, w)
+                vm = rank_metric(sc, w_valid)
+            else:
+                tm = metric_of(sc, ys, w)
+                vm = metric_of(sc, ys, w_valid)
             return (scores, deltas, tree_scale, key), (tree, tm, vm)
 
         deltas0 = (jnp.zeros((t_cap, n), jnp.float32) if dart

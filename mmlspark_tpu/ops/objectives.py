@@ -191,6 +191,14 @@ def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
         "tweedie": make_tweedie(tweedie_variance_power),
         "poisson": make_poisson(),
         "fair": fair,
+        # lambdarank grad/hess live in ops.ranking (they need group structure);
+        # this entry provides link/metric surfaces for fitted-model scoring
+        "lambdarank": Objective(
+            "lambdarank",
+            lambda s, y: (_ for _ in ()).throw(
+                RuntimeError("lambdarank gradients require group layout")),
+            lambda y, w: 0.0, lambda s: s,
+            lambda s, y, w: 0.0, "ndcg", larger_is_better=True),
     }
     if name not in table:
         raise ValueError(f"unknown objective {name!r}; known: {sorted(table)}")
