@@ -21,6 +21,8 @@ from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Seque
 def _as_column(values: Any) -> np.ndarray:
     """Coerce arbitrary input into a numpy column (1-D scalars or 2-D vectors)."""
     if isinstance(values, np.ndarray):
+        if values.dtype.kind == "U":  # normalize strings to object dtype
+            return values.astype(object)
         return values
     if isinstance(values, (list, tuple)):
         if len(values) > 0 and isinstance(values[0], (list, tuple, np.ndarray)):

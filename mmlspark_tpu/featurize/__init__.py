@@ -1,0 +1,14 @@
+"""Featurization layer (reference: featurize/ — SURVEY.md §2.3, 1757 LoC)."""
+
+from .clean import CleanMissingData, CleanMissingDataModel, DataConversion
+from .featurize import Featurize, FeaturizeModel
+from .indexers import (CATEGORICAL_META_KEY, IndexToValue, ValueIndexer,
+                       ValueIndexerModel)
+from .text import MultiNGram, PageSplitter, TextFeaturizer, TextFeaturizerModel
+
+__all__ = [
+    "CATEGORICAL_META_KEY", "CleanMissingData", "CleanMissingDataModel",
+    "DataConversion", "Featurize", "FeaturizeModel", "IndexToValue",
+    "MultiNGram", "PageSplitter", "TextFeaturizer", "TextFeaturizerModel",
+    "ValueIndexer", "ValueIndexerModel",
+]

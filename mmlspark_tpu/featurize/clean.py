@@ -1,0 +1,103 @@
+"""Missing-data cleaning and column type coercion.
+
+Reference: featurize/CleanMissingData.scala:49-160 (mean/median/custom replacement,
+fitted per column), featurize/DataConversion.scala:21 (column type coercion).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model, Transformer
+
+
+class CleanMissingData(Estimator):
+    """Replace NaN/None in numeric columns by mean / median / custom value.
+
+    Reference: featurize/CleanMissingData.scala:49-160."""
+    inputCols = _p.Param("inputCols", "columns to clean", None)
+    outputCols = _p.Param("outputCols", "cleaned output columns", None)
+    cleaningMode = _p.Param("cleaningMode", "Mean | Median | Custom", "Mean")
+    customValue = _p.Param("customValue", "replacement for Custom mode", None)
+
+    def _fit(self, df: DataFrame) -> "CleanMissingDataModel":
+        mode = self.get("cleaningMode")
+        fills: List[float] = []
+        for col_name in self.get("inputCols"):
+            v = np.asarray(df[col_name], np.float64)
+            finite = v[np.isfinite(v)]
+            if mode == "Mean":
+                fill = float(finite.mean()) if len(finite) else 0.0
+            elif mode == "Median":
+                fill = float(np.median(finite)) if len(finite) else 0.0
+            elif mode == "Custom":
+                fill = float(self.get("customValue"))
+            else:
+                raise ValueError(f"unknown cleaningMode {mode!r}")
+            fills.append(fill)
+        model = CleanMissingDataModel(fills=fills)
+        model.set("inputCols", self.get("inputCols"))
+        model.set("outputCols", self.get("outputCols") or self.get("inputCols"))
+        return model
+
+
+class CleanMissingDataModel(Model):
+    inputCols = _p.Param("inputCols", "columns to clean", None)
+    outputCols = _p.Param("outputCols", "cleaned output columns", None)
+    fills = _p.Param("fills", "replacement value per column", None, complex=True)
+
+    def __init__(self, fills: Optional[List[float]] = None, **kw):
+        super().__init__(**kw)
+        if fills is not None:
+            self.set("fills", [float(f) for f in fills])
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        out = df
+        for col_name, out_name, fill in zip(self.get("inputCols"),
+                                            self.get("outputCols"),
+                                            self.get("fills")):
+            v = np.asarray(df[col_name], np.float64).copy()
+            v[~np.isfinite(v)] = fill
+            out = out.with_column(out_name, v)
+        return out
+
+
+_DTYPES = {
+    "boolean": np.bool_, "byte": np.int8, "short": np.int16, "integer": np.int32,
+    "long": np.int64, "float": np.float32, "double": np.float64, "string": object,
+}
+
+
+class DataConversion(Transformer):
+    """Coerce columns to a named type; `date` renders epoch-ms to strings.
+
+    Reference: featurize/DataConversion.scala:21."""
+    cols = _p.Param("cols", "columns to convert", None)
+    convertTo = _p.Param("convertTo", "target type name", "double")
+    dateTimeFormat = _p.Param("dateTimeFormat", "format for date conversion",
+                              "yyyy-MM-dd HH:mm:ss")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        target = self.get("convertTo")
+        out = df
+        for name in self.get("cols") or []:
+            col = df[name]
+            if target == "string":
+                conv = np.array([str(v) for v in col], dtype=object)
+            elif target == "date":
+                import datetime
+                conv = np.array(
+                    [datetime.datetime.fromtimestamp(float(v) / 1000.0)
+                     .strftime("%Y-%m-%d %H:%M:%S") for v in col], dtype=object)
+            elif target in _DTYPES:
+                if col.dtype == object:
+                    col = np.array([float(v) for v in col])
+                conv = col.astype(_DTYPES[target])
+            else:
+                raise ValueError(f"unknown convertTo {target!r}")
+            out = out.with_column(name, conv)
+        return out

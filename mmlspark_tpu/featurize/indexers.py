@@ -1,0 +1,96 @@
+"""Value indexing — categorical <-> index codecs.
+
+Reference: featurize/ValueIndexer.scala:55-187 (`ValueIndexer`/`ValueIndexerModel`
+with null ordering), featurize/IndexToValue.scala, and the categorical-metadata
+convention of core/schema/Categoricals.scala:17-314 (levels stored as column metadata
+so downstream stages — one-hot, LightGBM categorical splits — can recover them).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model, Transformer
+
+CATEGORICAL_META_KEY = "ml_attr_levels"  # categorical levels metadata key
+
+
+def _is_missing(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        return True
+    return False
+
+
+class ValueIndexer(Estimator):
+    """Learn distinct values of a column -> contiguous indices.
+
+    Null ordering follows the reference (ValueIndexer.scala:55-187): missing values
+    sort first (index 0) when present; remaining levels sorted ascending."""
+    inputCol = _p.Param("inputCol", "column to index", "input")
+    outputCol = _p.Param("outputCol", "indexed output column", "output")
+
+    def _fit(self, df: DataFrame) -> "ValueIndexerModel":
+        col = df[self.get("inputCol")]
+        has_missing = any(_is_missing(v) for v in col)
+        present = [v.item() if hasattr(v, "item") else v
+                   for v in col if not _is_missing(v)]
+        levels: List[Any] = sorted(set(present))
+        if has_missing:
+            levels = [None] + levels
+        model = ValueIndexerModel(levels=levels)
+        model.set("inputCol", self.get("inputCol"))
+        model.set("outputCol", self.get("outputCol"))
+        return model
+
+
+class ValueIndexerModel(Model):
+    inputCol = _p.Param("inputCol", "column to index", "input")
+    outputCol = _p.Param("outputCol", "indexed output column", "output")
+    levels = _p.Param("levels", "ordered distinct values", None, complex=True)
+
+    def __init__(self, levels: Optional[List[Any]] = None, **kw):
+        super().__init__(**kw)
+        if levels is not None:
+            self.set("levels", list(levels))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        levels = self.get("levels")
+        lookup = {v: i for i, v in enumerate(levels)}
+        missing_idx = lookup.get(None, -1)
+        col = df[self.get("inputCol")]
+        out = np.empty(len(col), dtype=np.int64)
+        for i, v in enumerate(col):
+            if _is_missing(v):
+                out[i] = missing_idx
+            else:
+                out[i] = lookup.get(v.item() if hasattr(v, "item") else v, -1)
+        return df.with_column(
+            self.get("outputCol"), out,
+            metadata={CATEGORICAL_META_KEY: list(levels),
+                      "is_categorical": True})
+
+
+class IndexToValue(Transformer):
+    """Inverse of ValueIndexerModel using the levels stored in column metadata.
+
+    Reference: featurize/IndexToValue.scala."""
+    inputCol = _p.Param("inputCol", "indexed column", "input")
+    outputCol = _p.Param("outputCol", "decoded output column", "output")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        meta = df.metadata(self.get("inputCol"))
+        levels = meta.get(CATEGORICAL_META_KEY)
+        if levels is None:
+            raise ValueError(
+                f"column {self.get('inputCol')!r} has no categorical metadata")
+        col = df[self.get("inputCol")].astype(np.int64)
+        out = np.empty(len(col), dtype=object)
+        for i, idx in enumerate(col):
+            out[i] = levels[idx] if 0 <= idx < len(levels) else None
+        return df.with_column(self.get("outputCol"), out)

@@ -1,0 +1,169 @@
+"""Text featurization pipeline.
+
+Reference: featurize/text/TextFeaturizer.scala:181-408 — tokenize -> stopword removal
+-> n-grams -> hashingTF -> IDF, each stage toggleable; featurize/text/MultiNGram.scala
+(concatenate several n-gram lengths); featurize/text/PageSplitter.scala (split long
+strings into bounded pages).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model, Transformer
+from ..utils.hashing import hashing_tf
+
+# Spark's default english stop words (StopWordsRemover) — abbreviated core set
+_STOP_WORDS = set("""a about above after again against all am an and any are as at
+be because been before being below between both but by can did do does doing down
+during each few for from further had has have having he her here hers herself him
+himself his how i if in into is it its itself just me more most my myself no nor
+not now of off on once only or other our ours ourselves out over own same she
+should so some such than that the their theirs them themselves then there these
+they this those through to too under until up very was we were what when where
+which while who whom why will with you your yours yourself yourselves""".split())
+
+
+def tokenize(text: str) -> List[str]:
+    return [t for t in re.split(r"\W+", text.lower()) if t]
+
+
+def ngrams(tokens: Sequence[str], n: int) -> List[str]:
+    if n <= 1:
+        return list(tokens)
+    return [" ".join(tokens[i:i + n]) for i in range(len(tokens) - n + 1)]
+
+
+class TextFeaturizer(Estimator):
+    """tokenize -> stopwords -> ngram -> hashingTF -> IDF as one estimator.
+
+    Reference: featurize/text/TextFeaturizer.scala:181-408."""
+    inputCol = _p.Param("inputCol", "text column", "input")
+    outputCol = _p.Param("outputCol", "feature vector column", "output")
+    useTokenizer = _p.Param("useTokenizer", "tokenize input", True, bool)
+    useStopWordsRemover = _p.Param("useStopWordsRemover", "drop stop words", False, bool)
+    useNGram = _p.Param("useNGram", "emit n-grams", False, bool)
+    nGramLength = _p.Param("nGramLength", "n-gram length", 2, int)
+    binary = _p.Param("binary", "binary term counts", False, bool)
+    numFeatures = _p.Param("numFeatures", "hash space size", 1 << 18, int)
+    useIDF = _p.Param("useIDF", "apply inverse document frequency", True, bool)
+    minDocFreq = _p.Param("minDocFreq", "min doc frequency for IDF", 1, int)
+
+    def _tokens(self, col) -> List[List[str]]:
+        docs = []
+        for text in col:
+            toks = tokenize(str(text)) if self.get("useTokenizer") else list(text)
+            if self.get("useStopWordsRemover"):
+                toks = [t for t in toks if t not in _STOP_WORDS]
+            if self.get("useNGram"):
+                toks = ngrams(toks, int(self.get("nGramLength")))
+            docs.append(toks)
+        return docs
+
+    def _fit(self, df: DataFrame) -> "TextFeaturizerModel":
+        docs = self._tokens(df[self.get("inputCol")])
+        nf = int(self.get("numFeatures"))
+        idf = None
+        if self.get("useIDF"):
+            tf = hashing_tf(docs, nf, binary=True)
+            dfreq = tf.sum(axis=0)
+            n_docs = len(docs)
+            idf = np.log((n_docs + 1.0) / (dfreq + 1.0)).astype(np.float32)
+            # terms below the doc-frequency threshold are filtered out (weight
+            # 0), matching Spark IDF's minDocFreq semantics
+            idf[dfreq < self.get("minDocFreq")] = 0.0
+        model = TextFeaturizerModel(idf=idf)
+        for p in ("inputCol", "outputCol", "useTokenizer", "useStopWordsRemover",
+                  "useNGram", "nGramLength", "binary", "numFeatures"):
+            model.set(p, self.get(p))
+        return model
+
+
+class TextFeaturizerModel(Model):
+    inputCol = _p.Param("inputCol", "text column", "input")
+    outputCol = _p.Param("outputCol", "feature vector column", "output")
+    useTokenizer = _p.Param("useTokenizer", "tokenize input", True, bool)
+    useStopWordsRemover = _p.Param("useStopWordsRemover", "drop stop words", False, bool)
+    useNGram = _p.Param("useNGram", "emit n-grams", False, bool)
+    nGramLength = _p.Param("nGramLength", "n-gram length", 2, int)
+    binary = _p.Param("binary", "binary term counts", False, bool)
+    numFeatures = _p.Param("numFeatures", "hash space size", 1 << 18, int)
+    idf = _p.Param("idf", "idf weights (None = no idf)", None, complex=True)
+
+    def __init__(self, idf: Optional[np.ndarray] = None, **kw):
+        super().__init__(**kw)
+        if idf is not None:
+            self.set("idf", np.asarray(idf, np.float32))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        feat = TextFeaturizer()
+        for p in ("inputCol", "useTokenizer", "useStopWordsRemover", "useNGram",
+                  "nGramLength"):
+            feat.set(p, self.get(p))
+        docs = feat._tokens(df[self.get("inputCol")])
+        tf = hashing_tf(docs, int(self.get("numFeatures")),
+                        binary=self.get("binary"))
+        idf = self.get("idf") if self.is_set("idf") else None
+        if idf is not None:
+            tf = tf * idf[None, :]
+        return df.with_column(self.get("outputCol"), tf)
+
+
+class MultiNGram(Transformer):
+    """Concatenate token n-grams for several lengths into one token column.
+
+    Reference: featurize/text/MultiNGram.scala."""
+    inputCol = _p.Param("inputCol", "token-list column", "input")
+    outputCol = _p.Param("outputCol", "combined ngram column", "output")
+    lengths = _p.Param("lengths", "ngram lengths to emit", None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        lengths = [int(x) for x in (self.get("lengths") or [1, 2, 3])]
+        col = df[self.get("inputCol")]
+        out = np.empty(len(col), dtype=object)
+        for i, toks in enumerate(col):
+            toks = list(toks)
+            combined: List[str] = []
+            for n in lengths:
+                combined.extend(ngrams(toks, n))
+            out[i] = combined
+        return df.with_column(self.get("outputCol"), out)
+
+
+class PageSplitter(Transformer):
+    """Split long strings into pages within [minPageLength, maxPageLength],
+    preferring whitespace/boundary breaks.
+
+    Reference: featurize/text/PageSplitter.scala."""
+    inputCol = _p.Param("inputCol", "text column", "input")
+    outputCol = _p.Param("outputCol", "list-of-pages column", "output")
+    maxPageLength = _p.Param("maxPageLength", "max chars per page", 5000, int)
+    minPageLength = _p.Param("minPageLength", "min chars before break", 4500, int)
+    boundaryRegex = _p.Param("boundaryRegex", "preferred break pattern", r"\s")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        lo = int(self.get("minPageLength"))
+        hi = int(self.get("maxPageLength"))
+        pattern = re.compile(self.get("boundaryRegex"))
+        col = df[self.get("inputCol")]
+        out = np.empty(len(col), dtype=object)
+        for i, text in enumerate(col):
+            s = str(text)
+            pages: List[str] = []
+            while len(s) > hi:
+                window = s[lo:hi]
+                m = None
+                for m in pattern.finditer(window):
+                    pass  # last boundary in window
+                cut = lo + m.end() if m else hi
+                pages.append(s[:cut])
+                s = s[cut:]
+            if s:
+                pages.append(s)
+            out[i] = pages
+        return df.with_column(self.get("outputCol"), out)

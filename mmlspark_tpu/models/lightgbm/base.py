@@ -204,8 +204,18 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         num_batches = self.get("numBatches")
         if num_batches and num_batches > 1:
             rng = np.random.default_rng(self.get("seed"))
-            order = rng.permutation(len(y))
-            parts = np.array_split(order, num_batches)
+            if groups is not None:
+                # split on query-group boundaries so lambdarank pair gradients
+                # and IDCG normalization always see whole groups (the reference
+                # keeps groups intact via repartitionByGroupingColumn,
+                # LightGBMRanker.scala:77+)
+                uniq = np.unique(groups)
+                gperm = rng.permutation(uniq)
+                gparts = np.array_split(gperm, num_batches)
+                parts = [np.flatnonzero(np.isin(groups, gp)) for gp in gparts]
+            else:
+                order = rng.permutation(len(y))
+                parts = np.array_split(order, num_batches)
             booster = prev
             for part in parts:
                 booster = self._train_booster_once(

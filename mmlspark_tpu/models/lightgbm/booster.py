@@ -119,9 +119,13 @@ class Booster:
                            for t in range(t_used)]
                 thr_k = [np.asarray(self.thresholds[t, k])
                          for t in range(t_used)]
-                out[:, k * fp1:(k + 1) * fp1] = tree_shap(
-                    trees_k, thr_k, x, self.num_features,
-                    float(self.init_score[k]))
+                phi_k = tree_shap(trees_k, thr_k, x, self.num_features,
+                                  float(self.init_score[k]))
+                if self.average_output and t_used > 0:
+                    base = float(self.init_score[k])
+                    phi_k[:, :-1] /= t_used
+                    phi_k[:, -1] = base + (phi_k[:, -1] - base) / t_used
+                out[:, k * fp1:(k + 1) * fp1] = phi_k
             return out
         trees = [Tree(*[np.asarray(a[t]) for a in self.trees])
                  for t in range(t_used)]

@@ -97,6 +97,16 @@ class BinMapper:
     def fit(X: np.ndarray, max_bins: int = 255, sample_count: int = 200_000,
             seed: int = 0,
             categorical: Optional[Tuple[int, ...]] = None) -> "BinMapper":
+        if categorical:
+            X = np.asarray(X)
+            for j in categorical:
+                top = np.nanmax(X[:, j]) if len(X) else 0
+                if top >= max_bins:
+                    import warnings
+                    warnings.warn(
+                        f"categorical feature {j} has {int(top) + 1} codes but "
+                        f"maxBin={max_bins}; codes >= {max_bins} are clipped "
+                        f"into one bin (raise maxBin to keep them distinct)")
         return BinMapper(compute_bin_edges(X, max_bins, sample_count, seed),
                          categorical)
 

@@ -332,11 +332,12 @@ def tree_apply_raw(tree: Tree, x: jax.Array, thresholds: jax.Array) -> jax.Array
         mask = (slot == tree.split_slot[s]) & tree.split_valid[s]
         go_right = col > thresholds[s]
         if bm > 1:
-            # categorical: raw value IS the category code == bin id;
-            # codes outside the bitset range go RIGHT (LightGBM semantics)
+            # categorical: raw value IS the category code == bin id. Codes are
+            # clipped into [0, bm) exactly as BinMapper.transform clips them at
+            # training time (binning.py), so train/predict route out-of-range
+            # categories identically (they share the edge bin's direction).
             code = jnp.nan_to_num(col, nan=0.0).astype(jnp.int32)
-            in_range = (code >= 0) & (code < bm)
-            cat_left = in_range & tree.split_mask[s][jnp.clip(code, 0, bm - 1)]
+            cat_left = tree.split_mask[s][jnp.clip(code, 0, bm - 1)]
             go_right = jnp.where(tree.split_is_cat[s], ~cat_left, go_right)
         return jnp.where(mask & go_right, s + 1, slot)
 

@@ -1,0 +1,20 @@
+"""Utility pipeline stages (reference: stages/ — SURVEY.md §2.3, 19 files)."""
+
+from .basic import (Cacher, ClassBalancer, ClassBalancerModel, DropColumns,
+                    EnsembleByKey, Explode, Lambda, MultiColumnAdapter,
+                    RenameColumn, Repartition, SelectColumns,
+                    StratifiedRepartition, Timer, TimerModel, UDFTransformer,
+                    get_value_at, to_vector)
+from .batching import (DynamicMiniBatchTransformer, FixedMiniBatchTransformer,
+                       FlattenBatch, TimeIntervalMiniBatchTransformer)
+from .text import (SummarizeData, TextPreprocessor, Trie, UnicodeNormalize)
+
+__all__ = [
+    "Cacher", "ClassBalancer", "ClassBalancerModel", "DropColumns",
+    "DynamicMiniBatchTransformer", "EnsembleByKey", "Explode",
+    "FixedMiniBatchTransformer", "FlattenBatch", "Lambda",
+    "MultiColumnAdapter", "RenameColumn", "Repartition", "SelectColumns",
+    "StratifiedRepartition", "SummarizeData", "TextPreprocessor",
+    "TimeIntervalMiniBatchTransformer", "Timer", "TimerModel", "Trie",
+    "UDFTransformer", "UnicodeNormalize", "get_value_at", "to_vector",
+]

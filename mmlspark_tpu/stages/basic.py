@@ -1,0 +1,337 @@
+"""Utility transformers — the reference's stages/ package (SURVEY.md §2.3 stages/).
+
+Each class cites its reference analogue. These are host-side DataFrame ops: the
+reference runs them as Spark plan nodes; here they are cheap columnar transforms, and
+anything heavy (EnsembleByKey vector means, ClassBalancer counts) is vectorized numpy.
+`Repartition`/`Cacher` exist for pipeline-surface parity — device sharding replaces
+partitioning in the TPU design (see mmlspark_tpu.parallel.mesh), so they are metadata
+hints rather than data movement.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model, PipelineStage, Transformer
+
+
+class DropColumns(Transformer):
+    """Reference: stages/DropColumns.scala:20."""
+    cols = _p.Param("cols", "columns to drop", None)
+
+    def __init__(self, cols: Optional[Sequence[str]] = None, **kw):
+        super().__init__(**kw)
+        if cols is not None:
+            self.set("cols", list(cols))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.drop(*(self.get("cols") or []))
+
+
+class SelectColumns(Transformer):
+    """Reference: stages/SelectColumns.scala:22."""
+    cols = _p.Param("cols", "columns to keep", None)
+
+    def __init__(self, cols: Optional[Sequence[str]] = None, **kw):
+        super().__init__(**kw)
+        if cols is not None:
+            self.set("cols", list(cols))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.select(*(self.get("cols") or []))
+
+
+class RenameColumn(Transformer):
+    """Reference: stages/RenameColumn.scala:19."""
+    inputCol = _p.Param("inputCol", "column to rename", None)
+    outputCol = _p.Param("outputCol", "new name", None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.with_column_renamed(self.get("inputCol"), self.get("outputCol"))
+
+
+class Repartition(Transformer):
+    """Reference: stages/Repartition.scala:19 — here a no-op passthrough: rows are
+    sharded onto the device mesh at estimator boundaries, so host-side partition
+    count has no meaning. Kept for pipeline-surface parity."""
+    n = _p.Param("n", "requested partition count (ignored: device sharding "
+                 "replaces partitioning)", 1, int)
+    disable = _p.Param("disable", "passthrough switch", False, bool)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df
+
+
+class Cacher(Transformer):
+    """Reference: stages/Cacher.scala:13 — columns are already host-resident numpy;
+    materialization is a no-op."""
+    disable = _p.Param("disable", "passthrough switch", False, bool)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df
+
+
+class Lambda(Transformer):
+    """Arbitrary DataFrame=>DataFrame function as a serializable stage.
+
+    Reference: stages/Lambda.scala:21 (Dataset=>Dataset function stage).
+    """
+    transformFunc = _p.Param("transformFunc", "df -> df function", None, complex=True)
+
+    def __init__(self, transformFunc: Optional[Callable[[DataFrame], DataFrame]] = None,
+                 **kw):
+        super().__init__(**kw)
+        if transformFunc is not None:
+            self.set("transformFunc", transformFunc)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fn = self.get("transformFunc")
+        return fn(df) if fn is not None else df
+
+
+class UDFTransformer(Transformer):
+    """Apply a python function element-wise over an input column (or row-wise over
+    several). Reference: stages/UDFTransformer.scala:25 + UDFParam/UDPyFParam.
+
+    The UDF runs on host; vectorized functions may return a full column at once by
+    setting ``vectorized=True`` (the TPU-friendly path — feed the whole column to a
+    jitted function instead of the reference's per-row SQL UDF)."""
+    inputCol = _p.Param("inputCol", "input column", None)
+    inputCols = _p.Param("inputCols", "input columns (row-wise udf)", None)
+    outputCol = _p.Param("outputCol", "output column", "output")
+    udf = _p.Param("udf", "the function", None, complex=True)
+    vectorized = _p.Param("vectorized", "whether udf takes whole columns", False, bool)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fn = self.get("udf")
+        if self.get("inputCols"):
+            cols = [df[c] for c in self.get("inputCols")]
+            if self.get("vectorized"):
+                out = fn(*cols)
+            else:
+                out = [fn(*vals) for vals in zip(*cols)]
+        else:
+            col = df[self.get("inputCol")]
+            out = fn(col) if self.get("vectorized") else [fn(v) for v in col]
+        return df.with_column(self.get("outputCol"), np.asarray(out))
+
+
+class Explode(Transformer):
+    """Expand a ragged (object-dtype of sequences) column into one row per element.
+
+    Reference: stages/Explode.scala:16."""
+    inputCol = _p.Param("inputCol", "ragged column to explode", None)
+    outputCol = _p.Param("outputCol", "exploded output column", None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        name = self.get("inputCol")
+        out_name = self.get("outputCol") or name
+        col = df[name]
+        lengths = np.fromiter((len(v) for v in col), dtype=np.int64, count=len(col))
+        idx = np.repeat(np.arange(len(col)), lengths)
+        flat: List[Any] = []
+        for v in col:
+            flat.extend(v)
+        rep = df.take(idx)
+        return rep.with_column(out_name, np.asarray(flat, dtype=object)
+                               if any(isinstance(x, str) for x in flat[:8])
+                               else np.asarray(flat))
+
+
+class EnsembleByKey(Transformer):
+    """Group rows by key column(s) and average scalar/vector columns.
+
+    Reference: stages/EnsembleByKey.scala:22 (incl. VectorAvg UDAF :155).
+    Vectorized: sort-by-key + np.add.reduceat replaces the reference's UDAF.
+    """
+    keys = _p.Param("keys", "key columns", None)
+    cols = _p.Param("cols", "value columns to average", None)
+    colNames = _p.Param("colNames", "output names for averaged columns", None)
+    strategy = _p.Param("strategy", "aggregation strategy", "mean")
+    collapseGroup = _p.Param("collapseGroup", "emit one row per group", True, bool)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        keys = self.get("keys")
+        cols = self.get("cols")
+        names = self.get("colNames") or [f"{c}_mean" for c in cols]
+        key_arrays = [df[k] for k in keys]
+        # stable factorization of composite keys
+        seen: Dict[Any, int] = {}
+        gids = np.empty(len(df), dtype=np.int64)
+        for i, tup in enumerate(zip(*key_arrays)):
+            t = tuple(x.item() if hasattr(x, "item") else x for x in tup)
+            gids[i] = seen.setdefault(t, len(seen))
+        order = np.argsort(gids, kind="stable")
+        sorted_gids = gids[order]
+        boundaries = np.flatnonzero(np.diff(sorted_gids, prepend=-1))
+        counts = np.diff(np.append(boundaries, len(df)))
+        out_cols: Dict[str, np.ndarray] = {}
+        first_idx = order[boundaries]
+        for k in keys:
+            out_cols[k] = df[k][first_idx]
+        for c, n in zip(cols, names):
+            v = df[c]
+            vs = np.asarray(v, dtype=np.float64)[order]
+            sums = np.add.reduceat(vs, boundaries, axis=0)
+            means = sums / (counts[:, None] if vs.ndim > 1 else counts)
+            out_cols[n] = means
+        if self.get("collapseGroup"):
+            return DataFrame(out_cols)
+        # broadcast group means back onto every row
+        out = df
+        inv = np.empty(len(df), dtype=np.int64)
+        inv[order] = np.repeat(np.arange(len(boundaries)), counts)
+        for c, n in zip(cols, names):
+            out = out.with_column(n, out_cols[n][inv])
+        return out
+
+
+class ClassBalancer(Estimator):
+    """Weight column = max(count)/count(label) — inverse-frequency balancing.
+
+    Reference: stages/ClassBalancer.scala:27."""
+    inputCol = _p.Param("inputCol", "label column", "label")
+    outputCol = _p.Param("outputCol", "weight column", "weight")
+    broadcastJoin = _p.Param("broadcastJoin", "unused (host join)", True, bool)
+
+    def _fit(self, df: DataFrame) -> "ClassBalancerModel":
+        col = df[self.get("inputCol")]
+        values, counts = np.unique(col, return_counts=True)
+        weights = counts.max() / counts.astype(np.float64)
+        model = ClassBalancerModel(
+            values=[v.item() if hasattr(v, "item") else v for v in values],
+            weights=weights)
+        model.set("inputCol", self.get("inputCol"))
+        model.set("outputCol", self.get("outputCol"))
+        return model
+
+
+class ClassBalancerModel(Model):
+    inputCol = _p.Param("inputCol", "label column", "label")
+    outputCol = _p.Param("outputCol", "weight column", "weight")
+    values = _p.Param("values", "distinct label values", None, complex=True)
+    weights = _p.Param("weights", "weight per value", None, complex=True)
+
+    def __init__(self, values=None, weights=None, **kw):
+        super().__init__(**kw)
+        if values is not None:
+            self.set("values", list(values))
+        if weights is not None:
+            self.set("weights", np.asarray(weights, np.float64))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        lookup = {v: w for v, w in zip(self.get("values"), self.get("weights"))}
+        col = df[self.get("inputCol")]
+        w = np.fromiter((lookup[v.item() if hasattr(v, "item") else v] for v in col),
+                        dtype=np.float64, count=len(col))
+        return df.with_column(self.get("outputCol"), w)
+
+
+class StratifiedRepartition(Transformer):
+    """Rebalance rows so every label value appears spread across the dataset —
+    the reference uses per-label sampleByKeyExact + RangePartitioner so each LightGBM
+    partition sees all labels (stages/StratifiedRepartition.scala:29). On TPU the
+    analogous invariant is that each *device shard* sees all labels; we interleave
+    rows round-robin by label so any contiguous shard split is label-complete."""
+    labelCol = _p.Param("labelCol", "label column", "label")
+    mode = _p.Param("mode", "equal | original | mixed", "mixed")
+    seed = _p.Param("seed", "shuffle seed", 0, int)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        labels = df[self.get("labelCol")]
+        rng = np.random.default_rng(self.get("seed"))
+        values = np.unique(labels)
+        per_label = []
+        for v in values:
+            idx = np.flatnonzero(labels == v)
+            rng.shuffle(idx)
+            per_label.append(idx)
+        # round-robin interleave (ragged: shorter lists simply run out)
+        longest = max(len(ix) for ix in per_label)
+        order = []
+        for i in range(longest):
+            for ix in per_label:
+                if i < len(ix):
+                    order.append(ix[i])
+        return df.take(np.asarray(order))
+
+
+class MultiColumnAdapter(Transformer):
+    """Map a single-column stage over N (input, output) column pairs.
+
+    Reference: stages/MultiColumnAdapter.scala:18."""
+    baseStage = _p.Param("baseStage", "1-col stage to replicate", None, complex=True)
+    inputCols = _p.Param("inputCols", "input columns", None)
+    outputCols = _p.Param("outputCols", "output columns", None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        base: PipelineStage = self.get("baseStage")
+        cur = df
+        for i, o in zip(self.get("inputCols"), self.get("outputCols")):
+            stage = base.copy({"inputCol": i, "outputCol": o})
+            if isinstance(stage, Estimator):
+                cur = stage.fit(cur).transform(cur)
+            else:
+                cur = stage.transform(cur)
+        return cur
+
+
+class Timer(Estimator):
+    """Wrap a stage; log wall-time of fit/transform.
+
+    Reference: stages/Timer.scala:18+. Times include device sync (block_until_ready
+    happens inside estimators), so numbers are honest end-to-end latencies."""
+    stage = _p.Param("stage", "wrapped stage", None, complex=True)
+    logToScala = _p.Param("logToScala", "print timing (surface parity name)", True, bool)
+    disableMaterialization = _p.Param("disableMaterialization", "unused", True, bool)
+
+    def _fit(self, df: DataFrame) -> "TimerModel":
+        stage = self.get("stage")
+        t0 = time.perf_counter()
+        if isinstance(stage, Estimator):
+            fitted = stage.fit(df)
+        else:
+            fitted = stage
+        elapsed = time.perf_counter() - t0
+        if self.get("logToScala"):
+            print(f"[Timer] fit {type(stage).__name__}: {elapsed:.4f}s")
+        model = TimerModel(stage=fitted)
+        model.set("logToScala", self.get("logToScala"))
+        return model
+
+
+class TimerModel(Model):
+    stage = _p.Param("stage", "wrapped fitted stage", None, complex=True)
+    logToScala = _p.Param("logToScala", "print timing", True, bool)
+
+    def __init__(self, stage=None, **kw):
+        super().__init__(**kw)
+        if stage is not None:
+            self.set("stage", stage)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        t0 = time.perf_counter()
+        out = self.get("stage").transform(df)
+        elapsed = time.perf_counter() - t0
+        if self.get("logToScala"):
+            print(f"[Timer] transform {type(self.get('stage')).__name__}: "
+                  f"{elapsed:.4f}s")
+        return out
+
+
+# -------------------------------------------------------------------- udfs
+# Reference: stages/udfs.scala (`get_value_at`, `to_vector`)
+
+def get_value_at(col: np.ndarray, index: int) -> np.ndarray:
+    """Extract element `index` from a vector column."""
+    return np.asarray(col)[:, index]
+
+
+def to_vector(col: np.ndarray) -> np.ndarray:
+    """Coerce an array/list column to a dense 2-D vector column."""
+    return np.stack([np.asarray(v, dtype=np.float64) for v in col])

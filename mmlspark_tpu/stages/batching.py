@@ -1,0 +1,103 @@
+"""Mini-batching transformers.
+
+Reference: stages/MiniBatchTransformer.scala (DynamicMiniBatchTransformer:43,
+TimeIntervalMiniBatchTransformer:66, FixedMiniBatchTransformer:139, FlattenBatch:174)
++ iterator machinery stages/Batchers.scala:12-140.
+
+In the reference these exist to amortize per-row JNI/HTTP overhead. On TPU, batching is
+what makes the MXU useful at all: a batched column is one jit call. The transformers
+turn an N-row DataFrame into ceil(N/b) rows whose cells are arrays (object columns of
+per-batch arrays), and FlattenBatch undoes it. Estimators that are batch-aware
+(DeepModel) consume these directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Transformer
+
+
+def _batch_column(col: np.ndarray, bounds) -> np.ndarray:
+    out = np.empty(len(bounds) - 1, dtype=object)
+    for i in range(len(bounds) - 1):
+        out[i] = col[bounds[i]:bounds[i + 1]]
+    return out
+
+
+class FixedMiniBatchTransformer(Transformer):
+    """Group rows into fixed-size batches. Reference: MiniBatchTransformer.scala:139.
+
+    `buffered` exists for surface parity (the reference prefetches with a buffer
+    thread); host columns are already materialized here."""
+    batchSize = _p.Param("batchSize", "rows per batch", 10, int)
+    buffered = _p.Param("buffered", "prefetch batches (no-op)", False, bool)
+    maxBufferSize = _p.Param("maxBufferSize", "prefetch buffer cap (no-op)", 2147483647, int)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        b = int(self.get("batchSize"))
+        n = len(df)
+        bounds = list(range(0, n, b)) + [n]
+        out = DataFrame()
+        for name in df.columns:
+            out._cols[name] = _batch_column(df[name], bounds)
+        return out
+
+
+class DynamicMiniBatchTransformer(Transformer):
+    """Reference: MiniBatchTransformer.scala:43 — batches whatever has arrived, up to
+    maxBatchSize. Without a streaming source the whole input is 'available', so this
+    emits one batch capped at maxBatchSize each."""
+    maxBatchSize = _p.Param("maxBatchSize", "max rows per batch", 2147483647, int)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return FixedMiniBatchTransformer(
+            batchSize=min(int(self.get("maxBatchSize")), max(len(df), 1))
+        ).transform(df)
+
+
+class TimeIntervalMiniBatchTransformer(Transformer):
+    """Reference: MiniBatchTransformer.scala:66 — batch rows arriving within a time
+    interval. Batch-mode equivalent: same as Dynamic (all rows are 'within interval');
+    the serving path (mmlspark_tpu.io.serving) does real time-windowed batching."""
+    millisToWait = _p.Param("millisToWait", "interval in ms", 1000, int)
+    maxBatchSize = _p.Param("maxBatchSize", "max rows per batch", 2147483647, int)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return DynamicMiniBatchTransformer(
+            maxBatchSize=self.get("maxBatchSize")).transform(df)
+
+
+class FlattenBatch(Transformer):
+    """Unbatch: explode every object-array cell back into rows.
+
+    Reference: MiniBatchTransformer.scala:174."""
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        if not df.columns:
+            return df
+        first = df[df.columns[0]]
+        lengths = np.fromiter((len(v) for v in first), dtype=np.int64,
+                              count=len(first))
+        out = DataFrame()
+        for name in df.columns:
+            col = df[name]
+            parts = [np.asarray(v) for v in col]
+            if parts:
+                try:
+                    out._cols[name] = np.concatenate(parts, axis=0)
+                except ValueError:  # ragged cells -> object column
+                    flat = np.empty(int(lengths.sum()), dtype=object)
+                    i = 0
+                    for v in col:
+                        for x in v:
+                            flat[i] = x
+                            i += 1
+                    out._cols[name] = flat
+            else:
+                out._cols[name] = np.empty(0)
+        return out

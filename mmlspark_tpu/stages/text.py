@@ -1,0 +1,158 @@
+"""Text utility transformers.
+
+Reference: stages/TextPreprocessor.scala:96 (trie-based normalization, Trie :15),
+stages/UnicodeNormalize.scala, stages/SummarizeData.scala:100.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Transformer
+
+
+class Trie:
+    """Left-to-right longest-match trie. Reference: stages/TextPreprocessor.scala:15."""
+
+    __slots__ = ("children", "value")
+
+    def __init__(self):
+        self.children: Dict[str, "Trie"] = {}
+        self.value: Optional[str] = None
+
+    def put(self, key: str, value: str) -> None:
+        node = self
+        for ch in key:
+            node = node.children.setdefault(ch, Trie())
+        node.value = value
+
+    def map_text(self, text: str) -> str:
+        out = []
+        i, n = 0, len(text)
+        while i < n:
+            node, j, best_end, best_val = self, i, -1, None
+            while j < n and text[j] in node.children:
+                node = node.children[text[j]]
+                j += 1
+                if node.value is not None:
+                    best_end, best_val = j, node.value
+            if best_val is not None:
+                out.append(best_val)
+                i = best_end
+            else:
+                out.append(text[i])
+                i += 1
+        return "".join(out)
+
+
+class TextPreprocessor(Transformer):
+    """Apply a substitution map via longest-match trie scan.
+
+    Reference: stages/TextPreprocessor.scala:96."""
+    inputCol = _p.Param("inputCol", "input text column", "input")
+    outputCol = _p.Param("outputCol", "output text column", "output")
+    map = _p.Param("map", "substring -> replacement map", None, complex=True)
+    normFunc = _p.Param("normFunc", "pre-normalization: lowerCase|identity", "identity")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        trie = Trie()
+        for k, v in (self.get("map") or {}).items():
+            trie.put(k, v)
+        norm = str.lower if self.get("normFunc") == "lowerCase" else (lambda s: s)
+        col = df[self.get("inputCol")]
+        out = np.empty(len(col), dtype=object)
+        for i, text in enumerate(col):
+            out[i] = trie.map_text(norm(str(text)))
+        return df.with_column(self.get("outputCol"), out)
+
+
+class UnicodeNormalize(Transformer):
+    """Unicode normalization (NFC/NFD/NFKC/NFKD) + optional lowercasing.
+
+    Reference: stages/UnicodeNormalize.scala."""
+    inputCol = _p.Param("inputCol", "input text column", "input")
+    outputCol = _p.Param("outputCol", "output text column", "output")
+    form = _p.Param("form", "NFC | NFD | NFKC | NFKD", "NFKD")
+    lower = _p.Param("lower", "lowercase after normalizing", True, bool)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        form = self.get("form").upper()
+        lower = self.get("lower")
+        col = df[self.get("inputCol")]
+        out = np.empty(len(col), dtype=object)
+        for i, text in enumerate(col):
+            s = unicodedata.normalize(form, str(text))
+            out[i] = s.lower() if lower else s
+        return df.with_column(self.get("outputCol"), out)
+
+
+class SummarizeData(Transformer):
+    """Per-column summary statistics DataFrame.
+
+    Reference: stages/SummarizeData.scala:100 — counts / quantiles / sample stats /
+    percentiles per column, toggled by flags."""
+    counts = _p.Param("counts", "emit count/unique/missing", True, bool)
+    basic = _p.Param("basic", "emit min/max/mean/stddev", True, bool)
+    sample = _p.Param("sample", "emit variance/skew/kurtosis", True, bool)
+    percentiles = _p.Param("percentiles", "emit p0.5/1/5/25/50/75/95/99/99.5", True, bool)
+    errorThreshold = _p.Param("errorThreshold", "quantile error (exact here)", 0.0, float)
+
+    _PCTS = [0.5, 1, 5, 25, 50, 75, 95, 99, 99.5]
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        rows: Dict[str, list] = {"Feature": []}
+        want = []
+        if self.get("counts"):
+            want += ["Count", "Unique Value Count", "Missing Value Count"]
+        if self.get("basic"):
+            want += ["Min", "Max", "Mean", "Standard Deviation"]
+        if self.get("sample"):
+            want += ["Sample Variance", "Sample Skewness", "Sample Kurtosis"]
+        if self.get("percentiles"):
+            want += [f"P{p}" for p in self._PCTS]
+        for k in want:
+            rows[k] = []
+        for name in df.columns:
+            col = df[name]
+            if col.ndim > 1 or col.dtype.kind not in "biuf":
+                continue
+            v = np.asarray(col, np.float64)
+            finite = v[np.isfinite(v)]
+            rows["Feature"].append(name)
+            if self.get("counts"):
+                rows["Count"].append(float(len(v)))
+                rows["Unique Value Count"].append(float(len(np.unique(finite))))
+                rows["Missing Value Count"].append(float(len(v) - len(finite)))
+            if self.get("basic"):
+                rows["Min"].append(float(finite.min()) if len(finite) else np.nan)
+                rows["Max"].append(float(finite.max()) if len(finite) else np.nan)
+                rows["Mean"].append(float(finite.mean()) if len(finite) else np.nan)
+                rows["Standard Deviation"].append(
+                    float(finite.std(ddof=1)) if len(finite) > 1 else np.nan)
+            if self.get("sample"):
+                if len(finite) > 2:
+                    m = finite.mean()
+                    d = finite - m
+                    var = d.var(ddof=1) * len(finite) / max(len(finite) - 1, 1)
+                    s2 = d.std(ddof=1)
+                    skew = (np.mean(d ** 3) / s2 ** 3) if s2 > 0 else np.nan
+                    kurt = (np.mean(d ** 4) / s2 ** 4 - 3.0) if s2 > 0 else np.nan
+                else:
+                    var = skew = kurt = np.nan
+                rows["Sample Variance"].append(float(finite.var(ddof=1))
+                                               if len(finite) > 1 else np.nan)
+                rows["Sample Skewness"].append(float(skew))
+                rows["Sample Kurtosis"].append(float(kurt))
+            if self.get("percentiles"):
+                for p in self._PCTS:
+                    rows[f"P{p}"].append(
+                        float(np.percentile(finite, p)) if len(finite) else np.nan)
+        data = {"Feature": np.array(rows["Feature"], dtype=object)}
+        for k in want:
+            data[k] = np.asarray(rows[k], np.float64)
+        return DataFrame(data)

@@ -1,0 +1,132 @@
+"""Murmur3 x86 32-bit hashing — the feature-hashing primitive.
+
+Reference: the reference hashes features in two places — Spark's HashingTF (murmur3)
+used by featurize/text/TextFeaturizer.scala and the VW murmur re-implemented on the
+JVM in vw/VowpalWabbitMurmurWithPrefix.scala:77 (prefix-state optimization). This
+module is the single host-side implementation; mmlspark_tpu.utils.native swaps in the
+C++ batch kernel when the native runtime library is available.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def _mix_k(k1: int) -> int:
+    k1 = (k1 * _C1) & _M32
+    k1 = _rotl32(k1, 15)
+    return (k1 * _C2) & _M32
+
+
+def _mix_blocks(h1: int, data: bytes) -> int:
+    """Mix all whole 4-byte blocks of data into state h1."""
+    for i in range(len(data) // 4):
+        k1 = int.from_bytes(data[i * 4:(i + 1) * 4], "little")
+        h1 ^= _mix_k(k1)
+        h1 = _rotl32(h1, 13)
+        h1 = (h1 * 5 + 0xE6546B64) & _M32
+    return h1
+
+
+def _tail_and_finalize(h1: int, tail: bytes, total_len: int) -> int:
+    """Mix the <4-byte tail and apply murmur3 finalization for total_len bytes."""
+    k1 = 0
+    if len(tail) >= 3:
+        k1 ^= tail[2] << 16
+    if len(tail) >= 2:
+        k1 ^= tail[1] << 8
+    if len(tail) >= 1:
+        k1 ^= tail[0]
+        h1 ^= _mix_k(k1)
+    h1 ^= total_len
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M32
+    h1 ^= h1 >> 16
+    return h1
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """Murmur3 x86_32 over bytes. Matches Spark/Scala murmur3 on the same bytes."""
+    h1 = _mix_blocks(seed & _M32, data)
+    return _tail_and_finalize(h1, data[(len(data) // 4) * 4:], len(data))
+
+
+class MurmurWithPrefix:
+    """Hash strings under a constant prefix without re-hashing the prefix.
+
+    Reference: vw/VowpalWabbitMurmurWithPrefix.scala:77 — precomputes the murmur
+    state for whole 4-byte blocks of the prefix, then finishes with each suffix.
+    Exact same output as murmur3_32(prefix + s)."""
+
+    def __init__(self, prefix: str, seed: int = 0):
+        self.prefix = prefix.encode("utf-8")
+        self.seed = seed
+        nblocks = len(self.prefix) // 4
+        self._state = _mix_blocks(seed & _M32, self.prefix[:nblocks * 4])
+        self._rem = self.prefix[nblocks * 4:]
+
+    def hash(self, s: str) -> int:
+        data = self._rem + s.encode("utf-8")
+        h1 = _mix_blocks(self._state, data)
+        total = len(self.prefix) + len(s.encode("utf-8"))
+        return _tail_and_finalize(h1, data[(len(data) // 4) * 4:], total)
+
+
+def hash_strings(strings: Iterable[str], num_bits: int, seed: int = 0,
+                 ) -> np.ndarray:
+    """Batch-hash strings into [0, 2**num_bits) buckets.
+
+    Uses the native C++ kernel when available (utils/native.py), else pure python."""
+    from . import native
+    mask = (1 << num_bits) - 1
+    lib = native.get_lib()
+    if lib is not None:
+        return native.hash_strings(strings, mask, seed)
+    return np.fromiter(
+        (murmur3_32(s.encode("utf-8"), seed) & mask for s in strings),
+        dtype=np.int64)
+
+
+def hashing_tf(docs: Sequence[Sequence[str]], num_features: int, seed: int = 0,
+               binary: bool = False) -> np.ndarray:
+    """Dense term-frequency matrix by hashed bucket — Spark HashingTF equivalent
+    (used by TextFeaturizer.scala's hashingTF stage). Dense because TPU kernels
+    want dense matrices; num_features defaults are modest (2^18 max)."""
+    from . import native
+    n = len(docs)
+    out = np.zeros((n, num_features), np.float32)
+    pow2 = (num_features & (num_features - 1)) == 0
+    if pow2 and native.get_lib() is not None:
+        # native batch path: hash all terms of all docs in one C++ call
+        flat = [str(t) for doc in docs for t in doc]
+        lengths = [len(doc) for doc in docs]
+        if flat:
+            buckets = native.hash_strings(flat, num_features - 1, seed)
+            rows = np.repeat(np.arange(n), lengths)
+            if binary:
+                out[rows, buckets] = 1.0
+            else:
+                np.add.at(out, (rows, buckets), 1.0)
+        return out
+    mask = num_features - 1 if pow2 else None
+    for i, doc in enumerate(docs):
+        for term in doc:
+            h = murmur3_32(str(term).encode("utf-8"), seed)
+            j = (h & mask) if mask is not None else (h % num_features)
+            if binary:
+                out[i, j] = 1.0
+            else:
+                out[i, j] += 1.0
+    return out

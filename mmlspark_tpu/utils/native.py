@@ -1,0 +1,157 @@
+"""Native runtime loader — builds and loads the C++ host kernels via ctypes.
+
+Reference analogue: core/env/NativeLoader.java:28-100 — the reference extracts
+prebuilt .so files from jar resources and System.load()s them in dependency order.
+Here the artifact is built once from the in-tree source (g++ -O3 -shared) into a
+per-user cache dir and loaded with ctypes; every caller degrades to a numpy fallback
+when the toolchain is unavailable, so the framework never hard-fails on import.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Iterable, Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "native_src", "mmlspark_native.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("MMLSPARK_TPU_CACHE",
+                          os.path.join(tempfile.gettempdir(), "mmlspark_tpu_native"))
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"libmmlspark_{digest}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, OSError, FileNotFoundError):
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Build-on-demand + load. Returns None when native path is unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.mml_hash_strings.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_void_p]
+        lib.mml_bin_matrix.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+        lib.mml_resize_bilinear_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.mml_unroll_chw.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def hash_strings(strings: Iterable[str], mask: int, seed: int = 0) -> np.ndarray:
+    """Batch murmur3 of strings through the C++ kernel."""
+    lib = get_lib()
+    assert lib is not None
+    encoded = [s.encode("utf-8") for s in strings]
+    n = len(encoded)
+    offsets = np.zeros(n + 1, np.int64)
+    for i, b in enumerate(encoded):
+        offsets[i + 1] = offsets[i] + len(b)
+    blob = b"".join(encoded)
+    buf = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+    out = np.zeros(n, np.int64)
+    lib.mml_hash_strings(
+        buf.ctypes.data, offsets.ctypes.data, n, seed, mask, out.ctypes.data)
+    return out
+
+
+def bin_matrix(data: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Bin a dense [n,f] float32 matrix by per-feature edges [f,e]."""
+    lib = get_lib()
+    data = np.ascontiguousarray(data, np.float32)
+    edges = np.ascontiguousarray(edges, np.float64)
+    n, f = data.shape
+    out = np.zeros((n, f), np.int32)
+    if lib is not None:
+        lib.mml_bin_matrix(data.ctypes.data, n, f, edges.ctypes.data,
+                           edges.shape[1], out.ctypes.data)
+        return out
+    for j in range(f):  # numpy fallback
+        out[:, j] = np.searchsorted(edges[j], data[:, j], side="left")
+        out[np.isnan(data[:, j]), j] = 0
+    return out
+
+
+def resize_bilinear_u8(img: np.ndarray, dh: int, dw: int) -> np.ndarray:
+    """Bilinear-resize an HWC uint8 image."""
+    lib = get_lib()
+    img = np.ascontiguousarray(img, np.uint8)
+    sh, sw, c = img.shape
+    if lib is not None:
+        dst = np.zeros((dh, dw, c), np.uint8)
+        lib.mml_resize_bilinear_u8(img.ctypes.data, sh, sw, c,
+                                   dst.ctypes.data, dh, dw)
+        return dst
+    # numpy fallback: gather with bilinear weights
+    ys = np.linspace(0, sh - 1, dh)
+    xs = np.linspace(0, sw - 1, dw)
+    y0 = np.floor(ys).astype(int); y1 = np.minimum(y0 + 1, sh - 1)
+    x0 = np.floor(xs).astype(int); x1 = np.minimum(x0 + 1, sw - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    v00 = img[y0][:, x0]; v01 = img[y0][:, x1]
+    v10 = img[y1][:, x0]; v11 = img[y1][:, x1]
+    v = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+         v10 * wy * (1 - wx) + v11 * wy * wx)
+    return np.clip(np.round(v), 0, 255).astype(np.uint8)
+
+
+def unroll_chw(img: np.ndarray, scale: np.ndarray, shift: np.ndarray) -> np.ndarray:
+    """HWC uint8 -> flat CHW float32 with per-channel normalize."""
+    lib = get_lib()
+    img = np.ascontiguousarray(img, np.uint8)
+    h, w, c = img.shape
+    scale = np.ascontiguousarray(scale, np.float32)
+    shift = np.ascontiguousarray(shift, np.float32)
+    if lib is not None:
+        dst = np.zeros(c * h * w, np.float32)
+        lib.mml_unroll_chw(img.ctypes.data, h, w, c, scale.ctypes.data,
+                           shift.ctypes.data, dst.ctypes.data)
+        return dst
+    chw = img.astype(np.float32).transpose(2, 0, 1)
+    return (chw * scale[:, None, None] + shift[:, None, None]).reshape(-1)

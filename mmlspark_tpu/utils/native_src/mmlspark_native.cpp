@@ -1,0 +1,123 @@
+// Native host runtime kernels for mmlspark_tpu.
+//
+// Reference analogue: the reference embeds C++ engines via JNI (LightGBM, VW, OpenCV;
+// loaded by core/env/NativeLoader.java:28-100). The TPU build keeps compute on the
+// accelerator; the C++ here covers host-side hot paths the reference also did natively:
+//   - murmur3 batch feature hashing (vw/VowpalWabbitMurmurWithPrefix.scala:77 role)
+//   - quantile-bin assignment of dense matrices (LGBM_DatasetCreateFromMat role:
+//     reference lightgbm/LightGBMDataset.scala:12-101 marshals rows into native bins)
+//   - image resize/normalize (opencv/ImageTransformer.scala role)
+// Exposed with a plain C ABI and loaded from Python via ctypes (no pybind11).
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------- murmur3
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16; h *= 0x85ebca6b;
+  h ^= h >> 13; h *= 0xc2b2ae35;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t mml_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51, c2 = 0x1b873593;
+  const uint32_t* blocks = (const uint32_t*)(data);
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, blocks + i, 4);
+    k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2;
+    h1 ^= k1; h1 = rotl32(h1, 13); h1 = h1 * 5 + 0xe6546b64;
+  }
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= tail[2] << 16; [[fallthrough]];
+    case 2: k1 ^= tail[1] << 8;  [[fallthrough]];
+    case 1: k1 ^= tail[0];
+            k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2; h1 ^= k1;
+  }
+  h1 ^= (uint32_t)len;
+  return fmix32(h1);
+}
+
+// Batch-hash n strings (concatenated utf-8 bytes + offsets) into out[i] = h & mask.
+void mml_hash_strings(const uint8_t* bytes, const int64_t* offsets, int64_t n,
+                      uint32_t seed, uint32_t mask, int64_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* s = bytes + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    out[i] = (int64_t)(mml_murmur3_32(s, len, seed) & mask);
+  }
+}
+
+// ------------------------------------------------------- quantile binning
+// Assign each value to a bin via upper-bound binary search over per-feature bin
+// edges. data is row-major [n, f]; edges is [f, num_edges]; out is [n, f] int32.
+void mml_bin_matrix(const float* data, int64_t n, int64_t f,
+                    const double* edges, int64_t num_edges, int32_t* out) {
+  for (int64_t j = 0; j < f; j++) {
+    const double* e = edges + j * num_edges;
+    for (int64_t i = 0; i < n; i++) {
+      float v = data[i * f + j];
+      // NaN -> bin 0 (missing bin), matching host-side binning convention
+      if (std::isnan(v)) { out[i * f + j] = 0; continue; }
+      int32_t lo = 0, hi = (int32_t)num_edges;
+      while (lo < hi) {
+        int32_t mid = (lo + hi) / 2;
+        if ((double)v > e[mid]) lo = mid + 1; else hi = mid;
+      }
+      out[i * f + j] = lo;
+    }
+  }
+}
+
+// ------------------------------------------------------- image kernels
+// Bilinear resize HWC uint8 -> HWC uint8.
+void mml_resize_bilinear_u8(const uint8_t* src, int64_t sh, int64_t sw, int64_t c,
+                            uint8_t* dst, int64_t dh, int64_t dw) {
+  const double ry = dh > 1 ? (double)(sh - 1) / (dh - 1) : 0.0;
+  const double rx = dw > 1 ? (double)(sw - 1) / (dw - 1) : 0.0;
+  for (int64_t y = 0; y < dh; y++) {
+    double fy = y * ry;
+    int64_t y0 = (int64_t)fy;
+    int64_t y1 = std::min(y0 + 1, sh - 1);
+    double wy = fy - y0;
+    for (int64_t x = 0; x < dw; x++) {
+      double fx = x * rx;
+      int64_t x0 = (int64_t)fx;
+      int64_t x1 = std::min(x0 + 1, sw - 1);
+      double wx = fx - x0;
+      for (int64_t k = 0; k < c; k++) {
+        double v00 = src[(y0 * sw + x0) * c + k];
+        double v01 = src[(y0 * sw + x1) * c + k];
+        double v10 = src[(y1 * sw + x0) * c + k];
+        double v11 = src[(y1 * sw + x1) * c + k];
+        double v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                   v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(y * dw + x) * c + k] = (uint8_t)std::lround(std::min(255.0, std::max(0.0, v)));
+      }
+    }
+  }
+}
+
+// HWC uint8 -> CHW float32 unroll with per-channel scale/shift (normalization).
+void mml_unroll_chw(const uint8_t* src, int64_t h, int64_t w, int64_t c,
+                    const float* scale, const float* shift, float* dst) {
+  for (int64_t k = 0; k < c; k++)
+    for (int64_t y = 0; y < h; y++)
+      for (int64_t x = 0; x < w; x++)
+        dst[k * h * w + y * w + x] = src[(y * w + x) * c + k] * scale[k] + shift[k];
+}
+
+}  // extern "C"

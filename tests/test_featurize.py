@@ -1,0 +1,112 @@
+"""featurize/ layer tests (reference suites: featurize/** incl. schema-golden checks)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.featurize import (
+    CleanMissingData, DataConversion, Featurize, IndexToValue, MultiNGram,
+    PageSplitter, TextFeaturizer, ValueIndexer)
+
+
+def test_value_indexer_roundtrip():
+    df = DataFrame({"c": np.array(["b", "a", None, "b"], dtype=object)})
+    model = ValueIndexer(inputCol="c", outputCol="i").fit(df)
+    out = model.transform(df)
+    # missing sorts first (index 0), then ascending levels
+    assert list(out["i"]) == [2, 1, 0, 2]
+    back = IndexToValue(inputCol="i", outputCol="r").transform(out)
+    assert list(back["r"])[:2] == ["b", "a"]
+
+
+def test_clean_missing_data():
+    df = DataFrame({"x": np.array([1.0, np.nan, 3.0]),
+                    "y": np.array([np.nan, 4.0, 6.0])})
+    model = CleanMissingData(inputCols=["x", "y"], outputCols=["x", "y"],
+                             cleaningMode="Mean").fit(df)
+    out = model.transform(df)
+    np.testing.assert_allclose(out["x"], [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(out["y"], [5.0, 4.0, 6.0])
+    med = CleanMissingData(inputCols=["x"], outputCols=["xm"],
+                           cleaningMode="Median").fit(df).transform(df)
+    assert med["xm"][1] == 2.0
+    cust = CleanMissingData(inputCols=["x"], outputCols=["xc"],
+                            cleaningMode="Custom", customValue=-1).fit(df)
+    assert cust.transform(df)["xc"][1] == -1.0
+
+
+def test_data_conversion():
+    df = DataFrame({"x": np.array(["1", "2"], dtype=object)})
+    out = DataConversion(cols=["x"], convertTo="double").transform(df)
+    assert out["x"].dtype == np.float64
+    out2 = DataConversion(cols=["x"], convertTo="string").transform(out)
+    assert out2["x"][0] == "1.0"
+
+
+def test_featurize_mixed_types():
+    df = DataFrame({
+        "num": np.array([1.0, np.nan, 3.0, 4.0]),
+        "txt": np.array(["red", "blue", "red", "green"], dtype=object),
+        "vec": np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]]),
+    })
+    model = Featurize(inputCols=["num", "txt", "vec"], outputCol="features",
+                      numberOfFeatures=16).fit(df)
+    out = model.transform(df)
+    feats = out["features"]
+    assert feats.shape == (4, 1 + 16 + 2)
+    # numeric missing replaced by mean of finite values
+    assert feats[1, 0] == pytest.approx((1 + 3 + 4) / 3)
+    # same string -> same hashed bucket
+    np.testing.assert_array_equal(feats[0, 1:17], feats[2, 1:17])
+    # vector passthrough at the tail
+    np.testing.assert_allclose(feats[:, -2:], df["vec"])
+
+
+def test_featurize_categorical_onehot():
+    df = DataFrame({"c": np.array(["a", "b", "a"], dtype=object)})
+    ind = ValueIndexer(inputCol="c", outputCol="ci").fit(df)
+    dfi = ind.transform(df)
+    model = Featurize(inputCols=["ci"], outputCol="features").fit(dfi)
+    out = model.transform(dfi)
+    assert out["features"].shape == (3, 2)
+    np.testing.assert_allclose(out["features"].sum(axis=1), 1.0)
+
+
+def test_text_featurizer_idf():
+    df = DataFrame({"t": np.array(
+        ["the cat sat", "the dog sat", "a bird flew"], dtype=object)})
+    model = TextFeaturizer(inputCol="t", outputCol="f", numFeatures=64,
+                           useIDF=True).fit(df)
+    out = model.transform(df)
+    assert out["f"].shape == (3, 64)
+    assert out["f"].sum() > 0
+    # identical docs get identical vectors
+    df2 = DataFrame({"t": np.array(["the cat sat", "the cat sat"], dtype=object)})
+    v = model.transform(df2)["f"]
+    np.testing.assert_allclose(v[0], v[1])
+
+
+def test_text_featurizer_ngrams_stopwords():
+    df = DataFrame({"t": np.array(["the quick brown fox"], dtype=object)})
+    m = TextFeaturizer(inputCol="t", outputCol="f", numFeatures=32, useIDF=False,
+                       useStopWordsRemover=True, useNGram=True,
+                       nGramLength=2).fit(df)
+    out = m.transform(df)
+    # "the" dropped -> tokens [quick, brown, fox] -> 2 bigrams
+    assert out["f"].sum() == 2.0
+
+
+def test_multi_ngram():
+    df = DataFrame({"toks": np.array([["a", "b", "c"]], dtype=object)})
+    out = MultiNGram(inputCol="toks", outputCol="n", lengths=[1, 2]).transform(df)
+    assert out["n"][0] == ["a", "b", "c", "a b", "b c"]
+
+
+def test_page_splitter():
+    text = "word " * 200  # 1000 chars
+    df = DataFrame({"t": np.array([text], dtype=object)})
+    out = PageSplitter(inputCol="t", outputCol="p", maxPageLength=300,
+                       minPageLength=100).transform(df)
+    pages = out["p"][0]
+    assert "".join(pages) == text
+    assert all(len(p) <= 300 for p in pages)

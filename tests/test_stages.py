@@ -1,0 +1,160 @@
+"""stages/ utility-transformer tests.
+
+Mirrors the reference suites for the stages package (SURVEY.md §4): each stage gets a
+behavior test; serialization roundtrips are covered by test_fuzzing.py.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame, Pipeline
+from mmlspark_tpu.stages import (
+    Cacher, ClassBalancer, DropColumns, DynamicMiniBatchTransformer,
+    EnsembleByKey, Explode, FixedMiniBatchTransformer, FlattenBatch, Lambda,
+    MultiColumnAdapter, RenameColumn, Repartition, SelectColumns,
+    StratifiedRepartition, SummarizeData, TextPreprocessor,
+    TimeIntervalMiniBatchTransformer, Timer, UDFTransformer, UnicodeNormalize,
+    get_value_at, to_vector)
+
+
+@pytest.fixture
+def df():
+    return DataFrame({
+        "a": np.array([1.0, 2.0, 3.0, 4.0]),
+        "b": np.array([10.0, 20.0, 30.0, 40.0]),
+        "k": np.array(["x", "x", "y", "y"], dtype=object),
+    })
+
+
+def test_drop_select_rename(df):
+    assert DropColumns(cols=["a"]).transform(df).columns == ["b", "k"]
+    assert SelectColumns(cols=["b"]).transform(df).columns == ["b"]
+    out = RenameColumn(inputCol="a", outputCol="z").transform(df)
+    assert "z" in out.columns and "a" not in out.columns
+    assert list(out["z"]) == [1.0, 2.0, 3.0, 4.0]
+
+
+def test_noop_stages(df):
+    assert Repartition(n=2).transform(df) is df
+    assert Cacher().transform(df) is df
+
+
+def test_lambda_and_udf(df):
+    out = Lambda(transformFunc=lambda d: d.with_column("c", d["a"] * 2)).transform(df)
+    assert list(out["c"]) == [2.0, 4.0, 6.0, 8.0]
+    t = UDFTransformer(inputCol="a", outputCol="sq", udf=lambda v: v * v)
+    assert list(t.transform(df)["sq"]) == [1.0, 4.0, 9.0, 16.0]
+    tv = UDFTransformer(inputCols=["a", "b"], outputCol="s",
+                        udf=lambda x, y: x + y, vectorized=True)
+    assert list(tv.transform(df)["s"]) == [11.0, 22.0, 33.0, 44.0]
+
+
+def test_explode():
+    df = DataFrame({"id": np.array([0, 1]),
+                    "vals": np.array([[1, 2], [3, 4]], dtype=np.int64)})
+    out = Explode(inputCol="vals", outputCol="v").transform(df)
+    assert list(out["v"]) == [1, 2, 3, 4]
+    assert list(out["id"]) == [0, 0, 1, 1]
+
+
+def test_ensemble_by_key(df):
+    out = EnsembleByKey(keys=["k"], cols=["a"], colNames=["am"]).transform(df)
+    got = {k: v for k, v in zip(out["k"], out["am"])}
+    assert got == {"x": 1.5, "y": 3.5}
+    # vector column average
+    dfv = DataFrame({"k": np.array(["x", "x"], dtype=object),
+                     "v": np.array([[1.0, 2.0], [3.0, 4.0]])})
+    out = EnsembleByKey(keys=["k"], cols=["v"], colNames=["vm"]).transform(dfv)
+    np.testing.assert_allclose(out["vm"][0], [2.0, 3.0])
+    # broadcast mode keeps row count
+    out = EnsembleByKey(keys=["k"], cols=["a"], colNames=["am"],
+                        collapseGroup=False).transform(df)
+    assert len(out) == 4 and list(out["am"]) == [1.5, 1.5, 3.5, 3.5]
+
+
+def test_class_balancer():
+    df = DataFrame({"label": np.array([0.0, 0.0, 0.0, 1.0])})
+    model = ClassBalancer(inputCol="label").fit(df)
+    w = model.transform(df)["weight"]
+    np.testing.assert_allclose(w, [1.0, 1.0, 1.0, 3.0])
+
+
+def test_stratified_repartition():
+    labels = np.array([0.0] * 8 + [1.0] * 8)
+    df = DataFrame({"label": labels})
+    out = StratifiedRepartition(labelCol="label", seed=3).transform(df)
+    # every contiguous half must contain both labels (shard label-completeness)
+    half = out["label"][:8]
+    assert set(half) == {0.0, 1.0}
+
+
+def test_multi_column_adapter(df):
+    base = UDFTransformer(udf=lambda v: v + 1, vectorized=True)
+    t = MultiColumnAdapter(baseStage=base, inputCols=["a", "b"],
+                           outputCols=["a1", "b1"])
+    out = t.transform(df)
+    assert list(out["a1"]) == [2.0, 3.0, 4.0, 5.0]
+    assert list(out["b1"]) == [11.0, 21.0, 31.0, 41.0]
+
+
+def test_timer(df, capsys):
+    model = Timer(stage=UDFTransformer(inputCol="a", outputCol="o",
+                                       udf=lambda v: v, vectorized=True)).fit(df)
+    out = model.transform(df)
+    assert "o" in out.columns
+    assert "[Timer]" in capsys.readouterr().out
+
+
+def test_batching_roundtrip(df):
+    batched = FixedMiniBatchTransformer(batchSize=3).transform(df)
+    assert len(batched) == 2
+    assert len(batched["a"][0]) == 3 and len(batched["a"][1]) == 1
+    flat = FlattenBatch().transform(batched)
+    assert list(flat["a"]) == list(df["a"])
+    assert list(flat["k"]) == list(df["k"])
+    one = DynamicMiniBatchTransformer().transform(df)
+    assert len(one) == 1
+    tiv = TimeIntervalMiniBatchTransformer(millisToWait=10).transform(df)
+    assert len(FlattenBatch().transform(tiv)) == 4
+
+
+def test_summarize(df):
+    out = SummarizeData().transform(df)
+    row = {f: out[c][0] for f, c in zip(out["Feature"], [])} if False else None
+    feats = list(out["Feature"])
+    assert "a" in feats and "b" in feats and "k" not in feats
+    i = feats.index("a")
+    assert out["Count"][i] == 4.0
+    assert out["Min"][i] == 1.0 and out["Max"][i] == 4.0
+    assert abs(out["Mean"][i] - 2.5) < 1e-9
+    assert abs(out["P50"][i] - 2.5) < 1e-9
+
+
+def test_text_preprocessor():
+    df = DataFrame({"t": np.array(["The happy sad", "jumps ovER"], dtype=object)})
+    t = TextPreprocessor(inputCol="t", outputCol="o", normFunc="lowerCase",
+                         map={"happy": "sad", "sad": "happy", "ov": "under"})
+    out = t.transform(df)
+    assert out["o"][0] == "the sad happy"
+    assert out["o"][1] == "jumps underer"
+
+
+def test_unicode_normalize():
+    df = DataFrame({"t": np.array(["Ça Va Bien"], dtype=object)})
+    out = UnicodeNormalize(inputCol="t", outputCol="o", form="NFKD").transform(df)
+    assert "c" in out["o"][0]  # cedilla decomposed + lowered
+
+
+def test_udfs(df):
+    v = to_vector(np.array([[1, 2], [3, 4]]))
+    assert v.shape == (2, 2)
+    assert list(get_value_at(v, 1)) == [2.0, 4.0]
+
+
+def test_pipeline_of_stages(df):
+    pipe = Pipeline(stages=[
+        Lambda(transformFunc=lambda d: d.with_column("c", d["a"] + d["b"])),
+        DropColumns(cols=["b"]),
+    ])
+    out = pipe.fit(df).transform(df)
+    assert "c" in out.columns and "b" not in out.columns
