@@ -1,0 +1,24 @@
+"""VowpalWabbit-equivalent online linear learners (reference: vw/, 24 files).
+
+The C++ `vw-jni` engine + spanning-tree allreduce are replaced by a jitted
+minibatch SGD program sharded over the device mesh (models/vw/sgd.py)."""
+
+from .base import VowpalWabbitBase, VowpalWabbitBaseModel
+from .classifier import (VowpalWabbitClassifier,
+                         VowpalWabbitClassificationModel,
+                         VowpalWabbitRegressor, VowpalWabbitRegressionModel)
+from .contextual_bandit import (ContextualBanditMetrics,
+                                VowpalWabbitContextualBandit,
+                                VowpalWabbitContextualBanditModel)
+from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .sparse import SparseFeatures
+
+__all__ = [
+    "VowpalWabbitBase", "VowpalWabbitBaseModel",
+    "VowpalWabbitClassifier", "VowpalWabbitClassificationModel",
+    "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
+    "VowpalWabbitContextualBandit", "VowpalWabbitContextualBanditModel",
+    "ContextualBanditMetrics",
+    "VowpalWabbitFeaturizer", "VowpalWabbitInteractions",
+    "SparseFeatures",
+]

@@ -1,0 +1,61 @@
+"""VowpalWabbitClassifier / VowpalWabbitRegressor.
+
+Reference: vw/VowpalWabbitClassifier.scala:23-105 (logistic link, raw/probability
+columns, labels mapped to VW's {-1,+1}) and vw/VowpalWabbitRegressor.scala:1-55.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core import params as _p
+from ...core.dataframe import DataFrame
+from .base import VowpalWabbitBase, VowpalWabbitBaseModel
+
+
+class VowpalWabbitClassifier(VowpalWabbitBase, _p.HasProbabilityCol,
+                             _p.HasRawPredictionCol, _p.HasPredictionCol):
+    _loss = "logistic"
+
+    def _extract(self, df: DataFrame):
+        feats, y, w = super()._extract(df)
+        # 0/1 labels -> VW logistic convention {-1,+1}
+        y = np.where(y > 0.5, 1.0, -1.0).astype(np.float32)
+        return feats, y, w
+
+    def _make_model(self, state, losses, stats):
+        model = VowpalWabbitClassificationModel(state=state, losses=losses,
+                                                stats=stats)
+        for p in ("probabilityCol", "rawPredictionCol", "predictionCol"):
+            model.set(p, self.get(p))
+        return model
+
+
+class VowpalWabbitClassificationModel(VowpalWabbitBaseModel,
+                                      _p.HasProbabilityCol):
+    def transform(self, df: DataFrame) -> DataFrame:
+        margin = self._margin(df)
+        prob1 = 1.0 / (1.0 + np.exp(-margin))
+        probs = np.stack([1.0 - prob1, prob1], axis=1)
+        raws = np.stack([-margin, margin], axis=1)
+        pred = (margin > 0).astype(np.float64)
+        return (df.with_column(self.get("rawPredictionCol"), raws)
+                  .with_column(self.get("probabilityCol"), probs)
+                  .with_column(self.get("predictionCol"), pred))
+
+
+class VowpalWabbitRegressor(VowpalWabbitBase, _p.HasPredictionCol):
+    _loss = "squared"
+
+    def _make_model(self, state, losses, stats):
+        model = VowpalWabbitRegressionModel(state=state, losses=losses,
+                                            stats=stats)
+        model.set("predictionCol", self.get("predictionCol"))
+        return model
+
+
+class VowpalWabbitRegressionModel(VowpalWabbitBaseModel):
+    def transform(self, df: DataFrame) -> DataFrame:
+        margin = self._margin(df)
+        return df.with_column(self.get("predictionCol"),
+                              margin.astype(np.float64))

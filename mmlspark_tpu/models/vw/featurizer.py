@@ -1,0 +1,214 @@
+"""VowpalWabbitFeaturizer + VowpalWabbitInteractions — hashed sparse features.
+
+Reference: vw/VowpalWabbitFeaturizer.scala:22-226 (columns -> hashed SparseVector
+with per-type featurizer dispatch, JVM murmur — no JNI) and the per-type impls in
+vw/featurizer/*.scala (Numeric/String/Boolean/Map/Seq/Vector/StringSplit).
+Namespace-prefix hashing mirrors vw/VowpalWabbitMurmurWithPrefix.scala:77.
+VowpalWabbitInteractions (vw/VowpalWabbitInteractions.scala:89) is the JVM-side
+`-q` quadratic-interaction transformer.
+
+String and split-token columns hash through the batched host path
+(utils/hashing.hash_strings — C++ kernel when available); other object cells
+fall back to per-value python hashing. The resulting fixed-width sparse batch
+feeds the jit SGD engine directly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ...core import params as _p
+from ...core.dataframe import DataFrame
+from ...core.pipeline import Transformer
+from ...utils.hashing import MurmurWithPrefix, hash_strings, murmur3_32
+from .sparse import SparseFeatures
+
+
+class HasNumBits(_p.Params):
+    numBits = _p.Param(
+        "numBits", "log2 of the feature-table size (VW -b); weights table is "
+        "dense in HBM so the practical ceiling is ~24", 18, int)
+
+
+class HasSumCollisions(_p.Params):
+    sumCollisions = _p.Param(
+        "sumCollisions", "sum values of colliding hashes (else last wins)",
+        True, bool)
+
+
+class VowpalWabbitFeaturizer(Transformer, _p.HasInputCols, _p.HasOutputCol,
+                             HasNumBits, HasSumCollisions):
+    seed = _p.Param("seed", "murmur hash seed", 0, int)
+    stringSplitInputCols = _p.Param(
+        "stringSplitInputCols",
+        "string columns split on whitespace into multiple hashed tokens", None)
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "features")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        cols = list(self.get("inputCols") or [])
+        split_cols = list(self.get("stringSplitInputCols") or [])
+        num_bits = self.get("numBits")
+        mask = (1 << num_bits) - 1
+        seed = self.get("seed")
+        n = len(df)
+        rows: List[Tuple[List[int], List[float]]] = [([], []) for _ in range(n)]
+
+        for name in cols + split_cols:
+            col = df[name]
+            hasher = MurmurWithPrefix(name, seed)
+            if name in split_cols:
+                # batch path: one native hash_strings call for all tokens
+                toks, owners = [], []
+                for i in range(n):
+                    v = col[i]
+                    if v is None:
+                        continue
+                    for tok in str(v).split():
+                        toks.append(name + tok)
+                        owners.append(i)
+                if toks:
+                    buckets = hash_strings(toks, num_bits, seed)
+                    for i, b in zip(owners, buckets):
+                        rows[i][0].append(int(b))
+                        rows[i][1].append(1.0)
+            elif col.dtype == object and len(col) and isinstance(
+                    next((v for v in col if v is not None), None), str):
+                # plain string column: batch-hash name+value
+                live = [i for i in range(n) if col[i] is not None]
+                buckets = hash_strings([name + col[i] for i in live],
+                                       num_bits, seed)
+                for i, b in zip(live, buckets):
+                    rows[i][0].append(int(b))
+                    rows[i][1].append(1.0)
+            elif col.dtype == object:
+                for i in range(n):
+                    self._featurize_obj(rows[i], col[i], name, hasher, mask,
+                                        seed)
+            elif col.dtype.kind in "fiu":
+                if col.ndim == 2:  # dense vector column: index by position
+                    base = [murmur3_32(f"{name}_{j}".encode(), seed) & mask
+                            for j in range(col.shape[1])]
+                    for i in range(n):
+                        for j, v in enumerate(col[i]):
+                            if v != 0.0:
+                                rows[i][0].append(base[j])
+                                rows[i][1].append(float(v))
+                else:  # numeric scalar: one slot per column, value = number
+                    h = murmur3_32(name.encode(), seed) & mask
+                    for i in range(n):
+                        v = float(col[i])
+                        if v != 0.0:
+                            rows[i][0].append(h)
+                            rows[i][1].append(v)
+            elif col.dtype.kind == "b":
+                h = murmur3_32(name.encode(), seed) & mask
+                for i in range(n):
+                    if col[i]:
+                        rows[i][0].append(h)
+                        rows[i][1].append(1.0)
+            else:
+                raise TypeError(f"unsupported column dtype {col.dtype} "
+                                f"for {name!r}")
+
+        packed = self._pack(rows, mask + 1)
+        return df.with_column(self.get("outputCol"), packed.to_object_column(),
+                              metadata={"numFeatures": mask + 1,
+                                        "sparse": True})
+
+    @staticmethod
+    def _featurize_obj(row, value, name, hasher: MurmurWithPrefix, mask: int,
+                       seed: int) -> None:
+        """Per-type dispatch for object cells (vw/featurizer/*.scala)."""
+        if value is None:
+            return
+        if isinstance(value, str):
+            row[0].append(hasher.hash(value) & mask)
+            row[1].append(1.0)
+        elif isinstance(value, dict):
+            for k, v in value.items():
+                if isinstance(v, str):
+                    row[0].append(hasher.hash(f"{k}{v}") & mask)
+                    row[1].append(1.0)
+                else:
+                    row[0].append(hasher.hash(str(k)) & mask)
+                    row[1].append(float(v))
+        elif isinstance(value, (list, tuple, np.ndarray)):
+            for pos, item in enumerate(value):
+                if isinstance(item, str):
+                    row[0].append(hasher.hash(item) & mask)
+                    row[1].append(1.0)
+                else:  # numeric sequence: slot keyed by position in the seq
+                    row[0].append(hasher.hash(str(pos)) & mask)
+                    row[1].append(float(item))
+        elif isinstance(value, (bool, np.bool_)):
+            if value:
+                row[0].append(hasher.hash("") & mask)
+                row[1].append(1.0)
+        else:
+            row[0].append(hasher.hash("") & mask)
+            row[1].append(float(value))
+
+    def _pack(self, rows, num_features: int) -> SparseFeatures:
+        sum_collisions = self.get("sumCollisions")
+        out = []
+        for idx, val in rows:
+            idx_a = np.asarray(idx, np.int64)
+            val_a = np.asarray(val, np.float32)
+            if len(idx_a) > 1:
+                uniq, inv = np.unique(idx_a, return_inverse=True)
+                if len(uniq) < len(idx_a):
+                    merged = np.zeros(len(uniq), np.float32)
+                    if sum_collisions:
+                        np.add.at(merged, inv, val_a)
+                    else:
+                        merged[inv] = val_a
+                    idx_a, val_a = uniq, merged
+            out.append((idx_a, val_a))
+        return SparseFeatures.from_rows(out, num_features)
+
+
+class VowpalWabbitInteractions(Transformer, _p.HasInputCols, _p.HasOutputCol,
+                               HasNumBits, HasSumCollisions):
+    """Quadratic (and higher) feature interactions — VW `-q` done host-side.
+
+    Reference: vw/VowpalWabbitInteractions.scala:89 — for N input (hashed sparse)
+    columns, emit the outer product of their features: combined hash, multiplied
+    values. Input columns must be VowpalWabbitFeaturizer outputs (or dense)."""
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "interactions")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        cols = list(self.get("inputCols") or [])
+        if len(cols) < 2:
+            raise ValueError("interactions need >= 2 input columns")
+        num_bits = self.get("numBits")
+        mask = (1 << num_bits) - 1
+        feats = [SparseFeatures.from_column(df[c]) for c in cols]
+        n = len(df)
+        rows = []
+        for i in range(n):
+            idx = feats[0].indices[i].astype(np.int64)
+            val = feats[0].values[i].astype(np.float64)
+            live = val != 0.0
+            idx, val = idx[live], val[live]
+            for f in feats[1:]:
+                j_idx = f.indices[i].astype(np.int64)
+                j_val = f.values[i].astype(np.float64)
+                jl = j_val != 0.0
+                j_idx, j_val = j_idx[jl], j_val[jl]
+                # FNV-1a-style combine of the two hashed indices (VW interact())
+                idx = ((idx[:, None] * 0x01000193 ^ j_idx[None, :]) & mask
+                       ).reshape(-1)
+                val = (val[:, None] * j_val[None, :]).reshape(-1)
+            rows.append((idx, val.astype(np.float32)))
+        packed = SparseFeatures.from_rows(rows, mask + 1)
+        return df.with_column(self.get("outputCol"), packed.to_object_column(),
+                              metadata={"numFeatures": mask + 1,
+                                        "sparse": True})

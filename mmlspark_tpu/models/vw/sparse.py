@@ -1,0 +1,98 @@
+"""Fixed-width sparse feature batches for the VW-equivalent learners.
+
+The reference marshals each row into a native ``VowpalWabbitExample`` (sparse
+index/value pairs per namespace — vw/VowpalWabbitBase.scala:235-266,
+vw/VectorUtils.scala). A TPU kernel wants static shapes, so the batch layout here
+is a padded COO pair ``(indices[n,k], values[n,k])`` with k = max nnz per row.
+Padding slots carry ``(index=0, value=0.0)``: a zero value contributes nothing to
+either the dot product or the gradient scatter, so no mask is needed in the kernel
+(the masking discipline of SURVEY.md §7 "empty/skewed shards").
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+class SparseFeatures:
+    """A batch of hashed sparse feature rows with a fixed per-row width."""
+
+    __slots__ = ("indices", "values", "num_features")
+
+    def __init__(self, indices: np.ndarray, values: np.ndarray,
+                 num_features: int):
+        assert indices.shape == values.shape and indices.ndim == 2
+        self.indices = np.ascontiguousarray(indices, np.int32)
+        self.values = np.ascontiguousarray(values, np.float32)
+        self.num_features = int(num_features)
+
+    def __len__(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.indices.shape[1]
+
+    def take(self, idx: np.ndarray) -> "SparseFeatures":
+        return SparseFeatures(self.indices[idx], self.values[idx],
+                              self.num_features)
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros((len(self), self.num_features), np.float32)
+        rows = np.repeat(np.arange(len(self)), self.width)
+        np.add.at(out, (rows, self.indices.ravel()), self.values.ravel())
+        return out
+
+    @staticmethod
+    def from_rows(rows: Sequence[Tuple[np.ndarray, np.ndarray]],
+                  num_features: int, min_width: int = 1) -> "SparseFeatures":
+        """Pack per-row (indices, values) pairs into a padded batch.
+
+        Duplicate indices within one row are kept as-is — the dot product and
+        the scatter-add both sum duplicates, matching hash-collision-by-sum
+        semantics (vw featurizer sums colliding features when sumCollisions)."""
+        k = max(min_width, max((len(i) for i, _ in rows), default=1))
+        n = len(rows)
+        indices = np.zeros((n, k), np.int32)
+        values = np.zeros((n, k), np.float32)
+        for r, (idx, val) in enumerate(rows):
+            m = len(idx)
+            indices[r, :m] = idx
+            values[r, :m] = val
+        return SparseFeatures(indices, values, num_features)
+
+    @staticmethod
+    def from_dense(x: np.ndarray, num_features: int = 0) -> "SparseFeatures":
+        """Dense matrix -> trivially sparse batch (indices = column ids)."""
+        x = np.asarray(x, np.float32)
+        n, f = x.shape
+        indices = np.broadcast_to(np.arange(f, dtype=np.int32), (n, f))
+        return SparseFeatures(indices.copy(), x, max(num_features, f))
+
+    def to_object_column(self) -> np.ndarray:
+        """Store in a DataFrame as an object column of (indices, values) pairs."""
+        out = np.empty(len(self), dtype=object)
+        for i in range(len(self)):
+            out[i] = (self.indices[i], self.values[i])
+        return out
+
+    @staticmethod
+    def from_column(col: np.ndarray, num_features: int = 0) -> "SparseFeatures":
+        """Accept either a dense 2-D float column or an object column of
+        (indices, values) pairs (as produced by VowpalWabbitFeaturizer)."""
+        if col.dtype != object:
+            arr = np.asarray(col, np.float32)
+            if arr.ndim != 2:
+                arr = arr.reshape(len(arr), -1)
+            return SparseFeatures.from_dense(arr, num_features)
+        rows: List[Tuple[np.ndarray, np.ndarray]] = []
+        nf = num_features
+        for item in col:
+            idx, val = item
+            idx = np.asarray(idx, np.int64)
+            rows.append((idx, np.asarray(val, np.float32)))
+            if idx.size:
+                nf = max(nf, int(idx.max()) + 1)
+        return SparseFeatures.from_rows(rows, nf)
