@@ -1,0 +1,13 @@
+"""AutoML layer (reference: automl/, 6 files, 758 LoC)."""
+
+from .hyperparams import (DefaultHyperparams, DiscreteHyperParam, GridSpace,
+                          HyperparamBuilder, RandomSpace, RangeHyperParam)
+from .tune import (EvaluationUtils, FindBestModel, FindBestModelModel,
+                   TuneHyperparameters, TuneHyperparametersModel)
+
+__all__ = [
+    "DiscreteHyperParam", "RangeHyperParam", "HyperparamBuilder",
+    "GridSpace", "RandomSpace", "DefaultHyperparams",
+    "TuneHyperparameters", "TuneHyperparametersModel",
+    "FindBestModel", "FindBestModelModel", "EvaluationUtils",
+]

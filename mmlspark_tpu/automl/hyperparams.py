@@ -1,0 +1,130 @@
+"""Hyperparameter distributions + search spaces.
+
+Reference: automl/HyperparamBuilder.scala:11-100 (`DiscreteHyperParam`,
+`RangeHyperParam`, `HyperparamBuilder`), automl/ParamSpace.scala (GridSpace /
+RandomSpace), automl/DefaultHyperparams.scala:13 (canonical per-learner ranges).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+
+class HyperParam:
+    def values_for_grid(self, n: int) -> List[Any]:
+        raise NotImplementedError
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+
+class DiscreteHyperParam(HyperParam):
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def values_for_grid(self, n: int) -> List[Any]:
+        return list(self.values)
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        return self.values[int(rng.integers(len(self.values)))]
+
+
+class RangeHyperParam(HyperParam):
+    def __init__(self, low, high, is_log: bool = False):
+        self.low, self.high, self.is_log = low, high, is_log
+        self.is_int = isinstance(low, (int, np.integer)) and isinstance(
+            high, (int, np.integer))
+
+    def values_for_grid(self, n: int) -> List[Any]:
+        if self.is_log:
+            vals = np.logspace(np.log10(self.low), np.log10(self.high), n)
+        else:
+            vals = np.linspace(self.low, self.high, n)
+        if self.is_int:
+            vals = sorted(set(int(round(v)) for v in vals))
+        return [v.item() if hasattr(v, "item") else v for v in vals]
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        if self.is_log:
+            v = 10 ** rng.uniform(np.log10(self.low), np.log10(self.high))
+        else:
+            v = rng.uniform(self.low, self.high)
+        return int(round(v)) if self.is_int else float(v)
+
+
+class HyperparamBuilder:
+    """Accumulate (estimator, paramName) -> HyperParam entries
+    (HyperparamBuilder.scala:97)."""
+
+    def __init__(self):
+        self._entries: List[Tuple[Any, str, HyperParam]] = []
+
+    def add_hyperparam(self, est, param_name: str,
+                       dist: HyperParam) -> "HyperparamBuilder":
+        self._entries.append((est, param_name, dist))
+        return self
+
+    addHyperparam = add_hyperparam
+
+    def build(self) -> List[Tuple[Any, str, HyperParam]]:
+        return list(self._entries)
+
+
+class ParamSpace:
+    def param_maps(self) -> Iterator[List[Tuple[Any, str, Any]]]:
+        raise NotImplementedError
+
+
+class GridSpace(ParamSpace):
+    """Cartesian product over per-param grids."""
+
+    def __init__(self, entries: List[Tuple[Any, str, HyperParam]],
+                 grid_size: int = 5):
+        self.entries = entries
+        self.grid_size = grid_size
+
+    def param_maps(self):
+        grids = [d.values_for_grid(self.grid_size) for _, _, d in self.entries]
+        for combo in itertools.product(*grids):
+            yield [(est, name, v) for (est, name, _), v in
+                   zip(self.entries, combo)]
+
+
+class RandomSpace(ParamSpace):
+    """Random sampling (the reference's default search mode)."""
+
+    def __init__(self, entries: List[Tuple[Any, str, HyperParam]],
+                 seed: int = 0):
+        self.entries = entries
+        self.seed = seed
+
+    def param_maps(self):
+        rng = np.random.default_rng(self.seed)
+        while True:
+            yield [(est, name, d.sample(rng)) for est, name, d in self.entries]
+
+
+class DefaultHyperparams:
+    """Canonical search ranges per learner (DefaultHyperparams.scala:13)."""
+
+    @staticmethod
+    def for_learner(est) -> List[Tuple[Any, str, HyperParam]]:
+        name = type(est).__name__
+        if "LogisticRegression" in name:
+            return [(est, "regParam", RangeHyperParam(1e-4, 1.0, is_log=True)),
+                    (est, "maxIter", DiscreteHyperParam([100, 200]))]
+        if "LightGBM" in name:
+            return [(est, "numLeaves", DiscreteHyperParam([15, 31, 63])),
+                    (est, "learningRate",
+                     RangeHyperParam(0.02, 0.3, is_log=True)),
+                    (est, "numIterations", DiscreteHyperParam([50, 100]))]
+        if "VowpalWabbit" in name:
+            return [(est, "learningRate",
+                     RangeHyperParam(0.05, 2.0, is_log=True)),
+                    (est, "numPasses", DiscreteHyperParam([1, 5, 10]))]
+        if "LinearRegression" in name:
+            return [(est, "regParam", RangeHyperParam(1e-4, 1.0, is_log=True))]
+        return []

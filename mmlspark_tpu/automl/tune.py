@@ -1,0 +1,222 @@
+"""TuneHyperparameters / FindBestModel — model search + selection.
+
+Reference: automl/TuneHyperparameters.scala:37-235 (random/grid search, k-fold
+CV, threaded parallelism via HasParallelism futures, best-model refit),
+automl/FindBestModel.scala:55-199 (evaluate N fitted models on one dataset),
+automl/EvaluationUtils.scala:15 (metric dispatch per estimator type).
+
+Thread-parallel model search survives in the TPU build: independent fits are
+dispatched on a thread pool (each fit is its own compiled XLA program; the
+runtime serializes device access, threads overlap host-side work) — the
+analogue of HasParallelismInjected.getExecutionContextProxy.
+"""
+
+from __future__ import annotations
+
+import itertools
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model, Transformer
+from ..train.compute_statistics import _detect_scored_cols
+from ..train.metrics import (MetricConstants, auc_score,
+                             classification_metrics, index_label_pred,
+                             multiclass_metrics, regression_metrics)
+from .hyperparams import GridSpace, ParamSpace, RandomSpace
+
+
+class EvaluationUtils:
+    """Metric dispatch (EvaluationUtils.scala:15). Larger-is-better unless the
+    metric is an error metric."""
+
+    LOWER_IS_BETTER = {MetricConstants.MSE, MetricConstants.RMSE,
+                       MetricConstants.MAE, "l2"}
+
+    @staticmethod
+    def default_metric(est) -> str:
+        name = type(est).__name__
+        if "Regress" in name or "Regressor" in name:
+            return MetricConstants.RMSE
+        return MetricConstants.ACCURACY
+
+    @staticmethod
+    def compute(metric: str, df: DataFrame, label_col: str) -> float:
+        pred_col, prob_col = _detect_scored_cols(df)
+        if metric in (MetricConstants.MSE, MetricConstants.RMSE,
+                      MetricConstants.R2, MetricConstants.MAE, "l2"):
+            labels = np.asarray(df[label_col], np.float64)
+            preds = np.asarray(df[pred_col if pred_col else "scores"],
+                               np.float64)
+            r = regression_metrics(labels, preds)
+            return r["mse" if metric == "l2" else metric]
+        labels, preds = index_label_pred(df[label_col], df[pred_col])
+        num_class = int(max(labels.max(), preds.max())) + 1
+        if metric == MetricConstants.AUC:
+            probs = np.asarray(df[prob_col], np.float64)
+            scores = probs[:, 1] if probs.ndim == 2 else probs
+            return auc_score(labels, scores)
+        if num_class <= 2:
+            return classification_metrics(labels, preds)[metric]
+        return multiclass_metrics(labels, preds, num_class)[metric]
+
+
+def _best_index(metrics: Sequence[float], larger_better: bool) -> int:
+    """Index of the best FINITE metric (NaN candidates — e.g. AUC on a
+    single-class fold — are never selected)."""
+    vals = np.asarray(metrics, np.float64)
+    finite = np.isfinite(vals)
+    if not finite.any():
+        raise ValueError(f"all candidate metrics are non-finite: {metrics}")
+    vals = np.where(finite, vals, -np.inf if larger_better else np.inf)
+    return int(vals.argmax() if larger_better else vals.argmin())
+
+
+def _kfold_indices(n: int, k: int, seed: int) -> List[Tuple[np.ndarray,
+                                                            np.ndarray]]:
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    folds = np.array_split(perm, k)
+    out = []
+    for i in range(k):
+        test = np.sort(folds[i])
+        train = np.sort(np.concatenate([folds[j] for j in range(k) if j != i]))
+        out.append((train, test))
+    return out
+
+
+class TuneHyperparameters(Estimator, _p.HasLabelCol, _p.HasSeed):
+    """Search (estimator x paramMap) candidates by k-fold CV; refit the best.
+
+    Reference: automl/TuneHyperparameters.scala:37-235."""
+
+    models = _p.Param("models", "candidate estimators", None, complex=True)
+    paramSpace = _p.Param("paramSpace", "ParamSpace of hyperparam maps", None,
+                          complex=True)
+    evaluationMetric = _p.Param("evaluationMetric",
+                                "metric name (EvaluationUtils)", None)
+    numFolds = _p.Param("numFolds", "cross-validation folds", 3, int)
+    numRuns = _p.Param("numRuns", "candidates drawn from the space", 10, int)
+    parallelism = _p.Param("parallelism", "concurrent fits", 4, int)
+
+    def __init__(self, models: Optional[Sequence[Estimator]] = None, **kw):
+        super().__init__(**kw)
+        if models is not None:
+            self.set("models", list(models))
+
+    def _fit(self, df: DataFrame) -> "TuneHyperparametersModel":
+        models: List[Estimator] = self.get("models")
+        space: Optional[ParamSpace] = self.get("paramSpace")
+        label_col = self.get("labelCol")
+        metric = (self.get("evaluationMetric")
+                  or EvaluationUtils.default_metric(models[0]))
+        larger_better = metric not in EvaluationUtils.LOWER_IS_BETTER
+        k = self.get("numFolds")
+        folds = _kfold_indices(len(df), k, self.get("seed"))
+
+        # candidate list: estimator x paramMap
+        candidates: List[Tuple[Estimator, dict]] = []
+        if space is None:
+            candidates = [(m, {}) for m in models]
+        else:
+            maps = itertools.islice(space.param_maps(), self.get("numRuns"))
+            for pm in maps:
+                by_est: dict = {}
+                for est, name, value in pm:
+                    by_est.setdefault(id(est), (est, {}))[1][name] = value
+                for est, overrides in by_est.values():
+                    candidates.append((est, overrides))
+
+        def evaluate(cand: Tuple[Estimator, dict]) -> float:
+            est, overrides = cand
+            vals = []
+            for train_idx, test_idx in folds:
+                model = est.copy(overrides).fit(df.take(train_idx))
+                scored = model.transform(df.take(test_idx))
+                vals.append(EvaluationUtils.compute(
+                    metric, scored, label_col))
+            return float(np.mean(vals))
+
+        with ThreadPoolExecutor(max_workers=self.get("parallelism")) as ex:
+            metrics = list(ex.map(evaluate, candidates))
+
+        best_i = _best_index(metrics, larger_better)
+        best_est, best_overrides = candidates[best_i]
+        best_model = best_est.copy(best_overrides).fit(df)
+        out = TuneHyperparametersModel(best_model=best_model,
+                                       best_metric=float(metrics[best_i]))
+        out._all_metrics = [float(m) for m in metrics]
+        out._best_params = dict(best_overrides)
+        out.set("labelCol", label_col)
+        return out
+
+
+class TuneHyperparametersModel(Model, _p.HasLabelCol):
+    bestModel = _p.Param("bestModel", "refit best model", None, complex=True)
+    bestMetric = _p.Param("bestMetric", "CV metric of the best candidate",
+                          0.0, float)
+
+    def __init__(self, best_model: Optional[Transformer] = None,
+                 best_metric: float = 0.0, **kw):
+        super().__init__(**kw)
+        self._all_metrics: List[float] = []
+        self._best_params: dict = {}
+        if best_model is not None:
+            self._set(bestModel=best_model, bestMetric=best_metric)
+
+    def get_best_model_info(self) -> str:
+        return f"params={self._best_params} metric={self.get('bestMetric')}"
+
+    getBestModelInfo = get_best_model_info
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self.get("bestModel").transform(df)
+
+
+class FindBestModel(Estimator, _p.HasLabelCol):
+    """Evaluate already-fitted models on one dataset; keep the best.
+
+    Reference: automl/FindBestModel.scala:55-199."""
+
+    models = _p.Param("models", "fitted candidate models", None, complex=True)
+    evaluationMetric = _p.Param("evaluationMetric", "metric name", None)
+
+    def __init__(self, models: Optional[Sequence[Transformer]] = None, **kw):
+        super().__init__(**kw)
+        if models is not None:
+            self.set("models", list(models))
+
+    def _fit(self, df: DataFrame) -> "FindBestModelModel":
+        models: List[Transformer] = self.get("models")
+        metric = (self.get("evaluationMetric")
+                  or EvaluationUtils.default_metric(models[0]))
+        larger_better = metric not in EvaluationUtils.LOWER_IS_BETTER
+        label_col = self.get("labelCol")
+        vals = []
+        for m in models:
+            scored = m.transform(df)
+            vals.append(EvaluationUtils.compute(metric, scored, label_col))
+        best_i = _best_index(vals, larger_better)
+        out = FindBestModelModel(best_model=models[best_i],
+                                 best_metric=float(vals[best_i]))
+        out._all_metrics = [float(v) for v in vals]
+        out.set("labelCol", label_col)
+        return out
+
+
+class FindBestModelModel(Model, _p.HasLabelCol):
+    bestModel = _p.Param("bestModel", "winning model", None, complex=True)
+    bestMetric = _p.Param("bestMetric", "its metric", 0.0, float)
+
+    def __init__(self, best_model: Optional[Transformer] = None,
+                 best_metric: float = 0.0, **kw):
+        super().__init__(**kw)
+        self._all_metrics: List[float] = []
+        if best_model is not None:
+            self._set(bestModel=best_model, bestMetric=best_metric)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self.get("bestModel").transform(df)

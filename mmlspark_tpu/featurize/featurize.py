@@ -21,6 +21,10 @@ from ..utils.hashing import hash_strings
 from .indexers import CATEGORICAL_META_KEY
 
 ONE_HOT_MAX = 64  # above this many levels, hash instead of one-hot
+# dense hashed output is capped at 2^14 columns per string column: the
+# reference's 2^18 default exists for SPARSE vectors (JVM memory pressure);
+# a dense TPU feature tile at 2^18 x rows would be HBM-hostile
+HASH_BITS_CAP = 14
 
 
 class Featurize(Estimator):
@@ -31,8 +35,9 @@ class Featurize(Estimator):
     outputCol = _p.Param("outputCol", "assembled features column", "features")
     numberOfFeatures = _p.Param(
         "numberOfFeatures",
-        "hash-space bits for string columns (2^18 default, 2^12 for trees — "
-        "Featurize.scala:17-20)", 1 << 18, int)
+        "hash-space size for high-cardinality string columns (2^18 default, "
+        "2^12 for trees — Featurize.scala:17-20); dense output caps the "
+        "effective width at 2^14 per column (HASH_BITS_CAP)", 1 << 18, int)
     oneHotEncodeCategoricals = _p.Param(
         "oneHotEncodeCategoricals", "one-hot metadata categoricals", True, bool)
     allowImages = _p.Param("allowImages", "featurize image columns", False, bool)
@@ -51,8 +56,18 @@ class Featurize(Estimator):
             if col.ndim == 2:
                 plan.append({"col": name, "kind": "vector", "n": col.shape[1]})
             elif col.dtype == object and len(col) and isinstance(col[0], str):
+                # low-cardinality strings: one-hot over observed levels beats
+                # hashing (the reference hashes into a 2^18 SPARSE vector —
+                # AssembleFeatures.scala:96-462; dense TPU tiles want narrow).
+                # Missing values encode as the all-zeros row.
+                levels = sorted({v for v in col.tolist()
+                                 if isinstance(v, str)})
+                if len(levels) <= ONE_HOT_MAX:
+                    plan.append({"col": name, "kind": "levels",
+                                 "levels": levels, "n": len(levels)})
+                    continue
                 nf = int(self.get("numberOfFeatures"))
-                bits = max(1, int(np.log2(nf)))
+                bits = min(max(1, int(np.log2(nf))), HASH_BITS_CAP)
                 plan.append({"col": name, "kind": "hash", "bits": bits,
                              "n": 1 << bits})
             else:
@@ -91,6 +106,16 @@ class FeaturizeModel(Model):
                 out = np.zeros((n, spec["n"]), np.float32)
                 valid = (idx >= 0) & (idx < spec["n"])
                 out[np.flatnonzero(valid), idx[valid]] = 1.0
+                parts.append(out)
+            elif kind == "levels":
+                levels = np.asarray(spec["levels"], dtype=object)
+                strs = np.array([v if isinstance(v, str) else "" for v in col],
+                                dtype=object)
+                j = np.searchsorted(levels.astype(str), strs.astype(str))
+                j = np.clip(j, 0, len(levels) - 1)
+                valid = levels[j] == strs  # unseen/missing -> all-zeros row
+                out = np.zeros((n, spec["n"]), np.float32)
+                out[np.flatnonzero(valid), j[valid].astype(np.int64)] = 1.0
                 parts.append(out)
             elif kind == "hash":
                 buckets = hash_strings([str(s) for s in col], spec["bits"])

@@ -1,0 +1,11 @@
+"""Classic learners — TPU-jit equivalents of the SparkML algorithms the
+reference wraps through TrainClassifier/TrainRegressor (train/TrainClassifier.scala:53-374:
+LogisticRegression, DecisionTree/RandomForest/GBT, LinearRegression...).
+Tree-family learners map onto the GBDT engine (models/lightgbm); the linear
+family is here."""
+
+from .linear import (LinearRegression, LinearRegressionModel,
+                     LogisticRegression, LogisticRegressionModel)
+
+__all__ = ["LogisticRegression", "LogisticRegressionModel",
+           "LinearRegression", "LinearRegressionModel"]

@@ -1,0 +1,15 @@
+"""train/ layer — auto-featurizing trainers + model statistics
+(reference: train/, 6 files, 1232 LoC)."""
+
+from .compute_statistics import (ComputeModelStatistics,
+                                 ComputePerInstanceStatistics)
+from .metrics import MetricConstants
+from .trainers import (TrainClassifier, TrainedClassifierModel,
+                       TrainedRegressorModel, TrainRegressor)
+
+__all__ = [
+    "TrainClassifier", "TrainedClassifierModel",
+    "TrainRegressor", "TrainedRegressorModel",
+    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "MetricConstants",
+]

@@ -1,0 +1,108 @@
+"""ComputeModelStatistics / ComputePerInstanceStatistics.
+
+Reference: train/ComputeModelStatistics.scala:56-400 — confusion matrix,
+accuracy/precision/recall, AUC (binary), macro/micro multiclass metrics,
+regression MSE/RMSE/R2/MAE — emitted as a one-row metrics DataFrame; and
+train/ComputePerInstanceStatistics.scala:42 — per-row log-loss / squared error.
+Column-name conventions follow the scored-DataFrame convention of
+core/schema/SparkSchema.scala (scores / scored_probabilities / scored_labels).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Transformer
+from .metrics import (MetricConstants, classification_metrics,
+                      confusion_matrix, index_label_pred, multiclass_metrics,
+                      regression_metrics)
+
+
+def _detect_scored_cols(df: DataFrame):
+    """Find scored columns by convention (SparkSchema.scala score-column
+    metadata): scored_labels/prediction, scored_probabilities/probability."""
+    pred = next((c for c in ("scored_labels", "prediction") if c in df), None)
+    prob = next((c for c in ("scored_probabilities", "probability")
+                 if c in df), None)
+    return pred, prob
+
+
+class ComputeModelStatistics(Transformer, _p.HasLabelCol):
+    evaluationMetric = _p.Param(
+        "evaluationMetric",
+        "classification | regression | all (auto-detected when unset)", "all")
+    scoredLabelsCol = _p.Param("scoredLabelsCol",
+                               "predicted label column", None)
+    scoresCol = _p.Param("scoresCol", "raw score / probability column", None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        pred_col, prob_col = _detect_scored_cols(df)
+        if self.get("scoredLabelsCol"):
+            pred_col = self.get("scoredLabelsCol")
+        if self.get("scoresCol"):
+            prob_col = self.get("scoresCol")
+        if pred_col is None:
+            raise ValueError("no prediction column found "
+                             "(scored_labels/prediction)")
+        labels, preds = index_label_pred(df[self.get("labelCol")],
+                                         df[pred_col])
+
+        kind = self.get("evaluationMetric")
+        if kind in ("all", None):
+            is_int = np.allclose(labels, np.round(labels))
+            kind = ("classification"
+                    if is_int and len(np.unique(labels)) <= 20
+                    else "regression")
+
+        if kind == "regression":
+            return DataFrame({k: np.array([v]) for k, v in
+                              regression_metrics(labels, preds).items()})
+
+        num_class = int(max(labels.max(), preds.max())) + 1
+        if num_class <= 2:
+            scores = None
+            if prob_col is not None:
+                probs = df[prob_col]
+                scores = (np.asarray(probs, np.float64)[:, 1]
+                          if np.asarray(probs).ndim == 2 else
+                          np.asarray(probs, np.float64))
+            m = classification_metrics(labels, preds, scores)
+        else:
+            m = multiclass_metrics(labels, preds, num_class)
+        cm = confusion_matrix(labels.astype(np.int64),
+                              preds.astype(np.int64), max(num_class, 2))
+        out = {k: np.array([v]) for k, v in m.items()}
+        cm_col = np.empty(1, dtype=object)
+        cm_col[0] = cm
+        out["confusion_matrix"] = cm_col
+        return DataFrame(out)
+
+
+class ComputePerInstanceStatistics(Transformer, _p.HasLabelCol):
+    """Per-row log-loss (classification, from scored probabilities) or squared
+    / absolute error (regression). Reference: ComputePerInstanceStatistics.scala:42."""
+
+    evaluationMetric = _p.Param(
+        "evaluationMetric", "classification | regression | all", "all")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        labels = np.asarray(df[self.get("labelCol")], np.float64)
+        pred_col, prob_col = _detect_scored_cols(df)
+        kind = self.get("evaluationMetric")
+        if kind in ("all", None):
+            kind = ("classification" if prob_col is not None else "regression")
+        if kind == "classification":
+            probs = np.asarray(df[prob_col], np.float64)
+            if probs.ndim == 1:
+                probs = np.stack([1 - probs, probs], axis=1)
+            idx = labels.astype(np.int64)
+            p_true = np.clip(probs[np.arange(len(labels)), idx], 1e-15, 1.0)
+            return df.with_column("log_loss", -np.log(p_true))
+        preds = np.asarray(df[pred_col], np.float64)
+        err = preds - labels
+        return (df.with_column("squared_error", err ** 2)
+                  .with_column("absolute_error", np.abs(err)))

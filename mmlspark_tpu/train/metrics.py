@@ -1,0 +1,150 @@
+"""Metric constants + computations.
+
+Reference: core/metrics/MetricConstants.scala:7-97 (metric name enumeration),
+core/metrics/MetricUtils.scala, and the metric math inside
+train/ComputeModelStatistics.scala:56-400. Host-side numpy: metric reduction is
+cheap compared to training and keeps results exact/deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+
+class MetricConstants:
+    """Metric names (MetricConstants.scala:7-97)."""
+    ACCURACY = "accuracy"
+    PRECISION = "precision"
+    RECALL = "recall"
+    AUC = "AUC"
+    F1 = "f1"
+    MSE = "mse"
+    RMSE = "rmse"
+    R2 = "R^2"
+    MAE = "mean_absolute_error"
+    ALL = "all"
+
+    CLASSIFICATION_METRICS = [ACCURACY, PRECISION, RECALL, AUC]
+    REGRESSION_METRICS = [MSE, RMSE, R2, MAE]
+
+
+def index_label_pred(label_raw: np.ndarray, pred_raw: np.ndarray):
+    """Coerce label/prediction columns to numeric class indices. Non-numeric
+    (string/categorical — e.g. TrainClassifier's decoded scored_labels) are
+    indexed jointly over their sorted observed levels, the way the reference
+    recovers levels from column metadata."""
+    if label_raw.dtype == object or pred_raw.dtype == object:
+        levels = sorted(set(label_raw.tolist()) | set(pred_raw.tolist()),
+                        key=str)
+        lookup = {v: i for i, v in enumerate(levels)}
+        labels = np.array([lookup[v] for v in label_raw], np.float64)
+        preds = np.array([lookup[v] for v in pred_raw], np.float64)
+        return labels, preds
+    return (np.asarray(label_raw, np.float64),
+            np.asarray(pred_raw, np.float64))
+
+
+def auc_score(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Area under the ROC curve via the Mann-Whitney rank statistic
+    (equivalent to the trapezoid ROC integral the reference computes through
+    BinaryClassificationMetrics)."""
+    labels = np.asarray(labels, np.float64)
+    scores = np.asarray(scores, np.float64)
+    pos = labels > 0.5
+    n_pos = int(pos.sum())
+    n_neg = len(labels) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores), np.float64)
+    ranks[order] = np.arange(1, len(scores) + 1)
+    # average ranks over ties
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(sorted_scores):
+        j = i
+        while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    rank_sum = ranks[pos].sum()
+    return float((rank_sum - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def confusion_matrix(labels: np.ndarray, preds: np.ndarray,
+                     num_class: int) -> np.ndarray:
+    labels = np.asarray(labels, np.int64)
+    preds = np.asarray(preds, np.int64)
+    if labels.min(initial=0) < 0 or preds.min(initial=0) < 0:
+        raise ValueError(
+            "labels/predictions must be non-negative class indices "
+            "(got negative values — reindex e.g. -1/+1 labels first)")
+    cm = np.zeros((num_class, num_class), np.int64)
+    np.add.at(cm, (labels, preds), 1)
+    return cm
+
+
+def classification_metrics(labels: np.ndarray, preds: np.ndarray,
+                           scores: np.ndarray = None) -> Dict[str, float]:
+    """Binary metrics (ComputeModelStatistics.scala binary path): accuracy,
+    precision/recall of the positive class, AUC from scores."""
+    labels = np.asarray(labels, np.int64)
+    preds = np.asarray(preds, np.int64)
+    cm = confusion_matrix(labels, preds, 2)
+    tn, fp, fn, tp = cm[0, 0], cm[0, 1], cm[1, 0], cm[1, 1]
+    out = {
+        MetricConstants.ACCURACY: float((tp + tn) / max(cm.sum(), 1)),
+        MetricConstants.PRECISION: float(tp / max(tp + fp, 1)),
+        MetricConstants.RECALL: float(tp / max(tp + fn, 1)),
+    }
+    p, r = out[MetricConstants.PRECISION], out[MetricConstants.RECALL]
+    out[MetricConstants.F1] = 2 * p * r / max(p + r, 1e-12)
+    if scores is not None:
+        out[MetricConstants.AUC] = auc_score(labels, scores)
+    return out
+
+
+def multiclass_metrics(labels: np.ndarray, preds: np.ndarray,
+                       num_class: int) -> Dict[str, float]:
+    """Macro-averaged multiclass metrics (ComputeModelStatistics.scala:323-370)."""
+    cm = confusion_matrix(labels, preds, num_class)
+    tp = np.diag(cm).astype(np.float64)
+    support = cm.sum(axis=1).astype(np.float64)
+    predicted = cm.sum(axis=0).astype(np.float64)
+    prec = np.where(predicted > 0, tp / np.maximum(predicted, 1), 0.0)
+    rec = np.where(support > 0, tp / np.maximum(support, 1), 0.0)
+    live = support > 0
+    macro_p = float(prec[live].mean()) if live.any() else 0.0
+    macro_r = float(rec[live].mean()) if live.any() else 0.0
+    return {
+        MetricConstants.ACCURACY: float(tp.sum() / max(cm.sum(), 1)),
+        "macro_precision": macro_p,
+        "macro_recall": macro_r,
+        "micro_precision": float(tp.sum() / max(predicted.sum(), 1)),
+        "micro_recall": float(tp.sum() / max(support.sum(), 1)),
+        # binary-named aliases resolve to the macro average so a requested
+        # 'precision'/'recall'/'f1' metric works on multiclass problems too
+        MetricConstants.PRECISION: macro_p,
+        MetricConstants.RECALL: macro_r,
+        MetricConstants.F1: (2 * macro_p * macro_r / max(macro_p + macro_r,
+                                                         1e-12)),
+    }
+
+
+def regression_metrics(labels: np.ndarray, preds: np.ndarray
+                       ) -> Dict[str, float]:
+    labels = np.asarray(labels, np.float64)
+    preds = np.asarray(preds, np.float64)
+    err = preds - labels
+    mse = float(np.mean(err ** 2))
+    ss_tot = float(np.sum((labels - labels.mean()) ** 2))
+    return {
+        MetricConstants.MSE: mse,
+        MetricConstants.RMSE: float(np.sqrt(mse)),
+        MetricConstants.R2: (1.0 - float(np.sum(err ** 2)) / ss_tot
+                             if ss_tot > 0 else 0.0),
+        MetricConstants.MAE: float(np.mean(np.abs(err))),
+    }

@@ -1,0 +1,179 @@
+"""TrainClassifier / TrainRegressor — auto-featurizing trainers.
+
+Reference: train/AutoTrainer.scala:12 (featurize + inner SparkML learner),
+train/TrainClassifier.scala:53-374 (label reindexing via ValueIndexer, per-algo
+handling, levels stored on the model, scores/scored_probabilities/scored_labels
+output convention — TrainedClassifierModel :276), train/TrainRegressor.scala.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model, Transformer
+from ..featurize.featurize import Featurize
+from ..featurize.indexers import ValueIndexer
+
+# assembled-features hash bits: 2^18 default, 2^12 for tree learners
+# (featurize/Featurize.scala:17-20)
+FEATURES_DEFAULT = 1 << 18
+FEATURES_TREE = 1 << 12
+
+
+def _is_tree_learner(est) -> bool:
+    name = type(est).__name__
+    return "LightGBM" in name or "GBT" in name or "Forest" in name
+
+
+class AutoTrainer(Estimator, _p.HasLabelCol, _p.HasFeaturesCol):
+    """Shared surface: featurize all non-label columns into one vector, then
+    fit the inner learner on it (train/AutoTrainer.scala:12)."""
+
+    model = _p.Param("model", "inner learner estimator", None, complex=True)
+    numFeatures = _p.Param(
+        "numFeatures", "hash-space size for string featurization; 0 = auto "
+        "(2^18, or 2^12 for tree learners)", 0, int)
+
+    def __init__(self, model: Optional[Estimator] = None, **kw):
+        super().__init__(**kw)
+        if model is not None:
+            self.set("model", model)
+
+    def _default_learner(self) -> Estimator:
+        raise NotImplementedError
+
+    def _featurizer(self, df: DataFrame, label_col: str) -> "Model":
+        inner = self.get("model") or self._default_learner()
+        nf = self.get("numFeatures")
+        if not nf:
+            nf = FEATURES_TREE if _is_tree_learner(inner) else FEATURES_DEFAULT
+        cols = [c for c in df.columns if c != label_col]
+        feat = Featurize(inputCols=cols, outputCol=self.get("featuresCol"),
+                         numberOfFeatures=nf)
+        return feat.fit(df)
+
+
+class TrainClassifier(AutoTrainer):
+    """Reindex labels -> featurize -> fit inner classifier.
+
+    Reference: train/TrainClassifier.scala:53-374."""
+
+    reindexLabel = _p.Param("reindexLabel",
+                            "reindex label values to contiguous ints", True,
+                            bool)
+
+    def _default_learner(self) -> Estimator:
+        from ..models.classic import LogisticRegression
+        return LogisticRegression()
+
+    def _fit(self, df: DataFrame) -> "TrainedClassifierModel":
+        label_col = self.get("labelCol")
+        levels: Optional[List[Any]] = None
+        work = df
+        if self.get("reindexLabel"):
+            indexer = ValueIndexer(inputCol=label_col,
+                                   outputCol=label_col).fit(df)
+            levels = indexer.get("levels")
+            work = indexer.transform(df)
+        feat_model = self._featurizer(work, label_col)
+        feats = feat_model.transform(work)
+        inner = (self.get("model") or self._default_learner()).copy({
+            "labelCol": label_col,
+            "featuresCol": self.get("featuresCol")})
+        fitted = inner.fit(feats)
+        model = TrainedClassifierModel(
+            featurizer=feat_model, inner_model=fitted, levels=levels)
+        model.set("labelCol", label_col)
+        model.set("featuresCol", self.get("featuresCol"))
+        return model
+
+
+class TrainedClassifierModel(Model, _p.HasLabelCol, _p.HasFeaturesCol):
+    """Output convention (TrainClassifier.scala:276): `scores`,
+    `scored_probabilities`, `scored_labels` (decoded back through levels)."""
+
+    featurizer = _p.Param("featurizer", "fitted featurize model", None,
+                          complex=True)
+    innerModel = _p.Param("innerModel", "fitted inner classifier", None,
+                          complex=True)
+    levels = _p.Param("levels", "original label levels", None, complex=True)
+
+    def __init__(self, featurizer=None, inner_model=None, levels=None, **kw):
+        super().__init__(**kw)
+        if featurizer is not None:
+            self._set(featurizer=featurizer, innerModel=inner_model,
+                      levels=levels)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        feats = self.get("featurizer").transform(df)
+        scored = self.get("innerModel").transform(feats)
+        inner = self.get("innerModel")
+        out = df
+        raw_col = (inner.get("rawPredictionCol")
+                   if inner.has_param("rawPredictionCol") else None)
+        if raw_col and raw_col in scored:
+            out = out.with_column("scores", scored[raw_col])
+        prob_col = (inner.get("probabilityCol")
+                    if inner.has_param("probabilityCol") else None)
+        if prob_col and prob_col in scored:
+            out = out.with_column("scored_probabilities", scored[prob_col])
+        preds = np.asarray(scored[inner.get("predictionCol")], np.float64)
+        levels = self.get("levels")
+        if levels is not None:
+            decoded = np.empty(len(preds), dtype=object)
+            for i, p in enumerate(preds):
+                decoded[i] = levels[int(p)] if 0 <= int(p) < len(levels) else None
+            arr = np.asarray(decoded)
+            try:  # numeric levels decode back to a numeric column
+                arr = decoded.astype(np.float64)
+            except (TypeError, ValueError):
+                arr = decoded
+            out = out.with_column("scored_labels", arr)
+        else:
+            out = out.with_column("scored_labels", preds)
+        return out
+
+
+class TrainRegressor(AutoTrainer):
+    """Reference: train/TrainRegressor.scala."""
+
+    def _default_learner(self) -> Estimator:
+        from ..models.classic import LinearRegression
+        return LinearRegression()
+
+    def _fit(self, df: DataFrame) -> "TrainedRegressorModel":
+        label_col = self.get("labelCol")
+        feat_model = self._featurizer(df, label_col)
+        feats = feat_model.transform(df)
+        inner = (self.get("model") or self._default_learner()).copy({
+            "labelCol": label_col,
+            "featuresCol": self.get("featuresCol")})
+        fitted = inner.fit(feats)
+        model = TrainedRegressorModel(featurizer=feat_model,
+                                      inner_model=fitted)
+        model.set("labelCol", label_col)
+        model.set("featuresCol", self.get("featuresCol"))
+        return model
+
+
+class TrainedRegressorModel(Model, _p.HasLabelCol, _p.HasFeaturesCol):
+    featurizer = _p.Param("featurizer", "fitted featurize model", None,
+                          complex=True)
+    innerModel = _p.Param("innerModel", "fitted inner regressor", None,
+                          complex=True)
+
+    def __init__(self, featurizer=None, inner_model=None, **kw):
+        super().__init__(**kw)
+        if featurizer is not None:
+            self._set(featurizer=featurizer, innerModel=inner_model)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        feats = self.get("featurizer").transform(df)
+        scored = self.get("innerModel").transform(feats)
+        inner = self.get("innerModel")
+        return df.with_column("scores",
+                              scored[inner.get("predictionCol")])

@@ -53,11 +53,13 @@ def test_featurize_mixed_types():
                       numberOfFeatures=16).fit(df)
     out = model.transform(df)
     feats = out["features"]
-    assert feats.shape == (4, 1 + 16 + 2)
+    # low-cardinality strings one-hot over observed levels (3 here)
+    assert feats.shape == (4, 1 + 3 + 2)
     # numeric missing replaced by mean of finite values
     assert feats[1, 0] == pytest.approx((1 + 3 + 4) / 3)
-    # same string -> same hashed bucket
-    np.testing.assert_array_equal(feats[0, 1:17], feats[2, 1:17])
+    # same string -> same encoding
+    np.testing.assert_array_equal(feats[0, 1:4], feats[2, 1:4])
+    assert not np.array_equal(feats[0, 1:4], feats[1, 1:4])
     # vector passthrough at the tail
     np.testing.assert_allclose(feats[:, -2:], df["vec"])
 

@@ -1,0 +1,153 @@
+"""train/ + automl/ + classic linear learners tests.
+
+Reference model: train suites (VerifyTrainClassifier/TrainRegressor/
+ComputeModelStatistics) + automl (VerifyTuneHyperparameters/FindBestModel)
+with golden-metric thresholds (benchmarks_VerifyTrainClassifier.csv etc.)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.automl import (DiscreteHyperParam, FindBestModel, GridSpace,
+                                 HyperparamBuilder, RandomSpace,
+                                 RangeHyperParam, TuneHyperparameters)
+from mmlspark_tpu.models.classic import LinearRegression, LogisticRegression
+from mmlspark_tpu.train import (ComputeModelStatistics,
+                                ComputePerInstanceStatistics, TrainClassifier,
+                                TrainRegressor)
+from mmlspark_tpu.train.metrics import MetricConstants, auc_score
+
+
+def test_auc_score_known_values():
+    y = np.array([0, 0, 1, 1])
+    assert auc_score(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert auc_score(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    assert abs(auc_score(y, np.array([0.5, 0.5, 0.5, 0.5])) - 0.5) < 1e-9
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.default_rng(0)
+    yy = rng.integers(0, 2, 500)
+    ss = rng.normal(size=500)
+    assert abs(auc_score(yy, ss) - roc_auc_score(yy, ss)) < 1e-9
+
+
+def test_logistic_regression(binary_df):
+    model = LogisticRegression(maxIter=150).fit(binary_df)
+    out = model.transform(binary_df)
+    acc = (out["prediction"] == binary_df["label"]).mean()
+    assert acc > 0.8, acc
+
+
+def test_linear_regression(regression_df):
+    model = LinearRegression(maxIter=300).fit(regression_df)
+    out = model.transform(regression_df)
+    y = regression_df["label"]
+    mse = np.mean((out["prediction"] - y) ** 2)
+    assert mse < 0.5 * np.var(y)
+
+
+def test_train_classifier_mixed_types():
+    """String labels + mixed feature types: reindex + featurize + decode
+    (TrainClassifier.scala label-reindex logic)."""
+    rng = np.random.default_rng(2)
+    n = 1200
+    num = rng.normal(size=n)
+    cat = np.array(rng.choice(["x", "y", "z"], n), dtype=object)
+    label = np.where(num + (cat == "x") * 2 + rng.normal(scale=0.3, size=n) > 0.5,
+                     "pos", "neg").astype(object)
+    df = DataFrame({"num": num, "cat": cat, "mylabel": label})
+    model = TrainClassifier(labelCol="mylabel").fit(df)
+    out = model.transform(df)
+    assert "scored_labels" in out.columns
+    assert "scored_probabilities" in out.columns
+    acc = (out["scored_labels"] == label).mean()
+    assert acc > 0.85, acc
+
+
+def test_train_classifier_with_lightgbm(binary_df):
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+    df = DataFrame({"f": binary_df["features"], "label": binary_df["label"]})
+    model = TrainClassifier(
+        model=LightGBMClassifier(numIterations=20), labelCol="label").fit(df)
+    out = model.transform(df)
+    acc = (out["scored_labels"] == df["label"]).mean()
+    assert acc > 0.85, acc
+
+
+def test_train_regressor_and_statistics(regression_df):
+    df = DataFrame({"f": regression_df["features"],
+                    "label": regression_df["label"]})
+    model = TrainRegressor(labelCol="label").fit(df)
+    out = model.transform(df)
+    assert "scores" in out.columns
+    stats = ComputeModelStatistics(
+        labelCol="label", scoredLabelsCol="scores",
+        evaluationMetric="regression").transform(out)
+    assert stats["mse"][0] < 0.5 * np.var(df["label"])
+    assert 0.5 < stats["R^2"][0] <= 1.0
+    assert stats["rmse"][0] == pytest.approx(np.sqrt(stats["mse"][0]))
+
+
+def test_compute_statistics_binary(binary_df):
+    model = LogisticRegression().fit(binary_df)
+    out = model.transform(binary_df)
+    stats = ComputeModelStatistics(labelCol="label").transform(out)
+    for m in ("accuracy", "precision", "recall", "AUC"):
+        assert 0.0 <= stats[m][0] <= 1.0
+    assert stats["AUC"][0] > 0.85
+    cm = stats["confusion_matrix"][0]
+    assert cm.shape == (2, 2) and cm.sum() == len(binary_df)
+
+
+def test_compute_statistics_multiclass(multiclass_df):
+    model = LogisticRegression().fit(multiclass_df)
+    out = model.transform(multiclass_df)
+    stats = ComputeModelStatistics(labelCol="label").transform(out)
+    assert stats["accuracy"][0] > 0.7
+    assert "macro_precision" in stats.columns
+    cm = stats["confusion_matrix"][0]
+    assert cm.shape == (3, 3)
+
+
+def test_per_instance_statistics(binary_df):
+    model = LogisticRegression().fit(binary_df)
+    out = model.transform(binary_df)
+    per = ComputePerInstanceStatistics(labelCol="label").transform(out)
+    ll = per["log_loss"]
+    assert (ll >= 0).all()
+    # mean log-loss should beat the uninformed baseline ln(2)
+    assert ll.mean() < np.log(2)
+
+
+def test_tune_hyperparameters(binary_df):
+    est = LogisticRegression(maxIter=60)
+    builder = (HyperparamBuilder()
+               .add_hyperparam(est, "regParam",
+                               RangeHyperParam(1e-4, 0.5, is_log=True))
+               .add_hyperparam(est, "stepSize",
+                               DiscreteHyperParam([0.05, 0.1, 0.3])))
+    space = RandomSpace(builder.build(), seed=5)
+    tuned = TuneHyperparameters(
+        models=[est], paramSpace=space, numFolds=3, numRuns=4,
+        evaluationMetric=MetricConstants.ACCURACY, labelCol="label",
+        parallelism=2).fit(binary_df)
+    assert tuned.get("bestMetric") > 0.75
+    out = tuned.transform(binary_df)
+    assert "prediction" in out.columns
+    assert "metric=" in tuned.get_best_model_info()
+
+
+def test_grid_space_enumeration():
+    est = LogisticRegression()
+    entries = [(est, "regParam", DiscreteHyperParam([0.1, 0.2])),
+               (est, "maxIter", DiscreteHyperParam([10, 20, 30]))]
+    maps = list(GridSpace(entries).param_maps())
+    assert len(maps) == 6
+
+
+def test_find_best_model(binary_df):
+    weak = LogisticRegression(maxIter=1, stepSize=1e-4).fit(binary_df)
+    strong = LogisticRegression(maxIter=150).fit(binary_df)
+    fbm = FindBestModel(models=[weak, strong], labelCol="label",
+                        evaluationMetric="accuracy").fit(binary_df)
+    assert fbm.get("bestModel") is strong
+    assert fbm.get("bestMetric") > 0.75
