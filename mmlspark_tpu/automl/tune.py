@@ -39,7 +39,13 @@ class EvaluationUtils:
     @staticmethod
     def default_metric(est) -> str:
         name = type(est).__name__
-        if "Regress" in name or "Regressor" in name:
+        # classifier signals take precedence: "LogisticRegression" contains
+        # "Regress" but is a classifier (it declares a probability column)
+        if ("Classif" in name or "Logistic" in name
+                or (hasattr(est, "has_param")
+                    and est.has_param("probabilityCol"))):
+            return MetricConstants.ACCURACY
+        if "Regress" in name:
             return MetricConstants.RMSE
         return MetricConstants.ACCURACY
 
@@ -122,7 +128,12 @@ class TuneHyperparameters(Estimator, _p.HasLabelCol, _p.HasSeed):
         if space is None:
             candidates = [(m, {}) for m in models]
         else:
-            maps = itertools.islice(space.param_maps(), self.get("numRuns"))
+            # a grid is finite: enumerate it fully; numRuns bounds only
+            # infinite (random) spaces, as in the reference where numRuns is
+            # the random-search draw count (TuneHyperparameters.scala)
+            maps = (space.param_maps() if isinstance(space, GridSpace)
+                    else itertools.islice(space.param_maps(),
+                                          self.get("numRuns")))
             for pm in maps:
                 by_est: dict = {}
                 for est, name, value in pm:

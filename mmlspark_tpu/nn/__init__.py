@@ -1,0 +1,8 @@
+"""nn/ — exact nearest-neighbor search (reference: nn/, 5 files, 598 LoC).
+Ball trees are replaced by batched MXU distance contractions + lax.top_k."""
+
+from .knn import KNN, ConditionalKNN, ConditionalKNNModel, KNNModel
+from .search import BallTree, ConditionalBallTree
+
+__all__ = ["KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel",
+           "BallTree", "ConditionalBallTree"]

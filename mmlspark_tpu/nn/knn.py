@@ -1,0 +1,129 @@
+"""KNN / ConditionalKNN pipeline stages.
+
+Reference: nn/KNN.scala:45-115 (`KNN`/`KNNModel` — fit collects the feature
+matrix + values payload, transform probes per row, emitting an array of
+(value, distance) structs), nn/ConditionalKNN.scala:29-112 (adds per-query
+`conditionerCol` allowed-label sets and a labelCol payload).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model
+from .search import BallTree, ConditionalBallTree
+
+
+class KNN(Estimator, _p.HasFeaturesCol, _p.HasOutputCol):
+    valuesCol = _p.Param("valuesCol", "payload column returned with each "
+                         "neighbor", "values")
+    k = _p.Param("k", "number of neighbors", 5, int)
+    leafSize = _p.Param("leafSize", "accepted for reference API parity; the "
+                        "MXU brute-force search has no leaves", 50, int)
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "output")
+        super().__init__(**kw)
+
+    def _fit(self, df: DataFrame) -> "KNNModel":
+        feats = np.asarray(df[self.get("featuresCol")], np.float32)
+        model = KNNModel(points=feats,
+                         values=df[self.get("valuesCol")].copy())
+        for p in ("featuresCol", "outputCol", "k"):
+            model.set(p, self.get(p))
+        return model
+
+
+class KNNModel(Model, _p.HasFeaturesCol, _p.HasOutputCol):
+    k = _p.Param("k", "number of neighbors", 5, int)
+    points = _p.Param("points", "index feature matrix", None, complex=True)
+    values = _p.Param("values", "payload per index row", None, complex=True)
+
+    def __init__(self, points: Optional[np.ndarray] = None, values=None, **kw):
+        super().__init__(**kw)
+        self._tree: Optional[BallTree] = None
+        if points is not None:
+            self._set(points=points, values=values)
+
+    def _get_tree(self) -> BallTree:
+        if self._tree is None:
+            self._tree = BallTree(self.get("points"))
+        return self._tree
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        q = np.asarray(df[self.get("featuresCol")], np.float32)
+        dist, idx = self._get_tree().query(q, self.get("k"))
+        values = self.get("values")
+        out = np.empty(len(df), dtype=object)
+        for i in range(len(df)):
+            out[i] = [{"value": values[j], "distance": float(d)}
+                      for j, d in zip(idx[i], dist[i])]
+        return df.with_column(self.get("outputCol"), out)
+
+    def _load_extra(self, path, extra):
+        self._tree = None
+
+
+class ConditionalKNN(Estimator, _p.HasFeaturesCol, _p.HasOutputCol,
+                     _p.HasLabelCol):
+    valuesCol = _p.Param("valuesCol", "payload column", "values")
+    conditionerCol = _p.Param("conditionerCol",
+                              "per-query iterable of allowed labels",
+                              "conditioner")
+    k = _p.Param("k", "number of neighbors", 5, int)
+    leafSize = _p.Param("leafSize", "API parity; unused", 50, int)
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "output")
+        super().__init__(**kw)
+
+    def _fit(self, df: DataFrame) -> "ConditionalKNNModel":
+        feats = np.asarray(df[self.get("featuresCol")], np.float32)
+        model = ConditionalKNNModel(
+            points=feats, values=df[self.get("valuesCol")].copy(),
+            labels=df[self.get("labelCol")].copy())
+        for p in ("featuresCol", "outputCol", "conditionerCol", "k"):
+            model.set(p, self.get(p))
+        return model
+
+
+class ConditionalKNNModel(Model, _p.HasFeaturesCol, _p.HasOutputCol):
+    conditionerCol = _p.Param("conditionerCol", "allowed-label column",
+                              "conditioner")
+    k = _p.Param("k", "number of neighbors", 5, int)
+    points = _p.Param("points", "index feature matrix", None, complex=True)
+    values = _p.Param("values", "payload per index row", None, complex=True)
+    labels = _p.Param("labels", "label per index row", None, complex=True)
+
+    def __init__(self, points: Optional[np.ndarray] = None, values=None,
+                 labels=None, **kw):
+        super().__init__(**kw)
+        self._tree: Optional[ConditionalBallTree] = None
+        if points is not None:
+            self._set(points=points, values=values, labels=labels)
+
+    def _get_tree(self) -> ConditionalBallTree:
+        if self._tree is None:
+            self._tree = ConditionalBallTree(self.get("points"),
+                                             list(self.get("labels")))
+        return self._tree
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        q = np.asarray(df[self.get("featuresCol")], np.float32)
+        conds = df[self.get("conditionerCol")]
+        dist, idx = self._get_tree().query(q, self.get("k"), list(conds))
+        values = self.get("values")
+        labels = self.get("labels")
+        out = np.empty(len(df), dtype=object)
+        for i in range(len(df)):
+            out[i] = [{"value": values[j], "distance": float(d),
+                       "label": labels[j]}
+                      for j, d in zip(idx[i], dist[i]) if j >= 0]
+        return df.with_column(self.get("outputCol"), out)
+
+    def _load_extra(self, path, extra):
+        self._tree = None

@@ -1,0 +1,145 @@
+"""Batched exact nearest-neighbor search — the BallTree, the TPU way.
+
+Reference: nn/BallTree.scala:110 and nn/ConditionalBallTree.scala:203 build
+serial ball trees per collected partition and probe them row-by-row with a
+BoundedPriorityQueue (nn/KNN.scala:45-115). On TPU, exact brute-force search is
+a matmul: ||q-x||^2 = ||q||^2 + ||x||^2 - 2 q.x — one [Q,D]x[D,N] contraction
+on the MXU followed by `lax.top_k`, chunked over the index dimension to bound
+HBM. This beats tree traversal (branchy, scalar) by orders of magnitude on this
+hardware, and is exact, so results match the reference's trees.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk_chunk(q, x, x_sq, k: int):
+    """Top-k smallest squared distances of queries q against index chunk x.
+    Returns (neg_dist [Q,k], idx [Q,k])  (jax top_k takes largest => negate)."""
+    d2 = (q * q).sum(1, keepdims=True) + x_sq[None, :] - 2.0 * (q @ x.T)
+    return jax.lax.top_k(-d2, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk_chunk_masked(q, x, x_sq, allowed, k: int):
+    """Same, with a per-(query, point) bool mask; disallowed -> +inf."""
+    d2 = (q * q).sum(1, keepdims=True) + x_sq[None, :] - 2.0 * (q @ x.T)
+    d2 = jnp.where(allowed, d2, jnp.inf)
+    return jax.lax.top_k(-d2, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _merge_topk(neg_a, idx_a, neg_b, idx_b, k: int):
+    """Merge two top-k candidate sets into one."""
+    neg = jnp.concatenate([neg_a, neg_b], axis=1)
+    idx = jnp.concatenate([idx_a, idx_b], axis=1)
+    best_neg, pos = jax.lax.top_k(neg, k)
+    return best_neg, jnp.take_along_axis(idx, pos, axis=1)
+
+
+class BallTree:
+    """Exact k-NN index (API parity with nn/BallTree.scala; brute-force MXU
+    search inside). `chunk` bounds the index-side tile held in HBM."""
+
+    def __init__(self, points: np.ndarray, chunk: int = 65536):
+        self.points = np.ascontiguousarray(points, np.float32)
+        self.chunk = int(chunk)
+        self._sq = (self.points.astype(np.float64) ** 2).sum(1).astype(np.float32)
+
+    def __len__(self) -> int:
+        return len(self.points)
+
+    def find_maximum_inner_products(self, queries: np.ndarray, k: int):
+        """Reference-name alias (BallTree.findMaximumInnerProducts); here the
+        metric is euclidean distance (matching KNN.scala usage)."""
+        return self.query(queries, k)
+
+    def query(self, queries: np.ndarray, k: int):
+        """Returns (distances [Q,k], indices [Q,k]), ascending distance."""
+        q = jnp.asarray(np.asarray(queries, np.float32))
+        k = min(k, len(self.points))
+        best = None
+        for start in range(0, len(self.points), self.chunk):
+            x = jnp.asarray(self.points[start:start + self.chunk])
+            xs = jnp.asarray(self._sq[start:start + self.chunk])
+            kk = min(k, x.shape[0])
+            neg, idx = _topk_chunk(q, x, xs, kk)
+            idx = idx + start
+            if best is None:
+                best = (neg, idx)
+                if kk < k:  # first chunk smaller than k: pad with +inf
+                    pad = k - kk
+                    best = (jnp.pad(neg, ((0, 0), (0, pad)),
+                                    constant_values=-jnp.inf),
+                            jnp.pad(idx, ((0, 0), (0, pad))))
+            else:
+                if kk < k:
+                    neg = jnp.pad(neg, ((0, 0), (0, k - kk)),
+                                  constant_values=-jnp.inf)
+                    idx = jnp.pad(idx, ((0, 0), (0, k - kk)))
+                best = _merge_topk(best[0], best[1], neg, idx, k)
+        neg, idx = best
+        d2 = np.maximum(-np.asarray(neg), 0.0)
+        return np.sqrt(d2), np.asarray(idx)
+
+
+class ConditionalBallTree:
+    """k-NN with a per-query allowed-label set (nn/ConditionalBallTree.scala:203;
+    python binding nn/ConditionalBallTree.py). Masking replaces tree pruning."""
+
+    def __init__(self, points: np.ndarray, labels: Sequence,
+                 chunk: int = 65536):
+        self.tree = BallTree(points, chunk)
+        self.labels = list(labels)
+        self._levels = sorted(set(self.labels), key=str)
+        self._level_idx = {v: i for i, v in enumerate(self._levels)}
+        self._label_codes = np.array([self._level_idx[v] for v in self.labels],
+                                     np.int32)
+
+    def __len__(self) -> int:
+        return len(self.tree)
+
+    def query(self, queries: np.ndarray, k: int, conditioners: Sequence):
+        """conditioners: per-query iterable of allowed label values.
+        Returns (distances, indices); slots with no allowed neighbor left get
+        distance inf / index -1."""
+        q = np.asarray(queries, np.float32)
+        n_levels = len(self._levels)
+        allow_mat = np.zeros((len(q), n_levels), bool)
+        for i, cond in enumerate(conditioners):
+            for v in cond:
+                j = self._level_idx.get(v)
+                if j is not None:
+                    allow_mat[i, j] = True
+        k = min(k, len(self.tree))
+        qj = jnp.asarray(q)
+        best = None
+        pts, sq = self.tree.points, self.tree._sq
+        chunk = self.tree.chunk
+        for start in range(0, len(pts), chunk):
+            x = jnp.asarray(pts[start:start + chunk])
+            xs = jnp.asarray(sq[start:start + chunk])
+            codes = self._label_codes[start:start + chunk]
+            allowed = jnp.asarray(allow_mat[:, codes])
+            kk = min(k, x.shape[0])
+            neg, idx = _topk_chunk_masked(qj, x, xs, allowed, kk)
+            idx = idx + start
+            if kk < k:
+                neg = jnp.pad(neg, ((0, 0), (0, k - kk)),
+                              constant_values=-jnp.inf)
+                idx = jnp.pad(idx, ((0, 0), (0, k - kk)))
+            best = ((neg, idx) if best is None
+                    else _merge_topk(best[0], best[1], neg, idx, k))
+        neg, idx = np.asarray(best[0]), np.asarray(best[1])
+        dead = ~np.isfinite(neg)
+        d2 = np.maximum(-neg, 0.0)
+        d = np.sqrt(np.where(dead, np.inf, d2))
+        idx = np.where(dead, -1, idx)
+        return d, idx

@@ -1,0 +1,15 @@
+"""Recommendation layer (reference: recommendation/, 6 files, 1225 LoC)."""
+
+from .ranking import (AdvancedRankingMetrics, RankingAdapter,
+                      RankingAdapterModel, RankingEvaluator,
+                      RankingTrainValidationSplit,
+                      RankingTrainValidationSplitModel)
+from .sar import SAR, RecommendationIndexer, RecommendationIndexerModel, SARModel
+
+__all__ = [
+    "SAR", "SARModel",
+    "RecommendationIndexer", "RecommendationIndexerModel",
+    "RankingAdapter", "RankingAdapterModel",
+    "RankingEvaluator", "AdvancedRankingMetrics",
+    "RankingTrainValidationSplit", "RankingTrainValidationSplitModel",
+]

@@ -1,0 +1,237 @@
+"""Ranking evaluation + adapters + train/validation split.
+
+Reference: recommendation/RankingEvaluator.scala:98-152 (+
+`AdvancedRankingMetrics` :15-97 — ndcgAt, map, precisionAtk, recallAtK,
+diversityAtK, maxDiversity), recommendation/RankingAdapter.scala:67-151 (turn a
+recommender into a ranking-evaluable stage), and
+recommendation/RankingTrainValidationSplit.scala:24-328 (per-user stratified
+split + param sweep).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Evaluator, Model, Transformer
+
+
+class AdvancedRankingMetrics:
+    """Per-dataset ranking metrics over (predicted items, relevant items)."""
+
+    def __init__(self, pred_lists: Sequence[Sequence], label_lists:
+                 Sequence[Sequence], k: int, n_items: int):
+        self.preds = [list(p)[:k] for p in pred_lists]
+        self.labels = [set(l) for l in label_lists]
+        self.k = k
+        self.n_items = n_items
+
+    def ndcg_at(self) -> float:
+        vals = []
+        for pred, rel in zip(self.preds, self.labels):
+            if not rel:
+                continue
+            dcg = sum(1.0 / np.log2(i + 2)
+                      for i, p in enumerate(pred) if p in rel)
+            idcg = sum(1.0 / np.log2(i + 2)
+                       for i in range(min(len(rel), self.k)))
+            vals.append(dcg / idcg if idcg > 0 else 0.0)
+        return float(np.mean(vals)) if vals else 0.0
+
+    def mean_average_precision(self) -> float:
+        vals = []
+        for pred, rel in zip(self.preds, self.labels):
+            if not rel:
+                continue
+            hits, s = 0, 0.0
+            for i, p in enumerate(pred):
+                if p in rel:
+                    hits += 1
+                    s += hits / (i + 1)
+            vals.append(s / min(len(rel), self.k))
+        return float(np.mean(vals)) if vals else 0.0
+
+    def precision_at_k(self) -> float:
+        vals = [len(set(pred) & rel) / self.k
+                for pred, rel in zip(self.preds, self.labels) if rel]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall_at_k(self) -> float:
+        vals = [len(set(pred) & rel) / len(rel)
+                for pred, rel in zip(self.preds, self.labels) if rel]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def diversity_at_k(self) -> float:
+        """Distinct recommended items / catalog size (RankingEvaluator
+        diversityAtK)."""
+        distinct = set()
+        for pred in self.preds:
+            distinct.update(pred)
+        return len(distinct) / max(self.n_items, 1)
+
+    def max_diversity(self) -> float:
+        distinct = set()
+        for lab in self.labels:
+            distinct.update(lab)
+        for pred in self.preds:
+            distinct.update(pred)
+        return len(distinct) / max(self.n_items, 1)
+
+    def get(self, name: str) -> float:
+        table = {"ndcgAt": self.ndcg_at, "map": self.mean_average_precision,
+                 "precisionAtk": self.precision_at_k,
+                 "recallAtK": self.recall_at_k,
+                 "diversityAtK": self.diversity_at_k,
+                 "maxDiversity": self.max_diversity}
+        if name not in table:
+            raise ValueError(f"unknown ranking metric {name!r}; "
+                             f"known: {sorted(table)}")
+        return table[name]()
+
+
+class RankingEvaluator(Evaluator):
+    k = _p.Param("k", "cutoff", 10, int)
+    metricName = _p.Param("metricName", "ndcgAt | map | precisionAtk | "
+                          "recallAtK | diversityAtK | maxDiversity", "ndcgAt")
+    nItems = _p.Param("nItems", "catalog size (for diversity metrics)", 0, int)
+    predictionCol = _p.Param("predictionCol",
+                             "column of recommended item lists", "prediction")
+    labelCol = _p.Param("labelCol", "column of relevant item lists", "label")
+
+    def evaluate(self, df: DataFrame) -> float:
+        m = AdvancedRankingMetrics(
+            df[self.get("predictionCol")], df[self.get("labelCol")],
+            self.get("k"), self.get("nItems"))
+        return m.get(self.get("metricName"))
+
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class RankingAdapter(Estimator):
+    """Fit the wrapped recommender; transform emits per-user
+    (prediction=list of recommended items, label=list of observed items) for
+    RankingEvaluator (RankingAdapter.scala:67-151, mode=allUsers)."""
+
+    recommender = _p.Param("recommender", "inner recommender estimator", None,
+                           complex=True)
+    k = _p.Param("k", "recommendations per user", 10, int)
+
+    def __init__(self, recommender: Optional[Estimator] = None, **kw):
+        super().__init__(**kw)
+        if recommender is not None:
+            self.set("recommender", recommender)
+
+    def _fit(self, df: DataFrame) -> "RankingAdapterModel":
+        inner = self.get("recommender").fit(df)
+        model = RankingAdapterModel(inner_model=inner)
+        model.set("k", self.get("k"))
+        model.set("userCol", inner.get("userCol"))
+        model.set("itemCol", inner.get("itemCol"))
+        return model
+
+
+class RankingAdapterModel(Model):
+    innerModel = _p.Param("innerModel", "fitted recommender", None,
+                          complex=True)
+    k = _p.Param("k", "recommendations per user", 10, int)
+    userCol = _p.Param("userCol", "user column", "user")
+    itemCol = _p.Param("itemCol", "item column", "item")
+
+    def __init__(self, inner_model=None, **kw):
+        super().__init__(**kw)
+        if inner_model is not None:
+            self.set("innerModel", inner_model)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        ucol, icol = self.get("userCol"), self.get("itemCol")
+        recs = self.get("innerModel").recommend_for_all_users(self.get("k"))
+        rec_map: Dict[int, List] = {
+            int(u): [r["item"] for r in rl]
+            for u, rl in zip(recs[ucol], recs["recommendations"])}
+        users = np.asarray(df[ucol], np.int64)
+        items = np.asarray(df[icol], np.int64)
+        uniq = np.unique(users)
+        truth = {int(u): items[users == u].tolist() for u in uniq}
+        preds = np.empty(len(uniq), dtype=object)
+        labels = np.empty(len(uniq), dtype=object)
+        for i, u in enumerate(uniq):
+            preds[i] = rec_map.get(int(u), [])
+            labels[i] = truth[int(u)]
+        return DataFrame({ucol: uniq, "prediction": preds, "label": labels})
+
+
+class RankingTrainValidationSplit(Estimator):
+    """Per-user stratified split + (optional) param sweep
+    (RankingTrainValidationSplit.scala:24-328)."""
+
+    estimator = _p.Param("estimator", "recommender estimator", None,
+                         complex=True)
+    evaluator = _p.Param("evaluator", "RankingEvaluator", None, complex=True)
+    estimatorParamMaps = _p.Param("estimatorParamMaps",
+                                  "list of param override dicts", None,
+                                  complex=True)
+    trainRatio = _p.Param("trainRatio", "per-user train fraction", 0.75, float)
+    userCol = _p.Param("userCol", "user column", "user")
+    itemCol = _p.Param("itemCol", "item column", "item")
+    seed = _p.Param("seed", "split seed", 0, int)
+
+    def __init__(self, estimator: Optional[Estimator] = None, **kw):
+        super().__init__(**kw)
+        if estimator is not None:
+            self.set("estimator", estimator)
+
+    def _split(self, df: DataFrame):
+        users = np.asarray(df[self.get("userCol")], np.int64)
+        rng = np.random.default_rng(self.get("seed"))
+        ratio = self.get("trainRatio")
+        train_mask = np.zeros(len(df), bool)
+        for u in np.unique(users):
+            idx = np.flatnonzero(users == u)
+            rng.shuffle(idx)
+            cut = max(1, int(round(len(idx) * ratio)))
+            train_mask[idx[:cut]] = True
+        return df.filter(train_mask), df.filter(~train_mask)
+
+    def _fit(self, df: DataFrame) -> "RankingTrainValidationSplitModel":
+        train, valid = self._split(df)
+        est = self.get("estimator")
+        evaluator = self.get("evaluator") or RankingEvaluator()
+        maps = self.get("estimatorParamMaps") or [{}]
+        k = evaluator.get("k")
+        best, best_metric, metrics = None, -np.inf, []
+        for overrides in maps:
+            adapter = RankingAdapter(recommender=est.copy(overrides), k=k)
+            fitted = adapter.fit(train)
+            metric = evaluator.evaluate(fitted.transform(valid))
+            metrics.append(metric)
+            better = (metric > best_metric if evaluator.is_larger_better()
+                      else metric < best_metric)
+            if best is None or better:
+                best, best_metric = fitted, metric
+        out = RankingTrainValidationSplitModel(best_model=best,
+                                               validation_metrics=metrics)
+        return out
+
+
+class RankingTrainValidationSplitModel(Model):
+    bestModel = _p.Param("bestModel", "winning fitted adapter", None,
+                         complex=True)
+    validationMetrics = _p.Param("validationMetrics", "per-candidate metrics",
+                                 None, complex=True)
+
+    def __init__(self, best_model=None, validation_metrics=None, **kw):
+        super().__init__(**kw)
+        if best_model is not None:
+            self._set(bestModel=best_model,
+                      validationMetrics=list(validation_metrics or []))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self.get("bestModel").transform(df)
+
+    def recommend_for_all_users(self, k: int) -> DataFrame:
+        return self.get("bestModel").get("innerModel"
+                                         ).recommend_for_all_users(k)

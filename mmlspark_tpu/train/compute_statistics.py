@@ -90,18 +90,21 @@ class ComputePerInstanceStatistics(Transformer, _p.HasLabelCol):
         "evaluationMetric", "classification | regression | all", "all")
 
     def transform(self, df: DataFrame) -> DataFrame:
-        labels = np.asarray(df[self.get("labelCol")], np.float64)
         pred_col, prob_col = _detect_scored_cols(df)
         kind = self.get("evaluationMetric")
         if kind in ("all", None):
             kind = ("classification" if prob_col is not None else "regression")
         if kind == "classification":
+            labels, _ = index_label_pred(df[self.get("labelCol")],
+                                         df[pred_col] if pred_col
+                                         else df[self.get("labelCol")])
             probs = np.asarray(df[prob_col], np.float64)
             if probs.ndim == 1:
                 probs = np.stack([1 - probs, probs], axis=1)
             idx = labels.astype(np.int64)
             p_true = np.clip(probs[np.arange(len(labels)), idx], 1e-15, 1.0)
             return df.with_column("log_loss", -np.log(p_true))
+        labels = np.asarray(df[self.get("labelCol")], np.float64)
         preds = np.asarray(df[pred_col], np.float64)
         err = preds - labels
         return (df.with_column("squared_error", err ** 2)

@@ -57,19 +57,13 @@ def auc_score(labels: np.ndarray, scores: np.ndarray) -> float:
     n_neg = len(labels) - n_pos
     if n_pos == 0 or n_neg == 0:
         return float("nan")
-    order = np.argsort(scores, kind="mergesort")
-    ranks = np.empty(len(scores), np.float64)
-    ranks[order] = np.arange(1, len(scores) + 1)
-    # average ranks over ties
-    sorted_scores = scores[order]
-    i = 0
-    while i < len(sorted_scores):
-        j = i
-        while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
-            j += 1
-        if j > i:
-            ranks[order[i:j + 1]] = 0.5 * (i + 1 + j + 1)
-        i = j + 1
+    # average ranks with tie-groups, fully vectorized: for each distinct
+    # score the average rank is the mean of its occupied rank positions
+    uniq, inv, counts = np.unique(scores, return_inverse=True,
+                                  return_counts=True)
+    ends = np.cumsum(counts).astype(np.float64)        # last rank per group
+    avg_rank = ends - (counts - 1) / 2.0               # mean of the run
+    ranks = avg_rank[inv]
     rank_sum = ranks[pos].sum()
     return float((rank_sum - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
 

@@ -1,0 +1,92 @@
+"""nn/ KNN tests — exactness vs sklearn brute force (the reference's ball
+trees are exact too, so parity is checkable directly)."""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.nn import (KNN, BallTree, ConditionalBallTree,
+                             ConditionalKNN)
+
+
+def test_balltree_matches_sklearn():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3000, 16)).astype(np.float32)
+    q = rng.normal(size=(50, 16)).astype(np.float32)
+    tree = BallTree(x, chunk=1024)  # force multi-chunk merge path
+    dist, idx = tree.query(q, 7)
+    from sklearn.neighbors import NearestNeighbors
+    ref = NearestNeighbors(n_neighbors=7, algorithm="brute").fit(x)
+    rd, ri = ref.kneighbors(q)
+    np.testing.assert_allclose(dist, rd, atol=1e-3)
+    # indices can differ on exact ties; distances must agree
+    assert (idx == ri).mean() > 0.99
+
+
+def test_balltree_k_larger_than_first_chunk():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    tree = BallTree(x, chunk=8)  # chunk < k
+    dist, idx = tree.query(x[:5], 20)
+    assert dist.shape == (5, 20)
+    assert (np.diff(dist, axis=1) >= -1e-5).all()  # ascending
+    assert np.allclose(dist[:, 0], 0.0, atol=1e-3)  # self-match first
+
+
+def test_knn_stage():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(500, 8)).astype(np.float32)
+    names = np.array([f"item{i}" for i in range(500)], dtype=object)
+    fit_df = DataFrame({"features": x, "values": names})
+    model = KNN(k=3, valuesCol="values").fit(fit_df)
+    out = model.transform(DataFrame({"features": x[:4]}))
+    res = out["output"]
+    assert len(res[0]) == 3
+    assert res[0][0]["value"] == "item0"  # nearest to itself
+    assert res[0][0]["distance"] < 5e-3  # fp32 cancellation noise
+
+
+def test_conditional_knn_respects_conditioner():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(400, 6)).astype(np.float32)
+    labels = np.array(["a", "b"] * 200, dtype=object)
+    values = np.arange(400)
+    fit_df = DataFrame({"features": x, "values": values, "label": labels})
+    model = ConditionalKNN(k=5).fit(fit_df)
+    conds = np.empty(3, dtype=object)
+    conds[0] = {"a"}
+    conds[1] = {"b"}
+    conds[2] = {"a", "b"}
+    out = model.transform(DataFrame({"features": x[:3],
+                                     "conditioner": conds}))
+    res = out["output"]
+    assert all(r["label"] == "a" for r in res[0])
+    assert all(r["label"] == "b" for r in res[1])
+    labs2 = {r["label"] for r in res[2]}
+    assert labs2 <= {"a", "b"}
+    # exactness: unconditioned result equals plain KNN over the allowed subset
+    tree_a = BallTree(x[::2])  # label 'a' rows
+    da, _ = tree_a.query(x[:1], 5)
+    np.testing.assert_allclose(
+        [r["distance"] for r in res[0]], da[0], atol=1e-3)
+
+
+def test_conditional_balltree_exhausted_labels():
+    x = np.eye(4, dtype=np.float32)
+    tree = ConditionalBallTree(x, ["a", "a", "b", "b"])
+    d, i = tree.query(x[:1], 3, [{"b"}])
+    # only 2 'b' points exist; third slot is dead (-1 / inf)
+    assert (i[0] >= 0).sum() == 2
+    assert np.isinf(d[0][i[0] == -1]).all()
+
+
+def test_knn_save_load(tmp_path):
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(100, 5)).astype(np.float32)
+    df = DataFrame({"features": x, "values": np.arange(100)})
+    model = KNN(k=2).fit(df)
+    r1 = model.transform(df.head(3))["output"]
+    model.save(str(tmp_path / "knn"))
+    from mmlspark_tpu.core.pipeline import PipelineStage
+    loaded = PipelineStage.load(str(tmp_path / "knn"))
+    r2 = loaded.transform(df.head(3))["output"]
+    assert [x["value"] for x in r1[0]] == [x["value"] for x in r2[0]]
