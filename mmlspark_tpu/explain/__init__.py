@@ -1,0 +1,9 @@
+"""Model interpretability (reference: lime/, 4 files, 823 LoC)."""
+
+from .lasso import batched_lasso, lasso_fit
+from .lime import ImageLIME, TabularLIME, TabularLIMEModel
+from .superpixel import Superpixel, SuperpixelTransformer, slic_segments
+
+__all__ = ["TabularLIME", "TabularLIMEModel", "ImageLIME",
+           "Superpixel", "SuperpixelTransformer", "slic_segments",
+           "batched_lasso", "lasso_fit"]

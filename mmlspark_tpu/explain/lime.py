@@ -1,0 +1,154 @@
+"""TabularLIME / ImageLIME — local interpretable model-agnostic explanations.
+
+Reference: lime/LIME.scala:166-248 (`TabularLIME(Model)` — per-row perturbation
+sampling from column STDs, model.transform over replicated samples, lasso fit
+per row) and :258-317 (`ImageLIME` — SLIC superpixels, random masks, lasso on
+mask states vs prediction).
+
+TPU design (SURVEY.md §7: "perturbation batches are TPU-friendly"): all rows'
+perturbed samples go through the model as ONE batch per chunk, and the per-row
+lassos solve as one vmapped program (explain/lasso.py) — no per-row driver
+loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model, Transformer
+from .lasso import batched_lasso, lasso_fit
+from .superpixel import Superpixel, slic_segments
+
+
+def _model_outputs(model: Transformer, feats: np.ndarray, features_col: str,
+                   target_col: Optional[str], target_class: int) -> np.ndarray:
+    """Run the wrapped model on a feature batch; pull out the scalar being
+    explained (probability of target class, else prediction)."""
+    scored = model.transform(DataFrame({features_col: feats}))
+    if target_col is None:
+        target_col = next(
+            (c for c in ("probability", "scored_probabilities", "prediction",
+                         "scores") if c in scored), None)
+        if target_col is None:
+            raise ValueError(f"no model output column found in "
+                             f"{scored.columns}")
+    out = np.asarray(scored[target_col], np.float64)
+    if out.ndim == 2:
+        out = out[:, target_class]
+    return out
+
+
+class LIMEParams(_p.Params):
+    model = _p.Param("model", "fitted model to explain", None, complex=True)
+    numSamples = _p.Param("numSamples", "perturbation samples per row", 100,
+                          int)
+    regularization = _p.Param("regularization", "lasso alpha", 0.01, float)
+    targetCol = _p.Param("targetCol", "model output column to explain "
+                         "(auto: probability/prediction)", None)
+    targetClass = _p.Param("targetClass",
+                           "class index explained for vector outputs", 1, int)
+    samplingFraction = _p.Param("samplingFraction",
+                                "feature perturbation std multiplier", 1.0,
+                                float)
+
+
+class TabularLIME(Estimator, LIMEParams, _p.HasInputCol, _p.HasOutputCol,
+                  _p.HasSeed):
+    """fit() learns per-column STDs of the background data (LIME.scala:166-
+    248); the model emits per-row coefficient vectors."""
+
+    def __init__(self, **kw):
+        kw.setdefault("inputCol", "features")
+        kw.setdefault("outputCol", "weights")
+        super().__init__(**kw)
+
+    def _fit(self, df: DataFrame) -> "TabularLIMEModel":
+        x = np.asarray(df[self.get("inputCol")], np.float64)
+        stds = x.std(axis=0)
+        stds[stds < 1e-12] = 1e-12
+        out = TabularLIMEModel(column_stds=stds.astype(np.float32))
+        for p in ("model", "numSamples", "regularization", "targetCol",
+                  "targetClass", "samplingFraction", "inputCol", "outputCol",
+                  "seed"):
+            out.set(p, self.get(p))
+        return out
+
+
+class TabularLIMEModel(Model, LIMEParams, _p.HasInputCol, _p.HasOutputCol,
+                       _p.HasSeed):
+    columnSTDs = _p.Param("columnSTDs", "per-feature perturbation stds", None,
+                          complex=True)
+
+    def __init__(self, column_stds: Optional[np.ndarray] = None, **kw):
+        super().__init__(**kw)
+        if column_stds is not None:
+            self.set("columnSTDs", column_stds)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        x = np.asarray(df[self.get("inputCol")], np.float32)
+        n, d = x.shape
+        s = self.get("numSamples")
+        stds = (np.asarray(self.get("columnSTDs"), np.float32)
+                * self.get("samplingFraction"))
+        rng = np.random.default_rng(self.get("seed"))
+        noise = rng.normal(size=(n, s, d)).astype(np.float32) * stds
+        samples = x[:, None, :] + noise
+        preds = _model_outputs(
+            self.get("model"), samples.reshape(n * s, d),
+            self.get("inputCol"), self.get("targetCol"),
+            self.get("targetClass")).reshape(n, s).astype(np.float32)
+        # states are standardized offsets => coefficients are per-std effects
+        z = (noise / stds).astype(np.float32)
+        w = np.ones((n, s), np.float32)
+        coefs, _ = batched_lasso(z, preds, w,
+                                 np.float32(self.get("regularization")))
+        return df.with_column(self.get("outputCol"), np.asarray(coefs))
+
+
+class ImageLIME(Transformer, LIMEParams, _p.HasInputCol, _p.HasOutputCol,
+                _p.HasSeed):
+    """Superpixel-mask LIME for image models (LIME.scala:258-317).
+
+    transform(): per image — SLIC segments, `numSamples` random on/off masks,
+    censored images batched through the model, one lasso per image over mask
+    states. Output: per-superpixel weight vector (object column)."""
+
+    cellSize = _p.Param("cellSize", "superpixel size", 16.0, float)
+    modifier = _p.Param("modifier", "superpixel compactness", 130.0, float)
+    superpixelCol = _p.Param("superpixelCol",
+                             "optional precomputed segment column", None)
+
+    def __init__(self, **kw):
+        kw.setdefault("inputCol", "image")
+        kw.setdefault("outputCol", "weights")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        imgs = df[self.get("inputCol")]
+        s = self.get("numSamples")
+        rng = np.random.default_rng(self.get("seed"))
+        model = self.get("model")
+        out = np.empty(len(df), dtype=object)
+        seg_col = (df[self.get("superpixelCol")]
+                   if self.get("superpixelCol") else None)
+        for i in range(len(df)):
+            img = np.asarray(imgs[i], np.float64)
+            segments = (np.asarray(seg_col[i]) if seg_col is not None else
+                        slic_segments(img, self.get("cellSize"),
+                                      self.get("modifier")))
+            k = int(segments.max()) + 1
+            states = rng.random((s, k)) < 0.5
+            batch = np.stack([
+                Superpixel.censor(img, segments, st) for st in states])
+            preds = _model_outputs(
+                model, batch.astype(np.float32), self.get("inputCol"),
+                self.get("targetCol"), self.get("targetClass"))
+            coef, _ = lasso_fit(states.astype(np.float32),
+                                preds.astype(np.float32),
+                                alpha=self.get("regularization"))
+            out[i] = coef
+        return df.with_column(self.get("outputCol"), out)

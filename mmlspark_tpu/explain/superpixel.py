@@ -1,0 +1,105 @@
+"""SLIC superpixel clustering + SuperpixelTransformer.
+
+Reference: lime/Superpixel.scala:26-300+ implements a BFS cluster-expansion
+segmentation used by ImageLIME; lime/SuperpixelTransformer.scala exposes it as
+a stage. Here the segmentation is SLIC (k-means in (x, y, rgb) space) with a
+fixed iteration count — the assignment step is a vectorized distance argmin,
+the update a segment mean, both TPU/numpy friendly, no per-pixel BFS.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Transformer
+
+
+def slic_segments(img: np.ndarray, cell_size: float = 16.0,
+                  modifier: float = 10.0, iters: int = 5) -> np.ndarray:
+    """Segment an HWC float image into superpixels.
+
+    cell_size ~ reference `cellSize`; modifier ~ reference `modifier`
+    (SuperpixelTransformer params): color-vs-space tradeoff. Returns an int32
+    [H,W] label map with contiguous ids."""
+    img = np.asarray(img, np.float64)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, wdt, c = img.shape
+    step = max(int(cell_size), 2)
+    ys = np.arange(step // 2, h, step)
+    xs = np.arange(step // 2, wdt, step)
+    cy, cx = np.meshgrid(ys, xs, indexing="ij")
+    centers_xy = np.stack([cy.ravel(), cx.ravel()], 1).astype(np.float64)
+    centers_rgb = img[centers_xy[:, 0].astype(int),
+                      centers_xy[:, 1].astype(int)]
+    yy, xx = np.meshgrid(np.arange(h), np.arange(wdt), indexing="ij")
+    pix_xy = np.stack([yy.ravel(), xx.ravel()], 1).astype(np.float64)
+    pix_rgb = img.reshape(-1, c)
+    # spatial distances weighted so color differences of `modifier` match one
+    # cell of spatial distance (SLIC compactness)
+    ratio = (modifier / step) ** 2
+    n_centers = len(centers_xy)
+    for _ in range(iters):
+        d_xy = ((pix_xy[:, None, :] - centers_xy[None, :, :]) ** 2).sum(-1)
+        d_rgb = ((pix_rgb[:, None, :] - centers_rgb[None, :, :]) ** 2).sum(-1)
+        assign = (d_rgb + ratio * d_xy).argmin(1)
+        counts = np.bincount(assign, minlength=n_centers).astype(np.float64)
+        live = counts > 0
+        for d in range(2):
+            s = np.bincount(assign, weights=pix_xy[:, d],
+                            minlength=n_centers)
+            centers_xy[live, d] = s[live] / counts[live]
+        for d in range(c):
+            s = np.bincount(assign, weights=pix_rgb[:, d],
+                            minlength=n_centers)
+            centers_rgb[live, d] = s[live] / counts[live]
+    # compact ids
+    uniq, remap = np.unique(assign, return_inverse=True)
+    return remap.reshape(h, wdt).astype(np.int32)
+
+
+class Superpixel:
+    """API-parity holder (lime/Superpixel.scala): segmentation + censoring."""
+
+    @staticmethod
+    def get_clustered_image(img: np.ndarray, cell_size: float,
+                            modifier: float) -> np.ndarray:
+        return slic_segments(img, cell_size, modifier)
+
+    @staticmethod
+    def censor(img: np.ndarray, segments: np.ndarray,
+               states: np.ndarray, background: Optional[float] = None
+               ) -> np.ndarray:
+        """Zero (or background-fill) the superpixels whose state is False."""
+        img = np.asarray(img, np.float64)
+        if background is None:
+            background = img.mean()
+        keep = states[segments]  # [H,W] bool
+        out = np.where(keep[..., None] if img.ndim == 3 else keep,
+                       img, background)
+        return out
+
+
+class SuperpixelTransformer(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    """Image column -> superpixel label-map column
+    (lime/SuperpixelTransformer.scala)."""
+    cellSize = _p.Param("cellSize", "target superpixel size in pixels", 16.0,
+                        float)
+    modifier = _p.Param("modifier", "color/space compactness tradeoff", 130.0,
+                        float)
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "superpixels")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        col = df[self.get("inputCol")]
+        out = np.empty(len(df), dtype=object)
+        for i in range(len(df)):
+            out[i] = slic_segments(col[i], self.get("cellSize"),
+                                   self.get("modifier"))
+        return df.with_column(self.get("outputCol"), out)

@@ -1,0 +1,14 @@
+"""Deep-learning inference path (reference: cntk/ + image/ + opencv/ +
+downloader/). The CNTK JNI eval engine becomes a jitted flax forward pass."""
+
+from .dnn import DNNModel, GraphModel, ImageFeaturizer
+from .image import (ImageSetAugmenter, ImageTransformer,
+                    ResizeImageTransformer, UnrollImage)
+from .resnet import ModelDownloader, ModelSchema, ResNet, load_params, save_params
+
+__all__ = [
+    "DNNModel", "GraphModel", "ImageFeaturizer",
+    "ImageTransformer", "ResizeImageTransformer", "UnrollImage",
+    "ImageSetAugmenter",
+    "ResNet", "ModelDownloader", "ModelSchema", "load_params", "save_params",
+]

@@ -1,0 +1,168 @@
+"""DNNModel — batched jit DNN inference over DataFrame columns.
+
+Reference: cntk/CNTKModel.scala:145-532 — broadcast serialized graph, feed/
+fetch dicts mapping CNTK variables to columns (:204-223), minibatch ->
+`applyCNTKFunction` -> flatten (:490-530), per-partition JNI eval hot loop
+(:30-140). Here the graph is a flax module jitted once; minibatching
+(FixedMiniBatchTransformer -> FlattenBatch in the reference) collapses into
+padded fixed-size device batches inside transform, and the "broadcast" is XLA
+constant/device placement.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import params as _p
+from ...core.dataframe import DataFrame
+from ...core.pipeline import Model
+
+
+class GraphModel:
+    """A loaded network: flax module + variables + zoo schema
+    (the SerializableFunction equivalent — com/microsoft/CNTK/
+    SerializableFunction.scala:17-120)."""
+
+    def __init__(self, module, variables, schema):
+        self.module = module
+        self.variables = variables
+        self.schema = schema
+        self._jitted = {}
+
+    def apply_fn(self, layer: Optional[str]):
+        """jitted apply capturing the fetch layer (CNTK outputMap analogue)."""
+        key = layer
+        if key not in self._jitted:
+            def fn(variables, x):
+                return self.module.apply(variables, x, capture=layer)
+            self._jitted[key] = jax.jit(fn)
+        return self._jitted[key]
+
+    def __reduce__(self):
+        # pickled via the zoo name + host numpy leaves (model-bytes broadcast
+        # analogue, CNTKModel.scala:411-413)
+        leaves, treedef = jax.tree.flatten(self.variables)
+        return (_rebuild_graph_model,
+                (self.schema.name, [np.asarray(l) for l in leaves]))
+
+
+def _rebuild_graph_model(name: str, leaves):
+    from .resnet import _ZOO
+    schema = _ZOO[name]()
+    h, w, c = schema.input_dims
+    # eval_shape gets the variable treedef without materializing weights
+    shapes = jax.eval_shape(schema.module.init, jax.random.PRNGKey(0),
+                            jnp.zeros((1, h, w, c), jnp.float32))
+    _, treedef = jax.tree.flatten(shapes)
+    return GraphModel(module=schema.module,
+                      variables=jax.tree.unflatten(treedef, leaves),
+                      schema=schema)
+
+
+class DNNModel(Model, _p.HasInputCol, _p.HasOutputCol, _p.HasBatchSize):
+    """Reference surface: CNTKModel (cntk/CNTKModel.scala:145).
+
+    inputCol accepts a stacked [N,H,W,C] float column, an object column of
+    HWC images, or flat CHW vectors (UnrollImage output — reshaped back using
+    the model schema's input dims)."""
+
+    model = _p.Param("model", "GraphModel to evaluate", None, complex=True)
+    outputNode = _p.Param("outputNode", "layer to fetch (None = final "
+                          "logits); the CNTK outputMap analogue", None)
+    normalize = _p.Param("normalize", "apply schema mean/std normalization",
+                         True, bool)
+    scaleFactor = _p.Param(
+        "scaleFactor", "divide pixel values by this before normalization; "
+        "0 = by dtype (integer images / 255, float images / 1 — "
+        "deterministic, never inferred from batch contents)", 0.0, float)
+
+    def __init__(self, model: Optional[GraphModel] = None, **kw):
+        kw.setdefault("inputCol", "image")
+        kw.setdefault("outputCol", "output")
+        kw.setdefault("batchSize", 16)
+        super().__init__(**kw)
+        if model is not None:
+            self.set("model", model)
+
+    set_model = lambda self, m: self.set("model", m)  # CNTKModel.setModel
+
+    def _coerce_batch(self, col: np.ndarray) -> np.ndarray:
+        gm: GraphModel = self.get("model")
+        h, w, c = gm.schema.input_dims
+        if col.dtype == object:
+            int_input = all(np.asarray(v).dtype.kind in "iu" for v in col)
+            arr = np.stack([np.asarray(v, np.float32) for v in col])
+        else:
+            int_input = col.dtype.kind in "iu"
+            arr = np.asarray(col, np.float32)
+        if arr.ndim == 2:  # flat CHW vectors (UnrollImage convention)
+            arr = arr.reshape(len(arr), c, h, w).transpose(0, 2, 3, 1)
+        if arr.ndim == 3:
+            arr = arr[..., None]
+        if arr.shape[1:3] != (h, w):
+            import jax.image
+            arr = np.asarray(jax.image.resize(
+                jnp.asarray(arr), (arr.shape[0], h, w, arr.shape[3]),
+                "bilinear"))
+        if self.get("normalize"):
+            scale = self.get("scaleFactor") or (255.0 if int_input else 1.0)
+            arr = (arr / scale - gm.schema.mean) / gm.schema.std
+        return arr
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        gm: GraphModel = self.get("model")
+        arr = self._coerce_batch(df[self.get("inputCol")])
+        n = len(arr)
+        b = self.get("batchSize")
+        fn = gm.apply_fn(self.get("outputNode"))
+        outs = []
+        for start in range(0, n, b):
+            chunk = arr[start:start + b]
+            pad = b - len(chunk)
+            if pad:  # fixed batch shape => one compiled program
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad,) + chunk.shape[1:], np.float32)])
+            res = np.asarray(fn(gm.variables, jnp.asarray(chunk)))
+            outs.append(res[:b - pad] if pad else res)
+        out = np.concatenate(outs, axis=0)
+        return df.with_column(self.get("outputCol"),
+                              out.reshape(n, -1).astype(np.float64))
+
+
+class ImageFeaturizer(Model, _p.HasInputCol, _p.HasOutputCol):
+    """Resize -> normalize -> headless DNN forward (image/ImageFeaturizer.
+    scala:40-191; `cutOutputLayers=1` drops the classifier head and emits
+    pooled features)."""
+
+    cutOutputLayers = _p.Param("cutOutputLayers", "how many output layers to "
+                               "cut (1 = pooled features, 0 = logits)", 1, int)
+    dnnModel = _p.Param("dnnModel", "wrapped GraphModel", None, complex=True)
+    batchSize = _p.Param("batchSize", "inference minibatch", 16, int)
+
+    def __init__(self, model: Optional[GraphModel] = None, **kw):
+        kw.setdefault("inputCol", "image")
+        kw.setdefault("outputCol", "features")
+        super().__init__(**kw)
+        if model is not None:
+            self.set("dnnModel", model)
+
+    def set_model(self, model_or_name) -> "ImageFeaturizer":
+        """Accepts a GraphModel or a zoo name (setModel(ModelSchema) parity)."""
+        if isinstance(model_or_name, str):
+            from .resnet import ModelDownloader
+            model_or_name = ModelDownloader().download_by_name(model_or_name)
+        return self.set("dnnModel", model_or_name)
+
+    setModel = set_model
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        gm: GraphModel = self.get("dnnModel")
+        layer = "pool" if self.get("cutOutputLayers") >= 1 else None
+        dnn = DNNModel(model=gm, inputCol=self.get("inputCol"),
+                       outputCol=self.get("outputCol"),
+                       outputNode=layer, batchSize=self.get("batchSize"))
+        return dnn.transform(df)

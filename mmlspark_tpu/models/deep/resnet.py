@@ -1,0 +1,147 @@
+"""ResNet in flax + the local model zoo.
+
+Reference: the CNTK model zoo reached through downloader/ModelDownloader.scala
+:27-250 (remote `Repository[S]` of serialized CNTK graphs with schema —
+layerNames, inputNode, dims) whose flagship entry is ResNet-50 for
+ImageFeaturizer. Here models are flax modules with locally materialized
+parameters (zero-egress environment: weights initialize deterministically from
+a seed; `load_params` accepts externally supplied checkpoints via orbax/npz).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    projection: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = nn.BatchNorm(use_running_average=True)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False)(y)
+        y = nn.BatchNorm(use_running_average=True)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = nn.BatchNorm(use_running_average=True, scale_init=nn.initializers.zeros)(y)
+        if self.projection:
+            residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                               use_bias=False)(x)
+            residual = nn.BatchNorm(use_running_average=True)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 (bottleneck). stage_sizes (3,4,6,3) = ResNet-50."""
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, capture=None):
+        feats = {}
+        x = nn.Conv(64, (7, 7), (2, 2), use_bias=False, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=True)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), "SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(64 * 2 ** i, strides,
+                                    projection=(j == 0))(x)
+            feats[f"stage{i + 1}"] = x
+        x = x.mean(axis=(1, 2))
+        feats["pool"] = x  # penultimate features (the ImageFeaturizer cut)
+        x = nn.Dense(self.num_classes, name="head")(x)
+        feats["logits"] = x
+        if capture is not None:
+            return feats[capture]
+        return x
+
+
+class ModelSchema:
+    """Zoo entry metadata (downloader/Schema.scala: layerNames, inputNode,
+    dims)."""
+
+    def __init__(self, name: str, module: nn.Module,
+                 input_dims: Tuple[int, int, int],
+                 layer_names: Sequence[str],
+                 mean: Sequence[float], std: Sequence[float]):
+        self.name = name
+        self.module = module
+        self.input_dims = input_dims    # (H, W, C)
+        self.layer_names = list(layer_names)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+
+_ZOO: Dict[str, Callable[[], ModelSchema]] = {
+    "ResNet50": lambda: ModelSchema(
+        "ResNet50", ResNet(stage_sizes=(3, 4, 6, 3)), (224, 224, 3),
+        ["stage1", "stage2", "stage3", "stage4", "pool", "logits"],
+        mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    "ResNet18-ish": lambda: ModelSchema(
+        # bottleneck variant at ResNet-18 depth budget (for fast tests)
+        "ResNet18-ish", ResNet(stage_sizes=(1, 1, 1, 1)), (64, 64, 3),
+        ["stage1", "stage2", "stage3", "stage4", "pool", "logits"],
+        mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+}
+
+
+class ModelDownloader:
+    """Local zoo resolver (ModelDownloader.scala:27-250 without the network:
+    weights come from a deterministic init, or from a local checkpoint via
+    `load_params`)."""
+
+    def __init__(self, local_path: Optional[str] = None):
+        self.local_path = local_path
+
+    @staticmethod
+    def list_models() -> Sequence[str]:
+        return sorted(_ZOO)
+
+    def download_by_name(self, name: str, seed: int = 0):
+        from .dnn import GraphModel
+        if name not in _ZOO:
+            raise KeyError(f"unknown model {name!r}; have {sorted(_ZOO)}")
+        schema = _ZOO[name]()
+        h, w, c = schema.input_dims
+        variables = schema.module.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, h, w, c), jnp.float32))
+        if self.local_path:
+            variables = load_params(self.local_path, variables)
+        return GraphModel(module=schema.module, variables=variables,
+                          schema=schema)
+
+    downloadByName = download_by_name
+
+
+def _npz_path(path: str) -> str:
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def load_params(path: str, template):
+    """Load a checkpoint saved as npz of flattened paths onto a template
+    pytree."""
+    flat = np.load(_npz_path(path))
+    leaves, treedef = jax.tree.flatten(template)
+    keys = sorted(flat.files)
+    if len(keys) != len(leaves):
+        raise ValueError(f"checkpoint has {len(keys)} arrays, "
+                         f"model expects {len(leaves)}")
+    return jax.tree.unflatten(treedef, [flat[k] for k in keys])
+
+
+def save_params(path: str, variables) -> None:
+    leaves, _ = jax.tree.flatten(variables)
+    np.savez(_npz_path(path), **{f"p{i:05d}": np.asarray(l)
+                                 for i, l in enumerate(leaves)})

@@ -216,20 +216,28 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     s_mask = jnp.zeros((lcap - 1, bm), bool)
     done = jnp.array(False)
 
+    # per-slot cached best split (LightGBM's leaf split queue): only the two
+    # slots whose histograms changed are rescanned per step — O(L F B) per
+    # tree instead of O(L^2 F B). Unpopulated slots stay at -inf.
+    g0, f0, b0 = _best_split_per_slot(hists[:1], sums[:1], cfg, feature_mask)
+    cache_gain = jnp.full((lcap,), _NEG_INF).at[0].set(g0[0])
+    cache_feat = jnp.zeros((lcap,), jnp.int32).at[0].set(f0[0])
+    cache_bin = jnp.zeros((lcap,), jnp.int32).at[0].set(b0[0])
+
     def body(s, carry):
         (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-         s_valid, s_gain, s_is_cat, s_mask, done) = carry
-        gains, feats, bins = _best_split_per_slot(hists, sums, cfg, feature_mask)
+         s_valid, s_gain, s_is_cat, s_mask, done,
+         cache_gain, cache_feat, cache_bin) = carry
         slot_exists = jnp.arange(lcap) <= s
         if cfg.max_depth > 0:
             slot_exists = slot_exists & (depth_of_slot < cfg.max_depth)
-        gains = jnp.where(slot_exists, gains, _NEG_INF)
+        gains = jnp.where(slot_exists, cache_gain, _NEG_INF)
         best_slot = jnp.argmax(gains).astype(jnp.int32)
         best_gain = gains[best_slot]
         do = (best_gain > cfg.min_gain_to_split + _MIN_GAIN_EPS) & (~done)
 
-        feat_b = feats[best_slot]
-        bin_b = bins[best_slot]
+        feat_b = cache_feat[best_slot]
+        bin_b = cache_bin[best_slot]
         new_slot = (s + 1).astype(jnp.int32)
 
         col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
@@ -274,14 +282,31 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         s_is_cat = s_is_cat.at[s].set(feat_cat & do)
         s_mask = s_mask.at[s].set(mask[:bm])
         done = done | ~do
+
+        # rescan ONLY the two slots whose histograms changed
+        pair_idx = jnp.stack([best_slot, new_slot])
+        pg, pf, pb = _best_split_per_slot(hists[pair_idx], sums[pair_idx],
+                                          cfg, feature_mask)
+        cache_gain = cache_gain.at[best_slot].set(
+            jnp.where(do, pg[0], cache_gain[best_slot]))
+        cache_feat = cache_feat.at[best_slot].set(
+            jnp.where(do, pf[0], cache_feat[best_slot]))
+        cache_bin = cache_bin.at[best_slot].set(
+            jnp.where(do, pb[0], cache_bin[best_slot]))
+        cache_gain = cache_gain.at[new_slot].set(
+            jnp.where(do, pg[1], _NEG_INF))
+        cache_feat = cache_feat.at[new_slot].set(jnp.where(do, pf[1], 0))
+        cache_bin = cache_bin.at[new_slot].set(jnp.where(do, pb[1], 0))
         return (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat,
-                s_bin, s_valid, s_gain, s_is_cat, s_mask, done)
+                s_bin, s_valid, s_gain, s_is_cat, s_mask, done,
+                cache_gain, cache_feat, cache_bin)
 
     carry = (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-             s_valid, s_gain, s_is_cat, s_mask, done)
+             s_valid, s_gain, s_is_cat, s_mask, done,
+             cache_gain, cache_feat, cache_bin)
     carry = jax.lax.fori_loop(0, lcap - 1, body, carry)
     (hists, sums, _, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
-     s_is_cat, s_mask, _) = carry
+     s_is_cat, s_mask, _, _, _, _) = carry
 
     leaf_value = (_leaf_output(sums[:, 0], sums[:, 1], cfg.lambda_l1,
                                cfg.lambda_l2)

@@ -62,8 +62,7 @@ class SAR(Estimator):
         # --- item-item similarity from co-occurrence (SAR.scala:152-205)
         seen = np.zeros((n_users, n_items), np.float32)
         seen[users, items] = 1.0
-        cooc = np.asarray(
-            jax.jit(lambda s: s.T @ s)(jnp.asarray(seen)))  # [I,I] on MXU
+        cooc = np.asarray(_cooccurrence(jnp.asarray(seen)))  # [I,I] on MXU
         support = np.diag(cooc).copy()
         thresh = float(self.get("supportThreshold"))
         cooc = np.where(cooc >= thresh, cooc, 0.0)
@@ -87,6 +86,16 @@ class SAR(Estimator):
         for p in ("userCol", "itemCol"):
             model.set(p, self.get(p))
         return model
+
+
+@jax.jit
+def _cooccurrence(seen):
+    return seen.T @ seen
+
+
+@jax.jit
+def _affinity_scores(affinity_rows, similarity):
+    return affinity_rows @ similarity
 
 
 @jax.jit
@@ -151,7 +160,7 @@ class SARModel(Model):
         uniq, inv = np.unique(users[valid], return_inverse=True)
         pred = np.full(len(users), np.nan)
         if uniq.size:
-            sub = np.asarray(jax.jit(jnp.matmul)(
+            sub = np.asarray(_affinity_scores(
                 jnp.asarray(affinity[uniq]), jnp.asarray(similarity)))
             pred[valid] = sub[inv, items[valid]]
         return df.with_column("prediction", pred)

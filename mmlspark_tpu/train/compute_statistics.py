@@ -95,9 +95,22 @@ class ComputePerInstanceStatistics(Transformer, _p.HasLabelCol):
         if kind in ("all", None):
             kind = ("classification" if prob_col is not None else "regression")
         if kind == "classification":
-            labels, _ = index_label_pred(df[self.get("labelCol")],
-                                         df[pred_col] if pred_col
-                                         else df[self.get("labelCol")])
+            label_raw = df[self.get("labelCol")]
+            levels = (df.metadata(prob_col) or {}).get("levels")
+            if levels is not None and label_raw.dtype == object:
+                # index by the MODEL's training levels so label i matches
+                # probability column i (levels metadata set by
+                # TrainedClassifierModel.transform)
+                lookup = {v: i for i, v in enumerate(levels)}
+                labels = np.array([lookup.get(v, -1) for v in label_raw],
+                                  np.float64)
+                if (labels < 0).any():
+                    raise ValueError("labels outside the model's training "
+                                     "levels")
+            else:
+                labels, _ = index_label_pred(label_raw,
+                                             df[pred_col] if pred_col
+                                             else label_raw)
             probs = np.asarray(df[prob_col], np.float64)
             if probs.ndim == 1:
                 probs = np.stack([1 - probs, probs], axis=1)

@@ -119,10 +119,15 @@ class TrainedClassifierModel(Model, _p.HasLabelCol, _p.HasFeaturesCol):
             out = out.with_column("scores", scored[raw_col])
         prob_col = (inner.get("probabilityCol")
                     if inner.has_param("probabilityCol") else None)
-        if prob_col and prob_col in scored:
-            out = out.with_column("scored_probabilities", scored[prob_col])
-        preds = np.asarray(scored[inner.get("predictionCol")], np.float64)
         levels = self.get("levels")
+        if prob_col and prob_col in scored:
+            # column ordering metadata lets stats stages index probabilities
+            # by the TRAINING levels (SparkSchema.scala score-column metadata)
+            out = out.with_column(
+                "scored_probabilities", scored[prob_col],
+                metadata={"levels": list(levels)} if levels is not None
+                else None)
+        preds = np.asarray(scored[inner.get("predictionCol")], np.float64)
         if levels is not None:
             decoded = np.empty(len(preds), dtype=object)
             for i, p in enumerate(preds):

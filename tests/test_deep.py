@@ -1,0 +1,117 @@
+"""Deep-inference path tests: image stages, DNNModel batching, ImageFeaturizer
+layer cut, zoo + checkpoint roundtrip. Reference suites: cntk/ (CNTKModelSuite),
+opencv/ (ImageTransformerSuite), image/."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.deep import (DNNModel, ImageFeaturizer,
+                                      ImageSetAugmenter, ImageTransformer,
+                                      ModelDownloader, ResizeImageTransformer,
+                                      UnrollImage)
+
+
+def _img_df(n=3, h=32, w=32, seed=0):
+    rng = np.random.default_rng(seed)
+    imgs = np.empty(n, dtype=object)
+    for i in range(n):
+        imgs[i] = rng.random((h, w, 3)).astype(np.float32)
+    return DataFrame({"image": imgs})
+
+
+def test_image_transformer_pipeline():
+    df = _img_df()
+    t = (ImageTransformer()
+         .resize(16, 16)
+         .crop(2, 2, 12, 12)
+         .flip(True)
+         .blur(3, 3)
+         .threshold(0.5, 1.0))
+    out = t.transform(df)["image"]
+    assert out[0].shape == (12, 12, 3)
+    assert set(np.unique(out[0])) <= {0.0, 1.0}
+
+
+def test_image_transformer_grayscale_and_gaussian():
+    df = _img_df()
+    t = ImageTransformer().color_format("gray").gaussian_kernel(5, 1.5)
+    out = t.transform(df)["image"]
+    assert out[0].shape == (32, 32, 1)
+    orig_var = df["image"][0].mean(-1).var()
+    assert out[0].var() < orig_var  # smoothing reduces variance
+
+
+def test_resize_transformer_and_unroll():
+    df = _img_df()
+    resized = ResizeImageTransformer(height=8, width=8).transform(df)
+    assert resized["image"][0].shape == (8, 8, 3)
+    unrolled = UnrollImage().transform(resized)
+    feats = unrolled["features"]
+    assert feats.shape == (3, 8 * 8 * 3)
+    # CHW ordering: first 64 values are channel 0
+    np.testing.assert_allclose(
+        feats[0][:64], resized["image"][0][:, :, 0].ravel(), rtol=1e-5)
+
+
+def test_image_set_augmenter():
+    df = _img_df(n=2)
+    out = ImageSetAugmenter(flipLeftRight=True, flipUpDown=True).transform(df)
+    assert len(out) == 6
+    np.testing.assert_allclose(out["image"][2], df["image"][0][:, ::-1])
+    np.testing.assert_allclose(out["image"][4], df["image"][0][::-1])
+
+
+def test_dnn_model_batching_padding():
+    gm = ModelDownloader().download_by_name("ResNet18-ish")
+    df = _img_df(n=5, h=64, w=64)  # 5 rows, batch 2 => padded final batch
+    model = DNNModel(model=gm, batchSize=2)
+    out = model.transform(df)["output"]
+    assert out.shape == (5, 1000)
+    assert np.isfinite(out).all()
+    # padding must not contaminate results: same row alone vs in batch
+    single = DNNModel(model=gm, batchSize=1).transform(
+        df.take([4]))["output"]
+    np.testing.assert_allclose(out[4], single[0], atol=1e-4)
+
+
+def test_image_featurizer_layer_cut():
+    gm = ModelDownloader().download_by_name("ResNet18-ish")
+    df = _img_df(n=2, h=64, w=64)
+    feats = ImageFeaturizer(model=gm, cutOutputLayers=1).transform(df)
+    assert feats["features"].shape == (2, 2048)  # pooled stage4 width (512*4)
+    logits = ImageFeaturizer(model=gm, cutOutputLayers=0).transform(df)
+    assert logits["features"].shape == (2, 1000)
+
+
+def test_dnn_accepts_unrolled_vectors():
+    gm = ModelDownloader().download_by_name("ResNet18-ish")
+    df = _img_df(n=2, h=64, w=64)
+    unrolled = UnrollImage().transform(df)
+    out = DNNModel(model=gm, inputCol="features",
+                   batchSize=2).transform(unrolled)
+    stacked = DNNModel(model=gm, batchSize=2).transform(df)
+    np.testing.assert_allclose(out["output"], stacked["output"], atol=1e-4)
+
+
+def test_zoo_checkpoint_roundtrip(tmp_path):
+    from mmlspark_tpu.models.deep import load_params, save_params
+    gm = ModelDownloader().download_by_name("ResNet18-ish", seed=1)
+    p = str(tmp_path / "ckpt.npz")
+    save_params(p, gm.variables)
+    gm2 = ModelDownloader().download_by_name("ResNet18-ish", seed=2)
+    gm2.variables = load_params(p, gm2.variables)
+    df = _img_df(n=1, h=64, w=64)
+    o1 = DNNModel(model=gm).transform(df)["output"]
+    o2 = DNNModel(model=gm2).transform(df)["output"]
+    np.testing.assert_allclose(o1, o2, atol=1e-5)
+
+
+def test_dnn_model_pickle_roundtrip(tmp_path):
+    import pickle
+    gm = ModelDownloader().download_by_name("ResNet18-ish")
+    df = _img_df(n=1, h=64, w=64)
+    o1 = DNNModel(model=gm).transform(df)["output"]
+    gm2 = pickle.loads(pickle.dumps(gm))
+    o2 = DNNModel(model=gm2).transform(df)["output"]
+    np.testing.assert_allclose(o1, o2, atol=1e-5)

@@ -1,0 +1,126 @@
+"""explain/ LIME tests — lasso recovery, tabular LIME on a known-linear model,
+image LIME localization, SLIC sanity. Reference suites: lime/."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.explain import (ImageLIME, Superpixel, SuperpixelTransformer,
+                                  TabularLIME, lasso_fit, slic_segments)
+
+
+def test_lasso_recovers_sparse_coefs():
+    rng = np.random.default_rng(0)
+    s, d = 200, 10
+    z = rng.normal(size=(s, d)).astype(np.float32)
+    true = np.zeros(d, np.float32)
+    true[2], true[7] = 3.0, -2.0
+    y = z @ true + 1.5
+    coef, icept = lasso_fit(z, y, alpha=0.05, iters=500)
+    assert abs(coef[2] - 3.0) < 0.2
+    assert abs(coef[7] + 2.0) < 0.2
+    assert np.abs(coef[[0, 1, 3, 4, 5, 6, 8, 9]]).max() < 0.1
+    assert abs(icept - 1.5) < 0.3
+
+
+def test_lasso_batched_shapes():
+    rng = np.random.default_rng(1)
+    z = rng.normal(size=(4, 50, 6)).astype(np.float32)
+    y = rng.normal(size=(4, 50)).astype(np.float32)
+    coef, icept = lasso_fit(z, y, alpha=0.01)
+    assert coef.shape == (4, 6) and icept.shape == (4,)
+
+
+class _LinearModel(Transformer):
+    """Deterministic model: prediction = x @ w."""
+    def __init__(self, w, features_col="features", **kw):
+        super().__init__(**kw)
+        self._w = np.asarray(w, np.float64)
+
+    def transform(self, df):
+        x = np.asarray(df[self._features_col()], np.float64)
+        return df.with_column("prediction", x @ self._w)
+
+    def _features_col(self):
+        return "features"
+
+
+def test_tabular_lime_finds_important_features(binary_df):
+    # model depends only on features 0 and 3
+    w = np.zeros(10)
+    w[0], w[3] = 2.0, -1.0
+    model = _LinearModel(w)
+    lime = TabularLIME(model=model, numSamples=80, regularization=0.01,
+                       targetCol="prediction", seed=7)
+    fitted = lime.fit(binary_df)
+    out = fitted.transform(binary_df.head(5))
+    coefs = out["weights"]
+    assert coefs.shape == (5, 10)
+    for r in range(5):
+        mags = np.abs(coefs[r])
+        assert {int(np.argsort(mags)[-1]), int(np.argsort(mags)[-2])} == {0, 3}
+
+
+def test_slic_segments_basic():
+    img = np.zeros((32, 32, 3))
+    img[:, 16:] = 1.0  # two homogeneous halves
+    seg = slic_segments(img, cell_size=8, modifier=10)
+    assert seg.shape == (32, 32)
+    assert seg.min() == 0
+    k = seg.max() + 1
+    assert 2 <= k <= 32
+    # left/right halves should not share segments (strong color boundary)
+    left, right = set(seg[:, :8].ravel()), set(seg[:, 24:].ravel())
+    assert not (left & right)
+
+
+def test_superpixel_censor():
+    img = np.ones((8, 8, 3))
+    seg = np.zeros((8, 8), np.int32)
+    seg[:, 4:] = 1
+    censored = Superpixel.censor(img, seg, np.array([True, False]),
+                                 background=0.0)
+    assert censored[:, :4].sum() == 8 * 4 * 3
+    assert censored[:, 4:].sum() == 0
+
+
+def test_superpixel_transformer():
+    imgs = np.empty(2, dtype=object)
+    imgs[0] = np.random.default_rng(0).random((24, 24, 3))
+    imgs[1] = np.random.default_rng(1).random((16, 16, 3))
+    df = DataFrame({"image": imgs})
+    out = SuperpixelTransformer(inputCol="image", cellSize=8).transform(df)
+    assert out["superpixels"][0].shape == (24, 24)
+    assert out["superpixels"][1].shape == (16, 16)
+
+
+class _BrightnessModel(Transformer):
+    """Scores mean brightness of the top-left quadrant."""
+    def transform(self, df):
+        imgs = np.asarray(df["image"], np.float64)
+        score = imgs[:, :12, :12].mean(axis=(1, 2, 3))
+        return df.with_column("prediction", score)
+
+
+def test_image_lime_localizes():
+    rng = np.random.default_rng(5)
+    img = rng.random((24, 24, 3)) * 0.2
+    img[:12, :12] += 0.7  # bright top-left quadrant drives the model
+    imgs = np.empty(1, dtype=object)
+    imgs[0] = img
+    df = DataFrame({"image": imgs})
+    lime = ImageLIME(model=_BrightnessModel(), numSamples=120, cellSize=8,
+                     modifier=50, regularization=0.003,
+                     targetCol="prediction", seed=3)
+    out = lime.transform(df)
+    weights = out["weights"][0]
+    seg = slic_segments(img, 8, 50)
+    # superpixels overlapping the top-left quadrant should carry the largest
+    # positive weights
+    tl_segments = set(seg[:12, :12].ravel())
+    other = [w for k, w in enumerate(weights) if k not in tl_segments]
+    top = weights.argsort()[-3:]
+    assert all(t in tl_segments for t in top)
+    if other:
+        assert weights.max() > np.max(other) + 1e-6
