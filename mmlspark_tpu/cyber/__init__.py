@@ -1,0 +1,15 @@
+"""CyberML (reference: src/main/python/mmlspark/cyber/, 1962 LoC pure python)."""
+
+from .anomaly import (AccessAnomaly, AccessAnomalyModel,
+                      ComplementAccessTransformer, connected_components)
+from .feature import (IdIndexer, IdIndexerModel, LinearScalarScaler,
+                      LinearScalarScalerModel, StandardScalarScaler,
+                      StandardScalarScalerModel)
+
+__all__ = [
+    "AccessAnomaly", "AccessAnomalyModel", "ComplementAccessTransformer",
+    "connected_components",
+    "IdIndexer", "IdIndexerModel",
+    "StandardScalarScaler", "StandardScalarScalerModel",
+    "LinearScalarScaler", "LinearScalarScalerModel",
+]

@@ -1,0 +1,220 @@
+"""CyberML access-anomaly detection.
+
+Reference: src/main/python/mmlspark/cyber/anomaly/collaborative_filtering.py:
+44-988 — `AccessAnomaly`: per-tenant ALS factorization of the (user, resource)
+access matrix; anomaly score = standardized negative affinity (-u.v), so
+accesses unlike anything the factorization explains score high. Plus
+anomaly/complement_access.py:148 (`ComplementAccessTransformer` — sample
+(user, resource) pairs NOT present, for evaluation) and `ConnectedComponents`
+(:415 — used to group users/resources sharing access structure).
+
+TPU design: ALS alternating ridge solves are batched einsums + a vmapped
+Cholesky solve over all users (then all resources) at once — no per-user
+Python loops, one jit per alternation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model
+
+
+@partial(jax.jit, static_argnames=("rank", "n_out"))
+def _als_step(other_factors, rows, cols, vals, n_out, reg, rank: int):
+    """One ALS half-step: solve factors for every `row` id given the other
+    side's factors. Normal equations accumulated by segment-sum, solved by a
+    vmapped linear solve."""
+    f = other_factors[cols]                              # [nnz, r]
+    ata = jnp.einsum("ni,nj->nij", f, f)                 # [nnz, r, r]
+    atb = f * vals[:, None]                              # [nnz, r]
+    gram = jax.ops.segment_sum(ata, rows, n_out)         # [n, r, r]
+    rhs = jax.ops.segment_sum(atb, rows, n_out)          # [n, r]
+    gram = gram + reg * jnp.eye(rank)[None]
+    return jax.vmap(jnp.linalg.solve)(gram, rhs)
+
+
+@jax.jit
+def _pair_scores(user_f, res_f, users, resources):
+    return (user_f[users] * res_f[resources]).sum(axis=1)
+
+
+class AccessAnomaly(Estimator):
+    """Per-tenant ALS access model -> standardized anomaly scores."""
+
+    tenantCol = _p.Param("tenantCol", "tenant column", "tenant")
+    userCol = _p.Param("userCol", "user index column (int)", "user")
+    resCol = _p.Param("resCol", "resource index column (int)", "res")
+    likelihoodCol = _p.Param("likelihoodCol",
+                             "access strength column (count); None = 1",
+                             None)
+    outputCol = _p.Param("outputCol", "anomaly score column",
+                         "anomaly_score")
+    rankParam = _p.Param("rankParam", "latent dimension", 10, int)
+    maxIter = _p.Param("maxIter", "ALS sweeps", 10, int)
+    regParam = _p.Param("regParam", "ridge regularization", 0.1, float)
+    seed = _p.Param("seed", "init seed", 0, int)
+
+    def _fit(self, df: DataFrame) -> "AccessAnomalyModel":
+        tenants = df[self.get("tenantCol")]
+        users = np.asarray(df[self.get("userCol")], np.int64)
+        resources = np.asarray(df[self.get("resCol")], np.int64)
+        lik_col = self.get("likelihoodCol")
+        vals = (np.asarray(df[lik_col], np.float64) if lik_col and
+                lik_col in df else np.ones(len(df)))
+        vals = np.log1p(vals)  # dampen heavy hitters (reference scales counts)
+        rank = self.get("rankParam")
+        reg = self.get("regParam")
+        rng = np.random.default_rng(self.get("seed"))
+
+        factors: Dict[object, Tuple[np.ndarray, np.ndarray]] = {}
+        norm: Dict[object, Tuple[float, float]] = {}
+        for t in sorted(set(tenants.tolist()), key=str):
+            mask = np.array([x == t for x in tenants])
+            u, r, v = users[mask], resources[mask], vals[mask]
+            nu, nr = int(u.max()) + 1, int(r.max()) + 1
+            uf = rng.normal(scale=0.1, size=(nu, rank)).astype(np.float32)
+            rf = rng.normal(scale=0.1, size=(nr, rank)).astype(np.float32)
+            uj, rj = jnp.asarray(u), jnp.asarray(r)
+            vj = jnp.asarray(v, jnp.float32)
+            uf, rf = jnp.asarray(uf), jnp.asarray(rf)
+            for _ in range(self.get("maxIter")):
+                uf = _als_step(rf, uj, rj, vj, reg=reg, rank=rank, n_out=nu)
+                rf = _als_step(uf, rj, uj, vj, reg=reg, rank=rank, n_out=nr)
+            uf, rf = np.asarray(uf), np.asarray(rf)
+            # per-tenant standardization of the TRAINING scores
+            # (AccessAnomaly scales scores so tenants are comparable)
+            fit_scores = -(uf[u] * rf[r]).sum(axis=1)
+            norm[t] = (float(fit_scores.mean()),
+                       float(fit_scores.std()) or 1.0)
+            factors[t] = (uf, rf)
+        model = AccessAnomalyModel(factors=factors, norm=norm)
+        for p in ("tenantCol", "userCol", "resCol", "outputCol"):
+            model.set(p, self.get(p))
+        return model
+
+
+class AccessAnomalyModel(Model):
+    tenantCol = _p.Param("tenantCol", "tenant column", "tenant")
+    userCol = _p.Param("userCol", "user index column", "user")
+    resCol = _p.Param("resCol", "resource index column", "res")
+    outputCol = _p.Param("outputCol", "anomaly score column", "anomaly_score")
+    factors = _p.Param("factors", "tenant -> (user_f, res_f)", None,
+                       complex=True)
+    norm = _p.Param("norm", "tenant -> (mean, std)", None, complex=True)
+
+    def __init__(self, factors=None, norm=None, **kw):
+        super().__init__(**kw)
+        if factors is not None:
+            self._set(factors=factors, norm=norm)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        tenants = df[self.get("tenantCol")]
+        users = np.asarray(df[self.get("userCol")], np.int64)
+        resources = np.asarray(df[self.get("resCol")], np.int64)
+        factors = self.get("factors")
+        norm = self.get("norm")
+        out = np.full(len(df), np.nan)
+        for t in set(tenants.tolist()):
+            if t not in factors:
+                continue
+            uf, rf = factors[t]
+            mean, std = norm[t]
+            mask = np.array([x == t for x in tenants])
+            u, r = users[mask], resources[mask]
+            ok = (u >= 0) & (u < len(uf)) & (r >= 0) & (r < len(rf))
+            scores = np.full(len(u), np.nan)
+            if ok.any():
+                raw = -np.asarray(_pair_scores(
+                    jnp.asarray(uf), jnp.asarray(rf),
+                    jnp.asarray(u[ok]), jnp.asarray(r[ok])))
+                scores[ok] = (raw - mean) / std
+            out[mask] = scores
+        return df.with_column(self.get("outputCol"), out)
+
+
+class ComplementAccessTransformer(_p.Params):
+    """Sample (tenant, user, resource) triples NOT present in the input —
+    evaluation negatives (cyber/anomaly/complement_access.py:148)."""
+
+    tenantCol = _p.Param("tenantCol", "tenant column", "tenant")
+    indexedColNames = _p.Param("indexedColNames", "columns forming the pair",
+                               None)
+    complementsetFactor = _p.Param("complementsetFactor",
+                                   "negatives per positive", 2, int)
+    seed = _p.Param("seed", "sampling seed", 0, int)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        if not self.is_set("indexedColNames"):
+            self.set("indexedColNames", ["user", "res"])
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        tcol = self.get("tenantCol")
+        ucol, rcol = self.get("indexedColNames")
+        tenants = df[tcol]
+        users = np.asarray(df[ucol], np.int64)
+        resources = np.asarray(df[rcol], np.int64)
+        rng = np.random.default_rng(self.get("seed"))
+        factor = self.get("complementsetFactor")
+        out_t: List = []
+        out_u: List[int] = []
+        out_r: List[int] = []
+        for t in sorted(set(tenants.tolist()), key=str):
+            mask = np.array([x == t for x in tenants])
+            u, r = users[mask], resources[mask]
+            seen = set(zip(u.tolist(), r.tolist()))
+            n_want = len(u) * factor
+            hi_u, hi_r = int(u.max()) + 1, int(r.max()) + 1
+            cap = hi_u * hi_r - len(seen)
+            n_want = min(n_want, max(cap, 0))
+            tries = 0
+            got = set()
+            while len(got) < n_want and tries < 50 * max(n_want, 1):
+                cu = int(rng.integers(hi_u))
+                cr = int(rng.integers(hi_r))
+                tries += 1
+                if (cu, cr) not in seen and (cu, cr) not in got:
+                    got.add((cu, cr))
+            for cu, cr in sorted(got):
+                out_t.append(t)
+                out_u.append(cu)
+                out_r.append(cr)
+        return DataFrame({tcol: np.array(out_t, dtype=object),
+                          ucol: np.array(out_u, np.int64),
+                          rcol: np.array(out_r, np.int64)})
+
+
+def connected_components(edges_u: np.ndarray, edges_v: np.ndarray
+                         ) -> np.ndarray:
+    """Union-find over a bipartite edge list; returns the component id of each
+    edge (reference: collaborative_filtering.py ConnectedComponents :415).
+    Vertex spaces are disjoint (u and v are separate id spaces)."""
+    nu = int(edges_u.max()) + 1 if len(edges_u) else 0
+    parent = np.arange(nu + (int(edges_v.max()) + 1 if len(edges_v) else 0))
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    for u, v in zip(edges_u, edges_v):
+        ra, rb = find(int(u)), find(int(v) + nu)
+        if ra != rb:
+            parent[rb] = ra
+    comp = {}
+    out = np.empty(len(edges_u), np.int64)
+    for i, u in enumerate(edges_u):
+        root = find(int(u))
+        out[i] = comp.setdefault(root, len(comp))
+    return out

@@ -1,0 +1,169 @@
+"""CyberML feature utilities: per-tenant indexers and scalers.
+
+Reference: src/main/python/mmlspark/cyber/feature/indexers.py (partitioned id
+indexers — contiguous ids per tenant) and feature/scalers.py (standard / linear
+per-partition scalers). Pure-python in the reference too; here the grouping is
+vectorized numpy over the tenant column.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model
+
+
+class IdIndexer(Estimator):
+    """String ids -> per-tenant contiguous ints (cyber/feature/indexers.py)."""
+    inputCol = _p.Param("inputCol", "raw id column", "id")
+    partitionKey = _p.Param("partitionKey", "tenant column", "tenant")
+    outputCol = _p.Param("outputCol", "indexed id column", "id_idx")
+    resetPerPartition = _p.Param("resetPerPartition",
+                                 "ids restart at 1 per tenant", True, bool)
+
+    def _fit(self, df: DataFrame) -> "IdIndexerModel":
+        tenants = df[self.get("partitionKey")]
+        ids = df[self.get("inputCol")]
+        mapping: Dict[Tuple, int] = {}
+        per_tenant_next: Dict[object, int] = {}
+        reset = self.get("resetPerPartition")
+        global_next = [1]
+        for t, v in zip(tenants, ids):
+            key = (t, v) if reset else (None, v)
+            if key not in mapping:
+                if reset:
+                    nxt = per_tenant_next.get(t, 1)
+                    mapping[key] = nxt
+                    per_tenant_next[t] = nxt + 1
+                else:
+                    mapping[key] = global_next[0]
+                    global_next[0] += 1
+        model = IdIndexerModel(mapping=mapping)
+        for p in ("inputCol", "partitionKey", "outputCol",
+                  "resetPerPartition"):
+            model.set(p, self.get(p))
+        return model
+
+
+class IdIndexerModel(Model):
+    inputCol = _p.Param("inputCol", "raw id column", "id")
+    partitionKey = _p.Param("partitionKey", "tenant column", "tenant")
+    outputCol = _p.Param("outputCol", "indexed id column", "id_idx")
+    resetPerPartition = _p.Param("resetPerPartition", "per-tenant ids", True,
+                                 bool)
+    mapping = _p.Param("mapping", "(tenant, id) -> int", None, complex=True)
+
+    def __init__(self, mapping=None, **kw):
+        super().__init__(**kw)
+        if mapping is not None:
+            self.set("mapping", mapping)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        mapping = self.get("mapping")
+        reset = self.get("resetPerPartition")
+        tenants = df[self.get("partitionKey")]
+        ids = df[self.get("inputCol")]
+        out = np.array([mapping.get((t if reset else None, v), 0)
+                        for t, v in zip(tenants, ids)], np.int64)
+        return df.with_column(self.get("outputCol"), out)
+
+
+class _PerTenantScalerBase(Estimator):
+    inputCol = _p.Param("inputCol", "value column", "value")
+    partitionKey = _p.Param("partitionKey", "tenant column", "tenant")
+    outputCol = _p.Param("outputCol", "scaled column", "scaled")
+
+    def _tenant_groups(self, df: DataFrame):
+        tenants = df[self.get("partitionKey")]
+        vals = np.asarray(df[self.get("inputCol")], np.float64)
+        groups: Dict[object, np.ndarray] = {}
+        for t in set(tenants.tolist()):
+            groups[t] = vals[np.array([x == t for x in tenants])]
+        return tenants, vals, groups
+
+
+class StandardScalarScaler(_PerTenantScalerBase):
+    """Per-tenant (x - mean) / std (cyber/feature/scalers.py)."""
+    coefficientFactor = _p.Param("coefficientFactor", "std multiplier", 1.0,
+                                 float)
+
+    def _fit(self, df: DataFrame) -> "StandardScalarScalerModel":
+        _, _, groups = self._tenant_groups(df)
+        stats = {t: (float(v.mean()), float(v.std()) or 1.0)
+                 for t, v in groups.items()}
+        model = StandardScalarScalerModel(stats=stats)
+        for p in ("inputCol", "partitionKey", "outputCol",
+                  "coefficientFactor"):
+            model.set(p, self.get(p))
+        return model
+
+
+class StandardScalarScalerModel(Model):
+    inputCol = _p.Param("inputCol", "value column", "value")
+    partitionKey = _p.Param("partitionKey", "tenant column", "tenant")
+    outputCol = _p.Param("outputCol", "scaled column", "scaled")
+    coefficientFactor = _p.Param("coefficientFactor", "std multiplier", 1.0,
+                                 float)
+    stats = _p.Param("stats", "tenant -> (mean, std)", None, complex=True)
+
+    def __init__(self, stats=None, **kw):
+        super().__init__(**kw)
+        if stats is not None:
+            self.set("stats", stats)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        stats = self.get("stats")
+        k = self.get("coefficientFactor")
+        tenants = df[self.get("partitionKey")]
+        vals = np.asarray(df[self.get("inputCol")], np.float64)
+        out = np.empty(len(vals))
+        for i, (t, v) in enumerate(zip(tenants, vals)):
+            mean, std = stats.get(t, (0.0, 1.0))
+            out[i] = (v - mean) / (std * k if std else 1.0)
+        return df.with_column(self.get("outputCol"), out)
+
+
+class LinearScalarScaler(_PerTenantScalerBase):
+    """Per-tenant min-max to [minRequiredValue, maxRequiredValue]."""
+    minRequiredValue = _p.Param("minRequiredValue", "output min", 0.0, float)
+    maxRequiredValue = _p.Param("maxRequiredValue", "output max", 1.0, float)
+
+    def _fit(self, df: DataFrame) -> "LinearScalarScalerModel":
+        _, _, groups = self._tenant_groups(df)
+        stats = {t: (float(v.min()), float(v.max())) for t, v in
+                 groups.items()}
+        model = LinearScalarScalerModel(stats=stats)
+        for p in ("inputCol", "partitionKey", "outputCol", "minRequiredValue",
+                  "maxRequiredValue"):
+            model.set(p, self.get(p))
+        return model
+
+
+class LinearScalarScalerModel(Model):
+    inputCol = _p.Param("inputCol", "value column", "value")
+    partitionKey = _p.Param("partitionKey", "tenant column", "tenant")
+    outputCol = _p.Param("outputCol", "scaled column", "scaled")
+    minRequiredValue = _p.Param("minRequiredValue", "output min", 0.0, float)
+    maxRequiredValue = _p.Param("maxRequiredValue", "output max", 1.0, float)
+    stats = _p.Param("stats", "tenant -> (min, max)", None, complex=True)
+
+    def __init__(self, stats=None, **kw):
+        super().__init__(**kw)
+        if stats is not None:
+            self.set("stats", stats)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        stats = self.get("stats")
+        lo_t, hi_t = self.get("minRequiredValue"), self.get("maxRequiredValue")
+        tenants = df[self.get("partitionKey")]
+        vals = np.asarray(df[self.get("inputCol")], np.float64)
+        out = np.empty(len(vals))
+        for i, (t, v) in enumerate(zip(tenants, vals)):
+            lo, hi = stats.get(t, (0.0, 1.0))
+            frac = (v - lo) / (hi - lo) if hi > lo else 0.5
+            out[i] = lo_t + frac * (hi_t - lo_t)
+        return df.with_column(self.get("outputCol"), out)

@@ -32,6 +32,10 @@ def slic_segments(img: np.ndarray, cell_size: float = 16.0,
     step = max(int(cell_size), 2)
     ys = np.arange(step // 2, h, step)
     xs = np.arange(step // 2, wdt, step)
+    if ys.size == 0:  # image smaller than a cell: single center
+        ys = np.array([h // 2])
+    if xs.size == 0:
+        xs = np.array([wdt // 2])
     cy, cx = np.meshgrid(ys, xs, indexing="ij")
     centers_xy = np.stack([cy.ravel(), cx.ravel()], 1).astype(np.float64)
     centers_rgb = img[centers_xy[:, 0].astype(int),
@@ -43,7 +47,8 @@ def slic_segments(img: np.ndarray, cell_size: float = 16.0,
     # cell of spatial distance (SLIC compactness)
     ratio = (modifier / step) ** 2
     n_centers = len(centers_xy)
-    for _ in range(iters):
+    assign = np.zeros(h * wdt, np.int64)
+    for _ in range(max(iters, 1)):
         d_xy = ((pix_xy[:, None, :] - centers_xy[None, :, :]) ** 2).sum(-1)
         d_rgb = ((pix_rgb[:, None, :] - centers_rgb[None, :, :]) ** 2).sum(-1)
         assign = (d_rgb + ratio * d_xy).argmin(1)

@@ -1,0 +1,23 @@
+"""Data plane: HTTP stack, serving, file readers (reference: io/, 16 files +
+Spark Serving, 5 files)."""
+
+from .files import (decode_image, read_binary_files, read_images,
+                    write_to_powerbi)
+from .http import (AsyncClient, CustomInputParser, CustomOutputParser,
+                   HTTPRequestData, HTTPResponseData, HTTPTransformer,
+                   JSONInputParser, JSONOutputParser, SimpleHTTPTransformer,
+                   StringOutputParser, send_with_retries)
+from .serving import ServingServer, ServingUDFs, make_reply, parse_request
+from .shared import (PartitionConsolidator, RateLimiter, SharedSingleton,
+                     SharedVariable)
+
+__all__ = [
+    "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
+    "SimpleHTTPTransformer", "JSONInputParser", "JSONOutputParser",
+    "StringOutputParser", "CustomInputParser", "CustomOutputParser",
+    "AsyncClient", "send_with_retries",
+    "ServingServer", "ServingUDFs", "parse_request", "make_reply",
+    "SharedSingleton", "SharedVariable", "PartitionConsolidator",
+    "RateLimiter",
+    "read_binary_files", "read_images", "decode_image", "write_to_powerbi",
+]

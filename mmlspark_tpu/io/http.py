@@ -1,0 +1,291 @@
+"""HTTP data plane: schemas, clients, transformers, parsers.
+
+Reference files replaced here:
+- io/http/HTTPSchema.scala:36-348 — `HTTPRequestData`/`HTTPResponseData`
+  case classes + row codecs -> python dataclasses with to/from dict
+- io/http/HTTPClients.scala:26-167 — pooled client, `sendWithRetries`
+  (backoff array, 429 Retry-After handling)
+- io/http/Clients.scala:12-63 — `AsyncClient` bounded-concurrency ordered
+  future pipeline -> ThreadPoolExecutor map (order-preserving)
+- io/http/HTTPTransformer.scala:79-129, SimpleHTTPTransformer.scala:64-166,
+  Parsers.scala:24-230 — request-column -> response-column stages
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Transformer
+
+
+@dataclass
+class HTTPRequestData:
+    """Reference: HTTPSchema.scala HTTPRequestData."""
+    url: str
+    method: str = "GET"
+    headers: Dict[str, str] = field(default_factory=dict)
+    entity: Optional[bytes] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"url": self.url, "method": self.method,
+                "headers": dict(self.headers),
+                "entity": self.entity.decode("utf-8", "replace")
+                if self.entity else None}
+
+
+@dataclass
+class HTTPResponseData:
+    """Reference: HTTPSchema.scala HTTPResponseData."""
+    statusCode: int
+    entity: Optional[bytes] = None
+    headers: Dict[str, str] = field(default_factory=dict)
+    reasonPhrase: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"statusCode": self.statusCode,
+                "reasonPhrase": self.reasonPhrase,
+                "headers": dict(self.headers),
+                "entity": self.entity.decode("utf-8", "replace")
+                if self.entity else None}
+
+
+RETRY_BACKOFFS_MS = (100, 500, 1000)  # HTTPClients.scala retry array
+
+
+def send_with_retries(req: HTTPRequestData,
+                      backoffs=RETRY_BACKOFFS_MS,
+                      timeout: float = 60.0,
+                      session=None) -> HTTPResponseData:
+    """Reference: HandlingUtils.sendWithRetries (HTTPClients.scala:74-110):
+    retries on 429 (honoring Retry-After) and 5xx with the backoff array."""
+    import requests
+    sess = session or requests
+    last = None
+    for attempt, backoff in enumerate(list(backoffs) + [None]):
+        try:
+            r = sess.request(req.method, req.url, headers=req.headers,
+                             data=req.entity, timeout=timeout)
+            resp = HTTPResponseData(r.status_code, r.content,
+                                    dict(r.headers), r.reason or "")
+            if r.status_code == 429:
+                retry_after = r.headers.get("Retry-After")
+                if backoff is None:
+                    return resp
+                wait = (float(retry_after) * 1000 if retry_after else backoff)
+                time.sleep(wait / 1000.0)
+                last = resp
+                continue
+            if 500 <= r.status_code < 600 and backoff is not None:
+                time.sleep(backoff / 1000.0)
+                last = resp
+                continue
+            return resp
+        except Exception as e:  # connection errors retry too
+            if backoff is None:
+                return HTTPResponseData(0, str(e).encode(), {}, "send failed")
+            time.sleep(backoff / 1000.0)
+    return last or HTTPResponseData(0, b"", {}, "exhausted retries")
+
+
+class AsyncClient:
+    """Bounded-concurrency ordered request pipeline (Clients.scala:12-63)."""
+
+    def __init__(self, concurrency: int = 8, timeout: float = 60.0):
+        self.concurrency = concurrency
+        self.timeout = timeout
+
+    def send_all(self, requests_: List[Optional[HTTPRequestData]]
+                 ) -> List[Optional[HTTPResponseData]]:
+        import requests as _rq
+        with _rq.Session() as sess:
+            def one(req):
+                if req is None:
+                    return None
+                return send_with_retries(req, timeout=self.timeout,
+                                         session=sess)
+            with ThreadPoolExecutor(max_workers=self.concurrency) as ex:
+                return list(ex.map(one, requests_))  # order preserved
+
+
+class HTTPTransformer(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    """Column of HTTPRequestData -> column of HTTPResponseData
+    (HTTPTransformer.scala:79-129)."""
+    concurrency = _p.Param("concurrency", "parallel in-flight requests", 8,
+                           int)
+    timeout = _p.Param("timeout", "per-request timeout seconds", 60.0, float)
+
+    def __init__(self, **kw):
+        kw.setdefault("inputCol", "request")
+        kw.setdefault("outputCol", "response")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        reqs = list(df[self.get("inputCol")])
+        client = AsyncClient(self.get("concurrency"), self.get("timeout"))
+        resps = client.send_all(reqs)
+        out = np.empty(len(df), dtype=object)
+        for i, r in enumerate(resps):
+            out[i] = r
+        return df.with_column(self.get("outputCol"), out)
+
+
+# ---------------------------------------------------------------- parsers
+
+class JSONInputParser(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    """Row -> HTTPRequestData with JSON entity (Parsers.scala JSONInputParser)."""
+    url = _p.Param("url", "target url", None)
+    method = _p.Param("method", "HTTP method", "POST")
+    headers = _p.Param("headers", "extra headers", None)
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "request")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        headers = {"Content-Type": "application/json",
+                   **(self.get("headers") or {})}
+        col = df[self.get("inputCol")]
+        out = np.empty(len(df), dtype=object)
+        for i, v in enumerate(col):
+            body = v if isinstance(v, (dict, list)) else _jsonable(v)
+            out[i] = HTTPRequestData(
+                url=self.get("url"), method=self.get("method"),
+                headers=dict(headers),
+                entity=json.dumps(body).encode("utf-8"))
+        return df.with_column(self.get("outputCol"), out)
+
+
+class JSONOutputParser(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    """HTTPResponseData -> parsed JSON (Parsers.scala JSONOutputParser)."""
+
+    def __init__(self, **kw):
+        kw.setdefault("inputCol", "response")
+        kw.setdefault("outputCol", "parsed")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        col = df[self.get("inputCol")]
+        out = np.empty(len(df), dtype=object)
+        for i, r in enumerate(col):
+            if r is None or r.entity is None:
+                out[i] = None
+            else:
+                try:
+                    out[i] = json.loads(r.entity.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    out[i] = None
+        return df.with_column(self.get("outputCol"), out)
+
+
+class StringOutputParser(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    def __init__(self, **kw):
+        kw.setdefault("inputCol", "response")
+        kw.setdefault("outputCol", "parsed")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        col = df[self.get("inputCol")]
+        out = np.empty(len(df), dtype=object)
+        for i, r in enumerate(col):
+            out[i] = (r.entity.decode("utf-8", "replace")
+                      if r is not None and r.entity else None)
+        return df.with_column(self.get("outputCol"), out)
+
+
+class CustomInputParser(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    udf = _p.Param("udf", "value -> HTTPRequestData function", None,
+                   complex=True)
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "request")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fn: Callable = self.get("udf")
+        col = df[self.get("inputCol")]
+        out = np.empty(len(df), dtype=object)
+        for i, v in enumerate(col):
+            out[i] = fn(v)
+        return df.with_column(self.get("outputCol"), out)
+
+
+class CustomOutputParser(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    udf = _p.Param("udf", "HTTPResponseData -> value function", None,
+                   complex=True)
+
+    def __init__(self, **kw):
+        kw.setdefault("inputCol", "response")
+        kw.setdefault("outputCol", "parsed")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fn: Callable = self.get("udf")
+        col = df[self.get("inputCol")]
+        out = np.empty(len(df), dtype=object)
+        for i, v in enumerate(col):
+            out[i] = fn(v)
+        return df.with_column(self.get("outputCol"), out)
+
+
+class SimpleHTTPTransformer(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    """JSONInputParser -> HTTPTransformer -> output parser, with errorCol
+    (SimpleHTTPTransformer.scala:64-166)."""
+
+    url = _p.Param("url", "target url", None)
+    method = _p.Param("method", "HTTP method", "POST")
+    headers = _p.Param("headers", "extra headers", None)
+    concurrency = _p.Param("concurrency", "parallel requests", 8, int)
+    timeout = _p.Param("timeout", "request timeout seconds", 60.0, float)
+    errorCol = _p.Param("errorCol", "column receiving error info", "error")
+    outputParser = _p.Param("outputParser", "custom output parser stage", None,
+                            complex=True)
+    flattenOutputBatches = _p.Param("flattenOutputBatches",
+                                    "API parity; no-op here", False, bool)
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "parsed")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        inp = JSONInputParser(
+            inputCol=self.get("inputCol"), outputCol="__http_req",
+            url=self.get("url"), method=self.get("method"),
+            headers=self.get("headers"))
+        http = HTTPTransformer(inputCol="__http_req",
+                               outputCol="__http_resp",
+                               concurrency=self.get("concurrency"),
+                               timeout=self.get("timeout"))
+        parser = (self.get("outputParser")
+                  or JSONOutputParser()).copy(
+                      {"inputCol": "__http_resp",
+                       "outputCol": self.get("outputCol")})
+        mid = http.transform(inp.transform(df))
+        out = parser.transform(mid)
+        errors = np.empty(len(df), dtype=object)
+        for i, r in enumerate(mid["__http_resp"]):
+            if r is None:
+                errors[i] = "no response"
+            elif not (200 <= r.statusCode < 300):
+                errors[i] = f"{r.statusCode} {r.reasonPhrase}"
+            else:
+                errors[i] = None
+        return (out.drop("__http_req", "__http_resp")
+                   .with_column(self.get("errorCol"), errors))
+
+
+def _jsonable(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v

@@ -1,0 +1,98 @@
+"""Process-shared singletons + PartitionConsolidator.
+
+Reference: io/http/SharedVariable.scala:18-65 (`SharedVariable`/
+`SharedSingleton` — one cell per JVM keyed by constructor; the trick serving
+uses to share servers across tasks) and io/http/PartitionConsolidator.scala:
+17-132 (funnel many partitions' work through one per-executor resource, e.g.
+one rate-limited connection).
+
+In the single-process host runtime "per-JVM" becomes "per-process": the
+registry is a module-level dict; PartitionConsolidator becomes a transformer
+that routes all row processing through one shared, optionally rate-limited
+worker."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Transformer
+
+_REGISTRY: Dict[str, Any] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+class SharedSingleton:
+    """One instance per process per key (SharedVariable.scala:37)."""
+
+    def __init__(self, ctor: Callable[[], Any], key: Optional[str] = None):
+        self.key = key or f"{ctor.__module__}.{getattr(ctor, '__qualname__', repr(ctor))}"
+        self._ctor = ctor
+
+    def get(self) -> Any:
+        with _REGISTRY_LOCK:
+            if self.key not in _REGISTRY:
+                _REGISTRY[self.key] = self._ctor()
+            return _REGISTRY[self.key]
+
+    @staticmethod
+    def clear(key: Optional[str] = None) -> None:
+        with _REGISTRY_LOCK:
+            if key is None:
+                _REGISTRY.clear()
+            else:
+                _REGISTRY.pop(key, None)
+
+
+SharedVariable = SharedSingleton  # surface alias
+
+
+class RateLimiter:
+    """Token-per-interval limiter shared by all callers."""
+
+    def __init__(self, min_interval_s: float):
+        self.min_interval_s = min_interval_s
+        self._lock = threading.Lock()
+        self._last = 0.0
+
+    def acquire(self) -> None:
+        with self._lock:
+            now = time.perf_counter()
+            wait = self._last + self.min_interval_s - now
+            if wait > 0:
+                time.sleep(wait)
+                now = time.perf_counter()
+            self._last = now
+
+
+class PartitionConsolidator(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    """Route every row through ONE shared worker function, optionally rate
+    limited (PartitionConsolidator.scala:17-132). The worker is held in the
+    process-wide registry so concurrent transforms share it."""
+
+    fn = _p.Param("fn", "value -> value worker function", None, complex=True)
+    requestsPerSecond = _p.Param("requestsPerSecond",
+                                 "rate cap; 0 = unlimited", 0.0, float)
+    sharedKey = _p.Param("sharedKey",
+                         "registry key for the shared limiter", None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fn: Callable = self.get("fn")
+        rps = self.get("requestsPerSecond")
+        limiter: Optional[RateLimiter] = None
+        if rps and rps > 0:
+            key = self.get("sharedKey") or f"consolidator:{self.uid}"
+            limiter = SharedSingleton(
+                lambda: RateLimiter(1.0 / rps), key=key).get()
+        col = df[self.get("inputCol")]
+        out = np.empty(len(df), dtype=object)
+        for i, v in enumerate(col):
+            if limiter is not None:
+                limiter.acquire()
+            out[i] = fn(v)
+        return df.with_column(self.get("outputCol"), out)

@@ -93,9 +93,18 @@ class DNNModel(Model, _p.HasInputCol, _p.HasOutputCol, _p.HasBatchSize):
     def _coerce_batch(self, col: np.ndarray) -> np.ndarray:
         gm: GraphModel = self.get("model")
         h, w, c = gm.schema.input_dims
+        from .image import resize_image
         if col.dtype == object:
             int_input = all(np.asarray(v).dtype.kind in "iu" for v in col)
-            arr = np.stack([np.asarray(v, np.float32) for v in col])
+            imgs = []
+            for v in col:  # per-image resize handles heterogeneous sizes
+                a = np.asarray(v, np.float32)
+                if a.ndim == 2:
+                    a = a[:, :, None]
+                if a.shape[:2] != (h, w):
+                    a = resize_image(a, h, w)
+                imgs.append(a)
+            arr = np.stack(imgs)
         else:
             int_input = col.dtype.kind in "iu"
             arr = np.asarray(col, np.float32)
@@ -104,10 +113,8 @@ class DNNModel(Model, _p.HasInputCol, _p.HasOutputCol, _p.HasBatchSize):
         if arr.ndim == 3:
             arr = arr[..., None]
         if arr.shape[1:3] != (h, w):
-            import jax.image
-            arr = np.asarray(jax.image.resize(
-                jnp.asarray(arr), (arr.shape[0], h, w, arr.shape[3]),
-                "bilinear"))
+            resized = [resize_image(a, h, w) for a in arr]
+            arr = np.stack(resized)
         if self.get("normalize"):
             scale = self.get("scaleFactor") or (255.0 if int_input else 1.0)
             arr = (arr / scale - gm.schema.mean) / gm.schema.std

@@ -1,0 +1,200 @@
+"""IsolationForest — unsupervised anomaly detection.
+
+Reference: isolationforest/IsolationForest.scala:17-60, a thin facade over
+`com.linkedin.isolation-forest` (JVM): per-tree subsampled random splits,
+anomaly score 2^(-E[pathlen]/c(n)), threshold from `contamination`.
+
+TPU design: trees build on host (each is log2(maxSamples) deep over a 256-row
+subsample — trivially cheap); SCORING is the hot path and runs as one jitted
+program: trees stack into padded arrays [T, nodes] and every row walks all
+trees in lockstep via a depth-bounded gather loop (no recursion, no ragged
+work).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model
+
+
+def _c_factor(n: float) -> float:
+    """Average BST unsuccessful-search path length c(n)."""
+    if n <= 1:
+        return 0.0
+    h = math.log(n - 1) + 0.5772156649
+    return 2.0 * h - 2.0 * (n - 1) / n
+
+
+def _build_tree(x: np.ndarray, rng: np.random.Generator, max_depth: int):
+    """Array-form isolation tree over subsample x. Returns (feature, threshold,
+    left, right, size) with -1 children for leaves."""
+    cap = 2 ** (max_depth + 1)
+    feature = np.full(cap, -1, np.int32)
+    threshold = np.zeros(cap, np.float32)
+    left = np.full(cap, -1, np.int32)
+    right = np.full(cap, -1, np.int32)
+    size = np.zeros(cap, np.float32)
+    next_free = [1]
+
+    stack = [(0, np.arange(len(x)), 0)]
+    while stack:
+        node, idx, depth = stack.pop()
+        size[node] = len(idx)
+        if depth >= max_depth or len(idx) <= 1:
+            continue
+        sub = x[idx]
+        spans = sub.max(0) - sub.min(0)
+        live = np.flatnonzero(spans > 0)
+        if live.size == 0:
+            continue
+        f = int(rng.choice(live))
+        lo, hi = sub[:, f].min(), sub[:, f].max()
+        t = float(rng.uniform(lo, hi))
+        go_left = sub[:, f] < t
+        l_node, r_node = next_free[0], next_free[0] + 1
+        next_free[0] += 2
+        feature[node] = f
+        threshold[node] = t
+        left[node] = l_node
+        right[node] = r_node
+        stack.append((l_node, idx[go_left], depth + 1))
+        stack.append((r_node, idx[~go_left], depth + 1))
+    used = next_free[0]
+    return (feature[:used], threshold[:used], left[:used], right[:used],
+            size[:used])
+
+
+class _Forest(NamedTuple):
+    feature: np.ndarray    # [T, nodes]
+    threshold: np.ndarray
+    left: np.ndarray
+    right: np.ndarray
+    size: np.ndarray
+    max_depth: int
+    sub_sample: int
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _path_lengths(feature, threshold, left, right, size, x, max_depth: int):
+    """Average path length of each row over all trees. Inputs [T, nodes];
+    x [N, F]. Depth-bounded lockstep walk: every row advances one level per
+    step across all trees simultaneously."""
+    t = feature.shape[0]
+    n = x.shape[0]
+    node = jnp.zeros((n, t), jnp.int32)
+    depth_acc = jnp.zeros((n, t), jnp.float32)
+    t_idx = jnp.arange(t)
+
+    def body(_, carry):
+        node, depth_acc = carry
+        feat = feature[t_idx[None, :], node]              # [N,T]
+        is_leaf = feat < 0
+        thr = threshold[t_idx[None, :], node]
+        xv = jnp.take_along_axis(x, jnp.maximum(feat, 0), axis=1)  # [N,T]
+        go_left = xv < thr
+        nxt = jnp.where(go_left, left[t_idx[None, :], node],
+                        right[t_idx[None, :], node])
+        node = jnp.where(is_leaf, node, nxt)
+        depth_acc = depth_acc + jnp.where(is_leaf, 0.0, 1.0)
+        return node, depth_acc
+
+    node, depth_acc = jax.lax.fori_loop(0, max_depth + 1, body,
+                                        (node, depth_acc))
+    # leaf adjustment: + c(size) for unfinished isolation
+    leaf_size = size[t_idx[None, :], node]
+    ls = jnp.maximum(leaf_size, 1.0)
+    h = jnp.log(jnp.maximum(ls - 1.0, 1e-9)) + 0.5772156649
+    c_adj = jnp.where(ls > 1.0, 2.0 * h - 2.0 * (ls - 1.0) / ls, 0.0)
+    return (depth_acc + c_adj).mean(axis=1)
+
+
+class IsolationForest(Estimator, _p.HasFeaturesCol, _p.HasPredictionCol):
+    numEstimators = _p.Param("numEstimators", "number of trees", 100, int)
+    maxSamples = _p.Param("maxSamples", "subsample size per tree", 256, int)
+    maxFeatures = _p.Param("maxFeatures", "feature fraction per tree", 1.0,
+                           float)
+    contamination = _p.Param("contamination",
+                             "expected anomaly fraction (sets threshold); "
+                             "0 = no labels, scores only", 0.0, float)
+    scoreCol = _p.Param("scoreCol", "anomaly score column", "outlierScore")
+    randomSeed = _p.Param("randomSeed", "rng seed", 1, int)
+
+    def _fit(self, df: DataFrame) -> "IsolationForestModel":
+        x = np.asarray(df[self.get("featuresCol")], np.float32)
+        n, f = x.shape
+        rng = np.random.default_rng(self.get("randomSeed"))
+        sub = min(self.get("maxSamples"), n)
+        max_depth = max(int(math.ceil(math.log2(max(sub, 2)))), 1)
+        n_feat = max(int(round(self.get("maxFeatures") * f)), 1)
+        trees = []
+        for _ in range(self.get("numEstimators")):
+            idx = rng.choice(n, sub, replace=False)
+            feats = (np.arange(f) if n_feat >= f
+                     else rng.choice(f, n_feat, replace=False))
+            sample = x[idx][:, feats]
+            fe, th, le, ri, si = _build_tree(sample, rng, max_depth)
+            fe = np.where(fe >= 0, feats[np.maximum(fe, 0)], -1).astype(
+                np.int32)
+            trees.append((fe, th, le, ri, si))
+        cap = max(len(t[0]) for t in trees)
+
+        def pad(a, fill):
+            return np.stack([
+                np.concatenate([t, np.full(cap - len(t), fill, t.dtype)])
+                for t in a])
+        forest = _Forest(
+            feature=pad([t[0] for t in trees], -1),
+            threshold=pad([t[1] for t in trees], 0.0),
+            left=pad([t[2] for t in trees], -1),
+            right=pad([t[3] for t in trees], -1),
+            size=pad([t[4] for t in trees], 0.0),
+            max_depth=max_depth, sub_sample=sub)
+        model = IsolationForestModel(forest=forest)
+        for p in ("featuresCol", "predictionCol", "scoreCol"):
+            model.set(p, self.get(p))
+        contamination = self.get("contamination")
+        if contamination > 0:
+            scores = model._scores(x)
+            model.set("threshold",
+                      float(np.quantile(scores, 1.0 - contamination)))
+        return model
+
+
+class IsolationForestModel(Model, _p.HasFeaturesCol, _p.HasPredictionCol):
+    scoreCol = _p.Param("scoreCol", "anomaly score column", "outlierScore")
+    threshold = _p.Param("threshold", "score threshold for predicted label",
+                         0.5, float)
+    forest = _p.Param("forest", "stacked tree arrays", None, complex=True)
+
+    def __init__(self, forest: Optional[_Forest] = None, **kw):
+        super().__init__(**kw)
+        if forest is not None:
+            self.set("forest", forest)
+
+    def _scores(self, x: np.ndarray) -> np.ndarray:
+        fr = self.get("forest")
+        if not isinstance(fr, _Forest):
+            fr = _Forest(*fr)  # complex-param roundtrip may yield a tuple
+        avg_path = np.asarray(_path_lengths(
+            jnp.asarray(fr.feature), jnp.asarray(fr.threshold),
+            jnp.asarray(fr.left), jnp.asarray(fr.right),
+            jnp.asarray(fr.size), jnp.asarray(x, jnp.float32),
+            int(fr.max_depth)))
+        c = _c_factor(float(fr.sub_sample))
+        return np.exp2(-avg_path / max(c, 1e-9))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        x = np.asarray(df[self.get("featuresCol")], np.float32)
+        scores = self._scores(x)
+        pred = (scores >= self.get("threshold")).astype(np.float64)
+        return (df.with_column(self.get("scoreCol"), scores)
+                  .with_column(self.get("predictionCol"), pred))

@@ -97,10 +97,14 @@ class ComputePerInstanceStatistics(Transformer, _p.HasLabelCol):
         if kind == "classification":
             label_raw = df[self.get("labelCol")]
             levels = (df.metadata(prob_col) or {}).get("levels")
-            if levels is not None and label_raw.dtype == object:
+            probs = np.asarray(df[prob_col], np.float64)
+            if probs.ndim == 1:
+                probs = np.stack([1 - probs, probs], axis=1)
+            if levels is not None:
                 # index by the MODEL's training levels so label i matches
                 # probability column i (levels metadata set by
-                # TrainedClassifierModel.transform)
+                # TrainedClassifierModel.transform); applies to string AND
+                # non-contiguous numeric labels alike
                 lookup = {v: i for i, v in enumerate(levels)}
                 labels = np.array([lookup.get(v, -1) for v in label_raw],
                                   np.float64)
@@ -111,9 +115,12 @@ class ComputePerInstanceStatistics(Transformer, _p.HasLabelCol):
                 labels, _ = index_label_pred(label_raw,
                                              df[pred_col] if pred_col
                                              else label_raw)
-            probs = np.asarray(df[prob_col], np.float64)
-            if probs.ndim == 1:
-                probs = np.stack([1 - probs, probs], axis=1)
+                if labels.max(initial=0) >= probs.shape[1]:
+                    # non-contiguous numeric labels without metadata:
+                    # reindex by sorted observed values
+                    uniq = np.unique(labels)
+                    remap = {v: i for i, v in enumerate(uniq)}
+                    labels = np.array([remap[v] for v in labels], np.float64)
             idx = labels.astype(np.int64)
             p_true = np.clip(probs[np.arange(len(labels)), idx], 1e-15, 1.0)
             return df.with_column("log_loss", -np.log(p_true))
