@@ -1,0 +1,21 @@
+"""Cognitive-services transformer layer (reference: cognitive/, 21 files,
+3964 LoC — pure HTTP clients over the io/http stack)."""
+
+from .base import CognitiveServicesBase, ServiceParam
+from .services import (NER, OCR, AnalyzeImage, AzureSearchWriter,
+                       BingImageSearch, DescribeImage, DetectAnomalies,
+                       DetectFace, DetectLastAnomaly, FindSimilarFace,
+                       GenerateThumbnails, GroupFaces, IdentifyFaces,
+                       KeyPhraseExtractor, LanguageDetector, RecognizeText,
+                       SpeechToText, TagImage, TextSentiment, VerifyFaces)
+
+__all__ = [
+    "CognitiveServicesBase", "ServiceParam",
+    "TextSentiment", "KeyPhraseExtractor", "NER", "LanguageDetector",
+    "OCR", "AnalyzeImage", "DescribeImage", "TagImage", "GenerateThumbnails",
+    "RecognizeText",
+    "DetectFace", "VerifyFaces", "FindSimilarFace", "GroupFaces",
+    "IdentifyFaces",
+    "DetectLastAnomaly", "DetectAnomalies",
+    "BingImageSearch", "AzureSearchWriter", "SpeechToText",
+]

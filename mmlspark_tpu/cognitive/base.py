@@ -1,0 +1,144 @@
+"""Cognitive-service transformer base — external HTTP AI services as stages.
+
+Reference: cognitive/CognitiveServiceBase.scala:258-330 — every service
+transformer is internally `Lambda(prep) -> HTTPTransformer ->
+JSONOutputParser -> DropColumns`; `ServiceParam[T]` (:29-152) holds a
+scalar-or-column ("left/right") value so any request field can come from a
+constant or a per-row column. Auth via subscription-key header; url =
+endpoint template + location.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Transformer
+from ..io.http import (AsyncClient, HTTPRequestData, HTTPResponseData,
+                       JSONOutputParser)
+
+
+class ServiceParam:
+    """Scalar-or-column value (CognitiveServiceBase.scala:29-152).
+
+    `ServiceParam.value(x)` = constant for all rows; `ServiceParam.col(name)`
+    = read per-row from that column."""
+
+    def __init__(self, value: Any = None, col: Optional[str] = None):
+        self._value = value
+        self._col = col
+
+    @staticmethod
+    def value(v: Any) -> "ServiceParam":
+        return ServiceParam(value=v)
+
+    @staticmethod
+    def col(name: str) -> "ServiceParam":
+        return ServiceParam(col=name)
+
+    def resolve(self, df: DataFrame, i: int) -> Any:
+        if self._col is not None:
+            return df[self._col][i]
+        return self._value
+
+    def __repr__(self):
+        return (f"ServiceParam(col={self._col!r})" if self._col
+                else f"ServiceParam({self._value!r})")
+
+
+def _as_service_param(v: Any) -> ServiceParam:
+    return v if isinstance(v, ServiceParam) else ServiceParam(value=v)
+
+
+class CognitiveServicesBase(Transformer, _p.HasOutputCol):
+    """Shared request/response plumbing. Subclasses define `urlPath` and
+    override `prepare_entity(df, i) -> (dict|bytes|None)` plus optionally
+    `url_params(df, i)` / `extract(parsed)`."""
+
+    subscriptionKey = _p.Param("subscriptionKey",
+                               "service key (ServiceParam)", None,
+                               complex=True, converter=_as_service_param)
+    url = _p.Param("url", "full service url (overrides location template)",
+                   None)
+    location = _p.Param("location", "service region for the url template",
+                        "eastus")
+    errorCol = _p.Param("errorCol", "error info column", "error")
+    concurrency = _p.Param("concurrency", "parallel requests", 4, int)
+    timeout = _p.Param("timeout", "per-request timeout s", 60.0, float)
+
+    service_name: str = ""   # e.g. "text/analytics/v3.0/sentiment"
+    method: str = "POST"
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", type(self).__name__.lower())
+        super().__init__(**kw)
+
+    # -------------------------------------------------------- overridables
+    def base_url(self) -> str:
+        if self.get("url"):
+            return self.get("url")
+        return (f"https://{self.get('location')}.api.cognitive.microsoft.com/"
+                f"{self.service_name}")
+
+    def url_params(self, df: DataFrame, i: int) -> Dict[str, str]:
+        return {}
+
+    def prepare_entity(self, df: DataFrame, i: int):
+        raise NotImplementedError
+
+    def extract(self, parsed: Any) -> Any:
+        """Pull the useful payload out of the parsed JSON response."""
+        return parsed
+
+    def headers(self, df: DataFrame, i: int) -> Dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        key_param = self.get("subscriptionKey")
+        if key_param is not None:
+            key = key_param.resolve(df, i)
+            if key:
+                h["Ocp-Apim-Subscription-Key"] = str(key)
+        return h
+
+    # ------------------------------------------------------------ pipeline
+    def transform(self, df: DataFrame) -> DataFrame:
+        reqs: List[Optional[HTTPRequestData]] = []
+        for i in range(len(df)):
+            entity = self.prepare_entity(df, i)
+            if entity is None:
+                reqs.append(None)
+                continue
+            url = self.base_url()
+            params = self.url_params(df, i)
+            if params:
+                from urllib.parse import urlencode
+                url = url + "?" + urlencode(params)
+            body = (entity if isinstance(entity, bytes)
+                    else json.dumps(entity).encode("utf-8"))
+            reqs.append(HTTPRequestData(url=url, method=self.method,
+                                        headers=self.headers(df, i),
+                                        entity=body))
+        client = AsyncClient(self.get("concurrency"), self.get("timeout"))
+        resps = client.send_all(reqs)
+        out = np.empty(len(df), dtype=object)
+        errors = np.empty(len(df), dtype=object)
+        for i, r in enumerate(resps):
+            errors[i] = None
+            if r is None:
+                out[i] = None
+            elif not (200 <= r.statusCode < 300):
+                out[i] = None
+                errors[i] = f"{r.statusCode} {r.reasonPhrase}"
+            else:
+                try:
+                    out[i] = self.extract(
+                        json.loads(r.entity.decode("utf-8"))
+                        if r.entity else None)
+                except (ValueError, UnicodeDecodeError) as e:
+                    out[i] = None
+                    errors[i] = f"parse error: {e}"
+        return (df.with_column(self.get("outputCol"), out)
+                  .with_column(self.get("errorCol"), errors))

@@ -1,0 +1,333 @@
+"""Concrete cognitive-service transformers.
+
+Reference: the ~20 transformers of cognitive/ (SURVEY.md §2.3 cognitive —
+3964 LoC): TextAnalytics (TextAnalytics.scala: sentiment, key phrases, NER,
+language), ComputerVision (ComputerVision.scala: OCR, analyze, describe, tags,
+thumbnails), Face (Face.scala), AnomalyDetector (AnamolyDetection.scala),
+BingImageSearch (BingImageSearch.scala), AzureSearch sink (AzureSearch.scala +
+AzureSearchAPI.scala), SpeechToText (SpeechToText.scala REST path).
+
+Each class = url path + per-row payload prep + response extraction over
+CognitiveServicesBase; all payload shapes follow the public API wire formats.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from .base import CognitiveServicesBase, ServiceParam, _as_service_param
+
+
+# ------------------------------------------------------------ Text Analytics
+
+class _TextAnalyticsBase(CognitiveServicesBase):
+    """documents: [{id, text, language}] envelope (TextAnalytics.scala)."""
+    textCol = _p.Param("textCol", "input text column", "text")
+    languageCol = _p.Param("languageCol", "per-row language column (optional)",
+                           None)
+    language = _p.Param("language", "default language", "en")
+
+    def prepare_entity(self, df: DataFrame, i: int):
+        text = df[self.get("textCol")][i]
+        if text is None:
+            return None
+        lang_col = self.get("languageCol")
+        lang = (df[lang_col][i] if lang_col and lang_col in df
+                else self.get("language"))
+        return {"documents": [{"id": "0", "text": str(text),
+                               "language": lang}]}
+
+    def extract(self, parsed):
+        docs = (parsed or {}).get("documents") or []
+        return docs[0] if docs else None
+
+
+class TextSentiment(_TextAnalyticsBase):
+    service_name = "text/analytics/v3.0/sentiment"
+
+
+class KeyPhraseExtractor(_TextAnalyticsBase):
+    service_name = "text/analytics/v3.0/keyPhrases"
+
+    def extract(self, parsed):
+        doc = super().extract(parsed)
+        return doc.get("keyPhrases") if doc else None
+
+
+class NER(_TextAnalyticsBase):
+    service_name = "text/analytics/v3.0/entities/recognition/general"
+
+    def extract(self, parsed):
+        doc = super().extract(parsed)
+        return doc.get("entities") if doc else None
+
+
+class LanguageDetector(_TextAnalyticsBase):
+    service_name = "text/analytics/v3.0/languages"
+
+    def prepare_entity(self, df: DataFrame, i: int):
+        text = df[self.get("textCol")][i]
+        if text is None:
+            return None
+        return {"documents": [{"id": "0", "text": str(text)}]}
+
+    def extract(self, parsed):
+        doc = (parsed or {}).get("documents") or []
+        if not doc:
+            return None
+        langs = doc[0].get("detectedLanguage") or doc[0].get(
+            "detectedLanguages")
+        return langs
+
+
+# ------------------------------------------------------------ Computer Vision
+
+class _VisionBase(CognitiveServicesBase):
+    """Accepts an image url column OR raw image bytes column
+    (ComputerVision.scala `HasImageInput`)."""
+    imageUrlCol = _p.Param("imageUrlCol", "image url column", None)
+    imageBytesCol = _p.Param("imageBytesCol", "raw image bytes column", None)
+
+    def headers(self, df, i):
+        h = super().headers(df, i)
+        if self.get("imageBytesCol"):
+            h["Content-Type"] = "application/octet-stream"
+        return h
+
+    def prepare_entity(self, df: DataFrame, i: int):
+        if self.get("imageUrlCol"):
+            url = df[self.get("imageUrlCol")][i]
+            return {"url": str(url)} if url else None
+        if self.get("imageBytesCol"):
+            data = df[self.get("imageBytesCol")][i]
+            return bytes(data) if data is not None else None
+        raise ValueError("set imageUrlCol or imageBytesCol")
+
+
+class OCR(_VisionBase):
+    service_name = "vision/v2.0/ocr"
+    detectOrientation = _p.Param("detectOrientation", "detect rotation", True,
+                                 bool)
+
+    def url_params(self, df, i):
+        return {"detectOrientation": str(self.get("detectOrientation")
+                                         ).lower()}
+
+
+class AnalyzeImage(_VisionBase):
+    service_name = "vision/v2.0/analyze"
+    visualFeatures = _p.Param("visualFeatures", "feature list",
+                              None)
+
+    def url_params(self, df, i):
+        feats = self.get("visualFeatures") or ["Categories"]
+        return {"visualFeatures": ",".join(feats)}
+
+
+class DescribeImage(_VisionBase):
+    service_name = "vision/v2.0/describe"
+    maxCandidates = _p.Param("maxCandidates", "caption candidates", 1, int)
+
+    def url_params(self, df, i):
+        return {"maxCandidates": str(self.get("maxCandidates"))}
+
+
+class TagImage(_VisionBase):
+    service_name = "vision/v2.0/tag"
+
+    def extract(self, parsed):
+        return (parsed or {}).get("tags")
+
+
+class GenerateThumbnails(_VisionBase):
+    service_name = "vision/v2.0/generateThumbnail"
+    width = _p.Param("width", "thumbnail width", 64, int)
+    height = _p.Param("height", "thumbnail height", 64, int)
+    smartCropping = _p.Param("smartCropping", "smart crop", True, bool)
+
+    def url_params(self, df, i):
+        return {"width": str(self.get("width")),
+                "height": str(self.get("height")),
+                "smartCropping": str(self.get("smartCropping")).lower()}
+
+
+class RecognizeText(_VisionBase):
+    service_name = "vision/v2.0/recognizeText"
+    mode = _p.Param("mode", "Handwritten | Printed", "Printed")
+
+    def url_params(self, df, i):
+        return {"mode": self.get("mode")}
+
+
+# ------------------------------------------------------------------- Face
+
+class DetectFace(_VisionBase):
+    service_name = "face/v1.0/detect"
+    returnFaceAttributes = _p.Param("returnFaceAttributes",
+                                    "attribute list", None)
+
+    def url_params(self, df, i):
+        attrs = self.get("returnFaceAttributes")
+        return ({"returnFaceAttributes": ",".join(attrs)} if attrs else {})
+
+
+class VerifyFaces(CognitiveServicesBase):
+    service_name = "face/v1.0/verify"
+    faceId1Col = _p.Param("faceId1Col", "first face id column", "faceId1")
+    faceId2Col = _p.Param("faceId2Col", "second face id column", "faceId2")
+
+    def prepare_entity(self, df, i):
+        return {"faceId1": str(df[self.get("faceId1Col")][i]),
+                "faceId2": str(df[self.get("faceId2Col")][i])}
+
+
+class FindSimilarFace(CognitiveServicesBase):
+    service_name = "face/v1.0/findsimilars"
+    faceIdCol = _p.Param("faceIdCol", "probe face id column", "faceId")
+    faceIdsCol = _p.Param("faceIdsCol", "candidate face ids column", "faceIds")
+
+    def prepare_entity(self, df, i):
+        return {"faceId": str(df[self.get("faceIdCol")][i]),
+                "faceIds": [str(x) for x in df[self.get("faceIdsCol")][i]]}
+
+
+class GroupFaces(CognitiveServicesBase):
+    service_name = "face/v1.0/group"
+    faceIdsCol = _p.Param("faceIdsCol", "face ids column", "faceIds")
+
+    def prepare_entity(self, df, i):
+        return {"faceIds": [str(x) for x in df[self.get("faceIdsCol")][i]]}
+
+
+class IdentifyFaces(CognitiveServicesBase):
+    service_name = "face/v1.0/identify"
+    faceIdsCol = _p.Param("faceIdsCol", "face ids column", "faceIds")
+    personGroupId = _p.Param("personGroupId", "person group", None)
+
+    def prepare_entity(self, df, i):
+        return {"faceIds": [str(x) for x in df[self.get("faceIdsCol")][i]],
+                "personGroupId": self.get("personGroupId")}
+
+
+# --------------------------------------------------------- Anomaly Detector
+
+class _AnomalyBase(CognitiveServicesBase):
+    """series payload: [{timestamp, value}...] (AnamolyDetection.scala)."""
+    seriesCol = _p.Param("seriesCol",
+                         "column of [(timestamp, value)] series", "series")
+    granularity = _p.Param("granularity", "hourly | daily | ...", "daily")
+    sensitivity = _p.Param("sensitivity", "0-99", None)
+
+    def prepare_entity(self, df, i):
+        series = df[self.get("seriesCol")][i]
+        if series is None:
+            return None
+        body = {"granularity": self.get("granularity"),
+                "series": [{"timestamp": str(t), "value": float(v)}
+                           for t, v in series]}
+        if self.get("sensitivity") is not None:
+            body["sensitivity"] = self.get("sensitivity")
+        return body
+
+
+class DetectLastAnomaly(_AnomalyBase):
+    service_name = "anomalydetector/v1.0/timeseries/last/detect"
+
+
+class DetectAnomalies(_AnomalyBase):
+    service_name = "anomalydetector/v1.0/timeseries/entire/detect"
+
+
+# ------------------------------------------------------------------ Search
+
+class BingImageSearch(CognitiveServicesBase):
+    service_name = "bing/v7.0/images/search"
+    method = "GET"
+    queryCol = _p.Param("queryCol", "search query column", "query")
+    count = _p.Param("count", "results per query", 10, int)
+
+    def base_url(self) -> str:
+        return self.get("url") or "https://api.bing.microsoft.com/v7.0/images/search"
+
+    def prepare_entity(self, df, i):
+        return b""  # GET
+
+    def url_params(self, df, i):
+        return {"q": str(df[self.get("queryCol")][i]),
+                "count": str(self.get("count"))}
+
+    def extract(self, parsed):
+        return (parsed or {}).get("value")
+
+
+class AzureSearchWriter:
+    """Index documents into Azure Cognitive Search (AzureSearch.scala +
+    AzureSearchAPI.scala index upload)."""
+
+    @staticmethod
+    def write_to_azure_search(df: DataFrame, url: str, api_key: str,
+                              action: str = "mergeOrUpload",
+                              batch_size: int = 100) -> int:
+        from ..io.http import HTTPRequestData, send_with_retries
+        rows = df.collect()
+        n = 0
+        for start in range(0, len(rows), batch_size):
+            chunk = rows[start:start + batch_size]
+            docs = []
+            for r in chunk:
+                d = {"@search.action": action}
+                for k, v in r.items():
+                    if isinstance(v, np.ndarray):
+                        v = v.tolist()
+                    elif isinstance(v, (np.integer,)):
+                        v = int(v)
+                    elif isinstance(v, (np.floating,)):
+                        v = float(v)
+                    d[k] = v
+                docs.append(d)
+            resp = send_with_retries(HTTPRequestData(
+                url=url, method="POST",
+                headers={"Content-Type": "application/json",
+                         "api-key": api_key},
+                entity=json.dumps({"value": docs}).encode("utf-8")))
+            if not (200 <= resp.statusCode < 300):
+                raise RuntimeError(f"azure search write failed: "
+                                   f"{resp.statusCode} {resp.reasonPhrase}")
+            n += 1
+        return n
+
+    writeToAzureSearch = write_to_azure_search
+
+
+# ------------------------------------------------------------------ Speech
+
+class SpeechToText(CognitiveServicesBase):
+    """REST short-audio transcription (SpeechToText.scala; the native
+    streaming SDK path — SpeechToTextSDK.scala — is a remote-service client
+    out of the TPU build's scope per SURVEY.md §2.1)."""
+    audioBytesCol = _p.Param("audioBytesCol", "audio bytes column", "audio")
+    languageParam = _p.Param("languageParam", "BCP-47 language", "en-US")
+    format = _p.Param("format", "simple | detailed", "simple")
+
+    def base_url(self) -> str:
+        return (self.get("url")
+                or f"https://{self.get('location')}.stt.speech.microsoft.com/"
+                   f"speech/recognition/conversation/cognitiveservices/v1")
+
+    def headers(self, df, i):
+        h = super().headers(df, i)
+        h["Content-Type"] = "audio/wav"
+        return h
+
+    def url_params(self, df, i):
+        return {"language": self.get("languageParam"),
+                "format": self.get("format")}
+
+    def prepare_entity(self, df, i):
+        data = df[self.get("audioBytesCol")][i]
+        return bytes(data) if data is not None else None

@@ -1,0 +1,63 @@
+"""Golden-metric benchmark machinery — accuracy-regression gates.
+
+Reference: core/test/benchmarks/Benchmarks.scala:16-90+ — metric values
+recorded to CSV under src/test/resources/benchmarks/ and compared with
+per-entry precision: accuracy-regression tests, not wall-clock. Same protocol
+here: `Benchmarks(csv_path)` accumulates (name, value, precision) entries;
+`verify()` compares against the committed CSV, or writes it when absent
+(record mode, like the reference's regenerate flow).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+
+class Benchmarks:
+    def __init__(self, csv_path: str):
+        self.csv_path = csv_path
+        self.entries: List[Tuple[str, float, float]] = []
+
+    def add(self, name: str, value: float, precision: float) -> None:
+        self.entries.append((name, float(value), float(precision)))
+
+    compare_value = add  # reference surface name (compareValue)
+
+    def _read_golden(self):
+        golden = {}
+        with open(self.csv_path) as f:
+            for row in csv.reader(f):
+                if not row or row[0].startswith("#"):
+                    continue
+                golden[row[0]] = (float(row[1]), float(row[2]))
+        return golden
+
+    def _write_golden(self) -> None:
+        os.makedirs(os.path.dirname(self.csv_path), exist_ok=True)
+        with open(self.csv_path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["# name", "value", "precision"])
+            for name, value, precision in self.entries:
+                w.writerow([name, f"{value:.6f}", precision])
+
+    def verify(self) -> None:
+        """Compare recorded entries against the golden CSV; write the CSV if
+        it does not exist yet (record mode)."""
+        if not os.path.exists(self.csv_path):
+            self._write_golden()
+            return
+        golden = self._read_golden()
+        errors = []
+        for name, value, precision in self.entries:
+            if name not in golden:
+                errors.append(f"{name}: no golden entry")
+                continue
+            expected, tol = golden[name]
+            if abs(value - expected) > tol:
+                errors.append(f"{name}: got {value:.6f}, "
+                              f"expected {expected:.6f} ± {tol}")
+        if errors:
+            raise AssertionError("benchmark regressions:\n" +
+                                 "\n".join(errors))

@@ -1,0 +1,152 @@
+"""API-surface generation from the Params registry.
+
+Reference: the binding autogeneration system (src/test/scala/.../codegen/
+CodeGen.scala:15-48 + PySparkWrapper.scala / SparklyRWrapper.scala) reflects
+over every `Wrappable` stage to emit PySpark/SparklyR wrappers. The TPU build
+is single-language, so codegen shrinks to API-surface generation (SURVEY.md
+§7.8): reflect over the same Param registry to emit
+
+- `.pyi` stubs with typed setFoo/getFoo accessors, and
+- markdown API docs,
+
+keeping the "single source of truth" property: a param declared once on the
+class drives runtime config, serialization, AND the generated surface.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+from typing import Dict, List, Optional, Tuple, Type
+
+from ..core.params import Param, Params
+from ..core.pipeline import Estimator, Evaluator, Model, Transformer
+
+#: modules scanned for stages (mirrors the reference's jar reflection)
+PACKAGES = [
+    "mmlspark_tpu.core", "mmlspark_tpu.featurize", "mmlspark_tpu.stages",
+    "mmlspark_tpu.models", "mmlspark_tpu.train", "mmlspark_tpu.automl",
+    "mmlspark_tpu.nn", "mmlspark_tpu.recommendation", "mmlspark_tpu.explain",
+    "mmlspark_tpu.io", "mmlspark_tpu.cyber", "mmlspark_tpu.cognitive",
+]
+
+
+def discover_stages() -> List[Type[Params]]:
+    """Import every module under PACKAGES; collect concrete Params classes
+    (JarLoadingUtils equivalent)."""
+    seen: Dict[str, Type[Params]] = {}
+    for pkg_name in PACKAGES:
+        pkg = importlib.import_module(pkg_name)
+        modules = [pkg]
+        if hasattr(pkg, "__path__"):
+            for info in pkgutil.walk_packages(pkg.__path__,
+                                              pkg_name + "."):
+                try:
+                    modules.append(importlib.import_module(info.name))
+                except ImportError:
+                    continue
+        for mod in modules:
+            for name, obj in vars(mod).items():
+                if (inspect.isclass(obj) and issubclass(obj, Params)
+                        and obj.__module__.startswith("mmlspark_tpu")
+                        and not name.startswith("_")):
+                    seen[f"{obj.__module__}.{obj.__name__}"] = obj
+    return [seen[k] for k in sorted(seen)]
+
+
+def _is_abstract(cls: Type[Params]) -> bool:
+    if cls in (Params, Transformer, Estimator, Model, Evaluator):
+        return True
+    name = cls.__name__
+    return name.endswith("Base") or name.startswith("Has") or name.endswith(
+        "Params") or name.endswith("ParamsBase")
+
+
+def _py_type(p: Param) -> str:
+    if p.converter is int or isinstance(p.default, bool):
+        return "bool" if isinstance(p.default, bool) else "int"
+    if p.converter is float or isinstance(p.default, float):
+        return "float"
+    if isinstance(p.default, str):
+        return "str"
+    if isinstance(p.default, int):
+        return "int"
+    return "Any"
+
+
+def generate_stub(cls: Type[Params]) -> str:
+    """One class's .pyi body with typed accessors."""
+    lines = [f"class {cls.__name__}:"]
+    params = cls.params()
+    if not params:
+        lines.append("    ...")
+        return "\n".join(lines)
+    for name, p in sorted(params.items()):
+        t = _py_type(p)
+        cap = name[0].upper() + name[1:]
+        lines.append(f"    def set{cap}(self, value: {t}) -> "
+                     f"\"{cls.__name__}\": ...")
+        lines.append(f"    def get{cap}(self) -> {t}: ...")
+    return "\n".join(lines)
+
+
+def generate_stubs() -> str:
+    """Full .pyi content for every discovered concrete stage."""
+    parts = ["# auto-generated API stubs — mmlspark_tpu.utils.codegen",
+             "from typing import Any", ""]
+    for cls in discover_stages():
+        if _is_abstract(cls):
+            continue
+        parts.append(generate_stub(cls))
+        parts.append("")
+    return "\n".join(parts)
+
+
+def generate_docs() -> str:
+    """Markdown API reference: one section per stage with its param table."""
+    out = ["# mmlspark_tpu API reference", "",
+           "Auto-generated from the Param registry "
+           "(single source of truth).", ""]
+    current_pkg = None
+    for cls in discover_stages():
+        if _is_abstract(cls):
+            continue
+        pkg = cls.__module__.rsplit(".", 1)[0]
+        if pkg != current_pkg:
+            out.append(f"## {pkg}")
+            out.append("")
+            current_pkg = pkg
+        kind = ("Estimator" if issubclass(cls, Estimator)
+                else "Model" if issubclass(cls, Model)
+                else "Transformer" if issubclass(cls, Transformer)
+                else "Evaluator" if issubclass(cls, Evaluator)
+                else "Component")
+        out.append(f"### {cls.__name__} ({kind})")
+        doc = inspect.getdoc(cls)
+        if doc:
+            out.append(doc.split("\n\n")[0])
+        params = cls.params()
+        if params:
+            out.append("")
+            out.append("| param | type | default | doc |")
+            out.append("|---|---|---|---|")
+            for name, p in sorted(params.items()):
+                doc_text = (p.doc or "").replace("|", "\\|")
+                out.append(f"| {name} | {_py_type(p)} | `{p.default!r}` "
+                           f"| {doc_text} |")
+        out.append("")
+    return "\n".join(out)
+
+
+def write_artifacts(out_dir: str) -> Tuple[str, str]:
+    """Emit stubs + docs (CodeGen.generateArtifacts equivalent)."""
+    import os
+    os.makedirs(out_dir, exist_ok=True)
+    stub_path = os.path.join(out_dir, "mmlspark_tpu.pyi")
+    docs_path = os.path.join(out_dir, "API.md")
+    with open(stub_path, "w") as f:
+        f.write(generate_stubs())
+    with open(docs_path, "w") as f:
+        f.write(generate_docs())
+    return stub_path, docs_path

@@ -1,0 +1,84 @@
+"""Golden-metric accuracy-regression gates.
+
+Reference: benchmarks_VerifyLightGBMClassifier.csv (32 entries: dataset x
+boosting type), ...Regressor.csv, ...VowpalWabbitRegressor.csv,
+...TrainClassifier.csv, ...TuneHyperparameters.csv — compared with per-entry
+tolerance by Benchmarks.scala. Datasets here are seeded synthetic (the
+reference's UCI CSVs aren't shipped); golden values live in
+tests/benchmarks/*.csv and regenerate automatically when deleted.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import (LightGBMClassifier,
+                                          LightGBMRegressor)
+from mmlspark_tpu.models.vw import VowpalWabbitRegressor
+from mmlspark_tpu.train.metrics import auc_score
+from mmlspark_tpu.utils.benchmarks import Benchmarks
+
+BENCH_DIR = os.path.join(os.path.dirname(__file__), "benchmarks")
+
+
+def _dataset(seed, n=2000, f=12, kind="binary"):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    coef = rng.normal(size=f)
+    margin = x @ coef + 0.8 * x[:, 0] * x[:, 1] + np.sin(x[:, 2] * 2)
+    if kind == "binary":
+        y = (margin + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    else:
+        y = (margin + rng.normal(scale=0.3, size=n)).astype(np.float64)
+    return DataFrame({"features": x, "label": y})
+
+
+def test_lightgbm_classifier_golden():
+    bench = Benchmarks(os.path.join(BENCH_DIR,
+                                    "verify_lightgbm_classifier.csv"))
+    for name, seed, boosting in (("synth1", 101, "gbdt"),
+                                 ("synth2", 202, "gbdt"),
+                                 ("synth1_goss", 101, "goss"),
+                                 ("synth1_rf", 101, "rf")):
+        df = _dataset(seed)
+        train, test = df.random_split([0.75, 0.25], seed=1)
+        clf = LightGBMClassifier(numIterations=50, numLeaves=31,
+                                 boostingType=boosting,
+                                 baggingFraction=0.8 if boosting == "rf"
+                                 else 1.0,
+                                 baggingFreq=1 if boosting == "rf" else 0)
+        model = clf.fit(train)
+        proba = model.transform(test)["probability"][:, 1]
+        bench.add(f"auc_{name}_{boosting}",
+                  auc_score(test["label"], proba), 0.02)
+    bench.verify()
+
+
+def test_lightgbm_regressor_golden():
+    bench = Benchmarks(os.path.join(BENCH_DIR,
+                                    "verify_lightgbm_regressor.csv"))
+    for name, seed in (("synthA", 303), ("synthB", 404)):
+        df = _dataset(seed, kind="regression")
+        train, test = df.random_split([0.75, 0.25], seed=2)
+        model = LightGBMRegressor(numIterations=60).fit(train)
+        pred = model.transform(test)["prediction"]
+        l2 = float(np.mean((pred - test["label"]) ** 2))
+        bench.add(f"l2_{name}", l2, 0.15)
+    bench.verify()
+
+
+def test_vw_regressor_golden():
+    bench = Benchmarks(os.path.join(BENCH_DIR,
+                                    "verify_vw_regressor.csv"))
+    for name, args in (("default", ""), ("adaptive_only", "--adaptive"),
+                       ("plain_sgd", "--sgd -l 0.05")):
+        df = _dataset(505, kind="regression")
+        train, test = df.random_split([0.75, 0.25], seed=3)
+        model = VowpalWabbitRegressor(numPasses=8, numBits=6,
+                                      passThroughArgs=args).fit(train)
+        pred = model.transform(test)["prediction"]
+        l2 = float(np.mean((pred - test["label"]) ** 2))
+        bench.add(f"l2_{name}", l2, 0.25)
+    bench.verify()

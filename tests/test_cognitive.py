@@ -1,0 +1,165 @@
+"""cognitive/ tests — transformers exercised against a local mock service
+(the reference hits live Azure endpoints with keys; here a mock asserts the
+wire format)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.cognitive import (NER, AzureSearchWriter, DetectAnomalies,
+                                    DetectFace, KeyPhraseExtractor,
+                                    LanguageDetector, ServiceParam,
+                                    TagImage, TextSentiment, VerifyFaces)
+
+
+@pytest.fixture()
+def mock_service():
+    captured = {"requests": []}
+
+    class Handler(BaseHTTPRequestHandler):
+        def _respond(self, payload):
+            out = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+            parsed = urlparse(self.path)
+            captured["requests"].append({
+                "path": parsed.path,
+                "qs": parse_qs(parsed.query),
+                "headers": dict(self.headers),
+                "body": body,
+            })
+            if "sentiment" in self.path:
+                self._respond({"documents": [
+                    {"id": "0", "sentiment": "positive",
+                     "confidenceScores": {"positive": 0.99}}]})
+            elif "keyPhrases" in self.path:
+                self._respond({"documents": [
+                    {"id": "0", "keyPhrases": ["tpu", "framework"]}]})
+            elif "entities" in self.path:
+                self._respond({"documents": [
+                    {"id": "0", "entities": [{"text": "Seattle",
+                                              "category": "Location"}]}]})
+            elif "languages" in self.path:
+                self._respond({"documents": [
+                    {"id": "0", "detectedLanguage": {"iso6391Name": "en"}}]})
+            elif "tag" in self.path:
+                self._respond({"tags": [{"name": "cat", "confidence": 0.9}]})
+            elif "detect" in self.path and "timeseries" not in self.path:
+                self._respond([{"faceId": "f1",
+                                "faceRectangle": {"top": 1}}])
+            elif "verify" in self.path:
+                self._respond({"isIdentical": True, "confidence": 0.87})
+            elif "timeseries" in self.path:
+                self._respond({"isAnomaly": [False, True],
+                               "expectedValues": [1.0, 1.1]})
+            elif "index" in self.path:
+                self._respond({"value": [{"status": True}]})
+            else:
+                self._respond({})
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", captured
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_text_sentiment_wire_format(mock_service):
+    url, captured = mock_service
+    df = DataFrame({"text": np.array(["great product", None], dtype=object)})
+    t = TextSentiment(url=url + "/text/analytics/v3.0/sentiment",
+                      subscriptionKey=ServiceParam.value("k123"),
+                      outputCol="sentiment")
+    out = t.transform(df)
+    assert out["sentiment"][0]["sentiment"] == "positive"
+    assert out["sentiment"][1] is None  # null text -> no request
+    assert len(captured["requests"]) == 1
+    req = captured["requests"][0]
+    assert req["headers"]["Ocp-Apim-Subscription-Key"] == "k123"
+    sent = json.loads(req["body"])
+    assert sent["documents"][0]["text"] == "great product"
+    assert sent["documents"][0]["language"] == "en"
+
+
+def test_key_phrases_ner_language(mock_service):
+    url, _ = mock_service
+    df = DataFrame({"text": np.array(["visit Seattle"], dtype=object)})
+    kp = KeyPhraseExtractor(url=url + "/text/analytics/v3.0/keyPhrases",
+                            outputCol="phrases").transform(df)
+    assert kp["phrases"][0] == ["tpu", "framework"]
+    ner = NER(url=url + "/text/analytics/v3.0/entities/recognition/general",
+              outputCol="ents").transform(df)
+    assert ner["ents"][0][0]["category"] == "Location"
+    ld = LanguageDetector(url=url + "/text/analytics/v3.0/languages",
+                          outputCol="lang").transform(df)
+    assert ld["lang"][0]["iso6391Name"] == "en"
+
+
+def test_vision_and_face(mock_service):
+    url, captured = mock_service
+    df = DataFrame({"img": np.array(["http://x/cat.jpg"], dtype=object)})
+    tags = TagImage(url=url + "/vision/v2.0/tag", imageUrlCol="img",
+                    outputCol="tags").transform(df)
+    assert tags["tags"][0][0]["name"] == "cat"
+    faces = DetectFace(url=url + "/face/v1.0/detect", imageUrlCol="img",
+                       returnFaceAttributes=["age"],
+                       outputCol="faces").transform(df)
+    assert faces["faces"][0][0]["faceId"] == "f1"
+    assert captured["requests"][-1]["qs"]["returnFaceAttributes"] == ["age"]
+    vf = VerifyFaces(url=url + "/face/v1.0/verify",
+                     outputCol="verified").transform(
+        DataFrame({"faceId1": np.array(["a"], dtype=object),
+                   "faceId2": np.array(["b"], dtype=object)}))
+    assert vf["verified"][0]["isIdentical"] is True
+
+
+def test_anomaly_detector(mock_service):
+    url, captured = mock_service
+    series = np.empty(1, dtype=object)
+    series[0] = [("2024-01-01", 1.0), ("2024-01-02", 9.0)]
+    df = DataFrame({"series": series})
+    out = DetectAnomalies(
+        url=url + "/anomalydetector/v1.0/timeseries/entire/detect",
+        granularity="daily", outputCol="anomalies").transform(df)
+    assert out["anomalies"][0]["isAnomaly"] == [False, True]
+    body = json.loads(captured["requests"][-1]["body"])
+    assert body["granularity"] == "daily"
+    assert body["series"][1]["value"] == 9.0
+
+
+def test_azure_search_writer(mock_service):
+    url, captured = mock_service
+    df = DataFrame({"id": np.array(["1", "2"], dtype=object),
+                    "score": np.array([0.5, 0.7])})
+    n = AzureSearchWriter.write_to_azure_search(
+        df, url + "/index/docs/index", api_key="ak", batch_size=10)
+    assert n == 1
+    body = json.loads(captured["requests"][-1]["body"])
+    assert body["value"][0]["@search.action"] == "mergeOrUpload"
+    assert body["value"][1]["score"] == 0.7
+    assert captured["requests"][-1]["headers"]["api-key"] == "ak"
+
+
+def test_error_column_on_failure():
+    # unreachable endpoint -> error column populated, output None
+    df = DataFrame({"text": np.array(["x"], dtype=object)})
+    t = TextSentiment(url="http://127.0.0.1:1/nope", outputCol="s",
+                      timeout=0.5)
+    out = t.transform(df)
+    assert out["s"][0] is None
+    assert out["error"][0] is not None
