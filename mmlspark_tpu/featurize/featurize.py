@@ -63,8 +63,15 @@ class Featurize(Estimator):
                 levels = sorted({v for v in col.tolist()
                                  if isinstance(v, str)})
                 if len(levels) <= ONE_HOT_MAX:
-                    plan.append({"col": name, "kind": "levels",
-                                 "levels": levels, "n": len(levels)})
+                    if self.get("oneHotEncodeCategoricals"):
+                        plan.append({"col": name, "kind": "levels",
+                                     "levels": levels, "n": len(levels)})
+                    else:
+                        # single ordinal index column (the reference keeps the
+                        # categorical index when one-hot is off —
+                        # AssembleFeatures.scala categorical handling)
+                        plan.append({"col": name, "kind": "ordinal",
+                                     "levels": levels, "n": 1})
                     continue
                 nf = int(self.get("numberOfFeatures"))
                 bits = min(max(1, int(np.log2(nf))), HASH_BITS_CAP)
@@ -78,6 +85,20 @@ class Featurize(Estimator):
         model = FeaturizeModel(plan=plan)
         model.set("outputCol", self.get("outputCol"))
         return model
+
+
+def _lookup_levels(col, levels_list):
+    """Map a string column onto sorted levels. Returns (index, valid) where
+    valid is False for missing/non-string/unseen values — a separate mask so
+    missing never collides with a genuine empty-string level."""
+    levels = np.asarray(levels_list, dtype=object)
+    present = np.array([isinstance(v, str) for v in col], bool)
+    strs = np.array([v if isinstance(v, str) else "" for v in col],
+                    dtype=object)
+    j = np.searchsorted(levels.astype(str), strs.astype(str))
+    j = np.clip(j, 0, len(levels) - 1)
+    valid = present & (levels[j] == strs)
+    return j, valid
 
 
 class FeaturizeModel(Model):
@@ -108,15 +129,14 @@ class FeaturizeModel(Model):
                 out[np.flatnonzero(valid), idx[valid]] = 1.0
                 parts.append(out)
             elif kind == "levels":
-                levels = np.asarray(spec["levels"], dtype=object)
-                strs = np.array([v if isinstance(v, str) else "" for v in col],
-                                dtype=object)
-                j = np.searchsorted(levels.astype(str), strs.astype(str))
-                j = np.clip(j, 0, len(levels) - 1)
-                valid = levels[j] == strs  # unseen/missing -> all-zeros row
-                out = np.zeros((n, spec["n"]), np.float32)
+                j, valid = _lookup_levels(col, spec["levels"])
+                out = np.zeros((n, spec["n"]), np.float32)  # invalid: all-zero
                 out[np.flatnonzero(valid), j[valid].astype(np.int64)] = 1.0
                 parts.append(out)
+            elif kind == "ordinal":
+                j, valid = _lookup_levels(col, spec["levels"])
+                out = np.where(valid, j.astype(np.float32), -1.0)
+                parts.append(out[:, None].astype(np.float32))
             elif kind == "hash":
                 buckets = hash_strings([str(s) for s in col], spec["bits"])
                 out = np.zeros((n, spec["n"]), np.float32)

@@ -79,7 +79,14 @@ def send_with_retries(req: HTTPRequestData,
                 retry_after = r.headers.get("Retry-After")
                 if backoff is None:
                     return resp
-                wait = (float(retry_after) * 1000 if retry_after else backoff)
+                try:
+                    # numeric-seconds form only; an HTTP-date Retry-After
+                    # falls back to the backoff schedule instead of raising
+                    # inside the try (which would misclassify the response
+                    # as a connection failure)
+                    wait = float(retry_after) * 1000
+                except (TypeError, ValueError):
+                    wait = backoff
                 time.sleep(wait / 1000.0)
                 last = resp
                 continue

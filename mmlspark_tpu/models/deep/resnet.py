@@ -138,7 +138,15 @@ def load_params(path: str, template):
     if len(keys) != len(leaves):
         raise ValueError(f"checkpoint has {len(keys)} arrays, "
                          f"model expects {len(leaves)}")
-    return jax.tree.unflatten(treedef, [flat[k] for k in keys])
+    loaded = []
+    for k, leaf in zip(keys, leaves):
+        arr = flat[k]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint array {k} has shape {arr.shape}, model leaf "
+                f"expects {np.shape(leaf)} — wrong architecture?")
+        loaded.append(arr)
+    return jax.tree.unflatten(treedef, loaded)
 
 
 def save_params(path: str, variables) -> None:

@@ -1,0 +1,64 @@
+"""Training delegate hooks — user callbacks into the boosting loop.
+
+Reference: `trait LightGBMDelegate` (lightgbm/LightGBMDelegate.scala:1-60) with
+hook sites in TrainUtils.scala:192-218 (before/after iteration, dynamic
+learning rate) and LightGBMBase.scala:52-68 (before/after batch).
+
+TPU-native adaptation: the boosting loop is a jit-compiled `lax.scan`, so
+per-iteration Python callbacks cannot run *inside* the compiled program.
+Training instead proceeds in compiled CHUNKS of iterations
+(`make_train_fn(cfg).chunk`); hooks run on the host between chunks:
+
+- `get_learning_rate` / `before_train_iteration` are called for every
+  iteration of the upcoming chunk BEFORE it launches (learning rates become a
+  per-iteration multiplier array fed to the compiled program);
+- `after_train_iteration` is called for every finished iteration right after
+  its chunk returns, with the recorded train/valid metric values — the same
+  information the reference delivers (TrainUtils.scala:205-212), delayed by at
+  most one chunk;
+- dataset-generation hooks (`before/after_generate_train_dataset`) fire around
+  host-side binning.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class LightGBMDelegate:
+    """Subclass and override any hook (all are no-ops by default)."""
+
+    # ------------------------------------------------------------- batches
+    def before_train_batch(self, batch_index: int, df, previous_booster
+                           ) -> None:
+        """LightGBMDelegate.scala beforeTrainBatch."""
+
+    def after_train_batch(self, batch_index: int, df, booster) -> None:
+        """LightGBMDelegate.scala afterTrainBatch."""
+
+    # ------------------------------------------------------------ datasets
+    def before_generate_train_dataset(self, batch_index: int, params) -> None:
+        """Called before host-side binning (beforeGenerateTrainDataset)."""
+
+    def after_generate_train_dataset(self, batch_index: int, params) -> None:
+        """Called after host-side binning (afterGenerateTrainDataset)."""
+
+    # ---------------------------------------------------------- iterations
+    def before_train_iteration(self, batch_index: int, cur_iter: int,
+                               has_valid: bool) -> None:
+        """Called before iteration `cur_iter` launches (beforeTrainIteration).
+        Runs when the chunk containing the iteration is about to launch."""
+
+    def after_train_iteration(self, batch_index: int, cur_iter: int,
+                              has_valid: bool, is_finished: bool,
+                              train_eval: Optional[dict],
+                              valid_eval: Optional[dict]) -> None:
+        """Called after iteration `cur_iter` with its recorded metrics
+        (afterTrainIteration). `is_finished` is True on the final iteration —
+        by early stop or iteration-count exhaustion."""
+
+    def get_learning_rate(self, batch_index: int, cur_iter: int,
+                          previous_learning_rate: float) -> float:
+        """Return the learning rate for `cur_iter` (getLearningRate,
+        TrainUtils.scala:213-218). Default: keep the previous rate."""
+        return previous_learning_rate

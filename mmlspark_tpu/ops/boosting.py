@@ -441,11 +441,10 @@ def make_train_fn(cfg: GBDTConfig):
     if dart and multiclass:
         raise NotImplementedError("dart mode is single-output only for now")
 
-    def train(binned, y, w_all, is_train, init_margin, key, group_idx=None):
-        """init_margin [N, K]: per-row starting margins (initScoreCol / warm
-        start / batch training — LightGBMBase.scala:29-50, TrainUtils.scala:57-129).
-        Zeros when absent. group_idx [NG, G] (lambdarank only): padded
-        gather-index group layout from ops.ranking.make_group_layout."""
+    def _env(binned, y, w_all, is_train, init_margin, group_idx):
+        """Shared setup: init score, starting margins, and the per-iteration
+        `step` closure — used by both the full scan (`train`) and the chunked
+        scan (`train.chunk`, host-driven early stopping)."""
         n, f = binned.shape
         w = w_all * is_train           # training weight
         w_valid = w_all * (1.0 - is_train)  # validation-metric weight
@@ -488,7 +487,8 @@ def make_train_fn(cfg: GBDTConfig):
         scores0 = init + init_margin.astype(jnp.float32)  # [N, K]
         t_cap = cfg.num_iterations
 
-        def step(carry, it):
+        def step(carry, xs):
+            it, lr_mult = xs
             scores, deltas, tree_scale, key = carry
             key, k_bag, k_feat, k_drop = jax.random.split(key, 4)
 
@@ -545,6 +545,10 @@ def make_train_fn(cfg: GBDTConfig):
                     [gk * row_w, hk * row_w, jnp.where(row_w > 0, 1.0, 0.0)],
                     axis=1).astype(jnp.float32)
                 tree, slot = build_tree(binned, gh3, cfg, fmask)
+                # lr_mult: per-iteration learning-rate multiplier relative to
+                # cfg.learning_rate (delegate dynamic learning rate —
+                # LightGBMDelegate.scala getLearningRate, TrainUtils.scala:213+)
+                tree = tree._replace(leaf_value=tree.leaf_value * lr_mult)
                 return tree, tree.leaf_value[slot]
 
             if multiclass:
@@ -583,9 +587,22 @@ def make_train_fn(cfg: GBDTConfig):
         deltas0 = (jnp.zeros((t_cap, n), jnp.float32) if dart
                    else jnp.zeros((1, 1), jnp.float32))
         tree_scale0 = jnp.ones((t_cap,), jnp.float32)
+        return step, scores0, init, deltas0, tree_scale0
+
+    def train(binned, y, w_all, is_train, init_margin, key, group_idx=None,
+              lr_mult=None):
+        """init_margin [N, K]: per-row starting margins (initScoreCol / warm
+        start / batch training — LightGBMBase.scala:29-50, TrainUtils.scala:57-129).
+        Zeros when absent. group_idx [NG, G] (lambdarank only): padded
+        gather-index group layout from ops.ranking.make_group_layout.
+        lr_mult [T] (optional): per-iteration learning-rate multipliers."""
+        step, scores0, init, deltas0, tree_scale0 = _env(
+            binned, y, w_all, is_train, init_margin, group_idx)
+        lr = (jnp.ones((cfg.num_iterations,), jnp.float32) if lr_mult is None
+              else jnp.asarray(lr_mult, jnp.float32))
         (scores, _, tree_scale, _), (trees, train_m, valid_m) = jax.lax.scan(
             step, (scores0, deltas0, tree_scale0, key),
-            jnp.arange(cfg.num_iterations))
+            (jnp.arange(cfg.num_iterations), lr))
         if dart:
             # bake final DART scales into the leaf values
             trees = trees._replace(
@@ -593,4 +610,33 @@ def make_train_fn(cfg: GBDTConfig):
         init_out = jnp.full((k,), init) if multiclass else init
         return BoostResult(trees, init_out, train_m, valid_m)
 
+    def train_chunk(binned, y, w_all, is_train, init_margin, key, start,
+                    scores_in, lr_mult, group_idx=None):
+        """Run ONE chunk of iterations [start, start+C) where C =
+        len(lr_mult), carrying raw scores across chunks.
+
+        This is the jit-friendly analogue of the reference's `trainCore` loop
+        actually HALTING on early stopping (TrainUtils.scala:220-315): the
+        host checks the returned validation metrics between chunks and simply
+        stops launching further chunks. At start == 0 the carried scores are
+        ignored and the init-score margins are used.
+
+        Returns (trees [C,...], train_metric [C], valid_metric [C],
+        scores [N,K], init_score)."""
+        if dart:
+            raise NotImplementedError(
+                "chunked early stopping is not supported for dart (dropout "
+                "needs the full prior-tree delta history)")
+        step, scores0, init, deltas0, tree_scale0 = _env(
+            binned, y, w_all, is_train, init_margin, group_idx)
+        scores_start = jnp.where(start == 0, scores0, scores_in)
+        c = lr_mult.shape[0]
+        its = start + jnp.arange(c)
+        (scores, _, _, _), (trees, train_m, valid_m) = jax.lax.scan(
+            step, (scores_start, deltas0, tree_scale0, key),
+            (its, jnp.asarray(lr_mult, jnp.float32)))
+        init_out = jnp.full((k,), init) if multiclass else init
+        return trees, train_m, valid_m, scores, init_out
+
+    train.chunk = train_chunk
     return train

@@ -20,11 +20,20 @@ from ..core.pipeline import Estimator, Evaluator, Model, Transformer
 
 
 class AdvancedRankingMetrics:
-    """Per-dataset ranking metrics over (predicted items, relevant items)."""
+    """Per-dataset ranking metrics over (predicted items, relevant items).
+
+    Semantics match the reference `AdvancedRankingMetrics`
+    (RankingEvaluator.scala:15-97), which mixes Spark mllib RankingMetrics
+    (map over the FULL prediction list divided by label-set size; ndcgAt /
+    precisionAt truncated at k; empty ground truth contributes 0) with its own
+    recallAtK (divided by the PREDICTION list length, :28-31), mrr (first-hit
+    reciprocal rank, :43-61) and fcp (positionwise concordance, :62-74).
+    """
 
     def __init__(self, pred_lists: Sequence[Sequence], label_lists:
                  Sequence[Sequence], k: int, n_items: int):
-        self.preds = [list(p)[:k] for p in pred_lists]
+        self.preds = [list(p) for p in pred_lists]          # full lists
+        self.label_lists = [list(l) for l in label_lists]   # ordered
         self.labels = [set(l) for l in label_lists]
         self.k = k
         self.n_items = n_items
@@ -32,44 +41,67 @@ class AdvancedRankingMetrics:
     def ndcg_at(self) -> float:
         vals = []
         for pred, rel in zip(self.preds, self.labels):
-            if not rel:
-                continue
             dcg = sum(1.0 / np.log2(i + 2)
-                      for i, p in enumerate(pred) if p in rel)
+                      for i, p in enumerate(pred[:self.k]) if p in rel)
             idcg = sum(1.0 / np.log2(i + 2)
                        for i in range(min(len(rel), self.k)))
             vals.append(dcg / idcg if idcg > 0 else 0.0)
         return float(np.mean(vals)) if vals else 0.0
 
     def mean_average_precision(self) -> float:
+        # Spark meanAveragePrecision: full prediction list, / label-set size.
         vals = []
         for pred, rel in zip(self.preds, self.labels):
-            if not rel:
-                continue
             hits, s = 0, 0.0
             for i, p in enumerate(pred):
                 if p in rel:
                     hits += 1
                     s += hits / (i + 1)
-            vals.append(s / min(len(rel), self.k))
+            vals.append(s / len(rel) if rel else 0.0)
         return float(np.mean(vals)) if vals else 0.0
 
     def precision_at_k(self) -> float:
-        vals = [len(set(pred) & rel) / self.k
-                for pred, rel in zip(self.preds, self.labels) if rel]
+        # Spark precisionAt(k): hit count over first k (duplicates count), / k.
+        vals = [sum(1 for p in pred[:self.k] if p in rel) / self.k
+                for pred, rel in zip(self.preds, self.labels)]
         return float(np.mean(vals)) if vals else 0.0
 
     def recall_at_k(self) -> float:
-        vals = [len(set(pred) & rel) / len(rel)
-                for pred, rel in zip(self.preds, self.labels) if rel]
+        # Reference recallAtK divides by the prediction-list length
+        # (RankingEvaluator.scala:28-31), not the relevant-set size.
+        vals = [len(set(pred) & rel) / len(pred) if pred else 0.0
+                for pred, rel in zip(self.preds, self.labels)]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def mean_reciprocal_rank(self) -> float:
+        vals = []
+        for pred, rel in zip(self.preds, self.labels):
+            rr = 0.0
+            if rel:
+                for i, p in enumerate(pred):
+                    if p in rel:
+                        rr = 1.0 / (i + 1)
+                        break
+            vals.append(rr)
+        return float(np.mean(vals)) if vals else 0.0
+
+    def fraction_concordant_pairs(self) -> float:
+        vals = []
+        for pred, lab in zip(self.preds, self.label_lists):
+            nc = sum(1 for i, p in enumerate(pred)
+                     if i < len(lab) and p == lab[i])
+            nd = sum(1 for i, p in enumerate(pred)
+                     if i < len(lab) and p != lab[i])
+            vals.append(nc / (nc + nd) if nc + nd else 0.0)
         return float(np.mean(vals)) if vals else 0.0
 
     def diversity_at_k(self) -> float:
-        """Distinct recommended items / catalog size (RankingEvaluator
-        diversityAtK)."""
+        """Distinct recommended items in the top k / catalog size
+        (RankingEvaluator diversityAtK — the reference receives exactly-k
+        lists from RankingAdapter, so "at K" = truncate here)."""
         distinct = set()
         for pred in self.preds:
-            distinct.update(pred)
+            distinct.update(pred[:self.k])
         return len(distinct) / max(self.n_items, 1)
 
     def max_diversity(self) -> float:
@@ -77,7 +109,7 @@ class AdvancedRankingMetrics:
         for lab in self.labels:
             distinct.update(lab)
         for pred in self.preds:
-            distinct.update(pred)
+            distinct.update(pred[:self.k])
         return len(distinct) / max(self.n_items, 1)
 
     def get(self, name: str) -> float:
@@ -85,7 +117,9 @@ class AdvancedRankingMetrics:
                  "precisionAtk": self.precision_at_k,
                  "recallAtK": self.recall_at_k,
                  "diversityAtK": self.diversity_at_k,
-                 "maxDiversity": self.max_diversity}
+                 "maxDiversity": self.max_diversity,
+                 "mrr": self.mean_reciprocal_rank,
+                 "fcp": self.fraction_concordant_pairs}
         if name not in table:
             raise ValueError(f"unknown ranking metric {name!r}; "
                              f"known: {sorted(table)}")
@@ -95,7 +129,8 @@ class AdvancedRankingMetrics:
 class RankingEvaluator(Evaluator):
     k = _p.Param("k", "cutoff", 10, int)
     metricName = _p.Param("metricName", "ndcgAt | map | precisionAtk | "
-                          "recallAtK | diversityAtK | maxDiversity", "ndcgAt")
+                          "recallAtK | diversityAtK | maxDiversity | mrr | "
+                          "fcp", "ndcgAt")
     nItems = _p.Param("nItems", "catalog size (for diversity metrics)", 0, int)
     predictionCol = _p.Param("predictionCol",
                              "column of recommended item lists", "prediction")
@@ -208,9 +243,15 @@ class RankingTrainValidationSplit(Estimator):
             fitted = adapter.fit(train)
             metric = evaluator.evaluate(fitted.transform(valid))
             metrics.append(metric)
+            if not np.isfinite(metric):
+                # never let a NaN candidate pin best_metric (it would defeat
+                # all later comparisons) — same policy as automl.tune
+                if best is None:
+                    best = fitted
+                continue
             better = (metric > best_metric if evaluator.is_larger_better()
                       else metric < best_metric)
-            if best is None or better:
+            if best is None or not np.isfinite(best_metric) or better:
                 best, best_metric = fitted, metric
         out = RankingTrainValidationSplitModel(best_model=best,
                                                validation_metrics=metrics)

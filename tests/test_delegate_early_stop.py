@@ -1,0 +1,178 @@
+"""Chunked early stopping + LightGBMDelegate hooks.
+
+Reference behaviors under test:
+- trainCore HALTS the iteration loop on early stopping (TrainUtils.scala:220-315)
+  — not merely truncating afterwards; we assert fewer trees were BUILT.
+- LightGBMDelegate before/after batch + iteration hooks and dynamic learning
+  rate (LightGBMDelegate.scala:1-60; the reference's delegate learning-rate
+  test in VerifyLightGBMClassifier).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import (LightGBMClassifier,
+                                          LightGBMDelegate,
+                                          LightGBMRanker,
+                                          LightGBMRegressor)
+
+
+@pytest.fixture(scope="module")
+def valid_df():
+    rng = np.random.default_rng(7)
+    n, f = 4000, 10
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    coef = rng.normal(size=f)
+    y = ((x @ coef + rng.normal(scale=0.3, size=n)) > 0).astype(np.float64)
+    vi = (np.arange(n) % 5 == 0).astype(np.float64)
+    return DataFrame({"features": x, "label": y, "valid": vi})
+
+
+class TestEarlyStoppingHalts:
+    def test_serial_builds_fewer_trees(self, valid_df):
+        clf = LightGBMClassifier(numIterations=300, earlyStoppingRound=10,
+                                 validationIndicatorCol="valid", numTasks=1)
+        m = clf.fit(valid_df)
+        built = m.booster.trees.leaf_value.shape[0]
+        assert built < 300, "early stopping must halt the loop, not truncate"
+        assert m.booster.best_iteration is not None
+        assert m.booster.best_iteration <= built
+
+    def test_sharded_matches_serial(self, valid_df):
+        serial = LightGBMClassifier(numIterations=300, earlyStoppingRound=10,
+                                    validationIndicatorCol="valid",
+                                    numTasks=1).fit(valid_df)
+        sharded = LightGBMClassifier(numIterations=300, earlyStoppingRound=10,
+                                     validationIndicatorCol="valid",
+                                     numTasks=8).fit(valid_df)
+        # histogram psum is exact, so the stop point must agree
+        assert (serial.booster.best_iteration
+                == sharded.booster.best_iteration)
+        assert (serial.booster.trees.leaf_value.shape[0]
+                == sharded.booster.trees.leaf_value.shape[0])
+
+    def test_regressor_and_ranker_halt(self, valid_df):
+        rng = np.random.default_rng(3)
+        n = len(valid_df)
+        x = np.asarray(valid_df["features"])
+        yr = (x[:, 0] * 2 - x[:, 1]
+              + rng.normal(scale=0.05, size=n)).astype(np.float64)
+        df = DataFrame({"features": x, "label": yr,
+                        "valid": np.asarray(valid_df["valid"])})
+        m = LightGBMRegressor(numIterations=250, earlyStoppingRound=8,
+                              validationIndicatorCol="valid",
+                              numTasks=1).fit(df)
+        assert m.booster.trees.leaf_value.shape[0] < 250
+
+        g = np.repeat(np.arange(n // 20), 20).astype(np.float64)
+        dfr = DataFrame({"features": x,
+                         "label": np.floor(rng.random(n) * 4),
+                         "group": g,
+                         "valid": np.asarray(valid_df["valid"])})
+        r = LightGBMRanker(numIterations=120, earlyStoppingRound=6,
+                           validationIndicatorCol="valid", groupCol="group",
+                           numTasks=8).fit(dfr)
+        assert r.booster.trees.leaf_value.shape[0] < 120
+
+    def test_no_valid_rows_runs_full(self, binary_df):
+        m = LightGBMClassifier(numIterations=30, earlyStoppingRound=5,
+                               numTasks=1).fit(binary_df)
+        assert m.booster.trees.leaf_value.shape[0] == 30
+        assert m.booster.best_iteration is None
+
+
+class RecordingDelegate(LightGBMDelegate):
+    def __init__(self, decay=1.0):
+        self.decay = decay
+        self.before_iters = []
+        self.after_iters = []
+        self.lrs = []
+        self.batches = []
+        self.dataset_events = []
+        self.finished_flags = []
+        self.metrics = []
+
+    def before_train_batch(self, bi, df, prev):
+        self.batches.append(("before", bi, prev))
+
+    def after_train_batch(self, bi, df, booster):
+        self.batches.append(("after", bi, booster))
+
+    def before_generate_train_dataset(self, bi, params):
+        self.dataset_events.append(("before_gen", bi))
+
+    def after_generate_train_dataset(self, bi, params):
+        self.dataset_events.append(("after_gen", bi))
+
+    def before_train_iteration(self, bi, it, has_valid):
+        self.before_iters.append(it)
+
+    def after_train_iteration(self, bi, it, has_valid, finished, te, ve):
+        self.after_iters.append(it)
+        self.finished_flags.append(finished)
+        self.metrics.append((te, ve))
+
+    def get_learning_rate(self, bi, it, prev):
+        lr = 0.1 * (self.decay ** it)
+        self.lrs.append(lr)
+        return lr
+
+
+class TestDelegate:
+    def test_iteration_hooks_and_metrics(self, binary_df):
+        d = RecordingDelegate()
+        clf = LightGBMClassifier(numIterations=20, numTasks=1)
+        clf.set("delegate", d)
+        clf.fit(binary_df)
+        assert d.before_iters == list(range(20))
+        assert d.after_iters == list(range(20))
+        assert d.finished_flags[-1] is True
+        assert not any(d.finished_flags[:-1])
+        assert all(np.isfinite(te["train"]) for te, _ in d.metrics)
+        assert d.dataset_events == [("before_gen", 0), ("after_gen", 0)]
+
+    def test_dynamic_learning_rate_changes_model(self, binary_df):
+        """Mirrors the reference's delegate learning-rate case: a decaying
+        schedule must produce a different (and still sane) model."""
+        base = LightGBMClassifier(numIterations=30, numTasks=1).fit(binary_df)
+        d = RecordingDelegate(decay=0.8)
+        clf = LightGBMClassifier(numIterations=30, numTasks=1)
+        clf.set("delegate", d)
+        decayed = clf.fit(binary_df)
+        assert len(d.lrs) == 30
+        x = np.asarray(binary_df["features"])
+        s_base = base.booster.score(x)
+        s_dec = decayed.booster.score(x)
+        assert not np.allclose(s_base, s_dec)
+        from sklearn.metrics import roc_auc_score
+        y = np.asarray(binary_df["label"])
+        assert roc_auc_score(y, s_dec) > 0.8
+
+    def test_batch_hooks(self, binary_df):
+        d = RecordingDelegate()
+        clf = LightGBMClassifier(numIterations=8, numBatches=2, numTasks=1)
+        clf.set("delegate", d)
+        m = clf.fit(binary_df)
+        kinds = [(k, bi) for k, bi, _ in d.batches]
+        assert kinds == [("before", 0), ("after", 0),
+                         ("before", 1), ("after", 1)]
+        # first batch starts from no booster; after hooks carry fitted ones
+        assert d.batches[0][2] is None
+        assert d.batches[1][2] is not None
+        assert m.booster is not None
+
+    def test_constant_delegate_matches_plain_fit(self, binary_df):
+        """A delegate that keeps the configured rate must not change the
+        model vs the non-delegate (full-scan) path."""
+        class Keep(LightGBMDelegate):
+            pass
+
+        plain = LightGBMClassifier(numIterations=15, numTasks=1,
+                                   seed=5).fit(binary_df)
+        clf = LightGBMClassifier(numIterations=15, numTasks=1, seed=5)
+        clf.set("delegate", Keep())
+        hooked = clf.fit(binary_df)
+        x = np.asarray(binary_df["features"])[:100]
+        np.testing.assert_allclose(plain.booster.score(x),
+                                   hooked.booster.score(x), rtol=1e-5)

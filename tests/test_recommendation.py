@@ -90,10 +90,17 @@ def test_ranking_metrics_hand_computed():
     assert m.ndcg_at() == pytest.approx((expect_u1 + 0.0) / 2)
     # precision@3: u1 = 2/3, u2 = 0
     assert m.precision_at_k() == pytest.approx((2 / 3) / 2)
-    assert m.recall_at_k() == pytest.approx((2 / 2) / 2)
-    # map: u1 = (1/1 + 2/3)/2 ; u2 = 0
+    # reference recallAtK divides by the PREDICTION-list length
+    # (RankingEvaluator.scala:28-31): u1 = 2/3, u2 = 0/3
+    assert m.recall_at_k() == pytest.approx((2 / 3) / 2)
+    # map: u1 = (1/1 + 2/3)/|labels|=2 ; u2 = 0
     assert m.mean_average_precision() == pytest.approx((1 + 2 / 3) / 2 / 2)
     assert m.diversity_at_k() == pytest.approx(6 / 10)
+    # mrr: u1 first hit at rank 1 -> 1.0; u2 no hit -> 0
+    assert m.get("mrr") == pytest.approx(0.5)
+    # fcp: u1 positionwise [1==1, 2==3?, 3 beyond len(lab)] -> nc=1, nd=1
+    #      u2 [4==9?] -> nc=0, nd=1
+    assert m.get("fcp") == pytest.approx((0.5 + 0.0) / 2)
 
 
 def test_ranking_adapter_and_evaluator():
