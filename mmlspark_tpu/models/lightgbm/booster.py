@@ -70,9 +70,25 @@ class Booster:
                 else self.num_iterations)
 
     # ------------------------------------------------------------ prediction
+    def _prep_x(self, x: np.ndarray) -> np.ndarray:
+        """For boosters trained HERE, clip categorical feature codes into the
+        bin range exactly like BinMapper.transform did at training time, so
+        out-of-range categories route identically at train and serve time.
+        Parsed upstream models (bin_mapper None) keep upstream semantics:
+        out-of-bitset categories go right."""
+        x = np.asarray(x, np.float32)
+        bm = self.bin_mapper
+        if bm is not None and getattr(bm, "categorical", ()):
+            width = self.trees.split_mask.shape[-1]
+            if width > 1:
+                x = x.copy()
+                for ci in bm.categorical:
+                    x[:, ci] = np.clip(x[:, ci], 0, width - 1)
+        return x
+
     def raw_predict(self, x: np.ndarray) -> np.ndarray:
         """Margin scores: [N] (single-output) or [N, K]. Batched jit traversal."""
-        x = jnp.asarray(np.asarray(x, np.float32))
+        x = jnp.asarray(self._prep_x(x))
         t_used = self._used_iters()
         trees = Tree(*[jnp.asarray(a[:t_used]) for a in self.trees])
         thr = jnp.asarray(self.thresholds[:t_used])
@@ -94,7 +110,7 @@ class Booster:
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
         """Leaf index per tree: [N, T] or [N, T*K] (predictLeaf,
         LightGBMBooster.scala:216-228)."""
-        x = jnp.asarray(np.asarray(x, np.float32))
+        x = jnp.asarray(self._prep_x(x))
         t_used = self._used_iters()
         trees = Tree(*[jnp.asarray(a[:t_used]) for a in self.trees])
         thr = jnp.asarray(self.thresholds[:t_used])
@@ -109,7 +125,7 @@ class Booster:
         C++ `C_API_PREDICT_CONTRIB`). [N, F+1] or [N, K*(F+1)]; last column per
         class block is the expected value."""
         from .shap import tree_shap
-        x = np.asarray(x, np.float64)
+        x = np.asarray(self._prep_x(x), np.float64)
         t_used = self._used_iters()
         fp1 = self.num_features + 1
         if self.multiclass:
@@ -179,6 +195,15 @@ class Booster:
 
     @staticmethod
     def from_parts(meta: dict, arrays: dict) -> "Booster":
+        if "tree_split_default_left" not in arrays:
+            # checkpoints from before decision_type support: our trees always
+            # trained with default-left + numeric missing NaN / cat missing None
+            valid = np.asarray(arrays["tree_split_valid"])
+            is_cat = np.asarray(arrays["tree_split_is_cat"])
+            arrays = dict(arrays)
+            arrays["tree_split_default_left"] = np.ones_like(valid)
+            arrays["tree_split_missing_type"] = np.where(is_cat, 0, 2).astype(
+                np.int32)
         trees = Tree(*[arrays[f"tree_{f}"] for f in Tree._fields])
         bm = (BinMapper(arrays["bin_edges"],
                         tuple(meta.get("categorical", ())))
@@ -341,8 +366,14 @@ def _tree_to_text(tree: Tree, thresholds: np.ndarray, tree_id: int,
     out.write(f"num_cat={num_cat}\n")
     if n_splits:
         # categorical splits use LightGBM bitset encoding: threshold = index
-        # into cat_boundaries; cat_threshold bit c set => category c goes left
-        dec = np.where(is_cat, 1, 2)
+        # into cat_boundaries; cat_threshold bit c set => category c goes left.
+        # decision_type: bit0 categorical, bit1 default_left, bits2-3 missing
+        # type (0 None, 4 Zero, 8 NaN) — upstream tree.h encoding
+        dl = (np.asarray(tree.split_default_left[:n_splits]).astype(bool)
+              & ~is_cat)  # default-left bit is numeric-only upstream
+        mt = np.asarray(tree.split_missing_type[:n_splits]).astype(int)
+        dec = (is_cat.astype(int) | (dl.astype(int) << 1)
+               | (np.clip(mt, 0, 2) << 2))
         thr_out = thr.astype(np.float64).copy()
         cat_boundaries = [0]
         cat_words: list = []

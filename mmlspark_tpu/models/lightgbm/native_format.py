@@ -29,7 +29,8 @@ def _parse_tree_block(lines: Dict[str, str]):
                 if "leaf_count" in lines else np.zeros(1))
         return num_leaves, (np.zeros(0, int), np.zeros(0), np.zeros(0, int),
                             np.zeros(0, int), lv, lcnt,
-                            np.zeros(0, bool), np.zeros((0, 1), bool))
+                            np.zeros(0, bool), np.zeros((0, 1), bool),
+                            np.zeros(0, bool), np.zeros(0, int))
     sf = np.array([int(v) for v in lines["split_feature"].split()])
     thr = np.array([float(v) for v in lines["threshold"].split()])
     lc = np.array([int(v) for v in lines["left_child"].split()])
@@ -37,11 +38,13 @@ def _parse_tree_block(lines: Dict[str, str]):
     lv = np.array([float(v) for v in lines["leaf_value"].split()])
     lcnt = (np.array([float(v) for v in lines["leaf_count"].split()])
             if "leaf_count" in lines else np.zeros(len(lv)))
-    # categorical decision nodes: decision_type bit 0 set => bitset split
-    # (LightGBM model format; decision_type "2" = numeric default-left)
+    # decision_type (upstream tree.h): bit0 categorical, bit1 default_left,
+    # bits2-3 missing type (0 None, 1 Zero, 2 NaN)
     dec = (np.array([int(v) for v in lines["decision_type"].split()])
            if "decision_type" in lines else np.full(len(sf), 2))
     is_cat = (dec & 1).astype(bool)
+    default_left = ((dec >> 1) & 1).astype(bool)
+    missing_type = (dec >> 2) & 3
     n_splits = len(sf)
     if is_cat.any():
         cb = np.array([int(v) for v in lines["cat_boundaries"].split()])
@@ -61,13 +64,15 @@ def _parse_tree_block(lines: Dict[str, str]):
                         masks[s, wi * 32 + bit] = True
     else:
         masks = np.zeros((n_splits, 1), bool)
-    return num_leaves, (sf, thr, lc, rc, lv, lcnt, is_cat, masks)
+    return num_leaves, (sf, thr, lc, rc, lv, lcnt, is_cat, masks,
+                        default_left, missing_type)
 
 
 def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int,
                     mask_width: int = 1):
     """Convert LightGBM node arrays to padded slot/replay arrays."""
-    sf, thr, lc, rc, lv, lcnt, node_cat, node_masks = arrays
+    (sf, thr, lc, rc, lv, lcnt, node_cat, node_masks, node_dl,
+     node_mt) = arrays
     n_splits = len(sf)
     lcap = max_leaves
     split_slot = np.zeros(lcap - 1, np.int32)
@@ -77,6 +82,8 @@ def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int,
     split_gain = np.zeros(lcap - 1, np.float32)
     split_is_cat = np.zeros(lcap - 1, bool)
     split_mask = np.zeros((lcap - 1, mask_width), bool)
+    split_dl = np.zeros(lcap - 1, bool)
+    split_mt = np.zeros(lcap - 1, np.int32)
     thresholds = np.zeros(lcap - 1, np.float64)
     leaf_value = np.zeros(lcap, np.float32)
     leaf_count = np.zeros(lcap, np.float32)
@@ -86,7 +93,7 @@ def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int,
         leaf_count[0] = lcnt[0]
         return Tree(split_slot, split_feat, split_bin, split_valid, split_gain,
                     leaf_value, leaf_count, split_is_cat,
-                    split_mask), thresholds
+                    split_mask, split_dl, split_mt), thresholds
 
     slot_of_node = {0: 0}
     step = 0
@@ -98,6 +105,8 @@ def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int,
         split_feat[step] = sf[node]
         thresholds[step] = thr[node]
         split_valid[step] = True
+        split_dl[step] = bool(node_dl[node])
+        split_mt[step] = int(node_mt[node])
         if node_cat[node]:
             split_is_cat[step] = True
             w = min(node_masks.shape[1], mask_width)
@@ -120,7 +129,8 @@ def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int,
             leaf_count[new_slot] = lcnt[~right]
         step += 1
     return Tree(split_slot, split_feat, split_bin, split_valid, split_gain,
-                leaf_value, leaf_count, split_is_cat, split_mask), thresholds
+                leaf_value, leaf_count, split_is_cat, split_mask,
+                split_dl, split_mt), thresholds
 
 
 def parse_model_string(s: str) -> Booster:

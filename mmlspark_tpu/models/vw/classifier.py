@@ -57,5 +57,8 @@ class VowpalWabbitRegressor(VowpalWabbitBase, _p.HasPredictionCol):
 class VowpalWabbitRegressionModel(VowpalWabbitBaseModel):
     def transform(self, df: DataFrame) -> DataFrame:
         margin = self._margin(df)
+        if self.get("link") == "logistic":
+            # VW --link logistic: sigmoid applied to the output
+            margin = 1.0 / (1.0 + np.exp(-margin))
         return df.with_column(self.get("predictionCol"),
                               margin.astype(np.float64))

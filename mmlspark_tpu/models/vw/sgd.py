@@ -37,6 +37,7 @@ class VWConfig(NamedTuple):
     invariant: bool = True
     num_passes: int = 1
     minibatch: int = 256
+    use_constant: bool = True      # VW constant feature (--noconstant off)
     axis_name: Optional[str] = None  # set => per-pass pmean over this mesh axis
 
 
@@ -79,11 +80,38 @@ def predict_batch(state: VWState, indices, values):
     return (state.w[indices] * values).sum(axis=-1) + state.bias
 
 
+def _invariant_delta(loss: str, pred, y, xbar, h):
+    """Karampatziakis-Langford importance-weight-aware update: the exact
+    change in prediction after following the gradient flow
+    p'(tau) = -xbar * loss'(p) for time h (the importance weight), where
+    xbar = sum_i r_i x_i^2 is the example's squared norm under the per-weight
+    learning rates. Guarantees the update never overshoots the label.
+
+    squared: p(h) = y + (p0-y) e^{-xbar h}  =>  dp = (y-p0)(1-e^{-xbar h}).
+    logistic (y in {-1,+1}): with m = y p, m + e^m is conserved up to xbar*h:
+    m1 + e^{m1} = m0 + e^{m0} + xbar h — solved by Newton (convex, explicit
+    Euler start). Reference: VW gd.cc invariant updates (the reference forwards
+    --invariant to C++, vw/VowpalWabbitBase.scala:139-169)."""
+    if loss == "squared":
+        return (y - pred) * (1.0 - jnp.exp(-xbar * h))
+    # logistic
+    m0 = jnp.clip(y * pred, -30.0, 30.0)
+    em0 = jnp.exp(m0)
+    target = xbar * h
+    dm = target * jax.nn.sigmoid(-m0)  # explicit-Euler start (underestimate)
+    for _ in range(3):                 # Newton on dm + e^{m0}(e^{dm}-1) = t
+        e = em0 * (jnp.exp(jnp.clip(dm, -30.0, 30.0)) - 1.0)
+        phi = dm + e - target
+        dphi = 1.0 + em0 * jnp.exp(jnp.clip(dm, -30.0, 30.0))
+        dm = dm - phi / dphi
+    return y * dm
+
+
 def _minibatch_step(cfg: VWConfig, state: VWState, batch):
     indices, values, y, wt = batch   # [B,k], [B,k], [B], [B]
     pred = predict_batch(state, indices, values)
-    lv, g = _loss_and_grad(cfg.loss, pred, y)
-    g = g * wt                                   # importance weight
+    lv, g_raw = _loss_and_grad(cfg.loss, pred, y)
+    g = g_raw * wt                               # importance weight
     gx = g[:, None] * values                     # [B,k] per-weight gradients
 
     # adaptive accumulator: sum of (g x)^2 like VW's per-example AdaGrad
@@ -111,15 +139,26 @@ def _minibatch_step(cfg: VWConfig, state: VWState, batch):
         rate = rate / jnp.maximum(scale[indices], 1e-6)
 
     if cfg.invariant:
-        # importance-aware safeguard: cap the per-weight step so a single
-        # minibatch can't overshoot the loss minimum (VW's invariant updates,
-        # Karampatziakis & Langford); exact closed forms replaced by a clip.
-        step = jnp.clip(rate * gx, -1.0, 1.0)
+        # exact importance-weight-aware update: compute the closed-form
+        # prediction change dp and distribute it over the weights so the
+        # example's prediction moves by exactly dp (never past the label).
+        # The shared bias moves by the minibatch MEAN of per-example bias
+        # steps, so its contribution to each example's xbar is bias_rate/B —
+        # batch-total prediction change then matches batch-total dp exactly.
+        xbar = (rate * values * values).sum(axis=-1)  # [B]
+        if cfg.use_constant:
+            xbar = xbar + bias_rate / values.shape[0]
+        dp = _invariant_delta(cfg.loss, pred, y, xbar, wt)
+        # dp/xbar is the per-unit step; as xbar->0 it limits to -g*h
+        unit = jnp.where(xbar > 1e-12, dp / xbar, -g_raw * wt)
+        step = -(rate * values) * unit[:, None]
+        bias_step = -(bias_rate * unit).mean()
     else:
         step = rate * gx
+        bias_step = bias_rate * g.mean()
 
     w = state.w.at[indices].add(-step)
-    bias = state.bias - bias_rate * g.mean()
+    bias = state.bias - bias_step if cfg.use_constant else state.bias
 
     # L2 shrink + L1 truncated gradient, vectorized over the whole weight table
     if cfg.l2 > 0.0:

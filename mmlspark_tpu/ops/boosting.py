@@ -94,6 +94,10 @@ class Tree(NamedTuple):
     split_mask: jax.Array    # [L-1, Bm] bool — bins going LEFT for categorical
                              # splits (Bm = max_bins when categoricals are
                              # configured, else 1 to keep the model tiny)
+    split_default_left: jax.Array  # [L-1] bool — missing goes left (LightGBM
+                                   # decision_type bit 1)
+    split_missing_type: jax.Array  # [L-1] int32 — 0 None, 1 Zero, 2 NaN
+                                   # (LightGBM decision_type bits 2-3)
 
 
 def _split_score(g, h, lambda_l1, lambda_l2):
@@ -312,8 +316,13 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
                                cfg.lambda_l2)
                   * jnp.float32(cfg.learning_rate))
     # slots that never received rows keep value 0 (their sums are 0)
+    # NaN bins to bin 0 (binning.py) => numeric splits carry default_left=True
+    # + missing_type NaN (decision_type 2|8); categorical splits carry missing
+    # None so raw NaN coerces to category 0 exactly like the binned path
     tree = Tree(s_slot, s_feat, s_bin, s_valid, s_gain, leaf_value,
-                sums[:, 2], s_is_cat, s_mask)
+                sums[:, 2], s_is_cat, s_mask,
+                jnp.ones_like(s_valid),
+                jnp.where(s_is_cat, 0, 2).astype(s_feat.dtype))
     return tree, slot_of_row
 
 
@@ -345,8 +354,12 @@ def tree_predict_binned(tree: Tree, binned: jax.Array) -> jax.Array:
 
 
 def tree_apply_raw(tree: Tree, x: jax.Array, thresholds: jax.Array) -> jax.Array:
-    """Leaf assignment on raw features: go left iff x[:, feat] <= threshold[s].
-    NaN comparisons are False -> NaN goes left, consistent with NaN->bin 0 binning."""
+    """Leaf assignment on raw features with upstream-LightGBM decision
+    semantics (tree.h numerical_decision): missing_type None coerces NaN to
+    0.0 before comparing; missing_type Zero routes |x|<=1e-35 and NaN to the
+    default side; missing_type NaN routes NaN to the default side; the default
+    side is decision_type's default_left bit. Models trained here carry
+    (default_left=True, missing NaN) — matching their NaN->bin0 binning."""
     n = x.shape[0]
     nsplit = tree.split_slot.shape[0]
     bm = tree.split_mask.shape[-1]
@@ -355,14 +368,26 @@ def tree_apply_raw(tree: Tree, x: jax.Array, thresholds: jax.Array) -> jax.Array
         feat = tree.split_feat[s]
         col = jnp.take(x, feat, axis=1)
         mask = (slot == tree.split_slot[s]) & tree.split_valid[s]
-        go_right = col > thresholds[s]
+        mt = tree.split_missing_type[s]
+        is_nan = jnp.isnan(col)
+        col0 = jnp.where(is_nan, 0.0, col)
+        is_zero = jnp.abs(col0) <= 1e-35
+        is_missing = jnp.where(mt == 2, is_nan,
+                               jnp.where(mt == 1, is_zero | is_nan,
+                                         jnp.zeros_like(is_nan)))
+        go_right = jnp.where(is_missing, ~tree.split_default_left[s],
+                             col0 > thresholds[s])
         if bm > 1:
-            # categorical: raw value IS the category code == bin id. Codes are
-            # clipped into [0, bm) exactly as BinMapper.transform clips them at
-            # training time (binning.py), so train/predict route out-of-range
-            # categories identically (they share the edge bin's direction).
-            code = jnp.nan_to_num(col, nan=0.0).astype(jnp.int32)
-            cat_left = tree.split_mask[s][jnp.clip(code, 0, bm - 1)]
+            # categorical: raw value IS the category code == bin id, with
+            # upstream CategoricalDecision semantics: out-of-bitset codes go
+            # RIGHT; NaN with missing_type NaN goes right, otherwise NaN
+            # coerces to category 0. Boosters trained here pre-clip codes into
+            # bin range upstream of this kernel (Booster._prep_x), matching
+            # their BinMapper clipping at training time.
+            nan_code = jnp.where(mt == 2, -1.0, 0.0)
+            code = jnp.where(is_nan, nan_code, col).astype(jnp.int32)
+            in_range = (code >= 0) & (code < bm)
+            cat_left = in_range & tree.split_mask[s][jnp.clip(code, 0, bm - 1)]
             go_right = jnp.where(tree.split_is_cat[s], ~cat_left, go_right)
         return jnp.where(mask & go_right, s + 1, slot)
 

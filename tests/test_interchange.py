@@ -1,0 +1,163 @@
+"""Upstream-LightGBM model-format interchange, anchored on hand-built fixtures.
+
+The fixture files in tests/fixtures/ are written in the upstream LightGBM v3
+text format (the format `LGBM_BoosterSaveModelToString` emits and
+LightGBMBooster.scala:277-296 round-trips), exercising the parts round 1 left
+unproven: decision_type default-left/missing bits, categorical bitsets
+spanning >32 categories (multi-word cat_threshold), and the multiclass
+num_tree_per_iteration layout. EXPECTED outputs below are hand-computed from
+the upstream decision rules (tree.h NumericalDecision/CategoricalDecision),
+NOT from this library — so these tests anchor the parser against the format
+spec rather than against itself.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.lightgbm.native_format import parse_model_string
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def load(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return parse_model_string(f.read())
+
+
+nan = float("nan")
+
+
+class TestNumericDecisionTypes:
+    """Tree 0: node0 = f0<=0.5 dec=10 (default-left, missing NaN);
+    node1 = f1<=2 dec=8 (default-RIGHT, missing NaN); leaves 1/2/3.
+    Tree 1: node0 = f2<=10 dec=6 (default-left, missing Zero);
+    node1 = f0<=0 dec=2 (missing None: NaN coerces to 0.0); leaves .5/.25/.75.
+    """
+
+    # (f0, f1, f2) -> hand-computed tree0 + tree1 sum
+    CASES = [
+        ((0.0, 0.0, 50.0), 1.0 + 0.25),   # t0: left leaf; t1: f2>10, f0<=0
+        ((1.0, 1.0, 50.0), 2.0 + 0.75),   # t0: right,f1<=2; t1: f0>0
+        ((1.0, 5.0, 5.0), 3.0 + 0.5),     # t0: right,f1>2; t1: f2<=10
+        ((nan, 5.0, 50.0), 1.0 + 0.25),   # t0 n0: NaN default LEFT;
+                                          # t1 n1: NaN->0.0 <= 0 -> left
+        ((1.0, nan, 50.0), 3.0 + 0.75),   # t0 n1: NaN default RIGHT
+        ((2.0, 3.0, 0.0), 3.0 + 0.5),     # t1 n0: zero -> missing -> left
+        ((2.0, 3.0, nan), 3.0 + 0.5),     # t1 n0: missing Zero treats NaN
+                                          # as zero -> default left
+    ]
+
+    def test_hand_computed_predictions(self):
+        b = load("upstream_numeric.txt")
+        x = np.array([c for c, _ in self.CASES], np.float64)
+        expect = np.array([e for _, e in self.CASES])
+        got = b.raw_predict(x)
+        np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+    def test_reexport_reparse_identical(self):
+        b = load("upstream_numeric.txt")
+        s1 = b.model_string()
+        b2 = parse_model_string(s1)
+        assert b2.model_string() == s1
+        x = np.array([c for c, _ in self.CASES], np.float64)
+        np.testing.assert_allclose(b2.raw_predict(x), b.raw_predict(x),
+                                   rtol=1e-7)
+
+
+class TestCategoricalBitsets:
+    """Tree 0: cat split on f0, missing None (dec=1), bitset words
+    [34, 2] = categories {1, 5, 33} left; leaves +1/-1.
+    Tree 1: cat split, missing NaN (dec=9), words [4, 0] = {2} left;
+    leaves +10/-10."""
+
+    CASES = [
+        ((1.0, 0.0), 1.0 - 10.0),    # in t0 bitset; not in t1's {2}
+        ((5.0, 0.0), 1.0 - 10.0),
+        ((33.0, 0.0), 1.0 - 10.0),   # second bitset word (category >= 32)
+        ((2.0, 0.0), -1.0 + 10.0),   # t1's category
+        ((0.0, 0.0), -1.0 - 10.0),
+        ((45.0, 0.0), -1.0 - 10.0),  # in-word range but bit unset -> right
+        ((200.0, 0.0), -1.0 - 10.0),  # beyond bitset range -> right
+        # NaN: t0 missing None -> coerces to category 0 -> right (-1);
+        #      t1 missing NaN -> right (-10)
+        ((nan, 0.0), -1.0 - 10.0),
+    ]
+
+    def test_hand_computed_predictions(self):
+        b = load("upstream_categorical.txt")
+        x = np.array([c for c, _ in self.CASES], np.float64)
+        expect = np.array([e for _, e in self.CASES])
+        np.testing.assert_allclose(b.raw_predict(x), expect, rtol=1e-6)
+
+    def test_reexport_preserves_bitsets(self):
+        b = load("upstream_categorical.txt")
+        s = b.model_string()
+        assert "cat_threshold=34 2" in s
+        assert "cat_threshold=4 0" in s
+        b2 = parse_model_string(s)
+        x = np.array([c for c, _ in self.CASES], np.float64)
+        np.testing.assert_allclose(b2.raw_predict(x), b.raw_predict(x))
+
+
+class TestMulticlassLayout:
+    """num_tree_per_iteration=3, 2 iterations. Iteration 0: class0 stump on
+    f0<=0.5 (1/0), class1 stump (0/1), class2 stump on f1<=-1 (0.5/-0.5).
+    Iteration 1: constant leaves 0.1 / 0.2 / -0.3."""
+
+    def test_margins(self):
+        b = load("upstream_multiclass.txt")
+        assert b.num_class == 3
+        x = np.array([[0.0, 0.0], [1.0, 0.0], [1.0, -2.0]], np.float64)
+        expect = np.array([
+            [1.0 + 0.1, 0.0 + 0.2, -0.5 - 0.3],
+            [0.0 + 0.1, 1.0 + 0.2, -0.5 - 0.3],
+            [0.0 + 0.1, 1.0 + 0.2, 0.5 - 0.3],
+        ])
+        np.testing.assert_allclose(b.raw_predict(x), expect, rtol=1e-6)
+
+    def test_probabilities_softmax(self):
+        b = load("upstream_multiclass.txt")
+        x = np.array([[0.0, 0.0]], np.float64)
+        m = b.raw_predict(x)
+        p = b.score(x)
+        e = np.exp(m - m.max(axis=1, keepdims=True))
+        np.testing.assert_allclose(p, e / e.sum(axis=1, keepdims=True),
+                                   rtol=1e-5)
+
+    def test_reexport_reparse(self):
+        b = load("upstream_multiclass.txt")
+        s = b.model_string()
+        assert "num_tree_per_iteration=3" in s
+        b2 = parse_model_string(s)
+        x = np.array([[0.3, -5.0], [0.9, 3.0]], np.float64)
+        np.testing.assert_allclose(b2.raw_predict(x), b.raw_predict(x))
+
+
+class TestOwnExportCarriesDecisionTypes:
+    def test_trained_model_exports_missing_bits(self, binary_df):
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+        m = LightGBMClassifier(numIterations=3, numTasks=1).fit(binary_df)
+        s = m.booster.model_string()
+        # our numeric splits are default-left + missing NaN = 2|8 = 10
+        dec_lines = [l for l in s.splitlines()
+                     if l.startswith("decision_type=")]
+        assert dec_lines
+        for line in dec_lines:
+            vals = {int(v) for v in line.split("=")[1].split()}
+            assert vals <= {10}, vals
+
+    def test_nan_prediction_matches_training_convention(self, binary_df):
+        """NaN routes like bin 0 (left) — raw path must agree with the binned
+        training convention via the exported missing-NaN default-left bits."""
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+        m = LightGBMClassifier(numIterations=5, numTasks=1).fit(binary_df)
+        x = np.asarray(binary_df["features"])[:32].copy()
+        # feature 0 at its minimum bins to bin 0 -> same routing as NaN
+        x_min = x.copy()
+        x_min[:, 0] = np.asarray(binary_df["features"])[:, 0].min()
+        x_nan = x.copy()
+        x_nan[:, 0] = np.nan
+        np.testing.assert_allclose(m.booster.score(x_nan),
+                                   m.booster.score(x_min), rtol=1e-6)
