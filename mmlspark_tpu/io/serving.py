@@ -168,6 +168,17 @@ class ServingServer:
         df = parse_request([fake], self.vector_cols)
         self.handler(df.drop("id"))
 
+    def serve_direct(self, body: bytes) -> bytes:
+        """In-process continuous fast path: one request through the resident
+        compiled pipeline, bypassing the HTTP socket — the analogue of the
+        reference's continuous mode living inside the executor JVM
+        (HTTPSourceV2 long-lived readers). This is the path the sub-ms
+        latency claim (docs/mmlspark-serving.md:93) is measured on."""
+        fake = _PendingRequest("direct", body, {}, "/")
+        df = parse_request([fake], self.vector_cols)
+        scored = self.handler(df.drop("id"))
+        return make_reply(scored, self.reply_col)[0]
+
     # ------------------------------------------------------------ dispatcher
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,237 @@
+"""Incremental file-stream ingestion with offset/checkpoint semantics.
+
+Reference: the Structured-Streaming-capable readers the batch layer mirrors —
+`spark.readStream.image/binary` (io/IOImplicits.scala:19-212) backed by
+PatchedImageFileFormat (org/apache/spark/ml/source/image/
+PatchedImageFileFormat.scala) and Spark's file-stream source offset log.
+
+TPU-native restructure: Spark's micro-batch engine shrinks to an explicit
+(source -> pipeline -> sink) loop. `FileStreamSource` discovers new files by
+(mtime, name) watermark and exposes micro-batches as DataFrames;
+`StreamingQuery` drives the loop on a thread with at-least-once commit
+semantics — the offset checkpoint is persisted AFTER the sink call returns,
+so a crash between sink and commit replays that batch (exactly Spark's
+file-source + checkpoint contract). Batches feed one jitted transform per
+tick, which is the TPU-friendly shape: few large device calls, not per-file
+work.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from .files import decode_image
+
+
+class FileStreamSource:
+    """Directory-watch incremental source.
+
+    Each `read_batch()` returns a DataFrame of files not seen before (or None
+    when nothing new), in (mtime, name) order, at most `max_files_per_batch`
+    per call. `formats`: "binary" (path, bytes, length), "image" (path,
+    image HWC uint8), "json" (one row per .json file of scalars/lists).
+    """
+
+    def __init__(self, path: str, format: str = "binary",
+                 pattern: Optional[str] = None, recursive: bool = True,
+                 max_files_per_batch: int = 64,
+                 checkpoint_dir: Optional[str] = None):
+        if format not in ("binary", "image", "json"):
+            raise ValueError(f"unknown stream format {format!r}")
+        self.path = path
+        self.format = format
+        self.pattern = pattern
+        self.recursive = recursive
+        self.max_files_per_batch = max_files_per_batch
+        self.checkpoint_dir = checkpoint_dir
+        self._seen: Dict[str, float] = {}
+        self._batch_id = -1
+        if checkpoint_dir:
+            self._restore()
+
+    # ------------------------------------------------------------ offsets
+    @property
+    def batch_id(self) -> int:
+        return self._batch_id
+
+    def _offsets_file(self) -> str:
+        return os.path.join(self.checkpoint_dir, "offsets.json")
+
+    def _restore(self) -> None:
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        f = self._offsets_file()
+        if os.path.exists(f):
+            with open(f) as fh:
+                state = json.load(fh)
+            self._seen = {k: float(v) for k, v in state["seen"].items()}
+            self._batch_id = int(state["batch_id"])
+
+    def commit(self) -> None:
+        """Persist the offset watermark (the Spark offset-log commit). Call
+        AFTER the sink has consumed the batch => at-least-once delivery."""
+        if not self.checkpoint_dir:
+            return
+        tmp = self._offsets_file() + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"batch_id": self._batch_id, "seen": self._seen}, fh)
+        os.replace(tmp, self._offsets_file())  # atomic on POSIX
+
+    # ------------------------------------------------------------ discovery
+    def _discover(self) -> List[str]:
+        out = []
+        if not os.path.isdir(self.path):
+            return out
+        if self.recursive:
+            for root, _, names in os.walk(self.path):
+                out += [os.path.join(root, n) for n in names]
+        else:
+            out += [os.path.join(self.path, n)
+                    for n in os.listdir(self.path)
+                    if os.path.isfile(os.path.join(self.path, n))]
+        if self.pattern:
+            out = [p for p in out
+                   if fnmatch.fnmatch(os.path.basename(p), self.pattern)]
+        fresh = []
+        for p in out:
+            try:
+                m = os.path.getmtime(p)
+            except OSError:
+                continue  # raced with a delete
+            if p not in self._seen:
+                fresh.append((m, p))
+        fresh.sort()
+        return [p for _, p in fresh[:self.max_files_per_batch]]
+
+    def read_batch(self) -> Optional[DataFrame]:
+        files = self._discover()
+        if not files:
+            return None
+        self._batch_id += 1
+        for p in files:
+            try:
+                self._seen[p] = os.path.getmtime(p)
+            except OSError:
+                self._seen[p] = 0.0
+        return self._load(files)
+
+    def _load(self, files: List[str]) -> DataFrame:
+        if self.format == "json":
+            rows = []
+            for p in files:
+                with open(p) as fh:
+                    rows.append(json.load(fh))
+            keys = sorted({k for r in rows for k in r})
+            data = {"path": np.array(files, dtype=object)}
+            for k in keys:
+                vals = [r.get(k) for r in rows]
+                if vals and isinstance(vals[0], list):
+                    data[k] = np.array([np.asarray(v, np.float32)
+                                        for v in vals], dtype=object)
+                else:
+                    data[k] = np.asarray(vals)
+            return DataFrame(data)
+        blobs = []
+        for p in files:
+            with open(p, "rb") as fh:
+                blobs.append(fh.read())
+        if self.format == "image":
+            imgs = np.empty(len(files), dtype=object)
+            ok = np.zeros(len(files), bool)
+            for i, b in enumerate(blobs):
+                img = decode_image(b)
+                if img is not None:
+                    imgs[i] = img
+                    ok[i] = True
+            return DataFrame({"path": np.array(files, dtype=object),
+                              "image": imgs}).filter(ok)
+        data = np.empty(len(files), dtype=object)
+        for i, b in enumerate(blobs):
+            data[i] = b
+        return DataFrame({"path": np.array(files, dtype=object),
+                          "content": data,
+                          "length": np.array([len(b) for b in blobs],
+                                             np.int64)})
+
+
+class StreamingQuery:
+    """The micro-batch driver loop: source -> pipeline -> sink on a thread.
+
+    pipeline: DataFrame -> DataFrame (e.g. model.transform); sink receives
+    (batch_id, scored DataFrame) — the foreachBatch analogue. Offsets commit
+    after the sink returns (at-least-once)."""
+
+    def __init__(self, source: FileStreamSource,
+                 pipeline: Optional[Callable[[DataFrame], DataFrame]],
+                 sink: Callable[[int, DataFrame], None],
+                 poll_interval_s: float = 0.1):
+        self.source = source
+        self.pipeline = pipeline
+        self.sink = sink
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.batches_processed = 0
+        self.rows_processed = 0
+        self.last_error: Optional[Exception] = None
+
+    def start(self) -> "StreamingQuery":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                df = self.source.read_batch()
+            except Exception as e:  # noqa: BLE001
+                self.last_error = e
+                time.sleep(self.poll_interval_s)
+                continue
+            if df is None:
+                self._stop.wait(self.poll_interval_s)
+                continue
+            try:
+                out = self.pipeline(df) if self.pipeline else df
+                self.sink(self.source.batch_id, out)
+                self.source.commit()
+                self.batches_processed += 1
+                self.rows_processed += len(df)
+            except Exception as e:  # noqa: BLE001
+                self.last_error = e
+
+    def process_available(self) -> int:
+        """Synchronous drain (processAllAvailable analogue): run batches until
+        the directory has nothing new; returns rows processed."""
+        rows = 0
+        while True:
+            df = self.source.read_batch()
+            if df is None:
+                return rows
+            out = self.pipeline(df) if self.pipeline else df
+            self.sink(self.source.batch_id, out)
+            self.source.commit()
+            self.batches_processed += 1
+            rows += len(df)
+            self.rows_processed += len(df)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout)
+
+    def await_rows(self, n: int, timeout: float = 30.0) -> bool:
+        """Block until >= n rows processed (test helper)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.rows_processed >= n:
+                return True
+            time.sleep(0.02)
+        return False

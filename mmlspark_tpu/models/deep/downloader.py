@@ -1,0 +1,141 @@
+"""Remote model repository with retry/timeout, cache, and checksums.
+
+Reference: downloader/ModelDownloader.scala:27-250 — `Repository[S]` over a
+remote model zoo with a schema file, `FaultToleranceUtils.retryWithTimeout`
+(:37-52) around every fetch, and local caching; downloader/Schema.scala for
+the per-model metadata (layerNames, inputNode, dims, uri, hash).
+
+TPU restructure: models are flax checkpoints (npz of leaves, resnet.py
+save_params layout) instead of CNTK .model protobufs; the repository is any
+HTTP endpoint serving `MANIFEST.json` + checkpoint files. Checksums are
+sha256 (the reference records a hash per model in its schema).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import hashlib
+import json
+import os
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def retry_with_timeout(fn: Callable[[], T], timeout_s: float = 60.0,
+                       retries: int = 3, backoff_s: float = 0.5) -> T:
+    """FaultToleranceUtils.retryWithTimeout (:37-52): run fn with a hard
+    per-attempt timeout, retrying with backoff on failure OR timeout."""
+    last: Optional[BaseException] = None
+    for attempt in range(retries):
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+            fut = ex.submit(fn)
+            try:
+                return fut.result(timeout=timeout_s)
+            except concurrent.futures.TimeoutError:
+                last = TimeoutError(f"attempt {attempt + 1} exceeded "
+                                    f"{timeout_s}s")
+                fut.cancel()
+            except Exception as e:  # noqa: BLE001 - retry any failure
+                last = e
+        if attempt < retries - 1:
+            time.sleep(backoff_s * (attempt + 1))
+    raise RuntimeError(f"all {retries} attempts failed: {last}") from last
+
+
+class RemoteModelInfo:
+    """One manifest entry (downloader/Schema.scala fields that survive the
+    format change)."""
+
+    __slots__ = ("name", "uri", "sha256", "size", "input_dims")
+
+    def __init__(self, name: str, uri: str, sha256: str = "",
+                 size: int = 0, input_dims=None):
+        self.name = name
+        self.uri = uri
+        self.sha256 = sha256
+        self.size = size
+        self.input_dims = input_dims
+
+    @staticmethod
+    def from_dict(d: Dict) -> "RemoteModelInfo":
+        return RemoteModelInfo(d["name"], d["uri"], d.get("sha256", ""),
+                               int(d.get("size", 0)), d.get("inputDims"))
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class RemoteRepository:
+    """HTTP model zoo: MANIFEST.json + checkpoint files, cached locally.
+
+    The remote `Repository[S]` / `DefaultModelRepo` analogue
+    (ModelDownloader.scala:27-250): list models from the manifest, download
+    with retry+timeout, verify sha256, serve from cache when already present
+    and intact."""
+
+    def __init__(self, base_url: str, cache_dir: str,
+                 timeout_s: float = 60.0, retries: int = 3):
+        self.base_url = base_url.rstrip("/")
+        self.cache_dir = cache_dir
+        self.timeout_s = timeout_s
+        self.retries = retries
+        os.makedirs(cache_dir, exist_ok=True)
+
+    # -------------------------------------------------------------- manifest
+    def models(self) -> List[RemoteModelInfo]:
+        def fetch():
+            with urllib.request.urlopen(self.base_url + "/MANIFEST.json",
+                                        timeout=self.timeout_s) as r:
+                return [RemoteModelInfo.from_dict(d)
+                        for d in json.loads(r.read())]
+        return retry_with_timeout(fetch, self.timeout_s, self.retries)
+
+    def model_info(self, name: str) -> RemoteModelInfo:
+        for m in self.models():
+            if m.name == name:
+                return m
+        raise KeyError(f"model {name!r} not in repository "
+                       f"{self.base_url}")
+
+    # -------------------------------------------------------------- download
+    def _cache_path(self, info: RemoteModelInfo) -> str:
+        fname = os.path.basename(info.uri) or f"{info.name}.npz"
+        return os.path.join(self.cache_dir, fname)
+
+    def download_model(self, name: str) -> str:
+        """Fetch a model checkpoint; returns the local path. Cached files
+        with a matching checksum are reused without touching the network."""
+        info = self.model_info(name)
+        dest = self._cache_path(info)
+        if os.path.exists(dest):
+            if not info.sha256 or _sha256(dest) == info.sha256:
+                return dest
+            os.remove(dest)  # corrupt cache entry: refetch
+
+        url = (info.uri if info.uri.startswith(("http://", "https://"))
+               else f"{self.base_url}/{info.uri.lstrip('/')}")
+
+        def fetch():
+            tmp = dest + ".part"
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r, \
+                    open(tmp, "wb") as f:
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+            if info.sha256 and _sha256(tmp) != info.sha256:
+                os.remove(tmp)
+                raise IOError(f"checksum mismatch for {name!r}")
+            os.replace(tmp, dest)
+            return dest
+
+        return retry_with_timeout(fetch, self.timeout_s, self.retries)

@@ -10,6 +10,7 @@ a seed; `load_params` accepts externally supplied checkpoints via orbax/npz).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
@@ -98,15 +99,29 @@ _ZOO: Dict[str, Callable[[], ModelSchema]] = {
 
 
 class ModelDownloader:
-    """Local zoo resolver (ModelDownloader.scala:27-250 without the network:
-    weights come from a deterministic init, or from a local checkpoint via
-    `load_params`)."""
+    """Zoo resolver (ModelDownloader.scala:27-250): weights come from a
+    remote repository (repo_url -> RemoteRepository with retry/timeout,
+    cache, sha256 — downloader.py), a local checkpoint (local_path), or a
+    deterministic init (neither set)."""
 
-    def __init__(self, local_path: Optional[str] = None):
+    def __init__(self, local_path: Optional[str] = None,
+                 repo_url: Optional[str] = None,
+                 cache_dir: Optional[str] = None,
+                 timeout_s: float = 60.0, retries: int = 3):
         self.local_path = local_path
+        self.repo = None
+        if repo_url:
+            from .downloader import RemoteRepository
+            import tempfile
+            self.repo = RemoteRepository(
+                repo_url,
+                cache_dir or os.path.join(tempfile.gettempdir(),
+                                          "mmlspark_tpu_models"),
+                timeout_s=timeout_s, retries=retries)
 
-    @staticmethod
-    def list_models() -> Sequence[str]:
+    def list_models(self) -> Sequence[str]:
+        if self.repo is not None:
+            return sorted(m.name for m in self.repo.models())
         return sorted(_ZOO)
 
     def download_by_name(self, name: str, seed: int = 0):
@@ -117,7 +132,10 @@ class ModelDownloader:
         h, w, c = schema.input_dims
         variables = schema.module.init(
             jax.random.PRNGKey(seed), jnp.zeros((1, h, w, c), jnp.float32))
-        if self.local_path:
+        if self.repo is not None:
+            ckpt = self.repo.download_model(name)
+            variables = load_params(ckpt, variables)
+        elif self.local_path:
             variables = load_params(self.local_path, variables)
         return GraphModel(module=schema.module, variables=variables,
                           schema=schema)

@@ -41,7 +41,8 @@ def test_lightgbm_classifier_golden():
     for name, seed, boosting in (("synth1", 101, "gbdt"),
                                  ("synth2", 202, "gbdt"),
                                  ("synth1_goss", 101, "goss"),
-                                 ("synth1_rf", 101, "rf")):
+                                 ("synth1_rf", 101, "rf"),
+                                 ("synth1_dart", 101, "dart")):
         df = _dataset(seed)
         train, test = df.random_split([0.75, 0.25], seed=1)
         clf = LightGBMClassifier(numIterations=50, numLeaves=31,

@@ -1,0 +1,199 @@
+"""Real-dataset benchmark gates — the BASELINE.md anchor analogues.
+
+The reference gates AUC/L2 on real UCI datasets (BASELINE.md:13-26:
+breast-cancer gbdt AUC 0.9925 tol 0.1, multiclass accuracies, regressor L2,
+TrainClassifier AUROC, TuneHyperparameters). Its dataset files are downloaded
+at build time and are NOT vendored, and this environment has no egress — but
+scikit-learn ships several of the same/kindred UCI datasets offline:
+
+- load_breast_cancer = UCI WDBC, the same data family as the reference's
+  `breast-cancer.train` anchor (AUC 0.9925, tol 0.1) -> gated here directly;
+- load_wine / load_iris stand in for the multiclass accuracy anchors
+  (BreastTissue 0.7642 / CarEvaluation 0.7529 — those exact sets aren't
+  available offline);
+- load_diabetes stands in for the regression L2 anchors.
+
+Each gate records its value in tests/benchmarks/*.csv with a per-entry
+tolerance (the Benchmarks.scala comparison contract) — unlike the synthetic
+goldens, the datasets here are real and fixed, so these numbers are
+comparable across machines and rounds.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import (load_breast_cancer, load_diabetes, load_iris,
+                              load_wine)
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import (LightGBMClassifier,
+                                          LightGBMRegressor)
+from mmlspark_tpu.models.vw import VowpalWabbitRegressor
+from mmlspark_tpu.train.metrics import auc_score
+from mmlspark_tpu.utils.benchmarks import Benchmarks
+
+BENCH_DIR = os.path.join(os.path.dirname(__file__), "benchmarks")
+BOOSTING_TYPES = ("gbdt", "rf", "dart", "goss")
+
+
+def _df(x, y):
+    return DataFrame({"features": np.asarray(x, np.float32),
+                      "label": np.asarray(y, np.float64)})
+
+
+def _split(x, y, seed=7):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(y))
+    cut = int(len(y) * 0.75)
+    tr, te = idx[:cut], idx[cut:]
+    return _df(x[tr], y[tr]), _df(x[te], y[te])
+
+
+def _bagging(boosting):
+    return ({"baggingFraction": 0.8, "baggingFreq": 1}
+            if boosting == "rf" else {})
+
+
+class TestBreastCancerAnchor:
+    """The reference's breast-cancer gbdt anchor: AUC 0.9925 (tol 0.1) —
+    benchmarks_VerifyLightGBMClassifier.csv:22. Gated per boosting type, the
+    reference's dataset x boosting grid shape."""
+
+    def test_auc_grid(self):
+        data = load_breast_cancer()
+        train, test = _split(data.data, data.target)
+        bench = Benchmarks(os.path.join(BENCH_DIR,
+                                        "real_breast_cancer.csv"))
+        for boosting in BOOSTING_TYPES:
+            clf = LightGBMClassifier(numIterations=60, numLeaves=15,
+                                     boostingType=boosting,
+                                     **_bagging(boosting))
+            model = clf.fit(train)
+            auc = auc_score(test["label"],
+                            model.transform(test)["probability"][:, 1])
+            # hard floor from the BASELINE anchor (0.9925 - 0.1 tolerance)
+            assert auc > 0.8925, f"{boosting}: {auc}"
+            bench.add(f"auc_breast_cancer_{boosting}", auc, 0.02)
+        bench.verify()
+
+
+class TestMulticlassAccuracy:
+    def test_wine_iris_grid(self):
+        bench = Benchmarks(os.path.join(BENCH_DIR, "real_multiclass.csv"))
+        for name, loader in (("wine", load_wine), ("iris", load_iris)):
+            data = loader()
+            train, test = _split(data.data, data.target, seed=11)
+            for boosting in ("gbdt", "goss"):
+                clf = LightGBMClassifier(numIterations=40, numLeaves=15,
+                                         minDataInLeaf=5,
+                                         boostingType=boosting)
+                model = clf.fit(train)
+                pred = model.transform(test)["prediction"]
+                acc = float(np.mean(pred == test["label"]))
+                # the reference's multiclass anchors sit at ~0.75-0.76; these
+                # easier sets must clear that comfortably
+                assert acc > 0.85, f"{name}/{boosting}: {acc}"
+                bench.add(f"acc_{name}_{boosting}", acc, 0.03)
+        bench.verify()
+
+
+class TestRegressionL2:
+    def test_diabetes_grid(self):
+        data = load_diabetes()
+        # standardize the target so L2 tolerances are scale-free
+        y = (data.target - data.target.mean()) / data.target.std()
+        train, test = _split(data.data, y, seed=13)
+        bench = Benchmarks(os.path.join(BENCH_DIR, "real_regression.csv"))
+        base = float(np.mean((test["label"]
+                              - np.mean(train["label"])) ** 2))
+        for boosting in BOOSTING_TYPES:
+            reg = LightGBMRegressor(numIterations=60, numLeaves=7,
+                                    learningRate=0.05, minDataInLeaf=10,
+                                    boostingType=boosting,
+                                    **_bagging(boosting))
+            model = reg.fit(train)
+            pred = model.transform(test)["prediction"]
+            l2 = float(np.mean((pred - test["label"]) ** 2))
+            assert l2 < base, f"{boosting} worse than predicting the mean"
+            bench.add(f"l2_diabetes_{boosting}", l2, 0.08)
+        # VW on the same real data (the airfoil-anchor analogue)
+        vw = VowpalWabbitRegressor(numPasses=20, numBits=6).fit(train)
+        l2_vw = float(np.mean(
+            (np.asarray(vw.transform(test)["prediction"])
+             - test["label"]) ** 2))
+        assert l2_vw < base
+        bench.add("l2_diabetes_vw", l2_vw, 0.1)
+        bench.verify()
+
+
+class TestTrainClassifierGate:
+    """TrainClassifier AUROC gate (benchmarks_VerifyTrainClassifier.csv
+    analogue, anchor PimaIndian GBT 0.6817)."""
+
+    def test_breast_cancer(self):
+        from mmlspark_tpu.train.trainers import TrainClassifier
+        data = load_breast_cancer()
+        train, test = _split(data.data, data.target)
+        bench = Benchmarks(os.path.join(BENCH_DIR,
+                                        "real_train_classifier.csv"))
+        for mname, model in (
+                ("logistic", None),  # default learner
+                ("lightgbm", LightGBMClassifier(numIterations=30,
+                                                numLeaves=15))):
+            tc = TrainClassifier(model=model, labelCol="label")
+            fitted = tc.fit(train)
+            out = fitted.transform(test)
+            probs = np.asarray(out["scored_probabilities"])
+            auc = auc_score(test["label"], probs[:, 1])
+            assert auc > 0.9, f"{mname}: {auc}"
+            bench.add(f"auroc_breast_cancer_{mname}", auc, 0.03)
+        bench.verify()
+
+
+class TestTuneHyperparametersGate:
+    """TuneHyperparameters gate (benchmarks_VerifyTuneHyperparameters.csv
+    analogue, anchors 0.6507 binary / 0.5489 multiclass)."""
+
+    def test_binary_and_multiclass(self):
+        from mmlspark_tpu.automl.hyperparams import (DiscreteHyperParam,
+                                                     HyperparamBuilder,
+                                                     RandomSpace)
+        from mmlspark_tpu.automl.tune import TuneHyperparameters
+        from mmlspark_tpu.train.metrics import MetricConstants
+        bench = Benchmarks(os.path.join(BENCH_DIR, "real_tune.csv"))
+
+        data = load_breast_cancer()
+        train, test = _split(data.data, data.target)
+        est = LightGBMClassifier(numLeaves=7)
+        builder = (HyperparamBuilder()
+                   .add_hyperparam(est, "numIterations",
+                                   DiscreteHyperParam([20, 40]))
+                   .add_hyperparam(est, "learningRate",
+                                   DiscreteHyperParam([0.05, 0.2])))
+        tuned = TuneHyperparameters(
+            models=[est], paramSpace=RandomSpace(builder.build(), seed=3),
+            numFolds=3, numRuns=3, labelCol="label",
+            evaluationMetric=MetricConstants.ACCURACY,
+            parallelism=2).fit(train)
+        pred = tuned.transform(test)["prediction"]
+        acc = float(np.mean(pred == test["label"]))
+        assert acc > 0.9
+        bench.add("tune_breast_cancer_acc", acc, 0.03)
+
+        wine = load_wine()
+        wtrain, wtest = _split(wine.data, wine.target, seed=5)
+        est2 = LightGBMClassifier(numLeaves=7, minDataInLeaf=5)
+        b2 = (HyperparamBuilder()
+              .add_hyperparam(est2, "numIterations",
+                              DiscreteHyperParam([20, 40])))
+        tuned2 = TuneHyperparameters(
+            models=[est2], paramSpace=RandomSpace(b2.build(), seed=4),
+            numFolds=3, numRuns=2, labelCol="label",
+            evaluationMetric=MetricConstants.ACCURACY,
+            parallelism=2).fit(wtrain)
+        acc2 = float(np.mean(
+            tuned2.transform(wtest)["prediction"] == wtest["label"]))
+        assert acc2 > 0.85
+        bench.add("tune_wine_acc", acc2, 0.05)
+        bench.verify()

@@ -1,0 +1,211 @@
+"""Distributed serving: registration/routing, multi-process workers, latency.
+
+Reference behaviors under test:
+- per-executor servers + driver registration service + routing table
+  (DistributedHTTPSource.scala:26-424, HTTPSourceV2.scala:113-173);
+- round-robin request channels (MultiChannelMap :81-83);
+- the sub-millisecond continuous-mode latency claim (README.md:23,
+  docs/mmlspark-serving.md:93) — measured here with p50/p99 against the
+  resident compiled pipeline.
+"""
+
+import json
+import multiprocessing as mp
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.distributed_serving import (DistributedServingServer,
+                                                 ServiceInfo,
+                                                 ServingCoordinator,
+                                                 fetch_routes)
+from mmlspark_tpu.io.serving import ServingServer
+
+
+def _post(url: str, payload: dict, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _double_handler(df):
+    return df.with_column("prediction", np.asarray(df["x"], np.float64) * 2)
+
+
+class TestCoordinator:
+    def test_register_and_routes(self):
+        coord = ServingCoordinator().start()
+        try:
+            coord.register(ServiceInfo("svc", "127.0.0.1", 1234,
+                                       "m1", 0))
+            coord.register(ServiceInfo("svc", "127.0.0.1", 1235, "m1", 1))
+            # re-registration of the same machine:partition replaces
+            coord.register(ServiceInfo("svc", "127.0.0.1", 9999, "m1", 0))
+            routes = fetch_routes(coord.url, "svc")
+            assert len(routes) == 2
+            ports = {r.port for r in routes}
+            assert ports == {9999, 1235}
+        finally:
+            coord.stop()
+
+    def test_gateway_round_robin_two_workers(self):
+        coord = ServingCoordinator().start()
+        workers = []
+        try:
+            for part in range(2):
+                def handler(df, p=part):
+                    out = df.with_column(
+                        "prediction",
+                        np.full(len(df), float(p)))
+                    return out
+                w = DistributedServingServer(
+                    handler, coord.url, "rr", partition=part, port=0,
+                    max_latency_ms=1.0).start()
+                workers.append(w)
+            seen = set()
+            for _ in range(6):
+                status, body = _post(coord.url + "/gateway/rr", {"x": 1.0})
+                assert status == 200
+                seen.add(body["prediction"])
+            # round-robin must hit both partitions
+            assert seen == {0.0, 1.0}
+        finally:
+            for w in workers:
+                w.stop()
+            coord.stop()
+
+    def test_gateway_no_workers_503(self):
+        coord = ServingCoordinator().start()
+        try:
+            req = urllib.request.Request(
+                coord.url + "/gateway/ghost", data=b"{}",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5.0)
+            assert ei.value.code == 503
+        finally:
+            coord.stop()
+
+
+def _worker_proc(coord_url: str, partition: int, ready, stop):
+    """Separate-process worker: registers and serves until told to stop —
+    the per-executor JVMSharedServer analogue, one real OS process each."""
+    def handler(df):
+        return df.with_column(
+            "prediction", np.asarray(df["x"], np.float64) + 100 * partition)
+    server = DistributedServingServer(
+        handler, coord_url, "multi", partition=partition,
+        machine=f"proc{partition}", port=0, max_latency_ms=1.0).start()
+    ready.set()
+    stop.wait(60)
+    server.stop()
+
+
+class TestMultiProcessServing:
+    def test_two_process_fleet(self):
+        coord = ServingCoordinator().start()
+        ctx = mp.get_context("spawn")
+        readies = [ctx.Event() for _ in range(2)]
+        stop = ctx.Event()
+        procs = [ctx.Process(target=_worker_proc,
+                             args=(coord.url, p, readies[p], stop),
+                             daemon=True)
+                 for p in range(2)]
+        try:
+            for p in procs:
+                p.start()
+            for r in readies:
+                assert r.wait(30), "worker process failed to register"
+            routes = fetch_routes(coord.url, "multi")
+            assert len(routes) == 2
+            # direct-to-worker (the load-balancer path): each partition
+            # applies its own shift
+            got = {}
+            for r in routes:
+                status, body = _post(r.url, {"x": 7.0})
+                assert status == 200
+                got[r.partition] = body["prediction"]
+            assert got == {0: 7.0, 1: 107.0}
+            # through the gateway: both partitions appear
+            seen = set()
+            for _ in range(8):
+                _, body = _post(coord.url + "/gateway/multi", {"x": 1.0})
+                seen.add(body["prediction"])
+            assert seen == {1.0, 101.0}
+        finally:
+            stop.set()
+            for p in procs:
+                p.join(10)
+                if p.is_alive():
+                    p.terminate()
+            coord.stop()
+
+
+class TestLatency:
+    """Latency of the continuous path with the compiled program resident.
+
+    The reference's sub-ms claim applies to its executor-local continuous
+    mode (no network hop counted). The equivalent here is serve_direct();
+    the HTTP path adds the socket round-trip and is reported for context.
+    """
+
+    @pytest.fixture(scope="class")
+    def model_server(self):
+        import jax
+        import jax.numpy as jnp
+
+        w = jnp.asarray(np.random.default_rng(0).normal(size=8),
+                        jnp.float32)
+
+        @jax.jit
+        def predict(x):
+            return x @ w
+
+        def handler(df):
+            x = jnp.asarray(np.asarray(df["x"], np.float32))
+            return df.with_column(
+                "prediction", np.asarray(predict(x), np.float64))
+
+        s = ServingServer(handler, port=0, max_latency_ms=0.5,
+                          max_batch_size=32, vector_cols=("x",)).start()
+        s.warmup({"x": [0.0] * 8})
+        yield s
+        s.stop()
+
+    def test_direct_path_p50_sub_ms(self, model_server):
+        body = json.dumps({"x": [0.1] * 8}).encode()
+        # warm the direct path (first call may still trace the batch shape)
+        for _ in range(20):
+            model_server.serve_direct(body)
+        lat = []
+        for _ in range(300):
+            t0 = time.perf_counter()
+            out = model_server.serve_direct(body)
+            lat.append((time.perf_counter() - t0) * 1000)
+        assert b"prediction" in out
+        p50 = float(np.percentile(lat, 50))
+        p99 = float(np.percentile(lat, 99))
+        print(f"\nserve_direct p50={p50:.3f}ms p99={p99:.3f}ms")
+        # the headline claim: sub-millisecond median on the resident program
+        assert p50 < 1.0, f"p50 {p50:.3f}ms breaches the sub-ms target"
+
+    def test_http_path_latency_recorded(self, model_server):
+        body = {"x": [0.1] * 8}
+        for _ in range(5):
+            _post(model_server.url, body)
+        lat = []
+        for _ in range(100):
+            t0 = time.perf_counter()
+            status, _ = _post(model_server.url, body)
+            lat.append((time.perf_counter() - t0) * 1000)
+            assert status == 200
+        p50 = float(np.percentile(lat, 50))
+        p99 = float(np.percentile(lat, 99))
+        print(f"\nHTTP p50={p50:.3f}ms p99={p99:.3f}ms")
+        # socket + dynamic batcher overhead: keep a sane ceiling so
+        # regressions (e.g. accidental retrace per request) get caught
+        assert p50 < 50.0

