@@ -107,7 +107,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                      "number of data shards (devices); 0 = all devices "
                      "(ClusterUtil replacement)", 0, int)
     parallelism = Param("parallelism",
-                        "data_parallel or serial (tree_learner)", "data_parallel")
+                        "tree learner: data_parallel, voting_parallel or "
+                        "serial (LightGBMExecutionParams.parallelism)",
+                        "data_parallel")
+    topK = Param("topK",
+                 "voting_parallel top-k voted features per leaf; larger is "
+                 "more accurate but allreduces more histogram traffic "
+                 "(LightGBMConstants.DefaultTopK)", 20, int)
     useBarrierExecutionMode = Param(
         "useBarrierExecutionMode",
         "compat no-op: SPMD launch is inherently gang-scheduled", False)
@@ -215,6 +221,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             cat_smooth=self.get("catSmooth"),
             max_cat_threshold=self.get("maxCatThreshold"),
             axis_name=axis_name,
+            tree_learner=self.get("parallelism"),
+            top_k=self.get("topK"),
         )
 
     def _categorical_indexes(self):
@@ -305,8 +313,19 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             margin += pm.reshape(n, -1).astype(np.float32)
             has_init = True
 
+        par = self.get("parallelism")
+        if par not in ("serial", "data_parallel", "voting_parallel"):
+            raise ValueError(
+                f"parallelism must be serial, data_parallel or "
+                f"voting_parallel, got {par!r}")
+        if par == "voting_parallel" and self._categorical_indexes():
+            raise ValueError(
+                "voting_parallel does not support categoricalSlotIndexes/"
+                "Names; use data_parallel")
+        if par == "voting_parallel" and self.get("topK") < 1:
+            raise ValueError("topK must be >= 1 for voting_parallel")
         ndev = self.get("numTasks") or meshlib.device_count()
-        serial = (self.get("parallelism") == "serial" or ndev <= 1)
+        serial = (par == "serial" or ndev <= 1)
         key = jax.random.PRNGKey(self.get("seed"))
         is_train = (~is_valid).astype(np.float32)
         axis = meshlib.DATA_AXIS

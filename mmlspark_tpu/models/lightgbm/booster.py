@@ -86,15 +86,30 @@ class Booster:
                     x[:, ci] = np.clip(x[:, ci], 0, width - 1)
         return x
 
+    @staticmethod
+    def _pad_rows_pow2(x: np.ndarray) -> np.ndarray:
+        """Pad rows up to the next power of two so the jit prediction
+        program compiles once per size bucket instead of once per exact batch
+        size — a serving loop with ragged batches would otherwise retrace on
+        every request (the dynamic-batching dispatcher in io/serving.py uses
+        the same bucketing)."""
+        n = x.shape[0]
+        target = 1 << max(n - 1, 0).bit_length()
+        if target == n:
+            return x
+        pad = np.zeros((target - n,) + x.shape[1:], x.dtype)
+        return np.concatenate([x, pad], axis=0)
+
     def raw_predict(self, x: np.ndarray) -> np.ndarray:
         """Margin scores: [N] (single-output) or [N, K]. Batched jit traversal."""
-        x = jnp.asarray(self._prep_x(x))
+        n = x.shape[0]
+        x = jnp.asarray(self._pad_rows_pow2(self._prep_x(x)))
         t_used = self._used_iters()
         trees = Tree(*[jnp.asarray(a[:t_used]) for a in self.trees])
         thr = jnp.asarray(self.thresholds[:t_used])
         init = jnp.asarray(self.init_score)
         raw = np.asarray(_raw_predict_jit(trees, thr, init, x,
-                                          self.multiclass))
+                                          self.multiclass))[:n]
         if self.average_output and t_used > 0:
             raw = np.asarray(self.init_score) + (
                 raw - np.asarray(self.init_score)) / t_used
@@ -110,14 +125,15 @@ class Booster:
     def predict_leaf(self, x: np.ndarray) -> np.ndarray:
         """Leaf index per tree: [N, T] or [N, T*K] (predictLeaf,
         LightGBMBooster.scala:216-228)."""
-        x = jnp.asarray(self._prep_x(x))
+        n = x.shape[0]
+        x = jnp.asarray(self._pad_rows_pow2(self._prep_x(x)))
         t_used = self._used_iters()
         trees = Tree(*[jnp.asarray(a[:t_used]) for a in self.trees])
         thr = jnp.asarray(self.thresholds[:t_used])
         leaves = _predict_leaf_jit(trees, thr, x, self.multiclass)
-        out = np.asarray(leaves)
+        out = np.asarray(leaves)[..., :n]
         if out.ndim == 3:  # [T,K,N] -> [N, T*K]
-            return out.transpose(2, 0, 1).reshape(x.shape[0], -1)
+            return out.transpose(2, 0, 1).reshape(n, -1)
         return out.T
 
     def features_shap(self, x: np.ndarray) -> np.ndarray:

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import build_histogram
+from .histogram import hist_slots
 from .objectives import Objective, get_objective
 
 _NEG_INF = -1e30
@@ -77,6 +77,12 @@ class GBDTConfig(NamedTuple):
     hist_chunk: int = 512
     hist_dtype: str = "bf16"  # MXU operand dtype for the one-hot contraction
     axis_name: Optional[str] = None  # shard_map data axis; None = single shard
+    # tree learner: "data_parallel" allreduces full [L,F,B,3] histograms;
+    # "voting_parallel" (LightGBMParams.scala:13-27) allreduces only the
+    # top_k globally-voted features' histograms per slot — the cross-pod/DCN
+    # bandwidth mode (traffic cut by F/top_k at mild split-quality cost)
+    tree_learner: str = "data_parallel"
+    top_k: int = 20
 
 
 class Tree(NamedTuple):
@@ -125,14 +131,14 @@ def _cat_sort_order(hists, cfg: GBDTConfig):
     return jnp.argsort(-_cat_ratio(hists, cfg), axis=2)           # [L,F,B]
 
 
-def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
-    """Vectorized split-gain scan over [L, F, B] histograms.
+def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask):
+    """Masked split-gain table over [L, F, B, 3] histograms -> gain [L, F, B].
 
-    Returns per-slot (best_gain [L], best_feat [L], best_bin [L]).
-    For categorical features `best_bin` is the (sorted-order) prefix length - 1;
-    the caller reconstructs the category subset mask.
-    Reference semantics: LightGBM FeatureHistogram::FindBestThreshold /
-    FindBestThresholdCategorical (C++), driven from TrainUtils.scala:220-315.
+    feature_mask may be [F] (shared across slots) or [L, F] (per-slot, used by
+    the voting-parallel learner where each slot scans its own voted feature
+    subset). Invalid cells (min_data / min_hessian / masked features) are
+    _NEG_INF. Reference semantics: LightGBM FeatureHistogram::FindBestThreshold
+    / FindBestThresholdCategorical (C++), driven from TrainUtils.scala:220-315.
     """
     l, f, b, _ = hists.shape
     cat = cfg.categorical_features
@@ -155,18 +161,30 @@ def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
             + _split_score(right_g, right_h, cfg.lambda_l1, cfg.lambda_l2)
             - _split_score(tot_g, tot_h, cfg.lambda_l1, cfg.lambda_l2))
 
+    fm = (feature_mask[None, :, None] if feature_mask.ndim == 1
+          else feature_mask[:, :, None])
     min_data = max(cfg.min_data_in_leaf, 1)
     ok = ((left_n >= min_data) & (right_n >= min_data)
           & (left_h >= cfg.min_sum_hessian_in_leaf)
           & (right_h >= cfg.min_sum_hessian_in_leaf)
-          & feature_mask[None, :, None])
+          & fm)
     if cat:
         # categorical prefixes are capped at max_cat_threshold categories
         prefix_len = jnp.arange(b)[None, None, :] + 1
         ok = ok & (~is_cat[None, :, None]
                    | (prefix_len <= cfg.max_cat_threshold))
-    gain = jnp.where(ok, gain, _NEG_INF)
+    return jnp.where(ok, gain, _NEG_INF)
 
+
+def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
+    """Vectorized split-gain scan over [L, F, B] histograms.
+
+    Returns per-slot (best_gain [L], best_feat [L], best_bin [L]).
+    For categorical features `best_bin` is the (sorted-order) prefix length - 1;
+    the caller reconstructs the category subset mask.
+    """
+    l, f, b, _ = hists.shape
+    gain = _split_gain_table(hists, sums, cfg, feature_mask)
     flat = gain.reshape(l, f * b)
     best_idx = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
@@ -187,6 +205,14 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     Returns (tree, slot_of_row [N] int32). Slot semantics: slot 0 is the root; the split
     recorded at step s sends its right child to slot s+1, the left child keeps the parent's
     slot. Replaying splits in order reproduces leaf assignments exactly.
+
+    Kernel structure: each split step runs ONE all-slots histogram pass
+    (ops/histogram.hist_slots) producing every current leaf's [F, B, 3]
+    histogram in a single MXU contraction of output width num_leaves*3. This
+    costs the same as the narrow per-leaf pass (the MXU pads output width to
+    128 lanes either way) but yields all leaves at once, so no sibling
+    subtraction or split-cache bookkeeping is needed — per-tree work is
+    num_leaves passes total, each at high MXU utilization.
     """
     n, f = binned.shape
     lcap = cfg.num_leaves
@@ -195,20 +221,53 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     bm = b if cat else 1  # split-mask width (1 keeps numeric-only models tiny)
     is_cat_f = (jnp.zeros((f,), bool).at[jnp.asarray(cat)].set(True)
                 if cat else None)
+    voting = (cfg.tree_learner == "voting_parallel"
+              and cfg.axis_name is not None)
+    if voting and cat:
+        raise NotImplementedError(
+            "voting_parallel does not support categorical features (the "
+            "voted per-slot feature subsets don't compose with static "
+            "categorical indices); use data_parallel")
+    k_top = min(cfg.top_k, f) if voting else 0
 
-    def hist(mask_gh3):
-        h = build_histogram(binned, mask_gh3, b, cfg.hist_method,
-                            cfg.hist_chunk, cfg.hist_dtype)
-        if cfg.axis_name is not None:
-            # the ICI allreduce replacing LGBM_NetworkInit's TCP ring
-            h = jax.lax.psum(h, cfg.axis_name)
-        return h
+    def psum_(v):
+        return jax.lax.psum(v, cfg.axis_name) if cfg.axis_name else v
 
-    root_hist = hist(gh3)                         # [F,B,3]
-    root_sum = root_hist[0].sum(axis=0)           # [3] (any feature's bins sum to total)
+    def hist_local(slot_of_row):
+        return hist_slots(binned, slot_of_row, gh3, lcap, b, cfg.hist_method,
+                          cfg.hist_chunk, cfg.hist_dtype)   # [L, F, B, 3]
 
-    hists = jnp.zeros((lcap, f, b, 3), jnp.float32).at[0].set(root_hist)
-    sums = jnp.zeros((lcap, 3), jnp.float32).at[0].set(root_sum)
+    def scan_splits_voting(slot_of_row, feature_mask):
+        """Voting-parallel split scan: one all-slots LOCAL histogram pass;
+        each shard votes its local top-2k features per slot, only the globally
+        top-k voted features' histograms are allreduced, and the split is
+        chosen among those (LightGBM voting-parallel semantics,
+        LightGBMParams.scala:13-27). Allreduce traffic per step is
+        [L, top_k, B, 3] instead of data_parallel's [F, B, 3] sibling slice.
+        Returns (hists [L,k,B,3], sums [L,3], gains [L], feats [L], bins [L]).
+        """
+        local = hist_local(slot_of_row)
+        local_sums = local[:, 0].sum(axis=1)
+        sums = psum_(local_sums)
+        # local vote: best local gain per (slot, feature)
+        local_gain = _split_gain_table(local, local_sums, cfg,
+                                       feature_mask).max(axis=2)    # [L,F]
+        k2 = min(2 * k_top, f)
+        _, vote_idx = jax.lax.top_k(local_gain, k2)
+        vote_ok = (jnp.take_along_axis(local_gain, vote_idx, axis=1)
+                   > _NEG_INF / 2)
+        votes = jnp.zeros((lcap, f), jnp.float32).at[
+            jnp.arange(lcap)[:, None], vote_idx].add(
+                vote_ok.astype(jnp.float32))
+        votes = psum_(votes)                      # global vote counts [L,F]
+        _, sel = jax.lax.top_k(votes, k_top)      # [L,k] voted features
+        hist_v = psum_(jnp.take_along_axis(
+            local, sel[:, :, None, None], axis=1))           # [L,k,B,3]
+        gains, f_idx, bins_ = _best_split_per_slot(
+            hist_v, sums, cfg, feature_mask[sel])
+        feats = jnp.take_along_axis(sel, f_idx[:, None], axis=1)[:, 0]
+        return hist_v, sums, gains, feats.astype(jnp.int32), bins_
+
     depth_of_slot = jnp.zeros((lcap,), jnp.int32)
     slot_of_row = jnp.zeros((n,), jnp.int32)
     s_slot = jnp.zeros((lcap - 1,), jnp.int32)
@@ -220,28 +279,42 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     s_mask = jnp.zeros((lcap - 1, bm), bool)
     done = jnp.array(False)
 
-    # per-slot cached best split (LightGBM's leaf split queue): only the two
-    # slots whose histograms changed are rescanned per step — O(L F B) per
-    # tree instead of O(L^2 F B). Unpopulated slots stay at -inf.
-    g0, f0, b0 = _best_split_per_slot(hists[:1], sums[:1], cfg, feature_mask)
-    cache_gain = jnp.full((lcap,), _NEG_INF).at[0].set(g0[0])
-    cache_feat = jnp.zeros((lcap,), jnp.int32).at[0].set(f0[0])
-    cache_bin = jnp.zeros((lcap,), jnp.int32).at[0].set(b0[0])
+    if not voting:
+        # data_parallel keeps GLOBAL histograms in the loop carry: the local
+        # all-slots pass still runs once per step (that's where the MXU win
+        # is), but only the new right child's [F, B, 3] slice rides the ICI
+        # allreduce — the parent updates by sibling subtraction, so per-step
+        # interconnect traffic matches LightGBM data_parallel's per-leaf
+        # reduce-scatter (TrainUtils.scala:496-512), not L x it.
+        root_local = hist_local(slot_of_row)
+        root = psum_(root_local[0])                            # [F,B,3]
+        g_hists = jnp.zeros((lcap, f, b, 3), jnp.float32).at[0].set(root)
+        g_sums = jnp.zeros((lcap, 3), jnp.float32).at[0].set(
+            root[0].sum(axis=0))
 
     def body(s, carry):
-        (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-         s_valid, s_gain, s_is_cat, s_mask, done,
-         cache_gain, cache_feat, cache_bin) = carry
+        if voting:
+            (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
+             s_valid, s_gain, s_is_cat, s_mask, done) = carry
+            hists, sums, gains_all, feats_all, bins_all = scan_splits_voting(
+                slot_of_row, feature_mask)
+        else:
+            (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
+             s_valid, s_gain, s_is_cat, s_mask, done,
+             g_hists, g_sums) = carry
+            hists, sums = g_hists, g_sums
+            gains_all, feats_all, bins_all = _best_split_per_slot(
+                g_hists, g_sums, cfg, feature_mask)
         slot_exists = jnp.arange(lcap) <= s
         if cfg.max_depth > 0:
             slot_exists = slot_exists & (depth_of_slot < cfg.max_depth)
-        gains = jnp.where(slot_exists, cache_gain, _NEG_INF)
+        gains = jnp.where(slot_exists, gains_all, _NEG_INF)
         best_slot = jnp.argmax(gains).astype(jnp.int32)
         best_gain = gains[best_slot]
         do = (best_gain > cfg.min_gain_to_split + _MIN_GAIN_EPS) & (~done)
 
-        feat_b = cache_feat[best_slot]
-        bin_b = cache_bin[best_slot]
+        feat_b = feats_all[best_slot]
+        bin_b = bins_all[best_slot]
         new_slot = (s + 1).astype(jnp.int32)
 
         col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
@@ -260,18 +333,6 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
             go_right = col > bin_b
         slot_of_row = jnp.where(in_leaf & go_right & do, new_slot, slot_of_row)
 
-        right_gh3 = gh3 * (slot_of_row == new_slot)[:, None].astype(gh3.dtype)
-        right_hist = hist(right_gh3)
-        right_sum = right_hist[0].sum(axis=0)
-        parent_hist = hists[best_slot]
-        parent_sum = sums[best_slot]
-
-        hists = hists.at[new_slot].set(jnp.where(do, right_hist, 0.0))
-        hists = hists.at[best_slot].set(
-            jnp.where(do, parent_hist - right_hist, parent_hist))
-        sums = sums.at[new_slot].set(jnp.where(do, right_sum, 0.0))
-        sums = sums.at[best_slot].set(
-            jnp.where(do, parent_sum - right_sum, parent_sum))
         child_depth = depth_of_slot[best_slot] + 1
         depth_of_slot = depth_of_slot.at[new_slot].set(
             jnp.where(do, child_depth, 0))
@@ -286,31 +347,40 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         s_is_cat = s_is_cat.at[s].set(feat_cat & do)
         s_mask = s_mask.at[s].set(mask[:bm])
         done = done | ~do
+        if voting:
+            return (depth_of_slot, slot_of_row, s_slot, s_feat,
+                    s_bin, s_valid, s_gain, s_is_cat, s_mask, done)
 
-        # rescan ONLY the two slots whose histograms changed
-        pair_idx = jnp.stack([best_slot, new_slot])
-        pg, pf, pb = _best_split_per_slot(hists[pair_idx], sums[pair_idx],
-                                          cfg, feature_mask)
-        cache_gain = cache_gain.at[best_slot].set(
-            jnp.where(do, pg[0], cache_gain[best_slot]))
-        cache_feat = cache_feat.at[best_slot].set(
-            jnp.where(do, pf[0], cache_feat[best_slot]))
-        cache_bin = cache_bin.at[best_slot].set(
-            jnp.where(do, pb[0], cache_bin[best_slot]))
-        cache_gain = cache_gain.at[new_slot].set(
-            jnp.where(do, pg[1], _NEG_INF))
-        cache_feat = cache_feat.at[new_slot].set(jnp.where(do, pf[1], 0))
-        cache_bin = cache_bin.at[new_slot].set(jnp.where(do, pb[1], 0))
-        return (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat,
+        # post-split all-slots pass; only the new child's slice is allreduced
+        local = hist_local(slot_of_row)
+        right = psum_(jnp.take(local, new_slot, axis=0))       # [F,B,3]
+        right = jnp.where(do, right, 0.0)
+        right_sum = right[0].sum(axis=0)
+        g_hists = g_hists.at[new_slot].set(right)
+        g_hists = g_hists.at[best_slot].add(-right)            # sibling subtr.
+        g_sums = g_sums.at[new_slot].set(right_sum)
+        g_sums = g_sums.at[best_slot].add(-right_sum)
+        return (depth_of_slot, slot_of_row, s_slot, s_feat,
                 s_bin, s_valid, s_gain, s_is_cat, s_mask, done,
-                cache_gain, cache_feat, cache_bin)
+                g_hists, g_sums)
 
-    carry = (hists, sums, depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-             s_valid, s_gain, s_is_cat, s_mask, done,
-             cache_gain, cache_feat, cache_bin)
+    carry = (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
+             s_valid, s_gain, s_is_cat, s_mask, done)
+    if not voting:
+        carry = carry + (g_hists, g_sums)
     carry = jax.lax.fori_loop(0, lcap - 1, body, carry)
-    (hists, sums, _, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
-     s_is_cat, s_mask, _, _, _, _) = carry
+    (_, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
+     s_is_cat, s_mask, _) = carry[:10]
+
+    if voting:
+        # post-split leaf stats via a slot-onehot contraction (O(N*L), no
+        # histogram pass needed)
+        slot_oh = (slot_of_row[:, None]
+                   == jnp.arange(lcap)[None, :]).astype(jnp.float32)
+        sums = psum_(jnp.dot(slot_oh.T, gh3,
+                             preferred_element_type=jnp.float32))    # [L,3]
+    else:
+        sums = carry[11]                                       # carried g_sums
 
     leaf_value = (_leaf_output(sums[:, 0], sums[:, 1], cfg.lambda_l1,
                                cfg.lambda_l2)

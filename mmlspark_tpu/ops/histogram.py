@@ -84,6 +84,91 @@ def hist_scatter(binned: jax.Array, gh: jax.Array, num_bins: int) -> jax.Array:
     return out.reshape(f, num_bins, c)
 
 
+def hist_slots_onehot(binned: jax.Array, slot: jax.Array, gh: jax.Array,
+                      num_slots: int, num_bins: int, chunk: int = 8192,
+                      dtype: str = "bf16") -> jax.Array:
+    """All-slots MXU histogram: one pass builds EVERY leaf's histogram.
+
+    binned [N,F] int, slot [N] int32 (leaf slot of each row), gh [N,C] float
+    -> [L, F, B, C] float32.
+
+    This is the hot kernel of the whole framework. The per-leaf formulation
+    (mask gh to one leaf, contract to [F*B, C]) leaves the MXU ~C/128 utilized
+    because the matmul's output width is C=3; expanding the channel dim to
+    (slot × channel) makes the output width L*C (≈ 93 for num_leaves=31, i.e.
+    most of one 128-wide MXU tile) at identical pass count — a ~L× speedup
+    measured on v5e. Rows carry their slot id; padded rows must carry gh == 0.
+
+        hist[l, f, b, c] = sum_n 1[slot_n == l] * 1[bin_nf == b] * gh[n, c]
+    """
+    n, f = binned.shape
+    c = gh.shape[1]
+    w = num_slots * c
+    pad = (-n) % chunk
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        slot = jnp.pad(slot, (0, pad))
+        gh = jnp.pad(gh, ((0, pad), (0, 0)))
+    n_chunks = binned.shape[0] // chunk
+    bins_c = binned.reshape(n_chunks, chunk, f)
+    slot_c = slot.reshape(n_chunks, chunk)
+    gh_c = gh.reshape(n_chunks, chunk, c)
+
+    bin_iota = jnp.arange(num_bins, dtype=jnp.int32)
+    slot_iota = jnp.arange(num_slots, dtype=jnp.int32)
+    op_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
+    precision = (None if dtype == "bf16" else jax.lax.Precision.HIGHEST)
+
+    def body(acc, xs):
+        bins_t, slot_t, gh_t = xs
+        onehot = (bins_t[:, :, None] == bin_iota[None, None, :])
+        onehot = onehot.astype(op_dtype).reshape(chunk, f * num_bins)
+        slot_oh = (slot_t[:, None] == slot_iota[None, :]).astype(op_dtype)
+        ghw = (slot_oh[:, :, None] * gh_t[:, None, :].astype(op_dtype))
+        ghw = ghw.reshape(chunk, w)
+        acc = acc + jnp.dot(onehot.T, ghw,
+                            preferred_element_type=jnp.float32,
+                            precision=precision)
+        return acc, None
+
+    acc0 = jnp.zeros((f * num_bins, w), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (bins_c, slot_c, gh_c))
+    return acc.reshape(f, num_bins, num_slots, c).transpose(2, 0, 1, 3)
+
+
+def hist_slots_scatter(binned: jax.Array, slot: jax.Array, gh: jax.Array,
+                       num_slots: int, num_bins: int) -> jax.Array:
+    """All-slots scatter-add histogram (CPU/test path). -> [L, F, B, C]."""
+    n, f = binned.shape
+    c = gh.shape[1]
+    feat_iota = jnp.arange(f, dtype=jnp.int32)
+    flat_idx = (slot.astype(jnp.int32)[:, None] * (f * num_bins)
+                + feat_iota[None, :] * num_bins
+                + binned.astype(jnp.int32)).reshape(-1)
+    contrib = jnp.broadcast_to(gh[:, None, :].astype(jnp.float32),
+                               (n, f, c)).reshape(-1, c)
+    out = jnp.zeros((num_slots * f * num_bins, c), jnp.float32)
+    out = out.at[flat_idx].add(contrib)
+    return out.reshape(num_slots, f, num_bins, c)
+
+
+def hist_slots(binned: jax.Array, slot: jax.Array, gh: jax.Array,
+               num_slots: int, num_bins: int, method: str = "auto",
+               chunk: int = 8192, dtype: str = "bf16") -> jax.Array:
+    """Dispatch the all-slots histogram build. gh channels: [grad, hess, mask]."""
+    method = resolve_hist_method(method)
+    if method == "onehot":
+        return hist_slots_onehot(binned, slot, gh, num_slots, num_bins,
+                                 chunk, dtype)
+    if method == "scatter":
+        return hist_slots_scatter(binned, slot, gh, num_slots, num_bins)
+    if method == "pallas":
+        from .pallas_kernels import hist_slots_pallas
+        return hist_slots_pallas(binned, slot, gh, num_slots, num_bins,
+                                 block_rows=chunk, dtype=dtype)
+    raise ValueError(f"unknown histogram method {method!r}")
+
+
 def resolve_hist_method(method: str) -> str:
     """'auto' picks per backend: the one-hot contraction exists for the MXU; on CPU
     (tests, virtual meshes) XLA's native scatter-add is far cheaper."""

@@ -1,18 +1,29 @@
 """Pallas TPU kernels for the GBDT hot path.
 
-The histogram kernel is the TPU replacement for LightGBM's C++ per-leaf histogram
-construction (driven from lightgbm/TrainUtils.scala:220-315 via
-`LGBM_BoosterUpdateOneIter`). Strategy (see ops/histogram.py): turn scatter-add into a
-block-local one-hot × gradient contraction that runs on the MXU, accumulating the
-[F, B, C] histogram in VMEM across sequential grid steps over row blocks.
+The all-slots histogram kernel is the TPU replacement for LightGBM's C++
+per-leaf histogram construction (driven from lightgbm/TrainUtils.scala:220-315
+via `LGBM_BoosterUpdateOneIter`). Strategy (see ops/histogram.py): turn
+scatter-add into a block-local one-hot × slot-expanded-gradient contraction
+that runs on the MXU, accumulating the [F, B, L*C] histogram in VMEM across
+sequential grid steps over row blocks.
+
+Why Pallas beats the XLA one-hot formulation here: XLA materializes the
+[chunk, F*B] one-hot operand in HBM before the matmul (matmul operands are
+buffers, not fusion temporaries), so the XLA path moves ~2 * N * F * B bytes
+of pure scaffolding per pass and is HBM-bound. This kernel generates both the
+bin one-hot and the slot-expanded gradient matrix in VMEM, so HBM traffic is
+just the [N, F] uint8 bins + [N, C] gradients — the kernel runs at the MXU
+roofline instead.
 
 Layout choices:
-- accumulator kept as [F, C, B] inside the kernel so the large B dimension sits on
-  lanes (128-wide) and the tiny C=3 channel dim on sublanes; transposed on return.
-- per-feature unrolled dots: [C, T] x [T, B] — M=C pads to 8 sublanes, N=B lanes,
-  K=T contraction; f32 accumulation throughout (bf16 MXU passes flip near-tie splits).
-- rows are chunked by the grid; the whole accumulator uses the standard
-  zero-at-step-0 / accumulate-afterwards revisiting pattern (TPU grids are sequential).
+- grid = (feature_tiles, row_blocks) with row blocks minor, so each feature
+  tile's [Ft, B, W] accumulator stays resident in VMEM across its row sweep
+  (zero-at-first-visit / accumulate-afterwards revisiting pattern);
+- output width W = num_slots * C (≈ 93 for 31 leaves) sits on lanes — most of
+  one 128-wide MXU tile;
+- when B < 128, feature pairs are packed into one [T, 2B] one-hot so the dot's
+  M dimension fills the MXU's 128 sublanes;
+- bf16 one-hot / gradient operands (exact for the 0/1 side), f32 accumulation.
 """
 
 from __future__ import annotations
@@ -24,54 +35,116 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _hist_kernel(bins_ref, gh_ref, out_ref, *, num_features: int,
-                 num_bins: int):
-    @pl.when(pl.program_id(0) == 0)
+def _hist_slots_kernel(bins_ref, slot_ref, gh_ref, out_ref, *,
+                       num_bins: int, num_slots: int, channels: int,
+                       pack: int, op_dtype):
+    @pl.when(pl.program_id(1) == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    bins = bins_ref[...]            # [T, F] int32
+    bins = bins_ref[...]            # [T, Ft] int32
+    slot = slot_ref[...]            # [T, 1] int32
     gh = gh_ref[...]                # [T, C] f32
-    t = bins.shape[0]
-    ght = gh.T                      # [C, T]
+    t, ft = bins.shape
+    w = num_slots * channels
+
+    # slot-expanded gradient matrix ghw[t, l*C + c] = gh[t, c] * 1[slot_t == l]
+    w_iota = jax.lax.broadcasted_iota(jnp.int32, (t, w), 1)
+    ghw = jnp.zeros((t, w), jnp.float32)
+    for c in range(channels):
+        ghw = ghw + jnp.where(w_iota % channels == c, gh[:, c][:, None], 0.0)
+    ghw = jnp.where(slot == w_iota // channels, ghw, 0.0)
+    ghw = ghw.astype(op_dtype)
+
     bin_iota = jax.lax.broadcasted_iota(jnp.int32, (t, num_bins), 1)
-    for f in range(num_features):   # static unroll; F is small
-        onehot = (bins[:, f][:, None] == bin_iota).astype(jnp.float32)
-        contrib = jax.lax.dot_general(
-            ght, onehot, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)      # [C, B]
-        out_ref[f, :, :] += contrib
+    for f0 in range(0, ft, pack):
+        oh = jnp.concatenate(
+            [(bins[:, f0 + p][:, None] == bin_iota) for p in range(pack)],
+            axis=1).astype(op_dtype)                           # [T, pack*B]
+        res = jax.lax.dot_general(
+            oh, ghw, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            # f32 mode promises exact (multi-pass) MXU arithmetic — without
+            # HIGHEST the MXU would round operands to bf16 passes anyway
+            precision=(None if op_dtype == jnp.bfloat16
+                       else jax.lax.Precision.HIGHEST))        # [pack*B, W]
+        for p in range(pack):
+            out_ref[f0 + p, :, :] += res[p * num_bins:(p + 1) * num_bins]
 
 
-def hist_pallas(binned: jax.Array, gh: jax.Array, num_bins: int,
-                block_rows: int = 1024,
-                interpret: bool | None = None) -> jax.Array:
-    """Pallas histogram: binned [N, F] int, gh [N, C] f32 -> [F, B, C] f32.
+def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
+                      num_slots: int, num_bins: int,
+                      block_rows: int = 2048, feat_tile: int = 8,
+                      dtype: str = "bf16",
+                      interpret: bool | None = None) -> jax.Array:
+    """All-slots Pallas histogram.
 
-    Pads rows to a block multiple (padded rows carry zero gh, contributing
-    nothing). On CPU backends runs in interpret mode so virtual-mesh tests
-    exercise the same code path.
+    binned [N, F] int, slot [N] int32, gh [N, C] f32
+    -> [L, F, B, C] f32 where L = num_slots.
+
+    dtype: MXU operand dtype — 'bf16' rounds gradients to ~3 decimal digits
+    (one-hot side is exact either way, accumulation is always f32); 'f32'
+    keeps exact operands for bit-reproducibility with the scatter oracle
+    (near-tie split gains can flip under bf16).
+
+    Rows are padded to a block multiple (padded rows carry zero gh); features
+    are padded to the feature-tile multiple with bin id == num_bins, which
+    matches no one-hot column and contributes nothing. On CPU backends runs in
+    interpret mode so virtual-mesh tests exercise the same code path.
     """
     n, f = binned.shape
     c = gh.shape[1]
+    w = num_slots * c
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    pad = (-n) % block_rows
-    if pad:
-        binned = jnp.pad(binned, ((0, pad), (0, 0)))
-        gh = jnp.pad(gh, ((0, pad), (0, 0)))
-    n_pad = binned.shape[0]
-    grid = (n_pad // block_rows,)
 
+    # pack features per dot while pack*B fits the MXU's 128 sublanes
+    pack = max(1, min(feat_tile, 128 // num_bins))
+    while feat_tile % pack:
+        pack -= 1
+
+    pad_n = (-n) % block_rows
+    if pad_n:
+        binned = jnp.pad(binned, ((0, pad_n), (0, 0)))
+        slot = jnp.pad(slot, (0, pad_n))
+        gh = jnp.pad(gh, ((0, pad_n), (0, 0)))
+    pad_f = (-f) % feat_tile
+    if pad_f:
+        binned = jnp.pad(binned, ((0, 0), (0, pad_f)),
+                         constant_values=num_bins)
+    n_pad, f_pad = binned.shape
+    grid = (f_pad // feat_tile, n_pad // block_rows)
+
+    op_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, num_features=f, num_bins=num_bins),
+        functools.partial(_hist_slots_kernel, num_bins=num_bins,
+                          num_slots=num_slots, channels=c, pack=pack,
+                          op_dtype=op_dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_rows, f), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, feat_tile), lambda i, j: (j, i)),
+            pl.BlockSpec((block_rows, 1), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_rows, c), lambda i, j: (j, 0)),
         ],
-        out_specs=pl.BlockSpec((f, c, num_bins), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f, c, num_bins), jnp.float32),
+        out_specs=pl.BlockSpec((feat_tile, num_bins, w),
+                               lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f_pad, num_bins, w), jnp.float32),
         interpret=interpret,
-    )(binned.astype(jnp.int32), gh.astype(jnp.float32))
-    return out.transpose(0, 2, 1)   # [F, B, C]
+    )(binned.astype(jnp.int32), slot.astype(jnp.int32)[:, None],
+      gh.astype(jnp.float32))
+    out = out[:f].reshape(f, num_bins, num_slots, c)
+    return out.transpose(2, 0, 1, 3)               # [L, F, B, C]
+
+
+def hist_pallas(binned: jax.Array, gh: jax.Array, num_bins: int,
+                block_rows: int = 2048,
+                interpret: bool | None = None) -> jax.Array:
+    """Single-histogram Pallas build: [N,F] x [N,C] -> [F, B, C].
+
+    Thin wrapper over the all-slots kernel with one slot; kept for the
+    `build_histogram(..., method='pallas')` API surface and tests.
+    """
+    slot = jnp.zeros((binned.shape[0],), jnp.int32)
+    out = hist_slots_pallas(binned, slot, gh, 1, num_bins,
+                            block_rows=block_rows, interpret=interpret)
+    return out[0]

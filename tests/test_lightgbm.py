@@ -243,3 +243,42 @@ class TestModelPersistence:
         out = model.transform(binary_df)
         a = auc(binary_df["label"], np.stack(out["probability"])[:, 1])
         assert a > 0.9
+
+
+class TestVotingParallel:
+    """voting_parallel tree learner (LightGBMParams.scala:13-27): per-leaf
+    local top-2k feature votes, global top-k selection, histogram allreduce
+    restricted to the voted features."""
+
+    def test_topk_all_features_matches_data_parallel(self, binary_df):
+        # with topK >= F every feature is voted, so voting_parallel must pick
+        # exactly the same splits as data_parallel
+        f = np.asarray(binary_df["features"]).shape[1]
+        dp = LightGBMClassifier(numIterations=8, numLeaves=7, numTasks=8,
+                                seed=5).fit(binary_df)
+        vp = LightGBMClassifier(numIterations=8, numLeaves=7, numTasks=8,
+                                parallelism="voting_parallel", topK=f,
+                                seed=5).fit(binary_df)
+        x = np.asarray(binary_df["features"])
+        np.testing.assert_allclose(dp.booster.raw_predict(x),
+                                   vp.booster.raw_predict(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_small_topk_quality(self, binary_df):
+        vp = LightGBMClassifier(numIterations=30, numLeaves=15, numTasks=8,
+                                parallelism="voting_parallel", topK=3,
+                                seed=5).fit(binary_df)
+        out = vp.transform(binary_df)
+        a = auc(binary_df["label"], np.stack(out["probability"])[:, 1])
+        assert a > 0.9, f"voting_parallel train AUC {a}"
+
+    def test_voting_rejects_categoricals(self, binary_df):
+        import pytest
+        with pytest.raises(ValueError, match="voting_parallel"):
+            LightGBMClassifier(parallelism="voting_parallel",
+                               categoricalSlotIndexes=[0]).fit(binary_df)
+
+    def test_bad_parallelism_value(self, binary_df):
+        import pytest
+        with pytest.raises(ValueError, match="parallelism"):
+            LightGBMClassifier(parallelism="feature_parallel").fit(binary_df)
