@@ -139,14 +139,131 @@ def generate_docs() -> str:
     return "\n".join(out)
 
 
-def write_artifacts(out_dir: str) -> Tuple[str, str]:
-    """Emit stubs + docs (CodeGen.generateArtifacts equivalent)."""
+# ---------------------------------------------------------------------------
+# R bindings (SparklyRWrapper.scala equivalent)
+# ---------------------------------------------------------------------------
+
+_R_HEADER = '''# Auto-generated R bindings for mmlspark_tpu — utils/codegen.py.
+# Mirrors the reference's SparklyR wrapper generation
+# (codegen/SparklyRWrapper.scala): one ml_<stage> function per stage, param
+# defaults lifted from the Param registry. The bridge is reticulate instead of
+# a JVM gateway: stages are plain Python objects; data.frames cross via
+# reticulate's data.frame <-> dict conversion.
+
+.mmlspark_env <- new.env(parent = emptyenv())
+
+.mmlspark_module <- function() {
+  if (is.null(.mmlspark_env$mod)) {
+    .mmlspark_env$mod <- reticulate::import("mmlspark_tpu")
+  }
+  .mmlspark_env$mod
+}
+
+.mmlspark_new <- function(qualified_name, params) {
+  # import the defining module directly: the package __init__ does not
+  # re-export every submodule, so attribute-walking from the root would fail
+  parts <- strsplit(qualified_name, "\\\\.")[[1]]
+  module <- paste(head(parts, -1), collapse = ".")
+  cls <- tail(parts, 1)
+  stage <- reticulate::import(module)[[cls]]()
+  for (name in names(params)) {
+    value <- params[[name]]
+    if (!is.null(value)) {
+      setter <- paste0("set", toupper(substring(name, 1, 1)),
+                       substring(name, 2))
+      stage[[setter]](value)
+    }
+  }
+  stage
+}
+'''
+
+_R_FUNC_TEMPLATE = '''
+{doc}
+ml_{snake} <- function(x{args})
+{{
+  params <- list({param_list})
+  stage <- .mmlspark_new("{qualified}", params)
+  df <- .mmlspark_module()$core$dataframe$DataFrame(x)
+  {action}
+}}'''
+
+
+def _snake(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i and (
+                not name[i - 1].isupper()          # wordStart
+                or (i + 1 < len(name) and name[i + 1].islower())):  # GBMNext
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+def _r_default(p: Param) -> str:
+    d = p.default
+    if isinstance(d, bool):
+        return "TRUE" if d else "FALSE"
+    if isinstance(d, (int, float)):
+        return repr(d)
+    if isinstance(d, str):
+        return '"' + d.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    return "NULL"
+
+
+def generate_r_wrapper(cls: Type[Params]) -> str:
+    """One stage's R function, SparklyRWrapper functionTemplate analogue."""
+    snake = _snake(cls.__name__)
+    params = sorted(cls.params().items())
+    args = "".join(f",\n                {_snake(n)} = {_r_default(p)}"
+                   for n, p in params if not p.complex)
+    param_list = ", ".join(f"{n} = {_snake(n)}"
+                           for n, p in params if not p.complex)
+    if issubclass(cls, Estimator):
+        action = "stage$fit(df)"
+    elif issubclass(cls, (Transformer, Model)):
+        action = "stage$transform(df)$to_dict()"
+    elif issubclass(cls, Evaluator):
+        action = "stage$evaluate(df)"
+    else:
+        action = "stage"
+    doc_lines = [f"#' {cls.__name__}"]
+    cls_doc = inspect.getdoc(cls)
+    if cls_doc:
+        doc_lines += [f"#' {ln}" for ln in
+                      cls_doc.split("\n\n")[0].splitlines()]
+    doc_lines.append("#' @param x an R data.frame (or named list of columns)")
+    for n, p in params:
+        if not p.complex:
+            doc_lines.append(f"#' @param {_snake(n)} {p.doc or ''}")
+    doc_lines.append("#' @export")
+    return _R_FUNC_TEMPLATE.format(
+        doc="\n".join(doc_lines), snake=snake, args=args,
+        param_list=param_list,
+        qualified=f"{cls.__module__}.{cls.__name__}", action=action)
+
+
+def generate_r_wrappers() -> str:
+    """Full R source: every concrete stage as an ml_<stage> function."""
+    parts = [_R_HEADER]
+    for cls in discover_stages():
+        if _is_abstract(cls):
+            continue
+        parts.append(generate_r_wrapper(cls))
+    return "\n".join(parts) + "\n"
+
+
+def write_artifacts(out_dir: str) -> Tuple[str, str, str]:
+    """Emit stubs + docs + R bindings (CodeGen.generateArtifacts equivalent)."""
     import os
     os.makedirs(out_dir, exist_ok=True)
     stub_path = os.path.join(out_dir, "mmlspark_tpu.pyi")
     docs_path = os.path.join(out_dir, "API.md")
+    r_path = os.path.join(out_dir, "mmlspark_tpu.R")
     with open(stub_path, "w") as f:
         f.write(generate_stubs())
     with open(docs_path, "w") as f:
         f.write(generate_docs())
-    return stub_path, docs_path
+    with open(r_path, "w") as f:
+        f.write(generate_r_wrappers())
+    return stub_path, docs_path, r_path

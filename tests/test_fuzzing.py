@@ -95,3 +95,20 @@ def test_codegen_stubs_and_docs():
     assert "| numLeaves |" in docs
     # stubs must be valid python
     compile(stubs, "<stubs>", "exec")
+
+
+def test_codegen_r_wrappers():
+    """R bindings generation (SparklyRWrapper.scala equivalent): one
+    ml_<stage> function per concrete stage, balanced braces, R-literal
+    defaults."""
+    from mmlspark_tpu.utils.codegen import generate_r_wrappers
+    src = generate_r_wrappers()
+    assert src.count("{") == src.count("}")
+    assert "ml_light_gbm_classifier <- function(x" in src
+    assert "ml_vowpal_wabbit_regressor <- function(x" in src
+    # defaults lifted from the registry as R literals
+    assert "num_iterations = 100" in src
+    # roxygen docs present
+    assert "#' @export" in src
+    # complex params (delegates, models) are excluded from the R surface
+    assert "delegate =" not in src
