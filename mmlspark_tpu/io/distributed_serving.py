@@ -24,6 +24,7 @@ is the only mode.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 import urllib.request
@@ -83,9 +84,16 @@ class ServingCoordinator:
     def register(self, info: ServiceInfo) -> None:
         with self._lock:
             lst = self._routes.setdefault(info.name, [])
+            # a worker identity is (machine, partition) — re-registration
+            # (e.g. a restarted worker on a new port) replaces its stale
+            # entry. Workers must carry unique identities; the
+            # DistributedServingServer defaults derive them from hostname +
+            # bound port so unconfigured workers on any topology never
+            # collide. Same-endpoint re-posts are also collapsed.
             lst[:] = [s for s in lst
                       if (s.machine, s.partition) != (info.machine,
-                                                      info.partition)]
+                                                      info.partition)
+                      and (s.host, s.port) != (info.host, info.port)]
             lst.append(info)
 
     def routes(self, name: str) -> List[ServiceInfo]:
@@ -206,7 +214,8 @@ class DistributedServingServer(ServingServer):
     HTTPSourceV2.scala:318-430)."""
 
     def __init__(self, handler, coordinator_url: str, service_name: str,
-                 partition: int = 0, machine: str = "localhost", **kw):
+                 partition: Optional[int] = None,
+                 machine: Optional[str] = None, **kw):
         super().__init__(handler, **kw)
         self.coordinator_url = coordinator_url
         self.service_name = service_name
@@ -215,10 +224,16 @@ class DistributedServingServer(ServingServer):
 
     def start(self) -> "DistributedServingServer":
         super().start()
+        # default identity is (hostname, bound port): unique across hosts AND
+        # across multiple unconfigured workers on one host, so defaults never
+        # evict each other in the coordinator's (machine, partition) registry
+        machine = (self.machine if self.machine is not None
+                   else socket.gethostname())
+        partition = self.partition if self.partition is not None else self.port
         register_with_retries(
             self.coordinator_url,
             ServiceInfo(self.service_name, self.host, self.port,
-                        self.machine, self.partition))
+                        machine, partition))
         return self
 
 

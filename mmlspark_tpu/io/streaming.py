@@ -53,6 +53,7 @@ class FileStreamSource:
         self.max_files_per_batch = max_files_per_batch
         self.checkpoint_dir = checkpoint_dir
         self._seen: Dict[str, float] = {}
+        self._pending: Dict[str, float] = {}  # in-flight batch's files
         self._batch_id = -1
         if checkpoint_dir:
             self._restore()
@@ -75,8 +76,12 @@ class FileStreamSource:
             self._batch_id = int(state["batch_id"])
 
     def commit(self) -> None:
-        """Persist the offset watermark (the Spark offset-log commit). Call
-        AFTER the sink has consumed the batch => at-least-once delivery."""
+        """Mark the in-flight batch's files consumed and persist the offset
+        watermark (the Spark offset-log commit). Call AFTER the sink has
+        consumed the batch => at-least-once delivery: if the sink raises, the
+        files stay un-seen and the next read_batch replays them."""
+        self._seen.update(self._pending)
+        self._pending = {}
         if not self.checkpoint_dir:
             return
         tmp = self._offsets_file() + ".tmp"
@@ -105,7 +110,7 @@ class FileStreamSource:
                 m = os.path.getmtime(p)
             except OSError:
                 continue  # raced with a delete
-            if p not in self._seen:
+            if p not in self._seen and p not in self._pending:
                 fresh.append((m, p))
         fresh.sort()
         return [p for _, p in fresh[:self.max_files_per_batch]]
@@ -115,12 +120,21 @@ class FileStreamSource:
         if not files:
             return None
         self._batch_id += 1
+        # stage, don't mark seen: within a run read_batch keeps advancing
+        # (Spark's micro-batch engine does the same), but only commit()
+        # promotes staged files into the persisted watermark — a crash or an
+        # explicit rollback() before commit makes them discoverable again
         for p in files:
             try:
-                self._seen[p] = os.path.getmtime(p)
+                self._pending[p] = os.path.getmtime(p)
             except OSError:
-                self._seen[p] = 0.0
+                self._pending[p] = 0.0
         return self._load(files)
+
+    def rollback(self) -> None:
+        """Return all staged (read but uncommitted) files to the discoverable
+        pool — the failed-sink path of the at-least-once contract."""
+        self._pending = {}
 
     def _load(self, files: List[str]) -> DataFrame:
         if self.format == "json":
@@ -205,7 +219,11 @@ class StreamingQuery:
                 self.batches_processed += 1
                 self.rows_processed += len(df)
             except Exception as e:  # noqa: BLE001
+                # return the batch to the pool -> replayed next poll
+                # (at-least-once)
                 self.last_error = e
+                self.source.rollback()
+                self._stop.wait(self.poll_interval_s)
 
     def process_available(self) -> int:
         """Synchronous drain (processAllAvailable analogue): run batches until
@@ -215,8 +233,12 @@ class StreamingQuery:
             df = self.source.read_batch()
             if df is None:
                 return rows
-            out = self.pipeline(df) if self.pipeline else df
-            self.sink(self.source.batch_id, out)
+            try:
+                out = self.pipeline(df) if self.pipeline else df
+                self.sink(self.source.batch_id, out)
+            except Exception:
+                self.source.rollback()  # leave the batch replayable
+                raise
             self.source.commit()
             self.batches_processed += 1
             rows += len(df)

@@ -30,16 +30,25 @@ def retry_with_timeout(fn: Callable[[], T], timeout_s: float = 60.0,
     per-attempt timeout, retrying with backoff on failure OR timeout."""
     last: Optional[BaseException] = None
     for attempt in range(retries):
-        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
-            fut = ex.submit(fn)
-            try:
-                return fut.result(timeout=timeout_s)
-            except concurrent.futures.TimeoutError:
-                last = TimeoutError(f"attempt {attempt + 1} exceeded "
-                                    f"{timeout_s}s")
-                fut.cancel()
-            except Exception as e:  # noqa: BLE001 - retry any failure
-                last = e
+        # one throwaway executor per attempt, abandoned without joining: a
+        # `with` block (shutdown(wait=True)) would block on a hung fn and
+        # defeat the hard timeout this function exists to provide. The
+        # leaked worker thread dies with the hung call; cancel() is a no-op
+        # on a running future by design.
+        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        fut = ex.submit(fn)
+        try:
+            result = fut.result(timeout=timeout_s)
+            ex.shutdown(wait=False)
+            return result
+        except concurrent.futures.TimeoutError:
+            last = TimeoutError(f"attempt {attempt + 1} exceeded "
+                                f"{timeout_s}s")
+            fut.cancel()
+            ex.shutdown(wait=False)
+        except Exception as e:  # noqa: BLE001 - retry any failure
+            last = e
+            ex.shutdown(wait=False)
         if attempt < retries - 1:
             time.sleep(backoff_s * (attempt + 1))
     raise RuntimeError(f"all {retries} attempts failed: {last}") from last
@@ -107,8 +116,11 @@ class RemoteRepository:
 
     # -------------------------------------------------------------- download
     def _cache_path(self, info: RemoteModelInfo) -> str:
-        fname = os.path.basename(info.uri) or f"{info.name}.npz"
-        return os.path.join(self.cache_dir, fname)
+        # keyed by model name + uri digest: distinct models whose URIs share
+        # a basename (r18/model.npz vs r50/model.npz) must not collide
+        ext = os.path.splitext(info.uri)[1] or ".npz"
+        tag = hashlib.sha256(info.uri.encode()).hexdigest()[:12]
+        return os.path.join(self.cache_dir, f"{info.name}-{tag}{ext}")
 
     def download_model(self, name: str) -> str:
         """Fetch a model checkpoint; returns the local path. Cached files
