@@ -1,0 +1,93 @@
+"""Port forwarding relay: probe/retry contract of PortForwarding.scala:12-86."""
+
+import socket
+import threading
+
+import pytest
+
+from mmlspark_tpu.io.port_forwarding import (Forwarder, forward_port_to_remote,
+                                             forward_port_to_remote_options)
+
+
+def _echo_server():
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def loop():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            data = c.recv(1 << 16)
+            c.sendall(b"echo:" + data)
+            c.close()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return srv, srv.getsockname()[1]
+
+
+class TestForwarder:
+    def test_relays_both_directions(self):
+        srv, port = _echo_server()
+        fwd = Forwarder("127.0.0.1", 0, "127.0.0.1", port)
+        try:
+            with socket.create_connection(("127.0.0.1", fwd.port), 5) as c:
+                c.sendall(b"hello")
+                assert c.recv(1 << 16) == b"echo:hello"
+        finally:
+            fwd.stop()
+            srv.close()
+
+    def test_port_probe_skips_occupied(self):
+        srv, port = _echo_server()
+        # occupy the first candidate port so the probe must advance
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        start = blocker.getsockname()[1]
+        fwd, bound = forward_port_to_remote("127.0.0.1", start,
+                                            "127.0.0.1", port, max_retries=5)
+        try:
+            assert bound != start and start < bound <= start + 5
+        finally:
+            fwd.stop()
+            blocker.close()
+            srv.close()
+
+    def test_probe_exhaustion_raises(self):
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        start = blocker.getsockname()[1]
+        with pytest.raises(RuntimeError, match="open port"):
+            forward_port_to_remote("127.0.0.1", start, "127.0.0.1", 1,
+                                   max_retries=0)
+        blocker.close()
+
+    def test_options_map_reference_keys(self):
+        srv, port = _echo_server()
+        fwd, bound = forward_port_to_remote_options({
+            "forwarding.username": "ignored",
+            "forwarding.sshhost": "ignored",
+            "forwarding.localport": str(port),
+            "forwarding.remoteportstart": "0",
+            "forwarding.maxretires": "3",
+        })
+        try:
+            with socket.create_connection(("127.0.0.1", bound), 5) as c:
+                c.sendall(b"k")
+                assert c.recv(1 << 16) == b"echo:k"
+        finally:
+            fwd.stop()
+            srv.close()
+
+    def test_unreachable_target_closes_client(self):
+        fwd = Forwarder("127.0.0.1", 0, "127.0.0.1", 1)  # nothing listens
+        try:
+            with socket.create_connection(("127.0.0.1", fwd.port), 5) as c:
+                assert c.recv(1 << 16) == b""  # closed, not hung
+        finally:
+            fwd.stop()
