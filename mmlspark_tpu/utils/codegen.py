@@ -267,3 +267,16 @@ def write_artifacts(out_dir: str) -> Tuple[str, str, str]:
     with open(r_path, "w") as f:
         f.write(generate_r_wrappers())
     return stub_path, docs_path, r_path
+
+
+def main(argv=None) -> int:
+    """CLI entry (`mmlspark-tpu-codegen OUT_DIR`): emit stubs + docs + R."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Generate mmlspark_tpu API artifacts from the Params "
+                    "registry (.pyi stubs, API.md, R bindings)")
+    ap.add_argument("out_dir", help="output directory")
+    args = ap.parse_args(argv)
+    for path in write_artifacts(args.out_dir):
+        print(path)
+    return 0
