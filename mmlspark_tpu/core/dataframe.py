@@ -224,6 +224,22 @@ class DataFrame:
     def collect(self) -> List[Dict[str, Any]]:
         return list(self.rows())
 
+    # ------------------------------------------------------------ fluent API
+    def ml_transform(self, *stages) -> "DataFrame":
+        """Apply transformers (or fitted models) in sequence — the FluentAPI
+        sugar `df.mlTransform(t1, t2, ...)` (core/spark/FluentAPI.scala:14-18)."""
+        out = self
+        for stage in stages:
+            out = stage.transform(out)
+        return out
+
+    def ml_fit(self, estimator):
+        """`df.mlFit(e)` == `e.fit(df)` (core/spark/FluentAPI.scala:20)."""
+        return estimator.fit(self)
+
+    mlTransform = ml_transform  # reference casing
+    mlFit = ml_fit
+
     def to_pandas(self):
         import pandas as pd
         data = {}

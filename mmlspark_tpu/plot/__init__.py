@@ -1,0 +1,3 @@
+from .plot import confusionMatrix, confusion_matrix, roc, roc_points
+
+__all__ = ["confusionMatrix", "confusion_matrix", "roc", "roc_points"]

@@ -1,0 +1,89 @@
+"""Plotting helpers: confusion matrix + ROC curve.
+
+Reference: src/main/python/mmlspark/plot/plot.py (confusionMatrix :17, roc
+:45) — small matplotlib conveniences over scored DataFrames. Rebuilt over the
+columnar DataFrame: metrics are computed in numpy here (no Spark collect
+round-trip) and rendering degrades gracefully to returning the computed
+arrays when matplotlib is absent.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _counts(y, y_hat, labels: Sequence) -> np.ndarray:
+    idx = {v: i for i, v in enumerate(labels)}
+    cm = np.zeros((len(labels), len(labels)), np.int64)
+    for t, p in zip(np.asarray(y).tolist(), np.asarray(y_hat).tolist()):
+        if t in idx and p in idx:
+            cm[idx[t], idx[p]] += 1
+    return cm
+
+
+def confusion_matrix(df, y_col: str, y_hat_col: str,
+                     labels: Optional[Sequence] = None, ax=None):
+    """Render (or return) the confusion matrix of scored labels.
+
+    Returns (cm [K,K] int64, ax-or-None). With matplotlib available a heatmap
+    with count annotations is drawn; without it, only the matrix is returned.
+    """
+    y = np.asarray(df[y_col])
+    y_hat = np.asarray(df[y_hat_col])
+    if labels is None:
+        labels = sorted(set(y.tolist()) | set(y_hat.tolist()))
+    cm = _counts(y, y_hat, labels)
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return cm, None
+    if ax is None:
+        _, ax = plt.subplots()
+    ax.imshow(cm, cmap="Blues")
+    ax.set_xticks(range(len(labels)), [str(l) for l in labels])
+    ax.set_yticks(range(len(labels)), [str(l) for l in labels])
+    ax.set_xlabel(y_hat_col)
+    ax.set_ylabel(y_col)
+    for i in range(len(labels)):
+        for j in range(len(labels)):
+            ax.text(j, i, str(cm[i, j]), ha="center", va="center",
+                    color="white" if cm[i, j] > cm.max() / 2 else "black")
+    return cm, ax
+
+
+# reference-casing alias (plot.py:17)
+confusionMatrix = confusion_matrix
+
+
+def roc_points(y, scores) -> tuple:
+    """(fpr, tpr, thresholds) without sklearn: sort by score descending and
+    sweep the threshold across unique scores."""
+    y = np.asarray(y).astype(bool)
+    s = np.asarray(scores, np.float64)
+    order = np.argsort(-s)
+    y, s = y[order], s[order]
+    distinct = np.r_[np.flatnonzero(np.diff(s)), y.size - 1]
+    tps = np.cumsum(y)[distinct].astype(np.float64)
+    fps = (distinct + 1) - tps
+    tpr = np.r_[0.0, tps / max(tps[-1], 1.0)]
+    fpr = np.r_[0.0, fps / max(fps[-1], 1.0)]
+    return fpr, tpr, np.r_[np.inf, s[distinct]]
+
+
+def roc(df, y_col: str, y_hat_col: str, ax=None):
+    """Render (or return) the ROC curve for a score column. Returns
+    ((fpr, tpr), ax-or-None)."""
+    fpr, tpr, _ = roc_points(df[y_col], df[y_hat_col])
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return (fpr, tpr), None
+    if ax is None:
+        _, ax = plt.subplots()
+    ax.plot(fpr, tpr)
+    ax.plot([0, 1], [0, 1], linestyle="--")
+    ax.set_xlabel("false positive rate")
+    ax.set_ylabel("true positive rate")
+    return (fpr, tpr), ax

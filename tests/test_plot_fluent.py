@@ -1,0 +1,44 @@
+"""plot helpers (src/main/python/mmlspark/plot/plot.py analogue) + FluentAPI
+sugar (core/spark/FluentAPI.scala:14-20)."""
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.plot import confusionMatrix, roc, roc_points
+
+
+def test_confusion_matrix_counts_and_axes():
+    df = DataFrame({"y": np.array([0, 0, 1, 1, 1]),
+                    "p": np.array([0, 1, 1, 1, 0])})
+    cm, ax = confusionMatrix(df, "y", "p", labels=[0, 1])
+    assert cm.tolist() == [[1, 1], [1, 2]]
+    assert ax is not None
+
+
+def test_roc_matches_sklearn():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 200)
+    s = y * 0.6 + rng.random(200) * 0.7
+    fpr, tpr, _ = roc_points(y, s)
+    from sklearn.metrics import roc_auc_score
+    ours = float(np.trapezoid(tpr, fpr))
+    np.testing.assert_allclose(ours, roc_auc_score(y, s), atol=1e-9)
+    (f2, t2), ax = roc(DataFrame({"y": y, "s": s}), "y", "s")
+    assert ax is not None and len(f2) == len(fpr)
+
+
+def test_fluent_api():
+    from mmlspark_tpu.stages import RenameColumn, SelectColumns
+    df = DataFrame({"a": np.arange(4), "b": np.arange(4) * 2})
+    out = df.ml_transform(RenameColumn(inputCol="a", outputCol="x"),
+                          SelectColumns(cols=["x"]))
+    assert out.columns == ["x"]
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    dtrain = DataFrame({"features": x, "label": x[:, 0].astype(np.float64)})
+    model = dtrain.mlFit(LightGBMRegressor(numIterations=3, numTasks=1))
+    assert "prediction" in model.transform(dtrain)
