@@ -1,0 +1,110 @@
+"""Ring attention: sequence-parallel exact attention over a device mesh.
+
+The long-context primitive for the deep-inference path (models/deep): the
+reference scales deep scoring by replicating the CNTK graph per executor and
+splitting ROWS (cntk/CNTKModel.scala:30-140); the TPU-native scaling axis for
+transformer workloads is the SEQUENCE — shard Q/K/V over the mesh and rotate
+K/V blocks around the ring with `jax.lax.ppermute` (ICI neighbor exchange)
+while accumulating flash-style streaming softmax, so attention over a
+sequence of length S costs each device O(S * S/P) FLOPs and O(S/P) memory
+with communication fully overlappable — no [S, S] score matrix ever exists.
+
+Math (single pass per incoming block, numerically stable):
+    m'   = max(m, rowmax(q k'^T))
+    c    = exp(m - m')
+    p    = exp(q k'^T - m')
+    l'   = l * c + rowsum(p)
+    acc' = acc * c + p v'
+and out = acc / l after all P blocks have visited.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False) -> jax.Array:
+    """Exact single-device attention. q,k,v: [B, S, H, D] -> [B, S, H, D]."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _block_update(q, k_blk, v_blk, m, l, acc, q_pos, k_pos, causal):
+    """One streaming-softmax update with an incoming K/V block."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    if causal:
+        ok = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(ok[None, None], scores, -jnp.inf)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # blocks can be fully masked: keep exp() finite and their weight zero
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    corr = jnp.exp(jnp.where(jnp.isneginf(m), m_new, m) - m_safe)
+    p = jnp.exp(scores - m_safe[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+    return m_new, l_new, acc_new
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           axis_name: str, causal: bool = False) -> jax.Array:
+    """Shard-local ring attention body (call inside shard_map/pjit).
+
+    q, k, v: [B, S_local, H, D] — the local sequence shard, laid out so that
+    device i on `axis_name` holds global positions [i*S_local, (i+1)*S_local).
+    Returns the local [B, S_local, H, D] output shard.
+    """
+    p_count = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+
+    q_pos = idx * s_loc + jnp.arange(s_loc)
+    m0 = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    perm = [(j, (j + 1) % p_count) for j in range(p_count)]
+
+    def step(t, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # after t rotations this device holds the block born on (idx - t) % P
+        src = jnp.mod(idx - t, p_count)
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        m, l, acc = _block_update(q, k_cur, v_cur, m, l, acc,
+                                  q_pos, k_pos, causal)
+        # rotate AFTER consuming; the final rotation is skipped by the loop
+        # bound so every device ends one full cycle with its own block back
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(
+        0, p_count, step, (k, v, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]      # [B,H,S,D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B,S,H,D]
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "data",
+                   causal: bool = False) -> jax.Array:
+    """Driver: shard q/k/v over `axis_name` on the sequence dimension and run
+    the ring. q,k,v: [B, S, H, D] with S divisible by the mesh axis size."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)

@@ -1,0 +1,54 @@
+"""Ring attention (ops/attention.py): sequence-parallel exact attention over
+the 8-device virtual mesh must match the single-device reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.ops.attention import (attention_reference, ring_attention,
+                                        ring_attention_sharded)
+from mmlspark_tpu.parallel import mesh as meshlib
+
+
+def _qkv(b=2, s=64, h=4, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference_8_devices(self, causal):
+        q, k, v = _qkv()
+        mesh = meshlib.get_mesh(8)
+        out = ring_attention(q, k, v, mesh, meshlib.DATA_AXIS, causal=causal)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_single_device_degenerates_to_reference(self):
+        q, k, v = _qkv(s=32)
+        mesh = meshlib.get_mesh(1)
+        out = ring_attention(q, k, v, mesh, meshlib.DATA_AXIS)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_causal_first_row_attends_only_itself(self):
+        q, k, v = _qkv(s=64)
+        mesh = meshlib.get_mesh(8)
+        out = ring_attention(q, k, v, mesh, meshlib.DATA_AXIS, causal=True)
+        # position 0 can only see itself -> output == v[:, 0]
+        np.testing.assert_allclose(np.asarray(out[:, 0]),
+                                   np.asarray(v[:, 0]), rtol=2e-4, atol=2e-4)
+
+    def test_long_sequence_memory_shape(self):
+        # S=1024 over 8 devices: each holds 128; no [S,S] tensor materializes
+        # inside the shard (smoke: runs and matches on a slice)
+        q, k, v = _qkv(b=1, s=1024, h=2, d=8, seed=3)
+        mesh = meshlib.get_mesh(8)
+        out = ring_attention(q, k, v, mesh, meshlib.DATA_AXIS)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-4, atol=3e-4)
