@@ -5,10 +5,13 @@ from .dnn import DNNModel, GraphModel, ImageFeaturizer
 from .image import (ImageSetAugmenter, ImageTransformer,
                     ResizeImageTransformer, UnrollImage)
 from .resnet import ModelDownloader, ModelSchema, ResNet, load_params, save_params
+from .transformer import (TransformerEncoderModel, encoder_forward,
+                          init_encoder_params)
 
 __all__ = [
     "DNNModel", "GraphModel", "ImageFeaturizer",
     "ImageTransformer", "ResizeImageTransformer", "UnrollImage",
     "ImageSetAugmenter",
     "ResNet", "ModelDownloader", "ModelSchema", "load_params", "save_params",
+    "TransformerEncoderModel", "encoder_forward", "init_encoder_params",
 ]

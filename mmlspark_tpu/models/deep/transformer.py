@@ -1,0 +1,147 @@
+"""TransformerEncoder — long-context sequence scoring over the device mesh.
+
+The reference's deep path scales by splitting ROWS across executors and
+evaluating a broadcast CNTK graph per partition (cntk/CNTKModel.scala:30-140).
+Transformer workloads add a second scaling axis the reference never had:
+SEQUENCE length. This module is the TPU-native answer — a flax-free encoder
+stack whose attention runs either dense on one chip or sequence-parallel over
+a mesh axis via ring attention (ops/attention.py: K/V blocks rotating on the
+ICI with flash-style streaming softmax), so contexts far beyond one chip's
+HBM score exactly, not approximately.
+
+`TransformerEncoderModel` is a pipeline stage with the same transform
+contract as DNNModel (padded fixed device batches, feed/fetch columns).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import params as _p
+from ...core.dataframe import DataFrame
+from ...core.pipeline import Model
+from ...ops.attention import attention_reference, ring_attention_sharded
+
+
+def init_encoder_params(key, num_layers: int, d_model: int, num_heads: int,
+                        d_ff: int):
+    """Xavier-initialized parameter pytree for an encoder stack."""
+    def dense(k, fan_in, fan_out):
+        scale = np.sqrt(2.0 / (fan_in + fan_out))
+        return {"w": jax.random.normal(k, (fan_in, fan_out)) * scale,
+                "b": jnp.zeros((fan_out,))}
+
+    layers = []
+    for i in range(num_layers):
+        ks = jax.random.split(jax.random.fold_in(key, i), 6)
+        layers.append({
+            "qkv": dense(ks[0], d_model, 3 * d_model),
+            "proj": dense(ks[1], d_model, d_model),
+            "ff1": dense(ks[2], d_model, d_ff),
+            "ff2": dense(ks[3], d_ff, d_model),
+            "ln1": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+            "ln2": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+        })
+    return {"layers": layers}
+
+
+def _layer_norm(x, p):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * p["g"] + p["b"]
+
+
+def _apply(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def encoder_forward(params, x: jax.Array, num_heads: int,
+                    causal: bool = False,
+                    axis_name: Optional[str] = None) -> jax.Array:
+    """Pre-LN encoder stack. x: [B, S, D] (shard-local S when axis_name is
+    set — every non-attention op is position-wise, so only attention needs
+    the ring)."""
+    b, s, d = x.shape
+    hd = d // num_heads
+    for lp in params["layers"]:
+        h = _layer_norm(x, lp["ln1"])
+        qkv = _apply(lp["qkv"], h).reshape(b, s, 3, num_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if axis_name is None:
+            att = attention_reference(q, k, v, causal=causal)
+        else:
+            att = ring_attention_sharded(q, k, v, axis_name, causal=causal)
+        x = x + _apply(lp["proj"], att.reshape(b, s, d))
+        h = _layer_norm(x, lp["ln2"])
+        x = x + _apply(lp["ff2"], jax.nn.gelu(_apply(lp["ff1"], h)))
+    return x
+
+
+class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
+    """Sequence scorer: inputCol holds [S, D] float sequences (stacked
+    [N, S, D] or object column); outputCol receives the encoded [S, D]
+    sequence (or its mean-pooled [D] vector with pool='mean').
+
+    numTasks > 1 shards the SEQUENCE axis over the mesh and runs ring
+    attention — the long-context path. Weights live host-side in a pytree
+    (`params`), loadable from the downloader/zoo like DNNModel weights.
+    """
+
+    numHeads = _p.Param("numHeads", "attention heads", 4, int)
+    causal = _p.Param("causal", "causal (autoregressive) masking", False)
+    pool = _p.Param("pool", "output pooling: none | mean", "none")
+    numTasks = _p.Param("numTasks",
+                        "sequence-parallel shards; 0/1 = single device", 0,
+                        int)
+    weights = _p.Param("weights", "encoder parameter pytree", None,
+                       complex=True)
+
+    def __init__(self, **kw):
+        super().__init__()
+        kw.setdefault("inputCol", "sequence")
+        kw.setdefault("outputCol", "encoded")
+        self._set(**kw)
+
+    def _forward(self, x: jax.Array) -> jax.Array:
+        from ...parallel import mesh as meshlib
+        p = self.get("weights")
+        if p is None:
+            raise ValueError("TransformerEncoderModel needs `weights` "
+                             "(init_encoder_params or a loaded checkpoint)")
+        nh = self.get("numHeads")
+        causal = self.get("causal")
+        ndev = self.get("numTasks")
+        if ndev and ndev > 1:
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+            mesh = meshlib.get_mesh(ndev)
+            axis = meshlib.DATA_AXIS
+            fn = shard_map(
+                partial(encoder_forward, num_heads=nh, causal=causal,
+                        axis_name=axis),
+                mesh=mesh, in_specs=(P(), P(None, axis, None)),
+                out_specs=P(None, axis, None), check_rep=False)
+            return jax.jit(fn)(p, x)
+        return jax.jit(partial(encoder_forward, num_heads=nh,
+                               causal=causal))(p, x)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        col = df[self.get("inputCol")]
+        if col.dtype == object:
+            x = jnp.asarray(np.stack([np.asarray(v, np.float32)
+                                      for v in col]))
+        else:
+            x = jnp.asarray(np.asarray(col, np.float32))
+        out = np.asarray(self._forward(x))
+        if self.get("pool") == "mean":
+            out = out.mean(axis=1)
+            return df.with_column(self.get("outputCol"), out)
+        obj = np.empty(len(df), dtype=object)
+        for i in range(len(df)):
+            obj[i] = out[i]
+        return df.with_column(self.get("outputCol"), obj)
