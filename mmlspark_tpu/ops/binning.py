@@ -54,14 +54,23 @@ def compute_bin_edges(X: np.ndarray, max_bins: int = 255,
 
 
 def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """Map raw features to bin ids [N, F] (uint8 if max_bins<=256)."""
-    X = np.asarray(X, dtype=np.float64)
-    n, f = X.shape
+    """Map raw features to bin ids [N, F] (uint8 if max_bins<=256).
+
+    Uses the C++ host kernel (utils/native.bin_matrix — the NativeLoader-style
+    data-plane path) when the toolchain is available; identical numpy
+    semantics otherwise (both map NaN to bin 0)."""
     max_bins = edges.shape[1] + 1
-    out = np.empty((n, f), dtype=np.int32)
-    for j in range(f):
-        out[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
-    out[np.isnan(X)] = 0
+    from ..utils import native
+    X = np.asarray(X)
+    # the C++ kernel takes float32 rows; only exact for float32 inputs
+    if X.dtype == np.float32 and native.get_lib() is not None:
+        out = native.bin_matrix(X, edges)
+    else:
+        X = np.asarray(X, dtype=np.float64)
+        out = np.empty(X.shape, dtype=np.int32)
+        for j in range(X.shape[1]):
+            out[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
+        out[np.isnan(X)] = 0
     if max_bins <= 256:
         return out.astype(np.uint8)
     return out
