@@ -106,8 +106,19 @@ def main():
           + rng.normal(scale=1.0, size=n)) > 0).astype(np.float64)
     df = DataFrame({"features": x, "label": y})
 
-    clf = LightGBMClassifier(numIterations=iters, numLeaves=31, maxBin=64,
-                             histChunk=2048, numTasks=1)
+    # measured kernel selection at the bench shape (ops/autotune.py): times
+    # the onehot-scan and pallas candidates on the live chip, picks the winner
+    leaves, bins = 31, 64
+    if on_accel:
+        from mmlspark_tpu.ops.autotune import pick_hist_config
+        hist_method, hist_chunk = pick_hist_config(n, f, bins, leaves,
+                                                   verbose=True)
+    else:
+        hist_method, hist_chunk = "scatter", 512
+
+    clf = LightGBMClassifier(numIterations=iters, numLeaves=leaves,
+                             maxBin=bins, histMethod=hist_method,
+                             histChunk=hist_chunk, numTasks=1)
     # Warm-up = one full fit of the IDENTICAL program (same shapes, same static
     # config), so the timed fit below hits the compile cache and measures
     # execution only.
@@ -126,6 +137,7 @@ def main():
 
     extra = {"wall_s": round(wall, 2), "warm_wall_s": round(warm_wall, 2),
              "n": n, "iters": iters,
+             "hist_kernel": f"{hist_method}/{hist_chunk}",
              "train_auc_sample": round(auc, 4), "device": str(devs[0])}
     error = None
     if init_err is not None:

@@ -122,7 +122,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                               int)
     timeout = Param("timeout", "compat no-op socket timeout", 120.0, float)
     histMethod = Param("histMethod",
-                       "histogram kernel: auto | onehot | scatter | pallas",
+                       "histogram kernel: auto | autotune (measured) | onehot | scatter | pallas",
                        "auto")
     histChunk = Param("histChunk", "rows per histogram chunk", 512, int)
     slotNames = Param("slotNames", "feature slot names", None)
@@ -215,8 +215,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             has_init_score=bool(has_init_score),
             seed=self.get("seed"),
             bagging_seed=self.get("baggingSeed"),
-            hist_method=self.get("histMethod"),
-            hist_chunk=self.get("histChunk"),
+            hist_method=getattr(self, "_hist_method_resolved", None)
+            or self.get("histMethod"),
+            hist_chunk=getattr(self, "_hist_chunk_resolved", None)
+            or self.get("histChunk"),
             categorical_features=tuple(self._categorical_indexes()),
             cat_smooth=self.get("catSmooth"),
             max_cat_threshold=self.get("maxCatThreshold"),
@@ -312,6 +314,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             pm = prev.raw_predict(x)
             margin += pm.reshape(n, -1).astype(np.float32)
             has_init = True
+
+        if self.get("histMethod") == "autotune":
+            # measured kernel selection at the problem's actual shape
+            # (ops/autotune.py); resolved once per fit, cached per backend
+            from ...ops.autotune import pick_hist_config
+            m, c = pick_hist_config(n, f, self.get("maxBin"),
+                                    self.get("numLeaves"))
+            self._hist_method_resolved, self._hist_chunk_resolved = m, c
 
         par = self.get("parallelism")
         if par not in ("serial", "data_parallel", "voting_parallel"):

@@ -116,3 +116,16 @@ def test_random_split_no_row_loss():
     df = DataFrame({"a": np.arange(2000, dtype=np.float64)})
     parts = df.random_split([0.1] * 10, seed=0)
     assert sum(len(p) for p in parts) == 2000
+
+
+def test_autotune_hist_method(binary_df):
+    """histMethod='autotune' resolves to a measured (method, chunk) — on the
+    CPU backend that is the scatter kernel — and trains correctly."""
+    from mmlspark_tpu.ops.autotune import pick_hist_config
+    assert pick_hist_config(10000, 8, 32, 15) == ("scatter", 512)
+    clf = LightGBMClassifier(numIterations=5, numLeaves=7, numTasks=1,
+                             histMethod="autotune")
+    m = clf.fit(binary_df)
+    assert clf._hist_method_resolved == "scatter"
+    out = m.transform(binary_df)
+    assert "prediction" in out
