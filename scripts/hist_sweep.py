@@ -1,0 +1,27 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from functools import partial
+from mmlspark_tpu.ops.histogram import hist_slots_onehot
+from mmlspark_tpu.ops.pallas_kernels import hist_slots_pallas
+print(jax.devices(), flush=True)
+rng = np.random.default_rng(0)
+N, F, B, L = 1_000_000, 28, 64, 31
+binned = jnp.asarray(rng.integers(0, B, (N, F)), jnp.uint8)
+slot = jnp.asarray(rng.integers(0, L, (N,)), jnp.int32)
+gh = jnp.asarray(rng.normal(size=(N, 3)), jnp.float32)
+
+def bench(name, fn):
+    f = jax.jit(fn)
+    t0 = time.perf_counter()
+    out = f(binned, slot, gh); out.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter(); R = 10
+    for _ in range(R): out = f(binned, slot, gh)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / R
+    print(f'{name}: {dt*1e3:.2f} ms/pass (compile {compile_s:.1f}s)', flush=True)
+
+for chunk in (2048, 8192, 32768):
+    bench(f'onehot bf16 chunk={chunk}', partial(hist_slots_onehot, num_slots=L, num_bins=B, chunk=chunk, dtype='bf16'))
+for br in (1024, 2048, 4096, 8192):
+    for ft in (4, 14, 28):
+        bench(f'pallas br={br} ft={ft}', partial(hist_slots_pallas, num_slots=L, num_bins=B, block_rows=br, feat_tile=ft))
