@@ -125,6 +125,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                        "histogram kernel: auto | autotune (measured) | onehot | scatter | pallas",
                        "auto")
     histChunk = Param("histChunk", "rows per histogram chunk", 512, int)
+    histDtype = Param("histDtype",
+                      "MXU operand dtype for the histogram contraction: "
+                      "bf16 (fast, grads rounded ~3 digits) or f32 (exact, "
+                      "bit-reproducible vs the scatter oracle)", "bf16")
     slotNames = Param("slotNames", "feature slot names", None)
     categoricalSlotIndexes = Param("categoricalSlotIndexes",
                                    "indexes of categorical features", None)
@@ -219,6 +223,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             or self.get("histMethod"),
             hist_chunk=getattr(self, "_hist_chunk_resolved", None)
             or self.get("histChunk"),
+            hist_dtype=self.get("histDtype"),
             categorical_features=tuple(self._categorical_indexes()),
             cat_smooth=self.get("catSmooth"),
             max_cat_threshold=self.get("maxCatThreshold"),
