@@ -320,12 +320,16 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             margin += pm.reshape(n, -1).astype(np.float32)
             has_init = True
 
+        if self.get("histDtype") not in ("bf16", "f32"):
+            raise ValueError(
+                f"histDtype must be bf16 or f32, got {self.get('histDtype')!r}")
         if self.get("histMethod") == "autotune":
             # measured kernel selection at the problem's actual shape
             # (ops/autotune.py); resolved once per fit, cached per backend
             from ...ops.autotune import pick_hist_config
             m, c = pick_hist_config(n, f, self.get("maxBin"),
-                                    self.get("numLeaves"))
+                                    self.get("numLeaves"),
+                                    dtype=self.get("histDtype"))
             self._hist_method_resolved, self._hist_chunk_resolved = m, c
 
         par = self.get("parallelism")

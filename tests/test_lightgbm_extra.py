@@ -129,3 +129,12 @@ def test_autotune_hist_method(binary_df):
     assert clf._hist_method_resolved == "scatter"
     out = m.transform(binary_df)
     assert "prediction" in out
+
+
+def test_hist_dtype_validation(binary_df):
+    import pytest
+    with pytest.raises(ValueError, match="histDtype"):
+        LightGBMClassifier(histDtype="bfloat16").fit(binary_df)
+    m = LightGBMClassifier(numIterations=3, numLeaves=7, numTasks=1,
+                           histDtype="f32").fit(binary_df)
+    assert "prediction" in m.transform(binary_df)
