@@ -10,6 +10,11 @@ from .http import (AsyncClient, CustomInputParser, CustomOutputParser,
 from .serving import ServingServer, ServingUDFs, make_reply, parse_request
 from .shared import (PartitionConsolidator, RateLimiter, SharedSingleton,
                      SharedVariable)
+from .streaming import FileStreamSource, StreamingQuery
+from .distributed_serving import (DistributedServingServer, ServiceInfo,
+                                  ServingCoordinator, fetch_routes,
+                                  register_with_retries)
+from .port_forwarding import Forwarder, forward_port_to_remote
 
 __all__ = [
     "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
@@ -20,4 +25,8 @@ __all__ = [
     "SharedSingleton", "SharedVariable", "PartitionConsolidator",
     "RateLimiter",
     "read_binary_files", "read_images", "decode_image", "write_to_powerbi",
+    "FileStreamSource", "StreamingQuery",
+    "ServingCoordinator", "DistributedServingServer", "ServiceInfo",
+    "fetch_routes", "register_with_retries",
+    "Forwarder", "forward_port_to_remote",
 ]
