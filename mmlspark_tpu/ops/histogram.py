@@ -104,6 +104,13 @@ def hist_slots_onehot(binned: jax.Array, slot: jax.Array, gh: jax.Array,
     n, f = binned.shape
     c = gh.shape[1]
     w = num_slots * c
+    # cap the materialized [chunk, F*B] one-hot operand at ~256 MB so wide
+    # problems (large F*B) can't OOM; rounding down to a power of two keeps
+    # padding predictable
+    budget = 256 << 20
+    max_chunk = max(budget // (2 * f * num_bins), 128)
+    if chunk > max_chunk:
+        chunk = 1 << (max_chunk.bit_length() - 1)
     pad = (-n) % chunk
     if pad:
         binned = jnp.pad(binned, ((0, pad), (0, 0)))
