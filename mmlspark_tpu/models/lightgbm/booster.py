@@ -238,6 +238,52 @@ class Booster:
         with open(path, "w") as f:
             f.write(self.model_string())
 
+    def dump_model(self, path: Optional[str] = None) -> str:
+        """Upstream-style JSON model dump (dumpModel,
+        LightGBMBooster.scala:288-296 / C++ `LGBM_BoosterDumpModel`): header +
+        `tree_info` with nested `tree_structure` per tree. Returns the JSON
+        string; also writes it when `path` is given."""
+        import json
+        t_used = self._used_iters()
+        num_tree_per_it = self.num_class if self.multiclass else 1
+        tree_info = []
+        tree_id = 0
+        for t in range(t_used):
+            for k in range(num_tree_per_it):
+                if self.multiclass:
+                    tree = Tree(*[np.asarray(a[t, k]) for a in self.trees])
+                    thr = np.asarray(self.thresholds[t, k])
+                    shift = float(self.init_score[k]) / max(t_used, 1)
+                else:
+                    tree = Tree(*[np.asarray(a[t]) for a in self.trees])
+                    thr = np.asarray(self.thresholds[t])
+                    shift = float(self.init_score) / max(t_used, 1)
+                struct = _tree_to_json(tree, thr, shift)
+                tree_info.append({
+                    "tree_index": tree_id,
+                    "num_leaves": int(np.asarray(tree.split_valid).sum()) + 1,
+                    "shrinkage": 1,
+                    "tree_structure": struct,
+                })
+                tree_id += 1
+        doc = {
+            "name": "tree",
+            "version": "v3",
+            "num_class": self.num_class if self.multiclass else 1,
+            "num_tree_per_iteration": num_tree_per_it,
+            "label_index": 0,
+            "max_feature_idx": self.num_features - 1,
+            "objective": self.objective,
+            "average_output": bool(self.average_output),
+            "feature_names": list(self.feature_names),
+            "tree_info": tree_info,
+        }
+        text = json.dumps(doc, indent=2)
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
     def model_string(self) -> str:
         t_used = self._used_iters()
         num_tree_per_it = self.num_class if self.multiclass else 1
@@ -424,6 +470,53 @@ def _tree_to_text(tree: Tree, thresholds: np.ndarray, tree_id: int,
         str(int(round(c))) for c in lcnt) + "\n")
     out.write("shrinkage=1\n\n")
     return out.getvalue()
+
+
+def _tree_to_json(tree: Tree, thr: np.ndarray, value_shift: float) -> dict:
+    """Nested `tree_structure` dict from the slot representation (upstream
+    `LGBM_BoosterDumpModel` layout: internal nodes carry split fields +
+    left/right_child subdicts, leaves carry leaf_index/value/count). Leaf
+    indices are slot ids (slot 0 = root, split s's right child = slot s+1)."""
+    valid = np.asarray(tree.split_valid).astype(bool)
+    leaf_value = np.asarray(tree.leaf_value, np.float64)
+    leaf_count = np.asarray(tree.leaf_count, np.float64)
+    missing_names = ("None", "Zero", "NaN")
+    root: dict = {"leaf_index": 0}
+    leaves = {0: root}
+    split_index = 0
+    for s in range(len(valid)):
+        if not valid[s]:
+            continue
+        slot = int(np.asarray(tree.split_slot)[s])
+        node = leaves.pop(slot)
+        node.clear()
+        left = {"leaf_index": slot}
+        right = {"leaf_index": s + 1}
+        is_cat = bool(np.asarray(tree.split_is_cat)[s])
+        if is_cat:
+            cats = np.flatnonzero(np.asarray(tree.split_mask)[s])
+            threshold = "||".join(str(int(c)) for c in cats)
+        else:
+            threshold = float(thr[s])
+        node.update({
+            "split_index": split_index,
+            "split_feature": int(np.asarray(tree.split_feat)[s]),
+            "split_gain": float(np.asarray(tree.split_gain)[s]),
+            "threshold": threshold,
+            "decision_type": "==" if is_cat else "<=",
+            "default_left": bool(np.asarray(tree.split_default_left)[s]),
+            "missing_type": missing_names[
+                int(np.asarray(tree.split_missing_type)[s]) % 3],
+            "left_child": left,
+            "right_child": right,
+        })
+        leaves[slot] = left
+        leaves[s + 1] = right
+        split_index += 1
+    for slot, node in leaves.items():
+        node["leaf_value"] = float(leaf_value[slot]) + value_shift
+        node["leaf_count"] = int(round(float(leaf_count[slot])))
+    return root
 
 
 # ---------------------------------------------------------------------------

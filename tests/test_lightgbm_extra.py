@@ -138,3 +138,30 @@ def test_hist_dtype_validation(binary_df):
     m = LightGBMClassifier(numIterations=3, numLeaves=7, numTasks=1,
                            histDtype="f32").fit(binary_df)
     assert "prediction" in m.transform(binary_df)
+
+
+def test_dump_model_json(binary_df, tmp_path):
+    """dumpModel JSON (LightGBMBooster.scala:288-296): header fields, nested
+    tree_structure, and a hand-traversal of tree 0 matching the booster's own
+    routing for one row."""
+    import json
+    m = LightGBMClassifier(numIterations=4, numLeaves=7, numTasks=1,
+                           seed=0).fit(binary_df)
+    p = str(tmp_path / "dump.json")
+    doc = json.loads(m.booster.dump_model(p))
+    assert doc["num_class"] == 1 and doc["name"] == "tree"
+    assert len(doc["tree_info"]) == 4
+    assert doc["max_feature_idx"] == \
+        np.asarray(binary_df["features"]).shape[1] - 1
+    with open(p) as f:
+        assert json.load(f) == doc
+
+    # traverse tree 0 by hand for one row; compare to predict_leaf's slot
+    x = np.asarray(binary_df["features"])[0]
+    node = doc["tree_info"][0]["tree_structure"]
+    while "leaf_index" not in node:
+        v = x[node["split_feature"]]
+        go_left = v <= node["threshold"]
+        node = node["left_child"] if go_left else node["right_child"]
+    leaf = m.booster.predict_leaf(x[None, :])[0, 0]
+    assert node["leaf_index"] == leaf

@@ -48,3 +48,15 @@ class TestTransformerEncoder:
     def test_missing_params_raises(self):
         with pytest.raises(ValueError, match="weights"):
             TransformerEncoderModel().transform(_df(n=1))
+
+
+def test_save_load_roundtrip(params, tmp_path):
+    df = _df(n=2, s=16, d=32)
+    m = TransformerEncoderModel(weights=params)
+    out1 = np.stack(list(m.transform(df)["encoded"]))
+    p = str(tmp_path / "enc")
+    m.save(p)
+    from mmlspark_tpu.core.pipeline import PipelineStage
+    m2 = PipelineStage.load(p)
+    out2 = np.stack(list(m2.transform(df)["encoded"]))
+    np.testing.assert_allclose(out1, out2, rtol=1e-6, atol=1e-6)
