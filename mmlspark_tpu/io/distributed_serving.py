@@ -100,6 +100,15 @@ class ServingCoordinator:
         with self._lock:
             return list(self._routes.get(name, []))
 
+    def deregister(self, name: str, info: ServiceInfo) -> None:
+        """Drop a worker from the routing table (gateway failure detection:
+        a worker whose forward errored is evicted until it re-registers)."""
+        with self._lock:
+            lst = self._routes.get(name)
+            if lst:
+                lst[:] = [s for s in lst
+                          if (s.host, s.port) != (info.host, info.port)]
+
     def _next_worker(self, name: str) -> Optional[ServiceInfo]:
         """Round-robin channel selection (MultiChannelMap.addToNextList,
         DistributedHTTPSource.scala:81-83)."""
@@ -129,21 +138,36 @@ class ServingCoordinator:
                             {"error": str(e)}).encode())
                 elif self.path.startswith("/gateway/"):
                     name = self.path[len("/gateway/"):].strip("/")
-                    worker = outer._next_worker(name)
-                    if worker is None:
-                        self._reply(503, json.dumps(
-                            {"error": f"no workers for {name!r}"}).encode())
-                        return
-                    try:
-                        req = urllib.request.Request(
-                            worker.url, data=body,
-                            headers={"Content-Type": "application/json"})
-                        with urllib.request.urlopen(
-                                req, timeout=outer.forward_timeout) as r:
-                            self._reply(r.status, r.read())
-                    except Exception as e:  # worker down: surface, don't hang
-                        self._reply(502, json.dumps(
-                            {"error": f"forward failed: {e}"}).encode())
+                    # failure detection: a worker that refuses/errors is
+                    # deregistered and the request fails over to the next
+                    # one — bounded by the registered worker count
+                    last_err = "no workers registered"
+                    for _ in range(max(len(outer.routes(name)), 1)):
+                        worker = outer._next_worker(name)
+                        if worker is None:
+                            self._reply(503, json.dumps(
+                                {"error":
+                                 f"no workers for {name!r}: {last_err}"}
+                            ).encode())
+                            return
+                        try:
+                            req = urllib.request.Request(
+                                worker.url, data=body,
+                                headers={"Content-Type": "application/json"})
+                            with urllib.request.urlopen(
+                                    req, timeout=outer.forward_timeout) as r:
+                                self._reply(r.status, r.read())
+                                return
+                        except urllib.error.HTTPError as e:
+                            # worker is ALIVE and answered with an error
+                            # status — surface it, don't evict
+                            self._reply(e.code, e.read())
+                            return
+                        except Exception as e:  # unreachable: evict + retry
+                            last_err = str(e)
+                            outer.deregister(name, worker)
+                    self._reply(502, json.dumps(
+                        {"error": f"forward failed: {last_err}"}).encode())
                 else:
                     self._reply(404, b'{"error": "unknown endpoint"}')
 

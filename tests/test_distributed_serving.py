@@ -209,3 +209,38 @@ class TestLatency:
         # socket + dynamic batcher overhead: keep a sane ceiling so
         # regressions (e.g. accidental retrace per request) get caught
         assert p50 < 50.0
+
+
+class TestFailover:
+    def test_dead_worker_evicted_and_request_fails_over(self):
+        """Gateway failure detection: a dead worker is deregistered and the
+        request retries the next registered worker."""
+        import numpy as np
+        from mmlspark_tpu.io.serving import ServingServer
+
+        coord = ServingCoordinator(forward_timeout=5.0).start()
+        live = ServingServer(lambda df: df.with_column(
+            "prediction", np.ones(len(df))), port=0,
+            max_latency_ms=1.0).start()
+        try:
+            # dead worker registered first: grab a port, then close it
+            import socket as _s
+            sock = _s.socket()
+            sock.bind(("127.0.0.1", 0))
+            dead_port = sock.getsockname()[1]
+            sock.close()
+            coord.register(ServiceInfo("svc", "127.0.0.1", dead_port,
+                                       "m-dead", 0))
+            coord.register(ServiceInfo("svc", "127.0.0.1", live.port,
+                                       "m-live", 0))
+            body = json.dumps({"x": 1.0}).encode()
+            req = urllib.request.Request(
+                coord.url + "/gateway/svc", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                assert r.status == 200
+            # the dead worker is gone from the routing table
+            assert [s.port for s in coord.routes("svc")] == [live.port]
+        finally:
+            live.stop()
+            coord.stop()
