@@ -125,6 +125,16 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                        "histogram kernel: auto | autotune (measured) | onehot | scatter | pallas",
                        "auto")
     histChunk = Param("histChunk", "rows per histogram chunk", 512, int)
+    metric = Param("metric",
+                   "evaluation metric ('' = objective default): l1/mae, "
+                   "l2/mse, rmse, mape, auc, binary_logloss, binary_error, "
+                   "multi_logloss, multi_error, ndcg "
+                   "(LightGBMParams.scala:310-342); auc/ndcg are reported "
+                   "as 1 - value (lower-is-better convention)", "")
+    isProvideTrainingMetric = Param(
+        "isProvideTrainingMetric",
+        "compat: per-iteration train metrics are always computed here and "
+        "surfaced on the fitted model / delegate measures", False)
     histDtype = Param("histDtype",
                       "MXU operand dtype for the histogram contraction: "
                       "bf16 (fast, grads rounded ~3 digits) or f32 (exact, "
@@ -187,6 +197,37 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                       if icol and icol in df else None)
         return x, y, w, is_valid, init_score
 
+    #: reference metric aliases (LightGBMParams.scala:310-342)
+    _METRIC_ALIASES = {
+        "mae": "l1", "mean_absolute_error": "l1", "regression_l1": "l1",
+        "mse": "l2", "mean_squared_error": "l2", "regression_l2": "l2",
+        "regression": "l2", "root_mean_squared_error": "rmse",
+        "l2_root": "rmse", "mean_absolute_percentage_error": "mape",
+        "binary": "binary_logloss", "multiclass": "multi_logloss",
+        "softmax": "multi_logloss", "lambdarank": "ndcg",
+    }
+    _METRICS_BY_KIND = {
+        "binary": ("auc", "binary_logloss", "binary_error"),
+        "multiclass": ("multi_logloss", "multi_error"),
+        "regression": ("l1", "l2", "rmse", "mape"),
+        "ranking": ("ndcg",),
+    }
+
+    def _resolve_metric(self, objective: str, num_class: int) -> str:
+        raw = (self.get("metric") or "").strip().lower()
+        if raw in ("", "none", "na", "null", "custom"):
+            return ""
+        name = self._METRIC_ALIASES.get(raw, raw)
+        kind = ("ranking" if objective == "lambdarank"
+                else "multiclass" if num_class > 1
+                else "binary" if objective == "binary" else "regression")
+        allowed = self._METRICS_BY_KIND[kind]
+        if name not in allowed:
+            raise ValueError(
+                f"metric {raw!r} is not valid for objective {objective!r}; "
+                f"allowed: {allowed} (or '' for the objective default)")
+        return name
+
     def _make_config(self, num_class: int, axis_name: Optional[str],
                      objective: Optional[str] = None,
                      has_init_score: bool = False) -> GBDTConfig:
@@ -230,6 +271,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             axis_name=axis_name,
             tree_learner=self.get("parallelism"),
             top_k=self.get("topK"),
+            eval_metric=self._resolve_metric(
+                objective or self._objective_name(), num_class),
         )
 
     def _categorical_indexes(self):
@@ -432,6 +475,11 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                           self.get("slotNames"), best_iter,
                           self.get("learningRate"),
                           average_output=(self.get("boostingType") == "rf"))
+        # per-iteration eval record (trainCore's eval tracking,
+        # TrainUtils.scala:258-308) — surfaced as model.train_metrics /
+        # valid_metrics
+        booster.train_metric = np.asarray(result.train_metric)
+        booster.valid_metric = np.asarray(result.valid_metric)
         if prev is not None:
             booster = concat_boosters(prev, booster)
         return booster
@@ -546,6 +594,17 @@ class LightGBMModelBase(Model, _p.HasFeaturesCol, _p.HasPredictionCol):
     def __init__(self, booster: Optional[Booster] = None, **kw):
         super().__init__(**kw)
         self.booster = booster
+
+    @property
+    def train_metrics(self) -> Optional[np.ndarray]:
+        """Per-iteration training metric (metric param or objective default);
+        the eval record of TrainUtils.scala:258-308."""
+        return getattr(self.booster, "train_metric", None)
+
+    @property
+    def valid_metrics(self) -> Optional[np.ndarray]:
+        """Per-iteration validation metric (NaN when no validation rows)."""
+        return getattr(self.booster, "valid_metric", None)
 
     def _add_optional_cols(self, df: DataFrame, x: np.ndarray) -> DataFrame:
         """Leaf-index / SHAP output columns (LightGBMClassifier.scala:100-142

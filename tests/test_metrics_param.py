@@ -1,0 +1,67 @@
+"""`metric` param (LightGBMParams.scala:310-342): alias resolution,
+objective compatibility, and in-jit metric values incl. distributed AUC."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import (LightGBMClassifier,
+                                          LightGBMRegressor)
+
+
+@pytest.fixture(scope="module")
+def bdf():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4000, 10)).astype(np.float32)
+    y = ((x @ rng.normal(size=10)) > 0).astype(np.float64)
+    return DataFrame({"features": x, "label": y})
+
+
+class TestMetricParam:
+    def test_auc_metric_tracks_sklearn(self, bdf):
+        clf = LightGBMClassifier(numIterations=15, numLeaves=15, metric="auc",
+                                 numTasks=8)
+        model = clf.fit(bdf)
+        # reported value is 1 - auc (lower-is-better convention)
+        rep = 1.0 - np.asarray(model.train_metrics)[-1]
+        x = np.asarray(bdf["features"])
+        true_auc = roc_auc_score(bdf["label"], model.booster.score(x))
+        assert abs(rep - true_auc) < 0.01, (rep, true_auc)
+
+    def test_binary_error_metric(self, bdf):
+        m = LightGBMClassifier(numIterations=10, numLeaves=15,
+                               metric="binary_error", numTasks=1).fit(bdf)
+        err = np.asarray(m.train_metrics)[-1]
+        out = m.transform(bdf)
+        acc = (out["prediction"] == bdf["label"]).mean()
+        np.testing.assert_allclose(err, 1.0 - acc, atol=1e-6)
+
+    def test_regression_aliases(self, bdf):
+        rng = np.random.default_rng(1)
+        y = np.asarray(bdf["features"])[:, 0].astype(np.float64)
+        df = bdf.with_column("label", y)
+        m1 = LightGBMRegressor(numIterations=5, metric="mae",
+                               numTasks=1).fit(df)
+        m2 = LightGBMRegressor(numIterations=5, metric="l1",
+                               numTasks=1).fit(df)
+        np.testing.assert_allclose(m1.train_metrics, m2.train_metrics)
+        mr = LightGBMRegressor(numIterations=5, metric="rmse",
+                               numTasks=1).fit(df)
+        ml2 = LightGBMRegressor(numIterations=5, metric="l2",
+                                numTasks=1).fit(df)
+        np.testing.assert_allclose(np.asarray(mr.train_metrics) ** 2,
+                                   ml2.train_metrics, rtol=1e-4)
+
+    def test_incompatible_metric_raises(self, bdf):
+        with pytest.raises(ValueError, match="not valid for objective"):
+            LightGBMClassifier(metric="l2").fit(bdf)
+
+    def test_early_stopping_on_auc(self, bdf):
+        rng = np.random.default_rng(2)
+        is_val = rng.random(len(bdf)) < 0.3
+        df = bdf.with_column("val", is_val)
+        m = LightGBMClassifier(numIterations=60, metric="auc",
+                               validationIndicatorCol="val",
+                               earlyStoppingRound=5, numTasks=1).fit(df)
+        assert m.booster.best_iteration is not None
