@@ -475,13 +475,21 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                           self.get("slotNames"), best_iter,
                           self.get("learningRate"),
                           average_output=(self.get("boostingType") == "rf"))
-        # per-iteration eval record (trainCore's eval tracking,
-        # TrainUtils.scala:258-308) — surfaced as model.train_metrics /
-        # valid_metrics
-        booster.train_metric = np.asarray(result.train_metric)
-        booster.valid_metric = np.asarray(result.valid_metric)
         if prev is not None:
             booster = concat_boosters(prev, booster)
+        # per-iteration eval record (trainCore's eval tracking,
+        # TrainUtils.scala:258-308) — surfaced as model.train_metrics /
+        # valid_metrics; attached AFTER concat (which builds a fresh Booster)
+        # and appended to the previous batches' record for batch/warm-start
+        # training
+        tm = np.asarray(result.train_metric)
+        vm = np.asarray(result.valid_metric)
+        prev_tm = getattr(prev, "train_metric", None)
+        prev_vm = getattr(prev, "valid_metric", None)
+        booster.train_metric = (np.concatenate([prev_tm, tm])
+                                if prev_tm is not None else tm)
+        booster.valid_metric = (np.concatenate([prev_vm, vm])
+                                if prev_vm is not None else vm)
         return booster
 
     def _run_chunked(self, run_chunk, key, n_rows: int, k: int, rounds: int,

@@ -266,6 +266,9 @@ class Booster:
                     "tree_structure": struct,
                 })
                 tree_id += 1
+        obj_str = {"binary": "binary sigmoid:1",
+                   "multiclass": f"multiclass num_class:{self.num_class}",
+                   }.get(self.objective, self.objective)
         doc = {
             "name": "tree",
             "version": "v3",
@@ -273,7 +276,7 @@ class Booster:
             "num_tree_per_iteration": num_tree_per_it,
             "label_index": 0,
             "max_feature_idx": self.num_features - 1,
-            "objective": self.objective,
+            "objective": obj_str,
             "average_output": bool(self.average_output),
             "feature_names": list(self.feature_names),
             "tree_info": tree_info,

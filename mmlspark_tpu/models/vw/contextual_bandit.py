@@ -116,12 +116,16 @@ class VowpalWabbitContextualBandit(VowpalWabbitBase, _p.HasPredictionCol):
         {paramName: value} dict applied over this estimator's settings."""
         from concurrent.futures import ThreadPoolExecutor
 
+        pms = list(param_maps)
+        if not pms:
+            return []
+
         def one(pm):
             est = self.copy(dict(pm))
             return est.fit(df)
 
-        with ThreadPoolExecutor(max_workers=min(len(param_maps), 8)) as ex:
-            return list(ex.map(one, list(param_maps)))
+        with ThreadPoolExecutor(max_workers=min(len(pms), 8)) as ex:
+            return list(ex.map(one, pms))
 
     parallelFit = parallel_fit
 

@@ -150,6 +150,7 @@ def test_dump_model_json(binary_df, tmp_path):
     p = str(tmp_path / "dump.json")
     doc = json.loads(m.booster.dump_model(p))
     assert doc["num_class"] == 1 and doc["name"] == "tree"
+    assert doc["objective"] == "binary sigmoid:1"
     assert len(doc["tree_info"]) == 4
     assert doc["max_feature_idx"] == \
         np.asarray(binary_df["features"]).shape[1] - 1

@@ -65,3 +65,12 @@ class TestMetricParam:
                                validationIndicatorCol="val",
                                earlyStoppingRound=5, numTasks=1).fit(df)
         assert m.booster.best_iteration is not None
+
+
+def test_metrics_survive_batch_training(bdf):
+    """numBatches training concatenates per-batch eval records instead of
+    dropping them in concat_boosters (round-2 review finding)."""
+    m = LightGBMClassifier(numIterations=4, numLeaves=7, numTasks=1,
+                           numBatches=2).fit(bdf)
+    tm = m.train_metrics
+    assert tm is not None and len(tm) == 8  # 4 iters x 2 batches

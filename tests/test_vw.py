@@ -190,10 +190,11 @@ def test_contextual_bandit_parallel_fit():
                     "cost": rng.normal(size=n).astype(np.float32)})
     cb = VowpalWabbitContextualBandit(numPasses=1, numBits=8,
                                       sharedCol="nope")
-    models = cb.parallel_fit(df, [{"learningRate": 0.1},
-                                  {"learningRate": 0.5}])
+    models = cb.parallel_fit(df, [{"learningRate": 0.11},
+                                  {"learningRate": 0.77}])
     assert len(models) == 2
     for m in models:
         assert m.get_contextual_bandit_metrics() is not None
-    # estimator's own params untouched by the per-map copies
-    assert cb.get("learningRate") not in (0.1, 0.5) or True
+    # per-map copies must not mutate the source estimator
+    assert cb.get("learningRate") not in (0.11, 0.77)
+    assert cb.parallel_fit(df, []) == []
