@@ -1,27 +1,85 @@
-import time, numpy as np, jax, jax.numpy as jnp
-from functools import partial
-from mmlspark_tpu.ops.histogram import hist_slots_onehot
-from mmlspark_tpu.ops.pallas_kernels import hist_slots_pallas
-print(jax.devices(), flush=True)
-rng = np.random.default_rng(0)
-N, F, B, L = 1_000_000, 28, 64, 31
-binned = jnp.asarray(rng.integers(0, B, (N, F)), jnp.uint8)
-slot = jnp.asarray(rng.integers(0, L, (N,)), jnp.int32)
-gh = jnp.asarray(rng.normal(size=(N, 3)), jnp.float32)
+"""TPU histogram-kernel sweep: measured operating table for docs/KERNELS.md.
 
-def bench(name, fn):
-    f = jax.jit(fn)
+Times every (method, chunk, dtype) candidate of the all-slots histogram at
+bench shapes on the live backend, prints a markdown table, then times one
+full LightGBMClassifier.fit at the winning config. Run on a real chip; on
+CPU it still works but measures the scatter path (see docs/KERNELS.md)."""
+
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.histogram import hist_slots
+
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev})", flush=True)
+    rng = np.random.default_rng(0)
+    n, f, b, l = 1_000_000, 28, 64, 31
+    binned = jnp.asarray(rng.integers(0, b, (n, f)), jnp.uint8)
+    slot = jnp.asarray(rng.integers(0, l, (n,)), jnp.int32)
+    gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+
+    candidates = [("onehot", c, d) for c in (2048, 8192, 32768)
+                  for d in ("bf16", "f32")]
+    candidates += [("pallas", c, d) for c in (1024, 2048, 4096, 8192)
+                   for d in ("bf16", "f32")]
+    if dev.platform == "cpu":
+        candidates.append(("scatter", 512, "f32"))
+
+    rows = []
+    for method, chunk, dtype in candidates:
+        try:
+            fn = jax.jit(lambda bi, sl, g, m=method, c=chunk, d=dtype:
+                         hist_slots(bi, sl, g, l, b, m, c, d))
+            t0 = time.perf_counter()
+            fn(binned, slot, gh).block_until_ready()
+            compile_s = time.perf_counter() - t0
+            reps = 10
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(binned, slot, gh)
+            out.block_until_ready()
+            ms = (time.perf_counter() - t0) / reps * 1e3
+            rows.append((method, chunk, dtype, ms, compile_s))
+            print(f"  {method:7s} chunk={chunk:<6d} {dtype}: "
+                  f"{ms:8.2f} ms/pass (compile {compile_s:.1f}s)", flush=True)
+        except Exception as e:  # noqa: BLE001 - variant may not lower
+            print(f"  {method:7s} chunk={chunk:<6d} {dtype}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
+    rows.sort(key=lambda r: r[3])
+    print(f"\n| method | chunk | dtype | ms/pass ({n//1000}k x {f}, "
+          f"B={b}, L={l}) |")
+    print("|---|---|---|---|")
+    for method, chunk, dtype, ms, _ in rows:
+        print(f"| {method} | {chunk} | {dtype} | {ms:.2f} |")
+
+    best = rows[0]
+    print(f"\nwinner: {best[0]} chunk={best[1]} {best[2]}", flush=True)
+
+    # one full fit at the winner (100 iters, the bench problem)
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((x @ rng.normal(size=f)) > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    clf = LightGBMClassifier(numIterations=100, numLeaves=l, maxBin=b,
+                             histMethod=best[0], histChunk=best[1],
+                             histDtype=best[2], numTasks=1)
     t0 = time.perf_counter()
-    out = f(binned, slot, gh); out.block_until_ready()
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter(); R = 10
-    for _ in range(R): out = f(binned, slot, gh)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / R
-    print(f'{name}: {dt*1e3:.2f} ms/pass (compile {compile_s:.1f}s)', flush=True)
+    clf.fit(df)
+    print(f"fit #1 (compile+run): {time.perf_counter() - t0:.1f}s",
+          flush=True)
+    t0 = time.perf_counter()
+    clf.fit(df)
+    wall = time.perf_counter() - t0
+    print(f"fit #2 (run): {wall:.1f}s = "
+          f"{n * 100 / wall / 1e6:.2f}M rows*iter/s", flush=True)
 
-for chunk in (2048, 8192, 32768):
-    bench(f'onehot bf16 chunk={chunk}', partial(hist_slots_onehot, num_slots=L, num_bins=B, chunk=chunk, dtype='bf16'))
-for br in (1024, 2048, 4096, 8192):
-    for ft in (4, 14, 28):
-        bench(f'pallas br={br} ft={ft}', partial(hist_slots_pallas, num_slots=L, num_bins=B, block_rows=br, feat_tile=ft))
+
+if __name__ == "__main__":
+    main()
