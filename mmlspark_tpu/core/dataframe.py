@@ -19,7 +19,14 @@ from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Seque
 
 
 def _as_column(values: Any) -> np.ndarray:
-    """Coerce arbitrary input into a numpy column (1-D scalars or 2-D vectors)."""
+    """Coerce arbitrary input into a numpy column (1-D scalars or 2-D vectors).
+
+    scipy.sparse matrices densify on ingestion — the CSR marshalling path of
+    the reference (LightGBMUtils.scala:201-265 `LGBM_DatasetCreateFromCSR`):
+    the TPU data plane is dense (the binned matrix in HBM is dense uint8), so
+    sparsity is a host-ingestion format, not a device layout."""
+    if hasattr(values, "toarray") and hasattr(values, "tocsr"):
+        return np.asarray(values.toarray())
     if isinstance(values, np.ndarray):
         if values.dtype.kind == "U":  # normalize strings to object dtype
             return values.astype(object)

@@ -182,7 +182,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 
     def _extract_xyw(self, df: DataFrame
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        x = np.asarray(df[self.get("featuresCol")], np.float32)
+        x = df[self.get("featuresCol")]
+        if x.dtype == object and len(x) and hasattr(x[0], "toarray"):
+            # per-row scipy sparse vectors (the reference's sparse dataset
+            # path, LightGBMUtils.scala:201-265) densify at ingestion
+            x = np.vstack([np.asarray(r.toarray(), np.float32).ravel()
+                           for r in x])
+        x = np.asarray(x, np.float32)
         if x.ndim != 2:
             raise ValueError("featuresCol must be a 2-D vector column")
         y = np.asarray(df[self.get("labelCol")])

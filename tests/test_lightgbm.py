@@ -342,3 +342,32 @@ class TestShardRobustness:
         p1 = m.booster.raw_predict(x)
         p2 = m2.booster.raw_predict(x)
         np.testing.assert_allclose(p1, p2, rtol=1e-2, atol=1e-2)
+
+
+def test_sparse_features_ingestion():
+    """scipy CSR matrices and per-row sparse vectors train identically to
+    their dense equivalents (LGBM_DatasetCreateFromCSR path,
+    LightGBMUtils.scala:201-265)."""
+    import scipy.sparse as sp
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2000, 8)).astype(np.float32)
+    x[rng.random(x.shape) < 0.7] = 0.0          # sparse-ish
+    y = ((x @ rng.normal(size=8)) > 0).astype(np.float64)
+    dense = LightGBMClassifier(numIterations=5, numLeaves=7, numTasks=1,
+                               seed=1).fit(DataFrame({"features": x,
+                                                      "label": y}))
+    # whole-column CSR
+    m1 = LightGBMClassifier(numIterations=5, numLeaves=7, numTasks=1,
+                            seed=1).fit(DataFrame({"features": sp.csr_matrix(x),
+                                                   "label": y}))
+    # object column of per-row sparse vectors
+    rows = np.empty(len(x), dtype=object)
+    for i in range(len(x)):
+        rows[i] = sp.csr_matrix(x[i])
+    m2 = LightGBMClassifier(numIterations=5, numLeaves=7, numTasks=1,
+                            seed=1).fit(DataFrame({"features": rows,
+                                                   "label": y}))
+    np.testing.assert_allclose(dense.booster.raw_predict(x),
+                               m1.booster.raw_predict(x), rtol=1e-6)
+    np.testing.assert_allclose(dense.booster.raw_predict(x),
+                               m2.booster.raw_predict(x), rtol=1e-6)
