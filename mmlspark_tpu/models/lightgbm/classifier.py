@@ -22,6 +22,12 @@ from .booster import Booster
 class LightGBMClassifier(LightGBMParamsBase, _p.HasProbabilityCol,
                          _p.HasRawPredictionCol):
 
+    isUnbalance = _p.Param(
+        "isUnbalance",
+        "binary only: reweight training rows so both classes carry equal "
+        "total weight (upstream is_unbalance: positives scaled by "
+        "sum_neg/sum_pos; LightGBMClassifier.scala:32-36)", False)
+
     def __init__(self, **kw):
         super().__init__(**kw)
         if not self.is_set("objective"):
@@ -37,6 +43,15 @@ class LightGBMClassifier(LightGBMParamsBase, _p.HasProbabilityCol,
         objective = "binary" if num_class <= 2 else "multiclass"
         if num_class <= 2:
             num_class = 2
+        if self.get("isUnbalance"):
+            if objective != "binary":
+                raise ValueError("isUnbalance applies to binary objectives "
+                                 "only (upstream LightGBM restriction)")
+            train_mask = ~np.asarray(is_valid, bool)
+            pos = float(np.sum(w[train_mask & (labels > 0.5)]))
+            neg = float(np.sum(w[train_mask & (labels <= 0.5)]))
+            if pos > 0 and neg > 0:
+                w = np.where(labels > 0.5, w * (neg / pos), w).astype(w.dtype)
         booster = self._train_booster(
             x, labels.astype(np.int32) if num_class > 2 else labels,
             w, is_valid, num_class if num_class > 2 else 1,

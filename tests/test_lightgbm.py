@@ -371,3 +371,27 @@ def test_sparse_features_ingestion():
                                m1.booster.raw_predict(x), rtol=1e-6)
     np.testing.assert_allclose(dense.booster.raw_predict(x),
                                m2.booster.raw_predict(x), rtol=1e-6)
+
+
+def test_is_unbalance_recovers_minority_recall():
+    """isUnbalance (LightGBMClassifier.scala:32-36): equalizing class weight
+    mass lifts minority-class recall on a skewed dataset."""
+    rng = np.random.default_rng(4)
+    n = 6000
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    margin = x @ rng.normal(size=8) - 2.2          # ~5-10% positives
+    y = (margin + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    kw = dict(numIterations=30, numLeaves=15, numTasks=1, seed=0)
+    plain = LightGBMClassifier(**kw).fit(df).transform(df)
+    bal = LightGBMClassifier(isUnbalance=True, **kw).fit(df).transform(df)
+
+    def recall(out):
+        pred = np.asarray(out["prediction"])
+        return (pred[y > 0.5] > 0.5).mean()
+
+    assert recall(bal) > recall(plain)
+    import pytest
+    with pytest.raises(ValueError, match="isUnbalance"):
+        LightGBMClassifier(isUnbalance=True, **kw).fit(
+            df.with_column("label", (y + (x[:, 0] > 1) * 1).astype(np.float64)))
