@@ -79,8 +79,28 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
     binSampleCount = Param("binSampleCount",
                            "rows sampled for quantile bin edges", 200000, int)
     baggingFraction = Param("baggingFraction", "row subsample fraction", 1.0, float)
+    posBaggingFraction = Param("posBaggingFraction",
+                               "positive-class bagging fraction (binary; "
+                               "<0 = follow baggingFraction)", -1.0, float)
+    negBaggingFraction = Param("negBaggingFraction",
+                               "negative-class bagging fraction (binary; "
+                               "<0 = follow baggingFraction)", -1.0, float)
     baggingFreq = Param("baggingFreq", "bagging frequency (0=off)", 0, int)
     baggingSeed = Param("baggingSeed", "bagging seed", 3, int)
+    boostFromAverage = Param("boostFromAverage",
+                             "start boosting from the label mean "
+                             "(upstream boost_from_average)", True)
+    maxDeltaStep = Param("maxDeltaStep",
+                         "cap on |leaf output| before shrinkage; 0 = off "
+                         "(upstream max_delta_step)", 0.0, float)
+    maxBinByFeature = Param("maxBinByFeature",
+                            "per-feature bin budgets (list of ints, <= "
+                            "maxBin; empty = all features use maxBin)", None)
+    improvementTolerance = Param(
+        "improvementTolerance",
+        "early-stopping tolerance: validation metric counts as improved when "
+        "score - best < tolerance (TrainUtils.scala:287-298 comparator)", 0.0,
+        float)
     featureFraction = Param("featureFraction", "feature subsample per tree", 1.0,
                             float)
     maxDepth = Param("maxDepth", "max tree depth (<=0 = unlimited)", -1, int)
@@ -120,6 +140,9 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
     defaultListenPort = Param("defaultListenPort",
                               "compat no-op: no socket rendezvous on TPU", 12400,
                               int)
+    driverListenPort = Param("driverListenPort",
+                             "compat no-op: no driver rendezvous on TPU", 0,
+                             int)
     timeout = Param("timeout", "compat no-op socket timeout", 120.0, float)
     histMethod = Param("histMethod",
                        "histogram kernel: auto | autotune (measured) | onehot | scatter | pallas",
@@ -257,7 +280,11 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             min_gain_to_split=self.get("minGainToSplit"),
             bagging_fraction=self.get("baggingFraction"),
             bagging_freq=self.get("baggingFreq"),
+            pos_bagging_fraction=self.get("posBaggingFraction"),
+            neg_bagging_fraction=self.get("negBaggingFraction"),
             feature_fraction=self.get("featureFraction"),
+            max_delta_step=self.get("maxDeltaStep"),
+            boost_from_average=self.get("boostFromAverage"),
             num_class=num_class,
             objective=objective or self._objective_name(),
             top_rate=self.get("topRate"),
@@ -351,9 +378,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         _bi = getattr(self, "_batch_index", 0)
         if _dlg is not None:
             _dlg.before_generate_train_dataset(_bi, self)
+        mbbf = self.get("maxBinByFeature")
         bm = BinMapper.fit(x, self.get("maxBin"), self.get("binSampleCount"),
                            self.get("seed"),
-                           categorical=tuple(self._categorical_indexes()))
+                           categorical=tuple(self._categorical_indexes()),
+                           max_bins_by_feature=(
+                               np.asarray(mbbf, np.int64) if mbbf is not None
+                               and len(mbbf) else None))
         binned = bm.transform(x)
         if _dlg is not None:
             _dlg.after_generate_train_dataset(_bi, self)
@@ -537,11 +568,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             all_trees.append(jax.tree.map(np.asarray, trees_c))
             all_tm.append(tm_c)
             all_vm.append(vm_c)
+            tol = self.get("improvementTolerance")
             for j in range(c):
                 i = done + j
                 if rounds and has_valid and not stopped:
                     v = vm_c[j]
-                    if v < best:
+                    # reference comparator (TrainUtils.scala:287-298):
+                    # lower-is-better improves when score - best < tolerance
+                    if best == np.inf or v - best < tol:
                         best, best_at = v, i
                     elif i - best_at >= rounds:
                         stopped = True
@@ -572,9 +606,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         # metric hasn't improved for `rounds` iterations, keeping the best iteration.
         # Training runs the full scan here, so find the first stall point and
         # truncate to the best iteration seen before it.
+        tol = self.get("improvementTolerance")
         best, best_at = np.inf, 0
         for i, v in enumerate(vm):
-            if v < best:
+            if best == np.inf or v - best < tol:
                 best, best_at = v, i
             elif i - best_at >= rounds:
                 break

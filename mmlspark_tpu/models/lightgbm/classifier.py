@@ -70,6 +70,12 @@ class LightGBMClassificationModel(LightGBMModelBase, _p.HasProbabilityCol,
         super().__init__(booster=booster, **kw)
         self.set("numClass", num_class)
 
+    def get_actual_num_classes(self) -> int:
+        """getActualNumClasses (LightGBMClassifier.scala model surface)."""
+        return self.get("numClass")
+
+    getActualNumClasses = get_actual_num_classes
+
     def transform(self, df: DataFrame) -> DataFrame:
         x = np.asarray(df[self.get("featuresCol")], np.float32)
         raw = self.booster.raw_predict(x)

@@ -18,12 +18,17 @@ import numpy as np
 
 
 def compute_bin_edges(X: np.ndarray, max_bins: int = 255,
-                      sample_count: int = 200_000, seed: int = 0) -> np.ndarray:
+                      sample_count: int = 200_000, seed: int = 0,
+                      max_bins_by_feature: Optional[np.ndarray] = None
+                      ) -> np.ndarray:
     """Per-feature quantile bin upper-edges.
 
     Returns edges [F, max_bins-1]; feature f's bin id = searchsorted(edges[f], x, 'left'),
     i.e. x <= edges[f][b] falls in bin <= b. Features with < max_bins distinct values get
     exact-value edges (padded with +inf), preserving categorical-as-int behavior.
+    max_bins_by_feature (maxBinByFeature, LightGBMParams.scala): optional
+    per-feature bin budget (<= max_bins); 0/negative entries mean "use
+    max_bins".
     """
     X = np.asarray(X, dtype=np.float64)
     n, f = X.shape
@@ -34,19 +39,22 @@ def compute_bin_edges(X: np.ndarray, max_bins: int = 255,
     else:
         sample = X
     edges = np.full((f, max_bins - 1), np.inf, dtype=np.float64)
-    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
     for j in range(f):
+        mb = max_bins
+        if max_bins_by_feature is not None and max_bins_by_feature[j] > 0:
+            mb = min(int(max_bins_by_feature[j]), max_bins)
         col = sample[:, j]
         col = col[~np.isnan(col)]
         if col.size == 0:
             continue
         uniq = np.unique(col)
-        if uniq.size <= max_bins:
+        if uniq.size <= mb:
             # exact edges midway between consecutive distinct values
             if uniq.size > 1:
                 mids = (uniq[:-1] + uniq[1:]) / 2.0
                 edges[j, :mids.size] = mids
         else:
+            qs = np.linspace(0, 1, mb + 1)[1:-1]
             q = np.quantile(col, qs)
             q = np.unique(q)
             edges[j, :q.size] = q
@@ -105,7 +113,8 @@ class BinMapper:
     @staticmethod
     def fit(X: np.ndarray, max_bins: int = 255, sample_count: int = 200_000,
             seed: int = 0,
-            categorical: Optional[Tuple[int, ...]] = None) -> "BinMapper":
+            categorical: Optional[Tuple[int, ...]] = None,
+            max_bins_by_feature: Optional[np.ndarray] = None) -> "BinMapper":
         if categorical:
             X = np.asarray(X)
             for j in categorical:
@@ -116,7 +125,8 @@ class BinMapper:
                         f"categorical feature {j} has {int(top) + 1} codes but "
                         f"maxBin={max_bins}; codes >= {max_bins} are clipped "
                         f"into one bin (raise maxBin to keep them distinct)")
-        return BinMapper(compute_bin_edges(X, max_bins, sample_count, seed),
+        return BinMapper(compute_bin_edges(X, max_bins, sample_count, seed,
+                                           max_bins_by_feature),
                          categorical)
 
     def transform(self, X: np.ndarray) -> np.ndarray:

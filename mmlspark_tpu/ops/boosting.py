@@ -51,7 +51,12 @@ class GBDTConfig(NamedTuple):
     min_gain_to_split: float = 0.0
     bagging_fraction: float = 1.0
     bagging_freq: int = 0
+    # class-specific bagging (binary): keep probability per class; < 0 means
+    # follow bagging_fraction (posBaggingFraction/negBaggingFraction)
+    pos_bagging_fraction: float = -1.0
+    neg_bagging_fraction: float = -1.0
     feature_fraction: float = 1.0
+    max_delta_step: float = 0.0  # >0: cap |leaf output| (maxDeltaStep)
     num_class: int = 1
     objective: str = "regression"
     boost_from_average: bool = True
@@ -388,9 +393,13 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     else:
         sums = carry[11]                                       # carried g_sums
 
-    leaf_value = (_leaf_output(sums[:, 0], sums[:, 1], cfg.lambda_l1,
-                               cfg.lambda_l2)
-                  * jnp.float32(cfg.learning_rate))
+    raw_out = _leaf_output(sums[:, 0], sums[:, 1], cfg.lambda_l1,
+                           cfg.lambda_l2)
+    if cfg.max_delta_step > 0:
+        # maxDeltaStep: cap the unshrunk leaf output (upstream max_delta_step,
+        # the poisson/unbalanced-logit stabilizer)
+        raw_out = jnp.clip(raw_out, -cfg.max_delta_step, cfg.max_delta_step)
+    leaf_value = raw_out * jnp.float32(cfg.learning_rate)
     # slots that never received rows keep value 0 (their sums are 0)
     # NaN bins to bin 0 (binning.py) => numeric splits carry default_left=True
     # + missing_type NaN (decision_type 2|8); categorical splits carry missing
@@ -675,15 +684,31 @@ def make_train_fn(cfg: GBDTConfig):
                 g, h = g[:, None], h[:, None]
 
             row_w = w
+            class_bag = (cfg.pos_bagging_fraction >= 0.0
+                         or cfg.neg_bagging_fraction >= 0.0)
             if cfg.boosting_type == "goss":
                 g_tot = jnp.abs(g).sum(axis=1) * jnp.where(w > 0, 1.0, 0.0)
                 row_w = w * _goss_weights(k_bag, g_tot, cfg)
-            elif cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0:
+            elif (cfg.bagging_freq > 0
+                  and (cfg.bagging_fraction < 1.0 or class_bag)):
                 window = it // cfg.bagging_freq
                 k_window = jax.random.fold_in(
                     jax.random.PRNGKey(cfg.bagging_seed), window)
-                sub = jax.random.bernoulli(
-                    k_window, cfg.bagging_fraction, (n,)).astype(jnp.float32)
+                if class_bag:
+                    # per-class keep probability (pos/negBaggingFraction)
+                    p_pos = (cfg.pos_bagging_fraction
+                             if cfg.pos_bagging_fraction >= 0.0
+                             else cfg.bagging_fraction)
+                    p_neg = (cfg.neg_bagging_fraction
+                             if cfg.neg_bagging_fraction >= 0.0
+                             else cfg.bagging_fraction)
+                    u = jax.random.uniform(k_window, (n,))
+                    keep = u < jnp.where(yf > 0.5, p_pos, p_neg)
+                    sub = keep.astype(jnp.float32)
+                else:
+                    sub = jax.random.bernoulli(
+                        k_window, cfg.bagging_fraction,
+                        (n,)).astype(jnp.float32)
                 row_w = w * sub
 
             if cfg.feature_fraction < 1.0:

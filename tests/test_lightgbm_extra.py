@@ -166,3 +166,36 @@ def test_dump_model_json(binary_df, tmp_path):
         node = node["left_child"] if go_left else node["right_child"]
     leaf = m.booster.predict_leaf(x[None, :])[0, 0]
     assert node["leaf_index"] == leaf
+
+
+def test_new_param_surface(binary_df):
+    """Round-2 param additions: maxDeltaStep caps leaf values, class-specific
+    bagging trains, boostFromAverage=False starts from 0, maxBinByFeature
+    restricts a feature's bin budget, improvementTolerance accepted."""
+    import numpy as np
+    m = LightGBMClassifier(numIterations=5, numLeaves=7, numTasks=1,
+                           maxDeltaStep=0.01, learningRate=0.1).fit(binary_df)
+    lv = np.asarray(m.booster.trees.leaf_value)
+    assert np.abs(lv).max() <= 0.01 * 0.1 + 1e-6
+
+    m2 = LightGBMClassifier(numIterations=5, numLeaves=7, numTasks=1,
+                            baggingFreq=1, posBaggingFraction=0.9,
+                            negBaggingFraction=0.3).fit(binary_df)
+    assert "prediction" in m2.transform(binary_df)
+
+    m3 = LightGBMClassifier(numIterations=2, numLeaves=7, numTasks=1,
+                            boostFromAverage=False).fit(binary_df)
+    assert float(m3.booster.init_score) == 0.0
+
+    f = np.asarray(binary_df["features"]).shape[1]
+    m4 = LightGBMClassifier(numIterations=2, numLeaves=7, numTasks=1,
+                            maxBin=63,
+                            maxBinByFeature=[2] + [0] * (f - 1)).fit(binary_df)
+    from mmlspark_tpu.ops.binning import num_used_bins
+    used = num_used_bins(m4.booster.bin_mapper.edges)
+    assert used[0] <= 2 and used[1:].max() > 2
+
+    m5 = LightGBMClassifier(numIterations=10, numTasks=1,
+                            improvementTolerance=1e-4).fit(binary_df)
+    assert "prediction" in m5.transform(binary_df)
+    assert m.get_actual_num_classes() == 2
