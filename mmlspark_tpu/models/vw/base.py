@@ -286,6 +286,20 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
 
     _loss = "squared"  # subclass override
 
+    initialModel = _p.Param(
+        "initialModel",
+        "warm-start from a fitted VowpalWabbit model (its weight table seeds "
+        "training; numBits must match) — the reference's initialModel model "
+        "bytes (VowpalWabbitBase.scala)", None, complex=True)
+    performanceStatistics = _p.Param(
+        "performanceStatistics",
+        "compat: per-partition perf stats are always collected and exposed "
+        "via the model's get_performance_statistics()", False)
+    testArgs = _p.Param(
+        "testArgs", "compat: extra VW CLI args applied at test/transform "
+        "time in the reference; prediction here is a pure jit forward pass",
+        "")
+
     def _extract(self, df: DataFrame) -> Tuple[SparseFeatures, np.ndarray,
                                                np.ndarray]:
         eff = self._effective_params()
@@ -318,7 +332,27 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
         t_ingest = time.perf_counter_ns()
         idx, val, yy, ww = pad_examples(
             feats.indices, feats.values, y, w, mb * max(ntasks, 1))
-        state = init_state(nf)
+        init_m = self.get("initialModel")
+        if init_m is not None:
+            if isinstance(init_m, VWState):
+                prev_w = np.asarray(init_m.w)
+                prev_b = float(init_m.bias)
+            else:  # fitted VowpalWabbit model: weights + bias params
+                prev_w = np.asarray(init_m.get("weights"))
+                prev_b = float(init_m.get("biasValue"))
+            if prev_w.shape[0] != nf:
+                raise ValueError(
+                    f"initialModel was trained with a {prev_w.shape[0]}-slot "
+                    f"weight table but this estimator uses {nf} "
+                    f"(numBits mismatch)")
+            # weights/bias seed training; adaptive accumulators restart
+            # (the reference reloads full VW state from model bytes — here
+            # the model's persisted surface is the weight table)
+            state = init_state(nf)._replace(
+                w=jnp.asarray(prev_w, jnp.float32),
+                bias=jnp.asarray(prev_b, jnp.float32))
+        else:
+            state = init_state(nf)
         t_learn0 = time.perf_counter_ns()
         if ntasks > 1:
             from jax.sharding import PartitionSpec as P

@@ -17,10 +17,23 @@ class VowpalWabbitClassifier(VowpalWabbitBase, _p.HasProbabilityCol,
                              _p.HasRawPredictionCol, _p.HasPredictionCol):
     _loss = "logistic"
 
+    labelConversion = _p.Param(
+        "labelConversion",
+        "convert 0/1 Spark-style labels to -1/+1 VW style "
+        "(VowpalWabbitClassifier.scala:31-35); turn off when labels already "
+        "carry the VW convention", True)
+
     def _extract(self, df: DataFrame):
         feats, y, w = super()._extract(df)
-        # 0/1 labels -> VW logistic convention {-1,+1}
-        y = np.where(y > 0.5, 1.0, -1.0).astype(np.float32)
+        if self.get("labelConversion"):
+            # 0/1 labels -> VW logistic convention {-1,+1}
+            y = np.where(y > 0.5, 1.0, -1.0).astype(np.float32)
+        else:
+            bad = ~np.isin(y, (-1.0, 1.0))
+            if bad.any():
+                raise ValueError(
+                    "labelConversion=False requires labels in {-1, +1}; "
+                    f"found {np.unique(y[bad])[:5]}")
         return feats, y, w
 
     def _make_model(self, state, losses, stats):

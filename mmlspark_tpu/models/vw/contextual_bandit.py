@@ -61,6 +61,10 @@ class VowpalWabbitContextualBandit(VowpalWabbitBase, _p.HasPredictionCol):
 
     sharedCol = _p.Param("sharedCol", "shared (context) features column",
                          "shared")
+    additionalSharedFeatures = _p.Param(
+        "additionalSharedFeatures",
+        "extra shared-feature columns concatenated with sharedCol "
+        "(VowpalWabbitContextualBandit additionalSharedFeatures)", None)
     chosenActionCol = _p.Param("chosenActionCol",
                                "1-based chosen action index", "chosenAction")
     probabilityCol = _p.Param("probabilityCol",
@@ -77,6 +81,9 @@ class VowpalWabbitContextualBandit(VowpalWabbitBase, _p.HasPredictionCol):
         actions_col = df[self.get("featuresCol")]
         shared_col = (df[self.get("sharedCol")]
                       if self.get("sharedCol") in df else None)
+        extra_shared = [df[c] for c in
+                        (self.get("additionalSharedFeatures") or [])
+                        if c in df]
         chosen = np.asarray(df[self.get("chosenActionCol")], np.int64)
         prob = np.asarray(df[self.get("probabilityCol")], np.float64)
         cost = np.asarray(df[self.get("labelCol")], np.float32)
@@ -94,6 +101,10 @@ class VowpalWabbitContextualBandit(VowpalWabbitBase, _p.HasPredictionCol):
                 s_idx, s_val = _row_features(shared_col[i])
                 a_idx = np.concatenate([s_idx, a_idx])
                 a_val = np.concatenate([s_val, a_val])
+            for ecol in extra_shared:
+                e_idx, e_val = _row_features(ecol[i])
+                a_idx = np.concatenate([e_idx, a_idx])
+                a_val = np.concatenate([e_val, a_val])
             rows.append((a_idx % nf, a_val))
             metrics.add(float(prob[i]), float(cost[i]))
         feats = SparseFeatures.from_rows(rows, nf)
@@ -107,6 +118,8 @@ class VowpalWabbitContextualBandit(VowpalWabbitBase, _p.HasPredictionCol):
             model.set(p, self.get(p))
         model.set("numBits", self._effective_params()["numBits"])
         model.set("epsilon", self.get("epsilon"))
+        model.set("additionalSharedFeatures",
+                  list(self.get("additionalSharedFeatures") or []))
         return model
 
     def parallel_fit(self, df: DataFrame, param_maps) -> list:
@@ -133,6 +146,9 @@ class VowpalWabbitContextualBandit(VowpalWabbitBase, _p.HasPredictionCol):
 class VowpalWabbitContextualBanditModel(VowpalWabbitBaseModel):
     sharedCol = _p.Param("sharedCol", "shared (context) features column",
                          "shared")
+    additionalSharedFeatures = _p.Param(
+        "additionalSharedFeatures",
+        "extra shared-feature columns concatenated with sharedCol", None)
     epsilon = _p.Param("epsilon", "epsilon-greedy exploration rate", 0.05,
                        float)
 
@@ -151,6 +167,9 @@ class VowpalWabbitContextualBanditModel(VowpalWabbitBaseModel):
         actions_col = df[self.get("featuresCol")]
         shared_col = (df[self.get("sharedCol")]
                       if self.get("sharedCol") in df else None)
+        extra_shared = [df[c] for c in
+                        (self.get("additionalSharedFeatures") or [])
+                        if c in df]
         w = np.asarray(self.get("weights"))
         b = self.get("biasValue")
         eps = self.get("epsilon")
@@ -161,6 +180,10 @@ class VowpalWabbitContextualBanditModel(VowpalWabbitBaseModel):
             s_idx, s_val = (_row_features(shared_col[i]) if shared_col is not None
                             else (np.zeros(0, np.int64), np.zeros(0, np.float32)))
             shared_dot = float(w[s_idx % nf] @ s_val) if s_idx.size else 0.0
+            for ecol in extra_shared:
+                e_idx, e_val = _row_features(ecol[i])
+                if e_idx.size:
+                    shared_dot += float(w[e_idx % nf] @ e_val)
             scores = []
             for action in actions_col[i]:
                 a_idx, a_val = _row_features(action)

@@ -44,6 +44,14 @@ class VowpalWabbitFeaturizer(Transformer, _p.HasInputCols, _p.HasOutputCol,
     stringSplitInputCols = _p.Param(
         "stringSplitInputCols",
         "string columns split on whitespace into multiple hashed tokens", None)
+    prefixStringsWithColumnName = _p.Param(
+        "prefixStringsWithColumnName",
+        "prefix string values with their column name before hashing "
+        "(VowpalWabbitFeaturizer.scala default)", True)
+    preserveOrderNumBits = _p.Param(
+        "preserveOrderNumBits",
+        "reserve this many high hash bits for the column index, so features "
+        "from different columns never collide (0 = off)", 0, int)
 
     def __init__(self, **kw):
         kw.setdefault("outputCol", "features")
@@ -55,12 +63,26 @@ class VowpalWabbitFeaturizer(Transformer, _p.HasInputCols, _p.HasOutputCol,
         num_bits = self.get("numBits")
         mask = (1 << num_bits) - 1
         seed = self.get("seed")
+        prefix = self.get("prefixStringsWithColumnName")
+        p_bits = self.get("preserveOrderNumBits")
+        if p_bits < 0 or p_bits >= num_bits:
+            raise ValueError("preserveOrderNumBits must be in [0, numBits)")
+        low_bits = num_bits - p_bits
+        low_mask = (1 << low_bits) - 1
         n = len(df)
         rows: List[Tuple[List[int], List[float]]] = [([], []) for _ in range(n)]
 
-        for name in cols + split_cols:
+        for ci, name in enumerate(cols + split_cols):
+            if p_bits:
+                hi = (ci % (1 << p_bits)) << low_bits
+
+                def place(b, _hi=hi):
+                    return _hi | (int(b) & low_mask)
+            else:
+                def place(b):
+                    return int(b)
             col = df[name]
-            hasher = MurmurWithPrefix(name, seed)
+            hasher = MurmurWithPrefix(name if prefix else "", seed)
             if name in split_cols:
                 # batch path: one native hash_strings call for all tokens
                 toks, owners = [], []
@@ -69,29 +91,31 @@ class VowpalWabbitFeaturizer(Transformer, _p.HasInputCols, _p.HasOutputCol,
                     if v is None:
                         continue
                     for tok in str(v).split():
-                        toks.append(name + tok)
+                        toks.append((name + tok) if prefix else tok)
                         owners.append(i)
                 if toks:
                     buckets = hash_strings(toks, num_bits, seed)
                     for i, b in zip(owners, buckets):
-                        rows[i][0].append(int(b))
+                        rows[i][0].append(place(b))
                         rows[i][1].append(1.0)
             elif col.dtype == object and len(col) and isinstance(
                     next((v for v in col if v is not None), None), str):
                 # plain string column: batch-hash name+value
                 live = [i for i in range(n) if col[i] is not None]
-                buckets = hash_strings([name + col[i] for i in live],
-                                       num_bits, seed)
+                buckets = hash_strings(
+                    [(name + col[i]) if prefix else col[i] for i in live],
+                    num_bits, seed)
                 for i, b in zip(live, buckets):
-                    rows[i][0].append(int(b))
+                    rows[i][0].append(place(b))
                     rows[i][1].append(1.0)
             elif col.dtype == object:
                 for i in range(n):
                     self._featurize_obj(rows[i], col[i], name, hasher, mask,
-                                        seed)
+                                        seed, place)
             elif col.dtype.kind in "fiu":
                 if col.ndim == 2:  # dense vector column: index by position
-                    base = [murmur3_32(f"{name}_{j}".encode(), seed) & mask
+                    base = [place(murmur3_32(f"{name}_{j}".encode(), seed)
+                                  & mask)
                             for j in range(col.shape[1])]
                     for i in range(n):
                         for j, v in enumerate(col[i]):
@@ -99,14 +123,14 @@ class VowpalWabbitFeaturizer(Transformer, _p.HasInputCols, _p.HasOutputCol,
                                 rows[i][0].append(base[j])
                                 rows[i][1].append(float(v))
                 else:  # numeric scalar: one slot per column, value = number
-                    h = murmur3_32(name.encode(), seed) & mask
+                    h = place(murmur3_32(name.encode(), seed) & mask)
                     for i in range(n):
                         v = float(col[i])
                         if v != 0.0:
                             rows[i][0].append(h)
                             rows[i][1].append(v)
             elif col.dtype.kind == "b":
-                h = murmur3_32(name.encode(), seed) & mask
+                h = place(murmur3_32(name.encode(), seed) & mask)
                 for i in range(n):
                     if col[i]:
                         rows[i][0].append(h)
@@ -122,35 +146,35 @@ class VowpalWabbitFeaturizer(Transformer, _p.HasInputCols, _p.HasOutputCol,
 
     @staticmethod
     def _featurize_obj(row, value, name, hasher: MurmurWithPrefix, mask: int,
-                       seed: int) -> None:
+                       seed: int, place=int) -> None:
         """Per-type dispatch for object cells (vw/featurizer/*.scala)."""
         if value is None:
             return
         if isinstance(value, str):
-            row[0].append(hasher.hash(value) & mask)
+            row[0].append(place(hasher.hash(value) & mask))
             row[1].append(1.0)
         elif isinstance(value, dict):
             for k, v in value.items():
                 if isinstance(v, str):
-                    row[0].append(hasher.hash(f"{k}{v}") & mask)
+                    row[0].append(place(hasher.hash(f"{k}{v}") & mask))
                     row[1].append(1.0)
                 else:
-                    row[0].append(hasher.hash(str(k)) & mask)
+                    row[0].append(place(hasher.hash(str(k)) & mask))
                     row[1].append(float(v))
         elif isinstance(value, (list, tuple, np.ndarray)):
             for pos, item in enumerate(value):
                 if isinstance(item, str):
-                    row[0].append(hasher.hash(item) & mask)
+                    row[0].append(place(hasher.hash(item) & mask))
                     row[1].append(1.0)
                 else:  # numeric sequence: slot keyed by position in the seq
-                    row[0].append(hasher.hash(str(pos)) & mask)
+                    row[0].append(place(hasher.hash(str(pos)) & mask))
                     row[1].append(float(item))
         elif isinstance(value, (bool, np.bool_)):
             if value:
-                row[0].append(hasher.hash("") & mask)
+                row[0].append(place(hasher.hash("") & mask))
                 row[1].append(1.0)
         else:
-            row[0].append(hasher.hash("") & mask)
+            row[0].append(place(hasher.hash("") & mask))
             row[1].append(float(value))
 
     def _pack(self, rows, num_features: int) -> SparseFeatures:

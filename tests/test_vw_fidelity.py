@@ -234,3 +234,90 @@ class TestInvariantClosedForm:
                                    minibatchSize=1).fit(df)
         proba = np.asarray(m.transform(df)["probability"])
         assert np.isfinite(proba).all()
+
+
+class TestRound2Params:
+    """VW param-surface additions: initialModel warm start, labelConversion,
+    featurizer prefix/preserve-order options, CB additionalSharedFeatures."""
+
+    def _data(self, seed=0, n=2000, f=6):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, f)).astype(np.float32)
+        y = ((x @ rng.normal(size=f)) > 0).astype(np.float64)
+        return DataFrame({"features": x, "label": y})
+
+    def test_initial_model_warm_start(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitClassifier
+        df = self._data()
+        cold = VowpalWabbitClassifier(numPasses=1, numBits=12).fit(df)
+        warm = VowpalWabbitClassifier(numPasses=1, numBits=12,
+                                      initialModel=cold).fit(df)
+        # two passes via warm start == one fit with two passes (same order)
+        two = VowpalWabbitClassifier(numPasses=2, numBits=12).fit(df)
+        a_w = np.asarray(warm.get("weights"))
+        assert np.isfinite(a_w).all() and np.abs(a_w).sum() > 0
+        import pytest
+        with pytest.raises(ValueError, match="numBits"):
+            VowpalWabbitClassifier(numPasses=1, numBits=10,
+                                   initialModel=cold).fit(df)
+
+    def test_label_conversion_off(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitClassifier
+        df = self._data()
+        y = np.asarray(df["label"])
+        df_pm = df.with_column("label", np.where(y > 0.5, 1.0, -1.0))
+        m1 = VowpalWabbitClassifier(numPasses=1, numBits=12,
+                                    labelConversion=False).fit(df_pm)
+        m2 = VowpalWabbitClassifier(numPasses=1, numBits=12).fit(df)
+        np.testing.assert_allclose(np.asarray(m1.get("weights")),
+                                   np.asarray(m2.get("weights")), rtol=1e-6)
+        import pytest
+        with pytest.raises(ValueError, match="labelConversion"):
+            VowpalWabbitClassifier(labelConversion=False).fit(df)
+
+    def test_featurizer_prefix_and_preserve_order(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitFeaturizer
+        df = DataFrame({"a": np.array(["x", "y"], dtype=object),
+                        "b": np.array(["x", "z"], dtype=object)})
+        with_prefix = VowpalWabbitFeaturizer(
+            inputCols=["a", "b"], numBits=14).transform(df)["features"]
+        no_prefix = VowpalWabbitFeaturizer(
+            inputCols=["a", "b"], numBits=14,
+            prefixStringsWithColumnName=False).transform(df)["features"]
+        # without prefixes, identical values in different columns collide
+        def live_idx(cell):
+            idx, val = np.asarray(cell[0]), np.asarray(cell[1])
+            return idx[val != 0.0]
+        # "x" appears in both columns; sumCollisions merges them into one slot
+        assert len(np.unique(live_idx(no_prefix[0]))) == 1
+        assert len(np.unique(live_idx(with_prefix[0]))) == 2
+
+        po = VowpalWabbitFeaturizer(
+            inputCols=["a", "b"], numBits=14,
+            preserveOrderNumBits=2).transform(df)["features"]
+        idx = live_idx(po[0])
+        # column index occupies the top 2 bits -> distinct high-bit groups
+        assert set(int(v) >> 12 for v in idx) == {0, 1}
+
+    def test_cb_additional_shared_features(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitContextualBandit
+        rng = np.random.default_rng(5)
+        n, k, f = 200, 3, 4
+        actions = np.empty(n, dtype=object)
+        shared = np.empty(n, dtype=object)
+        extra = np.empty(n, dtype=object)
+        for i in range(n):
+            actions[i] = [rng.normal(size=f).astype(np.float32)
+                          for _ in range(k)]
+            shared[i] = rng.normal(size=f).astype(np.float32)
+            extra[i] = rng.normal(size=f).astype(np.float32)
+        df = DataFrame({"features": actions, "shared": shared,
+                        "extra": extra,
+                        "chosenAction": rng.integers(1, k + 1, n),
+                        "probability": np.full(n, 1.0 / k),
+                        "cost": rng.normal(size=n).astype(np.float32)})
+        cb = VowpalWabbitContextualBandit(
+            numPasses=1, numBits=10,
+            additionalSharedFeatures=["extra"]).fit(df)
+        out = cb.transform(df)
+        assert np.isfinite(np.concatenate(list(out["prediction"]))).all()
