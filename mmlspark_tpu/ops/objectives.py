@@ -174,13 +174,56 @@ def _fair_c(c: float = 1.0):
 fair = Objective("fair", _fair_c(), _l2_init, lambda s: s, _l2_metric, "fair")
 
 
+def make_gamma() -> Objective:
+    """Gamma regression NLL with log link (upstream objective=gamma):
+    grad = 1 - y*exp(-s), hess = y*exp(-s)."""
+    def gh(scores, y):
+        e = y * jnp.exp(-scores)
+        return 1.0 - e, e
+    return Objective("gamma", gh,
+                     lambda y, w: jnp.log(jnp.maximum(_wmean(y, w), 1e-12)),
+                     jnp.exp,
+                     lambda s, y, w: _wmean(s + y * jnp.exp(-s), w), "gamma")
+
+
+def make_mape() -> Objective:
+    """MAPE (upstream mean_absolute_percentage_error): L1 scaled by 1/|y|
+    (|y| floored at 1 like upstream's label clip)."""
+    def gh(scores, y):
+        inv = 1.0 / jnp.maximum(jnp.abs(y), 1.0)
+        return jnp.sign(scores - y) * inv, inv
+    return Objective(
+        "mape", gh,
+        _l1_init, lambda s: s,
+        lambda s, y, w: _wmean(jnp.abs(s - y)
+                               / jnp.maximum(jnp.abs(y), 1.0), w), "mape")
+
+
+def make_cross_entropy() -> Objective:
+    """cross_entropy (xentropy): sigmoid link with CONTINUOUS labels in
+    [0, 1] — binary's gradient form, unrestricted label support."""
+    def gh(scores, y):
+        p = jax.nn.sigmoid(scores)
+        return p - y, jnp.maximum(p * (1.0 - p), 1e-16)
+    def init(y, w):
+        m = jnp.clip(_wmean(y, w), 1e-7, 1 - 1e-7)
+        return jnp.log(m / (1 - m))
+    def metric(s, y, w):
+        p = jnp.clip(jax.nn.sigmoid(s), 1e-15, 1 - 1e-15)
+        return _wmean(-(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)), w)
+    return Objective("cross_entropy", gh, init, jax.nn.sigmoid, metric,
+                     "xentropy")
+
+
 def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
                   tweedie_variance_power: float = 1.5) -> Objective:
     """Resolve by LightGBM objective string (TrainParams.scala objective values)."""
     name = {"regression_l2": "regression", "mean_squared_error": "regression",
             "mse": "regression", "l2": "regression", "l1": "regression_l1",
             "mae": "regression_l1", "multiclassova": "multiclass",
-            "softmax": "multiclass"}.get(name, name)
+            "softmax": "multiclass",
+            "mean_absolute_percentage_error": "mape",
+            "xentropy": "cross_entropy"}.get(name, name)
     table = {
         "binary": binary,
         "multiclass": multiclass,
@@ -191,6 +234,9 @@ def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
         "tweedie": make_tweedie(tweedie_variance_power),
         "poisson": make_poisson(),
         "fair": fair,
+        "gamma": make_gamma(),
+        "mape": make_mape(),
+        "cross_entropy": make_cross_entropy(),
         # lambdarank grad/hess live in ops.ranking (they need group structure);
         # this entry provides link/metric surfaces for fitted-model scoring
         "lambdarank": Objective(

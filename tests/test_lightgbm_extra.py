@@ -199,3 +199,29 @@ def test_new_param_surface(binary_df):
                             improvementTolerance=1e-4).fit(binary_df)
     assert "prediction" in m5.transform(binary_df)
     assert m.get_actual_num_classes() == 2
+
+
+def test_gamma_mape_xentropy_objectives():
+    """Round-2 objectives: gamma (log link, positive targets), mape
+    (relative-error L1), cross_entropy (continuous [0,1] labels)."""
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3000, 6)).astype(np.float32)
+    mu = np.exp(0.5 * x[:, 0])
+    y_pos = (mu * rng.gamma(4.0, 0.25, size=len(x))).astype(np.float64)
+
+    for obj, y in (("gamma", y_pos), ("mape", y_pos),
+                   ("cross_entropy",
+                    (1 / (1 + np.exp(-x[:, 0]))).astype(np.float64))):
+        df = DataFrame({"features": x, "label": y})
+        m = LightGBMRegressor(objective=obj, numIterations=20, numLeaves=15,
+                              numTasks=1).fit(df)
+        pred = np.asarray(m.transform(df)["prediction"])
+        assert np.isfinite(pred).all(), obj
+        if obj == "gamma":
+            assert (pred > 0).all()
+            # log-link model recovers the multiplicative trend
+            corr = np.corrcoef(np.log(pred), 0.5 * x[:, 0])[0, 1]
+            assert corr > 0.8, corr
+        if obj == "cross_entropy":
+            assert (pred >= 0).all() and (pred <= 1).all()
