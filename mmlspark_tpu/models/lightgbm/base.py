@@ -287,6 +287,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             boost_from_average=self.get("boostFromAverage"),
             num_class=num_class,
             objective=objective or self._objective_name(),
+            alpha=self.get("alpha"),
+            tweedie_variance_power=self.get("tweedieVariancePower"),
             top_rate=self.get("topRate"),
             other_rate=self.get("otherRate"),
             boosting_type=boosting,

@@ -59,6 +59,8 @@ class GBDTConfig(NamedTuple):
     max_delta_step: float = 0.0  # >0: cap |leaf output| (maxDeltaStep)
     num_class: int = 1
     objective: str = "regression"
+    alpha: float = 0.9           # quantile/huber alpha
+    tweedie_variance_power: float = 1.5
     boost_from_average: bool = True
     top_rate: float = 0.2       # goss
     other_rate: float = 0.1     # goss
@@ -516,7 +518,9 @@ def make_train_fn(cfg: GBDTConfig):
     shard-local and histograms/metrics psum over the axis.
     """
     ranking = cfg.objective == "lambdarank"
-    obj = None if ranking else get_objective(cfg.objective, cfg.num_class)
+    obj = None if ranking else get_objective(
+        cfg.objective, cfg.num_class, alpha=cfg.alpha,
+        tweedie_variance_power=cfg.tweedie_variance_power)
     multiclass = cfg.objective == "multiclass"
     k = cfg.num_class if multiclass else 1
     if ranking:
