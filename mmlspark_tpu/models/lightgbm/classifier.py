@@ -40,7 +40,13 @@ class LightGBMClassifier(LightGBMParamsBase, _p.HasProbabilityCol,
         num_class = int(classes.max()) + 1 if classes.size else 2
         # numClass inferred from data (LightGBMClassifier.scala:39); resolved
         # locally so fit() never mutates the estimator's own params
-        objective = "binary" if num_class <= 2 else "multiclass"
+        if num_class <= 2:
+            objective = "binary"
+        elif self.get("objective") in ("multiclassova", "multiclass_ova",
+                                       "ova", "ovr"):
+            objective = "multiclassova"
+        else:
+            objective = "multiclass"
         if num_class <= 2:
             num_class = 2
         if self.get("isUnbalance"):
@@ -83,6 +89,11 @@ class LightGBMClassificationModel(LightGBMModelBase, _p.HasProbabilityCol,
             prob1 = 1.0 / (1.0 + np.exp(-raw))
             probs = np.stack([1 - prob1, prob1], axis=1)
             raws = np.stack([-raw, raw], axis=1)
+        elif self.booster.objective == "multiclassova":
+            # one-vs-all: per-class sigmoids, renormalized (upstream ova link)
+            p = 1.0 / (1.0 + np.exp(-raw))
+            probs = p / np.maximum(p.sum(axis=1, keepdims=True), 1e-15)
+            raws = raw
         else:
             z = raw - raw.max(axis=1, keepdims=True)
             e = np.exp(z)

@@ -521,7 +521,7 @@ def make_train_fn(cfg: GBDTConfig):
     obj = None if ranking else get_objective(
         cfg.objective, cfg.num_class, alpha=cfg.alpha,
         tweedie_variance_power=cfg.tweedie_variance_power)
-    multiclass = cfg.objective == "multiclass"
+    multiclass = cfg.objective in ("multiclass", "multiclassova")
     k = cfg.num_class if multiclass else 1
     if ranking:
         from . import ranking as _rk

@@ -81,6 +81,32 @@ multiclass = Objective("multiclass", _multiclass_grad_hess, _multiclass_init,
                        _multiclass_logloss, "multi_logloss")
 
 
+def _ova_grad_hess(scores, y):
+    """multiclassova: K independent binary sigmoid problems on one-hot labels
+    (upstream multiclass_ova), unlike softmax's coupled gradients."""
+    k = scores.shape[1]
+    p = jax.nn.sigmoid(scores)
+    onehot = jax.nn.one_hot(y, k, dtype=scores.dtype)
+    return p - onehot, jnp.maximum(p * (1.0 - p), 1e-16)
+
+
+def _ova_link(s):
+    p = jax.nn.sigmoid(s)
+    return p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-15)
+
+
+def _ova_logloss(scores, y, w):
+    k = scores.shape[1]
+    p = jnp.clip(jax.nn.sigmoid(scores), 1e-15, 1 - 1e-15)
+    onehot = jax.nn.one_hot(y, k, dtype=scores.dtype)
+    ll = -(onehot * jnp.log(p) + (1 - onehot) * jnp.log(1 - p)).sum(axis=1)
+    return _wmean(ll, w)
+
+
+multiclassova = Objective("multiclassova", _ova_grad_hess, _multiclass_init,
+                          _ova_link, _ova_logloss, "multi_logloss")
+
+
 # ------------------------------------------------------------- regression
 def _l2_grad_hess(scores, y):
     return scores - y, jnp.ones_like(scores)
@@ -220,13 +246,15 @@ def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
     """Resolve by LightGBM objective string (TrainParams.scala objective values)."""
     name = {"regression_l2": "regression", "mean_squared_error": "regression",
             "mse": "regression", "l2": "regression", "l1": "regression_l1",
-            "mae": "regression_l1", "multiclassova": "multiclass",
+            "mae": "regression_l1", "multiclass_ova": "multiclassova",
+            "ova": "multiclassova", "ovr": "multiclassova",
             "softmax": "multiclass",
             "mean_absolute_percentage_error": "mape",
             "xentropy": "cross_entropy"}.get(name, name)
     table = {
         "binary": binary,
         "multiclass": multiclass,
+        "multiclassova": multiclassova,
         "regression": regression,
         "regression_l1": regression_l1,
         "huber": make_huber(alpha),

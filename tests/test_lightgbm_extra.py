@@ -247,3 +247,16 @@ def test_quantile_alpha_actually_plumbs():
     frac_lo = (y < p_lo).mean()
     frac_hi = (y < p_hi).mean()
     assert frac_lo < 0.3 and frac_hi > 0.7
+
+
+def test_multiclassova_objective(multiclass_df):
+    """multiclassova: K independent sigmoid learners, renormalized
+    probabilities (upstream multiclass_ova), accuracy on par with softmax."""
+    ova = LightGBMClassifier(objective="multiclassova", numIterations=30,
+                             numLeaves=15, numTasks=1).fit(multiclass_df)
+    out = ova.transform(multiclass_df)
+    acc = (out["prediction"] == multiclass_df["label"]).mean()
+    assert acc > 0.9, acc
+    probs = np.stack(out["probability"])
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    assert ova.booster.objective == "multiclassova"
