@@ -268,6 +268,8 @@ class Booster:
                 tree_id += 1
         obj_str = {"binary": "binary sigmoid:1",
                    "multiclass": f"multiclass num_class:{self.num_class}",
+                   "multiclassova":
+                   f"multiclassova num_class:{self.num_class} sigmoid:1",
                    }.get(self.objective, self.objective)
         doc = {
             "name": "tree",
@@ -292,6 +294,8 @@ class Booster:
         num_tree_per_it = self.num_class if self.multiclass else 1
         obj_str = {"binary": "binary sigmoid:1",
                    "multiclass": f"multiclass num_class:{self.num_class}",
+                   "multiclassova":
+                   f"multiclassova num_class:{self.num_class} sigmoid:1",
                    }.get(self.objective, self.objective)
         out = io.StringIO()
         out.write("tree\n")
