@@ -597,9 +597,28 @@ def make_train_fn(cfg: GBDTConfig):
                          / jnp.maximum(jnp.abs(y), 1.0), w)
         if name == "l2":
             return wmean((scores - y) ** 2, w)
-        if cfg.objective == "binary":
+        if cfg.objective in ("binary", "cross_entropy"):
             p = jnp.clip(jax.nn.sigmoid(scores), 1e-15, 1 - 1e-15)
             return wmean(-(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)), w)
+        if cfg.objective == "poisson":
+            return wmean(jnp.exp(scores) - y * scores, w)
+        if cfg.objective == "gamma":
+            return wmean(scores + y * jnp.exp(-scores), w)
+        if cfg.objective == "tweedie":
+            rho = cfg.tweedie_variance_power
+            mu = jnp.exp(scores)
+            dev = 2 * (jnp.power(jnp.maximum(y, 0.0), 2 - rho)
+                       / ((1 - rho) * (2 - rho))
+                       - y * jnp.power(mu, 1 - rho) / (1 - rho)
+                       + jnp.power(mu, 2 - rho) / (2 - rho))
+            return wmean(dev, w)
+        if cfg.objective == "quantile":
+            d = y - scores
+            return wmean(jnp.maximum(cfg.alpha * d, (cfg.alpha - 1) * d), w)
+        if cfg.objective in ("regression_l1", "mape"):
+            scale = (jnp.maximum(jnp.abs(y), 1.0)
+                     if cfg.objective == "mape" else 1.0)
+            return wmean(jnp.abs(scores - y) / scale, w)
         return wmean((scores - y) ** 2, w)
 
     rf = cfg.boosting_type == "rf"
