@@ -306,8 +306,18 @@ class Booster:
         out.write(f"max_feature_idx={self.num_features - 1}\n")
         out.write(f"objective={obj_str}\n")
         out.write("feature_names=" + " ".join(self.feature_names) + "\n")
-        out.write("feature_infos=" + " ".join(
-            ["[-inf:inf]"] * self.num_features) + "\n")
+        if self.bin_mapper is not None:
+            infos = []
+            for j in range(self.num_features):
+                finite = self.bin_mapper.edges[j][
+                    np.isfinite(self.bin_mapper.edges[j])]
+                if finite.size:
+                    infos.append(f"[{finite[0]:g}:{finite[-1]:g}]")
+                else:
+                    infos.append("[-inf:inf]")
+        else:
+            infos = ["[-inf:inf]"] * self.num_features
+        out.write("feature_infos=" + " ".join(infos) + "\n")
         out.write("\n")
         tree_id = 0
         for t in range(t_used):
