@@ -118,14 +118,13 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
         ndev = self.get("numTasks")
         if ndev and ndev > 1:
             from jax.sharding import PartitionSpec as P
-            from jax.experimental.shard_map import shard_map
-            mesh = meshlib.get_mesh(ndev)
+                    mesh = meshlib.get_mesh(ndev)
             axis = meshlib.DATA_AXIS
-            fn = shard_map(
+            fn = jax.shard_map(
                 partial(encoder_forward, num_heads=nh, causal=causal,
                         axis_name=axis),
                 mesh=mesh, in_specs=(P(), P(None, axis, None)),
-                out_specs=P(None, axis, None), check_rep=False)
+                out_specs=P(None, axis, None), check_vma=False)
             return jax.jit(fn)(p, x)
         return jax.jit(partial(encoder_forward, num_heads=nh,
                                causal=causal))(p, x)

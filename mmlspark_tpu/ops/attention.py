@@ -100,10 +100,9 @@ def ring_attention(q, k, v, mesh, axis_name: str = "data",
     """Driver: shard q/k/v over `axis_name` on the sequence dimension and run
     the ring. q,k,v: [B, S, H, D] with S divisible by the mesh axis size."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
     spec = P(None, axis_name, None, None)
-    fn = shard_map(
+    fn = jax.shard_map(
         partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_rep=False)
