@@ -118,7 +118,7 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
         ndev = self.get("numTasks")
         if ndev and ndev > 1:
             from jax.sharding import PartitionSpec as P
-                    mesh = meshlib.get_mesh(ndev)
+            mesh = meshlib.get_mesh(ndev)
             axis = meshlib.DATA_AXIS
             fn = jax.shard_map(
                 partial(encoder_forward, num_heads=nh, causal=causal,

@@ -105,5 +105,5 @@ def ring_attention(q, k, v, mesh, axis_name: str = "data",
     fn = jax.shard_map(
         partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)
