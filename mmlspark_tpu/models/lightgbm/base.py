@@ -405,6 +405,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         if self.get("histDtype") not in ("bf16", "f32"):
             raise ValueError(
                 f"histDtype must be bf16 or f32, got {self.get('histDtype')!r}")
+        if ((self.get("posBaggingFraction") >= 0
+             or self.get("negBaggingFraction") >= 0)
+                and (objective or self._objective_name()) != "binary"):
+            raise ValueError(
+                "posBaggingFraction/negBaggingFraction can only be used with "
+                "the binary objective (upstream LightGBM restriction)")
         if self.get("histMethod") == "autotune":
             # measured kernel selection at the problem's actual shape
             # (ops/autotune.py); resolved once per fit, cached per backend

@@ -207,6 +207,9 @@ class Booster:
         arrays["thresholds"] = self.thresholds
         if self.bin_mapper is not None:
             arrays["bin_edges"] = self.bin_mapper.edges
+            if getattr(self.bin_mapper, "feature_min", None) is not None:
+                arrays["feature_min"] = self.bin_mapper.feature_min
+                arrays["feature_max"] = self.bin_mapper.feature_max
         return arrays
 
     @staticmethod
@@ -222,7 +225,8 @@ class Booster:
                 np.int32)
         trees = Tree(*[arrays[f"tree_{f}"] for f in Tree._fields])
         bm = (BinMapper(arrays["bin_edges"],
-                        tuple(meta.get("categorical", ())))
+                        tuple(meta.get("categorical", ())),
+                        arrays.get("feature_min"), arrays.get("feature_max"))
               if "bin_edges" in arrays else None)
         return Booster(trees, arrays["thresholds"],
                        np.asarray(meta["init_score"], np.float32),
@@ -230,6 +234,15 @@ class Booster:
                        meta["num_features"], bm, meta["feature_names"],
                        meta["best_iteration"], meta["learning_rate"],
                        meta.get("average_output", False))
+
+    def _objective_config_str(self) -> str:
+        """Upstream objective config string shared by the text model and the
+        JSON dump (binary sigmoid:1 / multiclass num_class:K / ...)."""
+        return {"binary": "binary sigmoid:1",
+                "multiclass": f"multiclass num_class:{self.num_class}",
+                "multiclassova":
+                f"multiclassova num_class:{self.num_class} sigmoid:1",
+                }.get(self.objective, self.objective)
 
     # ------------------------------------------------- LightGBM text format
     def save_native_model(self, path: str) -> None:
@@ -266,11 +279,7 @@ class Booster:
                     "tree_structure": struct,
                 })
                 tree_id += 1
-        obj_str = {"binary": "binary sigmoid:1",
-                   "multiclass": f"multiclass num_class:{self.num_class}",
-                   "multiclassova":
-                   f"multiclassova num_class:{self.num_class} sigmoid:1",
-                   }.get(self.objective, self.objective)
+        obj_str = self._objective_config_str()
         doc = {
             "name": "tree",
             "version": "v3",
@@ -292,11 +301,7 @@ class Booster:
     def model_string(self) -> str:
         t_used = self._used_iters()
         num_tree_per_it = self.num_class if self.multiclass else 1
-        obj_str = {"binary": "binary sigmoid:1",
-                   "multiclass": f"multiclass num_class:{self.num_class}",
-                   "multiclassova":
-                   f"multiclassova num_class:{self.num_class} sigmoid:1",
-                   }.get(self.objective, self.objective)
+        obj_str = self._objective_config_str()
         out = io.StringIO()
         out.write("tree\n")
         out.write("version=v3\n")
@@ -306,15 +311,16 @@ class Booster:
         out.write(f"max_feature_idx={self.num_features - 1}\n")
         out.write(f"objective={obj_str}\n")
         out.write("feature_names=" + " ".join(self.feature_names) + "\n")
-        if self.bin_mapper is not None:
+        bm = self.bin_mapper
+        if (bm is not None and getattr(bm, "feature_min", None) is not None
+                and bm.feature_max is not None):
+            # real value ranges captured at fit (upstream [min:max] form)
             infos = []
             for j in range(self.num_features):
-                finite = self.bin_mapper.edges[j][
-                    np.isfinite(self.bin_mapper.edges[j])]
-                if finite.size:
-                    infos.append(f"[{finite[0]:g}:{finite[-1]:g}]")
-                else:
-                    infos.append("[-inf:inf]")
+                lo, hi = bm.feature_min[j], bm.feature_max[j]
+                infos.append(f"[{lo:g}:{hi:g}]"
+                             if np.isfinite(lo) and np.isfinite(hi)
+                             else "[-inf:inf]")
         else:
             infos = ["[-inf:inf]"] * self.num_features
         out.write("feature_infos=" + " ".join(infos) + "\n")

@@ -67,6 +67,12 @@ class VowpalWabbitFeaturizer(Transformer, _p.HasInputCols, _p.HasOutputCol,
         p_bits = self.get("preserveOrderNumBits")
         if p_bits < 0 or p_bits >= num_bits:
             raise ValueError("preserveOrderNumBits must be in [0, numBits)")
+        if p_bits and len(cols) + len(split_cols) > (1 << p_bits):
+            # reference throws rather than silently aliasing columns
+            # (VowpalWabbitFeaturizer.scala:187-190)
+            raise ValueError(
+                f"too many input columns ({len(cols) + len(split_cols)}) for "
+                f"preserveOrderNumBits={p_bits} (capacity {1 << p_bits})")
         low_bits = num_bits - p_bits
         low_mask = (1 << low_bits) - 1
         n = len(df)
@@ -74,7 +80,7 @@ class VowpalWabbitFeaturizer(Transformer, _p.HasInputCols, _p.HasOutputCol,
 
         for ci, name in enumerate(cols + split_cols):
             if p_bits:
-                hi = (ci % (1 << p_bits)) << low_bits
+                hi = ci << low_bits
 
                 def place(b, _hi=hi):
                     return _hi | (int(b) & low_mask)

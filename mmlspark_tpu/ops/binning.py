@@ -98,9 +98,15 @@ class BinMapper:
     """
 
     def __init__(self, edges: np.ndarray,
-                 categorical: Optional[Tuple[int, ...]] = None):
+                 categorical: Optional[Tuple[int, ...]] = None,
+                 feature_min: Optional[np.ndarray] = None,
+                 feature_max: Optional[np.ndarray] = None):
         self.edges = edges
         self.categorical = tuple(sorted(categorical)) if categorical else ()
+        # real per-feature value ranges (upstream feature_infos [min:max]);
+        # None on mappers restored from pre-0.2 checkpoints
+        self.feature_min = feature_min
+        self.feature_max = feature_max
 
     @property
     def max_bins(self) -> int:
@@ -125,9 +131,15 @@ class BinMapper:
                         f"categorical feature {j} has {int(top) + 1} codes but "
                         f"maxBin={max_bins}; codes >= {max_bins} are clipped "
                         f"into one bin (raise maxBin to keep them distinct)")
+        X = np.asarray(X)
+        with np.errstate(all="ignore"):
+            fmin = (np.nanmin(X, axis=0).astype(np.float64)
+                    if len(X) else None)
+            fmax = (np.nanmax(X, axis=0).astype(np.float64)
+                    if len(X) else None)
         return BinMapper(compute_bin_edges(X, max_bins, sample_count, seed,
                                            max_bins_by_feature),
-                         categorical)
+                         categorical, fmin, fmax)
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         out = apply_bins(X, self.edges)

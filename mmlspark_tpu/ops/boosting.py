@@ -579,7 +579,15 @@ def make_train_fn(cfg: GBDTConfig):
             if name == "multi_error":
                 pred = jnp.argmax(scores, axis=1).astype(y.dtype)
                 return wmean((pred != y).astype(jnp.float32), w)
-            logp = jax.nn.log_softmax(scores, axis=1)
+            if cfg.objective == "multiclassova":
+                # OVA logloss: per-class sigmoid probabilities renormalized
+                # (upstream multi_logloss under multiclass_ova) — softmax of
+                # sigmoid margins would track the wrong quantity
+                p = jax.nn.sigmoid(scores)
+                p = p / jnp.maximum(p.sum(axis=1, keepdims=True), 1e-15)
+                logp = jnp.log(jnp.clip(p, 1e-15, 1.0))
+            else:
+                logp = jax.nn.log_softmax(scores, axis=1)
             picked = jnp.take_along_axis(
                 logp, y[:, None].astype(jnp.int32), axis=1)[:, 0]
             return wmean(-picked, w)

@@ -252,10 +252,20 @@ class TestRound2Params:
         cold = VowpalWabbitClassifier(numPasses=1, numBits=12).fit(df)
         warm = VowpalWabbitClassifier(numPasses=1, numBits=12,
                                       initialModel=cold).fit(df)
-        # two passes via warm start == one fit with two passes (same order)
-        two = VowpalWabbitClassifier(numPasses=2, numBits=12).fit(df)
-        a_w = np.asarray(warm.get("weights"))
-        assert np.isfinite(a_w).all() and np.abs(a_w).sum() > 0
+        w_cold = np.asarray(cold.get("weights"))
+        w_warm = np.asarray(warm.get("weights"))
+        assert np.isfinite(w_warm).all()
+        # training continued from the seeded table, not restarted from zero
+        assert not np.allclose(w_warm, w_cold)
+        y = np.asarray(df["label"])
+        x_m = df  # margins via transform
+        def logloss(model):
+            p = np.stack(model.transform(df)["probability"])[:, 1]
+            p = np.clip(p, 1e-12, 1 - 1e-12)
+            return -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        # a second pass (with restarted adaptive accumulators) must stay in
+        # the same quality regime — it continued, it didn't diverge or reset
+        assert logloss(warm) <= logloss(cold) * 1.2
         import pytest
         with pytest.raises(ValueError, match="numBits"):
             VowpalWabbitClassifier(numPasses=1, numBits=10,
