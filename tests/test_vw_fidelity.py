@@ -260,8 +260,10 @@ class TestRound2Params:
         y = np.asarray(df["label"])
         x_m = df  # margins via transform
         def logloss(model):
+            # float64 before clipping: float32 probabilities saturate to
+            # exactly 1.0 and clip(1.0, ..., 1 - 1e-12) is a no-op in f32
             p = np.stack(model.transform(df)["probability"])[:, 1]
-            p = np.clip(p, 1e-12, 1 - 1e-12)
+            p = np.clip(p.astype(np.float64), 1e-12, 1 - 1e-12)
             return -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
         # a second pass (with restarted adaptive accumulators) must stay in
         # the same quality regime — it continued, it didn't diverge or reset
