@@ -546,28 +546,42 @@ def _tree_to_json(tree: Tree, thr: np.ndarray, value_shift: float) -> dict:
 # jit prediction programs
 # ---------------------------------------------------------------------------
 
+#: below this many rows, trees traverse in parallel (vmap over the tree
+#: axis, one wide kernel — serving-latency shape); above it, a scan over
+#: trees accumulates in place (bulk-transform shape, no [T, N] temporary)
+_PREDICT_VMAP_MAX_ROWS = 4096
+
+
 @partial(jax.jit, static_argnames=("multiclass",))
 def _raw_predict_jit(trees: Tree, thresholds, init, x, multiclass: bool):
     def one_tree(tree, thr):
         slot = tree_apply_raw(tree, x, thr)
         return tree.leaf_value[slot]
 
+    small = x.shape[0] <= _PREDICT_VMAP_MAX_ROWS  # static at trace time
     if multiclass:
+        if small:
+            vals = jax.vmap(jax.vmap(one_tree))(trees, thresholds)  # [T,K,N]
+            return init[None, :] + vals.sum(axis=0).T               # [N,K]
+
         def per_iter(acc, tk):
             tree, thr = tk
-            vals = jax.vmap(one_tree)(tree, thr)   # [K, N]
-            return acc + vals.T, None
+            return acc + jax.vmap(one_tree)(tree, thr).T, None
         k = trees.split_slot.shape[1]
-        acc0 = jnp.broadcast_to(init[None, :], (x.shape[0], k)).astype(jnp.float32)
+        acc0 = jnp.broadcast_to(init[None, :],
+                                (x.shape[0], k)).astype(jnp.float32)
         out, _ = jax.lax.scan(per_iter, acc0, (trees, thresholds))
         return out
-    else:
-        def per_iter(acc, tk):
-            tree, thr = tk
-            return acc + one_tree(tree, thr), None
-        acc0 = jnp.full((x.shape[0],), init, jnp.float32)
-        out, _ = jax.lax.scan(per_iter, acc0, (trees, thresholds))
-        return out
+    if small:
+        vals = jax.vmap(one_tree)(trees, thresholds)                # [T,N]
+        return init + vals.sum(axis=0)
+
+    def per_iter(acc, tk):
+        tree, thr = tk
+        return acc + one_tree(tree, thr), None
+    acc0 = jnp.full((x.shape[0],), init, jnp.float32)
+    out, _ = jax.lax.scan(per_iter, acc0, (trees, thresholds))
+    return out
 
 
 @partial(jax.jit, static_argnames=("multiclass",))
