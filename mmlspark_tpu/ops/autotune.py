@@ -26,9 +26,9 @@ import numpy as np
 _ACCEL_CANDIDATES = (
     ("onehot", 4096),
     ("onehot", 16384),
-    ("pallas", 1024),
     ("pallas", 2048),
     ("pallas", 4096),
+    ("pallas", 8192),
 )
 
 _cache: Dict[Tuple, Tuple[str, int]] = {}
@@ -44,7 +44,9 @@ def _sidecar_path() -> str:
                           os.path.join(tempfile.gettempdir(),
                                        "mmlspark_tpu_native"))
     os.makedirs(base, exist_ok=True)
-    return os.path.join(base, "hist_autotune.json")
+    # v2: bumped when the timing methodology changed (host-fetch barrier) so
+    # winners recorded with the broken block_until_ready timing are discarded
+    return os.path.join(base, "hist_autotune_v2.json")
 
 
 def _load_sidecar() -> Dict[str, list]:
@@ -67,9 +69,37 @@ def _store_sidecar(key: str, val: Tuple[str, int]) -> None:
         pass
 
 
+def _dispatch_overhead() -> float:
+    """Median wall seconds of a dispatch+fetch of a trivial jit program —
+    the per-call floor that must be subtracted from kernel timings. On
+    tunneled backends (axon) this is a network round trip (~60 ms), which
+    would otherwise swamp every candidate's real execution time."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    float(fn(x))
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(fn(x))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
 def measure_hist(method: str, chunk: int, n: int, f: int, b: int, l: int,
-                 dtype: str = "bf16", repeats: int = 3) -> float:
-    """Median seconds per all-slots histogram pass at the given shape."""
+                 dtype: str = "bf16", repeats: int = 3, inner: int = 8,
+                 overhead_s: Optional[float] = None) -> float:
+    """Median seconds per all-slots histogram pass at the given shape.
+
+    Timing methodology for remote/tunneled backends, where both pitfalls were
+    hit in round 2: (a) `block_until_ready` can return before the computation
+    finishes (0.02 ms/pass readings for a 1M-row pass), so the barrier is a
+    host FETCH of a scalar; (b) each dispatch+fetch pays the tunnel round
+    trip (~60 ms), so `inner` passes run inside ONE jit program via lax.scan
+    (gh perturbed per step to defeat CSE) and the measured dispatch overhead
+    is subtracted before dividing."""
     import jax
     import jax.numpy as jnp
     from .histogram import hist_slots
@@ -79,15 +109,24 @@ def measure_hist(method: str, chunk: int, n: int, f: int, b: int, l: int,
     slot = jnp.asarray(rng.integers(0, l, (n,)), jnp.int32)
     gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
 
-    fn = jax.jit(lambda bi, sl, g: hist_slots(bi, sl, g, l, b, method,
-                                              chunk, dtype))
-    fn(binned, slot, gh).block_until_ready()          # compile
+    def k_passes(bi, sl, g):
+        def body(acc, j):
+            gj = g * (1.0 + 1e-6 * j.astype(jnp.float32))
+            h = hist_slots(bi, sl, gj, l, b, method, chunk, dtype)
+            return acc + jnp.sum(h), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(inner))
+        return acc
+
+    fn = jax.jit(k_passes)
+    float(fn(binned, slot, gh))                       # compile + settle
+    if overhead_s is None:
+        overhead_s = _dispatch_overhead()
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        fn(binned, slot, gh).block_until_ready()
+        float(fn(binned, slot, gh))
         times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    return max(float(np.median(times)) - overhead_s, 1e-9) / inner
 
 
 def pick_hist_config(n: int, f: int, b: int, l: int, dtype: str = "bf16",
@@ -113,11 +152,13 @@ def pick_hist_config(n: int, f: int, b: int, l: int, dtype: str = "bf16",
         return best
 
     n_probe = int(min(n, probe_rows))
+    overhead = _dispatch_overhead()
     results = {}
     for method, chunk in _ACCEL_CANDIDATES:
         try:
             results[(method, chunk)] = measure_hist(method, chunk, n_probe,
-                                                    f, b, l, dtype)
+                                                    f, b, l, dtype,
+                                                    overhead_s=overhead)
         except Exception:  # noqa: BLE001 - a kernel variant may not lower
             continue
     if not results:

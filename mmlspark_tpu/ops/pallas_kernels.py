@@ -10,19 +10,28 @@ sequential grid steps over row blocks.
 Why Pallas beats the XLA one-hot formulation here: XLA materializes the
 [chunk, F*B] one-hot operand in HBM before the matmul (matmul operands are
 buffers, not fusion temporaries), so the XLA path moves ~2 * N * F * B bytes
-of pure scaffolding per pass and is HBM-bound. This kernel generates both the
-bin one-hot and the slot-expanded gradient matrix in VMEM, so HBM traffic is
-just the [N, F] uint8 bins + [N, C] gradients — the kernel runs at the MXU
-roofline instead.
+of pure scaffolding per pass and is HBM-bound (~7 GB/pass at the bench shape).
+This kernel generates both the bin one-hot and the slot-expanded gradient
+matrix in VMEM, so HBM traffic is just the [F, N] bins + [8, N] gradient pack
+— the kernel runs at the MXU roofline instead.
 
-Layout choices:
-- grid = (feature_tiles, row_blocks) with row blocks minor, so each feature
-  tile's [Ft, B, W] accumulator stays resident in VMEM across its row sweep
-  (zero-at-first-visit / accumulate-afterwards revisiting pattern);
-- output width W = num_slots * C (≈ 93 for 31 leaves) sits on lanes — most of
-  one 128-wide MXU tile;
-- when B < 128, feature pairs are packed into one [T, 2B] one-hot so the dot's
-  M dimension fills the MXU's 128 sublanes;
+Layout (all blocks respect the TPU's (8, 128) f32 / (8, 128) int32 tiling —
+the first version of this kernel used row-major [N, F] blocks with minor dims
+28/1/3 wide and never lowered on real hardware):
+- bins are TRANSPOSED to [F_pad, N_pad] int32: features on sublanes (padded to
+  the 8-multiple feature tile), rows on lanes (padded to the 128-multiple row
+  block). The transpose is loop-invariant — XLA's while-loop LICM hoists it
+  out of the boosting loop, so it is paid once per fit, not per pass;
+- gh channels and the slot id ride one [8, N_pad] f32 operand (rows 0..C-1 =
+  grad/hess/mask, row C = slot id, rest zero) so the row-block slice is one
+  aligned block;
+- the one-hot is generated directly in [pack*B_pad, T] orientation and the
+  slot-expanded gradients in [W_pad, T]; the dot contracts the row dimension
+  of both (no transposes in VMEM);
+- output width W = num_slots * C (≈ 93 for 31 leaves) is padded to 128 lanes
+  — exactly one MXU tile; bins pad to B_pad = 8-multiple sublanes;
+- when B_pad < 128, feature pairs are packed into one [pack*B_pad, T] one-hot
+  so the dot's M dimension fills the MXU's 128 sublanes;
 - bf16 one-hot / gradient operands (exact for the 0/1 side), f32 accumulation.
 """
 
@@ -33,48 +42,59 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
-def _hist_slots_kernel(bins_ref, slot_ref, gh_ref, out_ref, *,
-                       num_bins: int, num_slots: int, channels: int,
-                       pack: int, op_dtype):
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _hist_slots_kernel(bins_ref, ghs_ref, out_ref, *,
+                       b_pad: int, num_slots: int,
+                       channels: int, pack: int, op_dtype):
+    # bins_ref [FT, T] int32 (features x rows), ghs_ref [8, T] f32,
+    # out_ref [FT, B_pad, W_pad] f32 — resident across the row-block sweep
     @pl.when(pl.program_id(1) == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    bins = bins_ref[...]            # [T, Ft] int32
-    slot = slot_ref[...]            # [T, 1] int32
-    gh = gh_ref[...]                # [T, C] f32
-    t, ft = bins.shape
+    ft, t = bins_ref.shape
+    w_pad = out_ref.shape[2]
     w = num_slots * channels
 
-    # slot-expanded gradient matrix ghw[t, l*C + c] = gh[t, c] * 1[slot_t == l]
-    w_iota = jax.lax.broadcasted_iota(jnp.int32, (t, w), 1)
-    ghw = jnp.zeros((t, w), jnp.float32)
+    # slot-expanded gradient matrix ghw[w, t] = gh[w % C, t] * 1[slot_t == w//C]
+    # built once per (feature-tile, row-block) step; cost is O(W*T) elementwise
+    # vs the dot's O(pack*B*W*T) — noise
+    slot = ghs_ref[channels, :].astype(jnp.int32)               # [T]
+    w_iota = jax.lax.broadcasted_iota(jnp.int32, (w_pad, t), 0)
+    ghw = jnp.zeros((w_pad, t), jnp.float32)
     for c in range(channels):
-        ghw = ghw + jnp.where(w_iota % channels == c, gh[:, c][:, None], 0.0)
-    ghw = jnp.where(slot == w_iota // channels, ghw, 0.0)
+        ghw = jnp.where(w_iota % channels == c,
+                        ghs_ref[c, :][None, :], ghw)
+    ghw = jnp.where((w_iota // channels == slot[None, :]) & (w_iota < w),
+                    ghw, 0.0)
     ghw = ghw.astype(op_dtype)
 
-    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (t, num_bins), 1)
+    precision = (None if op_dtype == jnp.bfloat16
+                 # f32 mode promises exact (multi-pass) MXU arithmetic —
+                 # without HIGHEST the MXU would round to bf16 passes anyway
+                 else jax.lax.Precision.HIGHEST)
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (b_pad, t), 0)
     for f0 in range(0, ft, pack):
         oh = jnp.concatenate(
-            [(bins[:, f0 + p][:, None] == bin_iota) for p in range(pack)],
-            axis=1).astype(op_dtype)                           # [T, pack*B]
+            [(bins_ref[f0 + p, :][None, :] == bin_iota) for p in range(pack)],
+            axis=0).astype(op_dtype)                            # [pack*Bp, T]
         res = jax.lax.dot_general(
-            oh, ghw, (((0,), (0,)), ((), ())),
+            oh, ghw, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            # f32 mode promises exact (multi-pass) MXU arithmetic — without
-            # HIGHEST the MXU would round operands to bf16 passes anyway
-            precision=(None if op_dtype == jnp.bfloat16
-                       else jax.lax.Precision.HIGHEST))        # [pack*B, W]
+            precision=precision)                                # [pack*Bp, Wp]
         for p in range(pack):
-            out_ref[f0 + p, :, :] += res[p * num_bins:(p + 1) * num_bins]
+            out_ref[f0 + p, :, :] += res[p * b_pad:(p + 1) * b_pad]
 
 
 def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
                       num_slots: int, num_bins: int,
-                      block_rows: int = 2048, feat_tile: int = 8,
+                      block_rows: int = 4096, feat_tile: int = 32,
                       dtype: str = "bf16",
                       interpret: bool | None = None) -> jax.Array:
     """All-slots Pallas histogram.
@@ -87,57 +107,71 @@ def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
     keeps exact operands for bit-reproducibility with the scatter oracle
     (near-tie split gains can flip under bf16).
 
-    Rows are padded to a block multiple (padded rows carry zero gh); features
-    are padded to the feature-tile multiple with bin id == num_bins, which
-    matches no one-hot column and contributes nothing. On CPU backends runs in
-    interpret mode so virtual-mesh tests exercise the same code path.
+    Rows pad to the 128-multiple block (padded rows carry zero gh => zero
+    contribution); features pad to the tile multiple with bin id == B_pad,
+    which matches no one-hot row. On CPU backends runs in interpret mode so
+    virtual-mesh tests exercise the same code path.
     """
     n, f = binned.shape
     c = gh.shape[1]
-    w = num_slots * c
+    assert c <= 7, "gh channel pack rides one 8-sublane operand"
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
 
-    # pack features per dot while pack*B fits the MXU's 128 sublanes
-    pack = max(1, min(feat_tile, 128 // num_bins))
+    b_pad = _round_up(num_bins, 8)
+    w_pad = _round_up(num_slots * c, 128)
+    block_rows = _round_up(block_rows, 128)
+    feat_tile = _round_up(min(feat_tile, _round_up(f, 8)), 8)
+    # pack features per dot while pack*B_pad fills <= 256 MXU sublanes
+    pack = max(1, min(feat_tile, 256 // b_pad))
     while feat_tile % pack:
         pack -= 1
+    # clamp the row block so the kernel's VMEM temporaries (ghw + iotas + the
+    # packed one-hot, all [*, T]) stay inside the scoped budget: wide B/L
+    # configs (e.g. B=255, L=63) otherwise blow the stack allocation
+    temp_bytes_per_row = 4 * (3 * w_pad + 2 * pack * b_pad + 2 * b_pad)
+    budget = 24 << 20
+    while block_rows > 128 and temp_bytes_per_row * block_rows > budget:
+        block_rows = max(128, _round_up(block_rows // 2, 128))
 
     pad_n = (-n) % block_rows
+    f_pad = _round_up(f, feat_tile)
+    # transposed bins [F_pad, N_pad]: loop-invariant wrt the boosting loop
+    bins_t = jnp.pad(binned.astype(jnp.int32).T,
+                     ((0, f_pad - f), (0, pad_n)), constant_values=b_pad)
+    ghs = jnp.concatenate(
+        [gh.astype(jnp.float32).T,
+         slot.astype(jnp.float32)[None, :],
+         jnp.zeros((8 - c - 1, n), jnp.float32)], axis=0)       # [8, N]
     if pad_n:
-        binned = jnp.pad(binned, ((0, pad_n), (0, 0)))
-        slot = jnp.pad(slot, (0, pad_n))
-        gh = jnp.pad(gh, ((0, pad_n), (0, 0)))
-    pad_f = (-f) % feat_tile
-    if pad_f:
-        binned = jnp.pad(binned, ((0, 0), (0, pad_f)),
-                         constant_values=num_bins)
-    n_pad, f_pad = binned.shape
+        ghs = jnp.pad(ghs, ((0, 0), (0, pad_n)))
+    n_pad = n + pad_n
     grid = (f_pad // feat_tile, n_pad // block_rows)
 
     op_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
     out = pl.pallas_call(
-        functools.partial(_hist_slots_kernel, num_bins=num_bins,
+        functools.partial(_hist_slots_kernel, b_pad=b_pad,
                           num_slots=num_slots, channels=c, pack=pack,
                           op_dtype=op_dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_rows, feat_tile), lambda i, j: (j, i)),
-            pl.BlockSpec((block_rows, 1), lambda i, j: (j, 0)),
-            pl.BlockSpec((block_rows, c), lambda i, j: (j, 0)),
+            pl.BlockSpec((feat_tile, block_rows), lambda i, j: (i, j)),
+            pl.BlockSpec((8, block_rows), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((feat_tile, num_bins, w),
+        out_specs=pl.BlockSpec((feat_tile, b_pad, w_pad),
                                lambda i, j: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f_pad, num_bins, w), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((f_pad, b_pad, w_pad), jnp.float32),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 << 20),
         interpret=interpret,
-    )(binned.astype(jnp.int32), slot.astype(jnp.int32)[:, None],
-      gh.astype(jnp.float32))
-    out = out[:f].reshape(f, num_bins, num_slots, c)
-    return out.transpose(2, 0, 1, 3)               # [L, F, B, C]
+    )(bins_t, ghs)
+    out = out[:f, :num_bins, :num_slots * c]
+    return out.reshape(f, num_bins, num_slots, c).transpose(2, 0, 1, 3)
 
 
 def hist_pallas(binned: jax.Array, gh: jax.Array, num_bins: int,
-                block_rows: int = 2048,
+                block_rows: int = 4096, dtype: str = "bf16",
                 interpret: bool | None = None) -> jax.Array:
     """Single-histogram Pallas build: [N,F] x [N,C] -> [F, B, C].
 
@@ -146,5 +180,6 @@ def hist_pallas(binned: jax.Array, gh: jax.Array, num_bins: int,
     """
     slot = jnp.zeros((binned.shape[0],), jnp.int32)
     out = hist_slots_pallas(binned, slot, gh, 1, num_bins,
-                            block_rows=block_rows, interpret=interpret)
+                            block_rows=block_rows, dtype=dtype,
+                            interpret=interpret)
     return out[0]

@@ -5,27 +5,30 @@ bench shapes on the live backend, prints a markdown table, then times one
 full LightGBMClassifier.fit at the winning config. Run on a real chip; on
 CPU it still works but measures the scatter path (see docs/KERNELS.md)."""
 
+import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
-    from mmlspark_tpu.ops.histogram import hist_slots
+    from mmlspark_tpu.ops.autotune import _dispatch_overhead, measure_hist
 
     dev = jax.devices()[0]
     print(f"backend: {dev.platform} ({dev})", flush=True)
-    rng = np.random.default_rng(0)
+    overhead = _dispatch_overhead()
+    inner = 8
+    print(f"dispatch+fetch overhead: {overhead * 1e3:.1f} ms "
+          f"(subtracted; {inner} passes amortized per timed call)", flush=True)
     n, f, b, l = 1_000_000, 28, 64, 31
-    binned = jnp.asarray(rng.integers(0, b, (n, f)), jnp.uint8)
-    slot = jnp.asarray(rng.integers(0, l, (n,)), jnp.int32)
-    gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
 
     candidates = [("onehot", c, d) for c in (2048, 8192, 32768)
                   for d in ("bf16", "f32")]
-    candidates += [("pallas", c, d) for c in (1024, 2048, 4096, 8192)
+    candidates += [("pallas", c, d) for c in (2048, 4096, 8192, 16384)
                    for d in ("bf16", "f32")]
     if dev.platform == "cpu":
         candidates.append(("scatter", 512, "f32"))
@@ -33,20 +36,14 @@ def main() -> None:
     rows = []
     for method, chunk, dtype in candidates:
         try:
-            fn = jax.jit(lambda bi, sl, g, m=method, c=chunk, d=dtype:
-                         hist_slots(bi, sl, g, l, b, m, c, d))
             t0 = time.perf_counter()
-            fn(binned, slot, gh).block_until_ready()
-            compile_s = time.perf_counter() - t0
-            reps = 10
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                out = fn(binned, slot, gh)
-            out.block_until_ready()
-            ms = (time.perf_counter() - t0) / reps * 1e3
-            rows.append((method, chunk, dtype, ms, compile_s))
+            sec = measure_hist(method, chunk, n, f, b, l, dtype,
+                               inner=inner, overhead_s=overhead)
+            total_s = time.perf_counter() - t0
+            ms = sec * 1e3
+            rows.append((method, chunk, dtype, ms, total_s))
             print(f"  {method:7s} chunk={chunk:<6d} {dtype}: "
-                  f"{ms:8.2f} ms/pass (compile {compile_s:.1f}s)", flush=True)
+                  f"{ms:8.2f} ms/pass (probe {total_s:.1f}s)", flush=True)
         except Exception as e:  # noqa: BLE001 - variant may not lower
             print(f"  {method:7s} chunk={chunk:<6d} {dtype}: FAILED "
                   f"{type(e).__name__}: {str(e)[:120]}", flush=True)
@@ -64,6 +61,7 @@ def main() -> None:
     # one full fit at the winner (100 iters, the bench problem)
     from mmlspark_tpu.core.dataframe import DataFrame
     from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+    rng = np.random.default_rng(0)
     x = rng.normal(size=(n, f)).astype(np.float32)
     y = ((x @ rng.normal(size=f)) > 0).astype(np.float64)
     df = DataFrame({"features": x, "label": y})
