@@ -50,8 +50,7 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _hist_slots_kernel(bins_ref, ghs_ref, out_ref, *,
-                       b_pad: int, num_slots: int,
-                       channels: int, pack: int, op_dtype):
+                       b_pad: int, channels: int, pack: int, op_dtype):
     # bins_ref [FT, T] int32 (features x rows), ghs_ref [8, T] f32,
     # out_ref [FT, B_pad, W_pad] f32 — resident across the row-block sweep
     @pl.when(pl.program_id(1) == 0)
@@ -60,19 +59,19 @@ def _hist_slots_kernel(bins_ref, ghs_ref, out_ref, *,
 
     ft, t = bins_ref.shape
     w_pad = out_ref.shape[2]
-    w = num_slots * channels
 
-    # slot-expanded gradient matrix ghw[w, t] = gh[w % C, t] * 1[slot_t == w//C]
-    # built once per (feature-tile, row-block) step; cost is O(W*T) elementwise
-    # vs the dot's O(pack*B*W*T) — noise
-    slot = ghs_ref[channels, :].astype(jnp.int32)               # [T]
+    # slot-expanded gradient matrix ghw[w, t] = gh[w % C, t] * 1[slot_t == w//C],
+    # built WITHOUT integer div/mod: key_t = slot_t * C, then row w of channel
+    # c matches where w_iota == key_t + c (measured equal-speed to the div/mod
+    # form at the bench shape — the dot dominates — but fewer ops and no
+    # multi-op integer division on the VPU). Rows w >= num_slots*C can never
+    # equal key+c => they stay zero, which zero-pads the output width.
+    key = ghs_ref[channels, :].astype(jnp.int32) * channels     # [T]
     w_iota = jax.lax.broadcasted_iota(jnp.int32, (w_pad, t), 0)
     ghw = jnp.zeros((w_pad, t), jnp.float32)
     for c in range(channels):
-        ghw = jnp.where(w_iota % channels == c,
+        ghw = jnp.where(w_iota == key[None, :] + c,
                         ghs_ref[c, :][None, :], ghw)
-    ghw = jnp.where((w_iota // channels == slot[None, :]) & (w_iota < w),
-                    ghw, 0.0)
     ghw = ghw.astype(op_dtype)
 
     precision = (None if op_dtype == jnp.bfloat16
@@ -151,8 +150,7 @@ def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
     op_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
     out = pl.pallas_call(
         functools.partial(_hist_slots_kernel, b_pad=b_pad,
-                          num_slots=num_slots, channels=c, pack=pack,
-                          op_dtype=op_dtype),
+                          channels=c, pack=pack, op_dtype=op_dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((feat_tile, block_rows), lambda i, j: (i, j)),
