@@ -162,6 +162,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                       "MXU operand dtype for the histogram contraction: "
                       "bf16 (fast, grads rounded ~3 digits) or f32 (exact, "
                       "bit-reproducible vs the scatter oracle)", "bf16")
+    histRefresh = Param(
+        "histRefresh",
+        "histogram refresh policy: eager (exact LightGBM leaf-wise, one "
+        "all-slots pass per split) or lazy (split best-first among leaves "
+        "with current histograms, re-histogram only when that pool dries — "
+        "~one pass per tree level, new children enter the pool one refresh "
+        "late; TPU-native optimization, no reference analogue)", "eager")
     slotNames = Param("slotNames", "feature slot names", None)
     categoricalSlotIndexes = Param("categoricalSlotIndexes",
                                    "indexes of categorical features", None)
@@ -300,6 +307,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             hist_chunk=getattr(self, "_hist_chunk_resolved", None)
             or self.get("histChunk"),
             hist_dtype=self.get("histDtype"),
+            split_refresh=self.get("histRefresh"),
             categorical_features=tuple(self._categorical_indexes()),
             cat_smooth=self.get("catSmooth"),
             max_cat_threshold=self.get("maxCatThreshold"),
@@ -405,6 +413,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         if self.get("histDtype") not in ("bf16", "f32"):
             raise ValueError(
                 f"histDtype must be bf16 or f32, got {self.get('histDtype')!r}")
+        if self.get("histRefresh") not in ("eager", "lazy"):
+            raise ValueError(
+                f"histRefresh must be eager or lazy, got "
+                f"{self.get('histRefresh')!r}")
         if ((self.get("posBaggingFraction") >= 0
              or self.get("negBaggingFraction") >= 0)
                 and (objective or self._objective_name()) != "binary"):

@@ -260,3 +260,56 @@ def test_multiclassova_objective(multiclass_df):
     probs = np.stack(out["probability"])
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
     assert ova.booster.objective == "multiclassova"
+
+
+class TestHistRefresh:
+    """Lazy histogram refresh (histRefresh='lazy'): best-first splitting over
+    leaves with current histograms, re-histogramming only when the pool dries
+    (~one all-slots pass per tree level). TPU-native optimization with no
+    reference analogue; quality must stay close to exact leaf-wise and the
+    distributed path must agree with single-shard."""
+
+    def test_lazy_quality_close_to_eager(self, binary_df):
+        kw = dict(numIterations=40, numLeaves=31, numTasks=1, seed=3)
+        e = LightGBMClassifier(histRefresh="eager", **kw).fit(binary_df)
+        l = LightGBMClassifier(histRefresh="lazy", **kw).fit(binary_df)
+        y = binary_df["label"]
+        pe = np.stack(e.transform(binary_df)["probability"])[:, 1]
+        pl = np.stack(l.transform(binary_df)["probability"])[:, 1]
+        auc_e, auc_l = auc(y, pe), auc(y, pl)
+        assert auc_l > 0.9, auc_l
+        assert abs(auc_e - auc_l) < 0.03, (auc_e, auc_l)
+
+    def test_lazy_shard_equivalence(self, binary_df):
+        kw = dict(numIterations=20, numLeaves=15, histRefresh="lazy", seed=5)
+        p1 = np.stack(LightGBMClassifier(numTasks=1, **kw).fit(binary_df)
+                      .transform(binary_df)["probability"])[:, 1]
+        p8 = np.stack(LightGBMClassifier(numTasks=8, **kw).fit(binary_df)
+                      .transform(binary_df)["probability"])[:, 1]
+        np.testing.assert_allclose(p1, p8, atol=2e-5)
+
+    def test_lazy_regression(self, regression_df):
+        m = LightGBMRegressor(numIterations=40, numLeaves=31, numTasks=1,
+                              histRefresh="lazy").fit(regression_df)
+        pred = np.asarray(m.transform(regression_df)["prediction"])
+        y = regression_df["label"]
+        mse = float(((pred - y) ** 2).mean())
+        assert mse < 0.5 * float(np.var(y)), mse
+
+    def test_lazy_metrics_finite_and_decreasing(self, binary_df):
+        m = LightGBMClassifier(numIterations=30, numLeaves=15, numTasks=1,
+                               histRefresh="lazy").fit(binary_df)
+        tm = m.train_metrics
+        assert np.isfinite(tm).all()
+        assert tm[-1] < tm[0]
+
+    def test_invalid_refresh_rejected(self, binary_df):
+        import pytest
+        with pytest.raises(ValueError, match="histRefresh"):
+            LightGBMClassifier(histRefresh="sometimes").fit(binary_df)
+
+    def test_lazy_voting_rejected(self, binary_df):
+        import pytest
+        with pytest.raises(NotImplementedError, match="voting"):
+            LightGBMClassifier(histRefresh="lazy", numTasks=8,
+                               parallelism="voting_parallel").fit(binary_df)
