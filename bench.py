@@ -93,9 +93,13 @@ def main():
     from mmlspark_tpu.models.lightgbm import LightGBMClassifier
 
     # Full problem on an accelerator; scaled down on CPU fallback so the bench
-    # stays bounded (throughput unit is identical either way).
+    # stays bounded (throughput unit is identical either way). 4M rows is the
+    # largest HIGGS-shaped slice that keeps the whole bench (autotune + warm
+    # + timed + lazy extra) under ~5 min on one chip behind the tunnel —
+    # larger N only amortizes fixed costs further, so this under-reports
+    # full-HIGGS throughput rather than inflating it.
     if on_accel:
-        n, f, iters = 1_000_000, 28, 100
+        n, f, iters = 4_000_000, 28, 100
     else:
         n, f, iters = 100_000, 28, 10
 

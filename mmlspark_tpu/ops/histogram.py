@@ -176,16 +176,41 @@ def hist_slots(binned: jax.Array, slot: jax.Array, gh: jax.Array,
     raise ValueError(f"unknown histogram method {method!r}")
 
 
+_PALLAS_OK: Optional[bool] = None
+
+
+def _pallas_lowers() -> bool:
+    """One-time probe: compile+run a tiny all-slots Pallas histogram on the
+    live backend. Guards the 'auto' default — a Mosaic lowering change (or a
+    TPU generation with different tiling rules) degrades auto to the XLA
+    one-hot path instead of failing every fit."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            from .pallas_kernels import hist_slots_pallas
+            import numpy as np
+            out = hist_slots_pallas(
+                jnp.asarray(np.zeros((8, 2), np.uint8)),
+                jnp.zeros((8,), jnp.int32),
+                jnp.ones((8, 3), jnp.float32), 3, 4, interpret=False)
+            jax.block_until_ready(out)
+            _PALLAS_OK = True
+        except Exception:  # noqa: BLE001 - any lowering failure disables it
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
 def resolve_hist_method(method: str) -> str:
     """'auto' picks per backend: on TPU the Pallas kernel is the measured
-    winner (2.9 vs 4.1 ms/pass at the bench shape — docs/KERNELS.md); other
-    accelerators get the XLA one-hot contraction; on CPU (tests, virtual
-    meshes) XLA's native scatter-add is far cheaper (~27x)."""
+    winner (2.9 vs 4.1 ms/pass at the bench shape — docs/KERNELS.md), with a
+    one-time lowering probe falling back to the XLA one-hot contraction;
+    other accelerators get the one-hot path; on CPU (tests, virtual meshes)
+    XLA's native scatter-add is far cheaper (~27x)."""
     if method == "auto":
         backend = jax.default_backend()
         if backend == "cpu":
             return "scatter"
-        return "pallas" if backend == "tpu" else "onehot"
+        return "pallas" if backend == "tpu" and _pallas_lowers() else "onehot"
     return method
 
 

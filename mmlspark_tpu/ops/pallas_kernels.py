@@ -51,7 +51,7 @@ def _round_up(x: int, m: int) -> int:
 
 def _hist_slots_kernel(bins_ref, ghs_ref, out_ref, *,
                        b_pad: int, channels: int, pack: int, op_dtype):
-    # bins_ref [FT, T] int32 (features x rows), ghs_ref [8, T] f32,
+    # bins_ref [FT, T] int8 or int32 (features x rows), ghs_ref [8, T] f32,
     # out_ref [FT, B_pad, W_pad] f32 — resident across the row-block sweep
     @pl.when(pl.program_id(1) == 0)
     def _init():
@@ -59,6 +59,7 @@ def _hist_slots_kernel(bins_ref, ghs_ref, out_ref, *,
 
     ft, t = bins_ref.shape
     w_pad = out_ref.shape[2]
+    bins = bins_ref[...].astype(jnp.int32)
 
     # slot-expanded gradient matrix ghw[w, t] = gh[w % C, t] * 1[slot_t == w//C],
     # built WITHOUT integer div/mod: key_t = slot_t * C, then row w of channel
@@ -81,7 +82,7 @@ def _hist_slots_kernel(bins_ref, ghs_ref, out_ref, *,
     bin_iota = jax.lax.broadcasted_iota(jnp.int32, (b_pad, t), 0)
     for f0 in range(0, ft, pack):
         oh = jnp.concatenate(
-            [(bins_ref[f0 + p, :][None, :] == bin_iota) for p in range(pack)],
+            [(bins[f0 + p, :][None, :] == bin_iota) for p in range(pack)],
             axis=0).astype(op_dtype)                            # [pack*Bp, T]
         res = jax.lax.dot_general(
             oh, ghw, (((1,), (1,)), ((), ())),
@@ -120,7 +121,14 @@ def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
     b_pad = _round_up(num_bins, 8)
     w_pad = _round_up(num_slots * c, 128)
     block_rows = _round_up(block_rows, 128)
-    feat_tile = _round_up(min(feat_tile, _round_up(f, 8)), 8)
+    # int8 bins when ids (incl. the b_pad feature-padding sentinel) fit a
+    # signed byte: 4x less HBM residency + bins read traffic than int32. The
+    # int8 memory tile is (32, 128), so the feature tile widens to 32.
+    bins_i8 = b_pad < 127
+    if bins_i8:
+        feat_tile = _round_up(min(max(feat_tile, 32), _round_up(f, 32)), 32)
+    else:
+        feat_tile = _round_up(min(feat_tile, _round_up(f, 8)), 8)
     # pack features per dot while pack*B_pad fills <= 256 MXU sublanes
     pack = max(1, min(feat_tile, 256 // b_pad))
     while feat_tile % pack:
@@ -136,7 +144,7 @@ def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
     pad_n = (-n) % block_rows
     f_pad = _round_up(f, feat_tile)
     # transposed bins [F_pad, N_pad]: loop-invariant wrt the boosting loop
-    bins_t = jnp.pad(binned.astype(jnp.int32).T,
+    bins_t = jnp.pad(binned.astype(jnp.int8 if bins_i8 else jnp.int32).T,
                      ((0, f_pad - f), (0, pad_n)), constant_values=b_pad)
     ghs = jnp.concatenate(
         [gh.astype(jnp.float32).T,
