@@ -313,3 +313,64 @@ class TestHistRefresh:
         with pytest.raises(NotImplementedError, match="voting"):
             LightGBMClassifier(histRefresh="lazy", numTasks=8,
                                parallelism="voting_parallel").fit(binary_df)
+
+    def test_lazy_cross_param_grid(self, binary_df, multiclass_df,
+                                   regression_df):
+        """Lazy refresh must compose with every boosting mode / objective the
+        trainer exposes (mirrors the reference's FuzzingTest breadth idea:
+        param combinations must not interact into crashes or NaNs)."""
+        cases = [
+            (LightGBMClassifier, binary_df,
+             dict(boostingType="goss", topRate=0.3, otherRate=0.2)),
+            (LightGBMClassifier, binary_df,
+             dict(boostingType="dart")),
+            (LightGBMClassifier, binary_df,
+             dict(boostingType="rf", baggingFreq=1, baggingFraction=0.7)),
+            (LightGBMClassifier, binary_df,
+             dict(featureFraction=0.6, baggingFreq=2, baggingFraction=0.8)),
+            (LightGBMClassifier, multiclass_df, dict(objective="multiclass")),
+            (LightGBMRegressor, regression_df, dict(objective="quantile",
+                                                    alpha=0.7)),
+            (LightGBMRegressor, regression_df, dict(objective="huber")),
+            (LightGBMClassifier, binary_df, dict(maxDepth=3)),
+            (LightGBMClassifier, binary_df, dict(minGainToSplit=0.5)),
+        ]
+        for est, df, kw in cases:
+            m = est(numIterations=8, numLeaves=15, numTasks=1,
+                    histRefresh="lazy", **kw).fit(df)
+            tm = m.train_metrics
+            assert tm is not None and np.isfinite(tm).all(), (kw, tm)
+
+    def test_lazy_categorical(self):
+        """Lazy + categorical bitset splits: the cached best_bin is a
+        sorted-order prefix length whose mask is reconstructed from the SAME
+        histogram snapshot the cache was computed from."""
+        rng = np.random.default_rng(4)
+        n = 3000
+        cat = rng.integers(0, 12, n)
+        x = np.stack([cat.astype(np.float32),
+                      rng.normal(size=n).astype(np.float32)], axis=1)
+        y = ((cat % 3 == 0) ^ (rng.random(n) < 0.05)).astype(np.float64)
+        df = DataFrame({"features": x, "label": y})
+        kw = dict(numIterations=20, numLeaves=15, numTasks=1,
+                  categoricalSlotIndexes=[0])
+        pe = np.stack(LightGBMClassifier(histRefresh="eager", **kw).fit(df)
+                      .transform(df)["probability"])[:, 1]
+        pl = np.stack(LightGBMClassifier(histRefresh="lazy", **kw).fit(df)
+                      .transform(df)["probability"])[:, 1]
+        assert auc(y, pe) > 0.95
+        assert auc(y, pl) > 0.95
+
+    def test_lazy_early_stopping(self, binary_df):
+        """Lazy + chunked early stopping (validationIndicatorCol)."""
+        df = binary_df
+        n = len(df)
+        is_valid = np.zeros(n, bool)
+        is_valid[::4] = True
+        df2 = DataFrame({"features": df["features"], "label": df["label"],
+                         "isVal": is_valid})
+        m = LightGBMClassifier(numIterations=200, earlyStoppingRound=5,
+                               validationIndicatorCol="isVal", numTasks=1,
+                               histRefresh="lazy").fit(df2)
+        assert m.booster.num_iterations < 200
+        assert np.isfinite(m.valid_metrics).all()
