@@ -79,6 +79,22 @@ class TestBreastCancerAnchor:
 
 
 class TestMulticlassAccuracy:
+    def test_digits_10class(self):
+        """10-class digits (1797 x 64): the widest multiclass gate — also
+        exercises the vmapped per-class tree build at K=10."""
+        from sklearn.datasets import load_digits
+        bench = Benchmarks(os.path.join(BENCH_DIR, "real_multiclass.csv"))
+        data = load_digits()
+        train, test = _split(data.data, data.target, seed=11)
+        clf = LightGBMClassifier(numIterations=40, numLeaves=15,
+                                 minDataInLeaf=5)
+        model = clf.fit(train)
+        pred = model.transform(test)["prediction"]
+        acc = float(np.mean(pred == test["label"]))
+        assert acc > 0.9, f"digits: {acc}"
+        bench.add("acc_digits_gbdt", acc, 0.03)
+        bench.verify()
+
     def test_wine_iris_grid(self):
         bench = Benchmarks(os.path.join(BENCH_DIR, "real_multiclass.csv"))
         for name, loader in (("wine", load_wine), ("iris", load_iris)):
