@@ -25,7 +25,8 @@ import numpy as np
 from ...core import params as _p
 from ...core.dataframe import DataFrame
 from ...core.pipeline import Model
-from ...ops.attention import attention_reference, ring_attention_sharded
+from ...ops.attention import (attention_reference, flash_attention,
+                              ring_attention_sharded)
 
 
 def init_encoder_params(key, num_layers: int, d_model: int, num_heads: int,
@@ -62,10 +63,13 @@ def _apply(p, x):
 
 def encoder_forward(params, x: jax.Array, num_heads: int,
                     causal: bool = False,
-                    axis_name: Optional[str] = None) -> jax.Array:
+                    axis_name: Optional[str] = None,
+                    attention_impl: str = "flash") -> jax.Array:
     """Pre-LN encoder stack. x: [B, S, D] (shard-local S when axis_name is
     set — every non-attention op is position-wise, so only attention needs
-    the ring)."""
+    the ring). Single-device attention uses the fused Pallas flash kernel
+    (no [S, S] score matrix in HBM); attention_impl="reference" keeps the
+    dense XLA path for cross-checks."""
     b, s, d = x.shape
     hd = d // num_heads
     for lp in params["layers"]:
@@ -73,7 +77,10 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
         qkv = _apply(lp["qkv"], h).reshape(b, s, 3, num_heads, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if axis_name is None:
-            att = attention_reference(q, k, v, causal=causal)
+            if attention_impl == "flash":
+                att = flash_attention(q, k, v, causal=causal)
+            else:
+                att = attention_reference(q, k, v, causal=causal)
         else:
             att = ring_attention_sharded(q, k, v, axis_name, causal=causal)
         x = x + _apply(lp["proj"], att.reshape(b, s, d))

@@ -20,12 +20,19 @@ and out = acc / l after all P blocks have visited.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
 
 
 def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -107,3 +114,118 @@ def ring_attention(q, k, v, mesh, axis_name: str = "data",
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Single-device flash attention (Pallas)
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q: int, block_k: int, s: int, d: int, causal: bool):
+    # grid (BH, S/Bq, S/Bk), k-blocks minor. q_ref [1, Bq, Dp]; k/v [1, Bk, Dp];
+    # o_ref [1, Bq, Dp]; scratch m/l [Bq, 128], acc [Bq, Dp] persist across
+    # the k sweep of one (bh, qi) cell.
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    i = pl.program_id(1)
+    q_pos = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    run = True
+    if causal:
+        # skip k-blocks strictly above the diagonal (their mask is all-False)
+        run = (j * block_k) <= (i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)             # [Bq, Dp]
+        k = k_ref[0].astype(jnp.float32)             # [Bk, Dp]
+        scale = 1.0 / np.sqrt(d)                     # true head dim, not Dp
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [Bq, Bk]
+        valid = k_pos < s
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        scores = jnp.where(valid, scores, -jnp.inf)
+
+        m_prev = m_ref[:, 0]                         # [Bq]
+        m_new = jnp.maximum(m_prev, scores.max(axis=1))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m_prev), m_new, m_prev) - m_safe)
+        p = jnp.exp(scores - m_safe[:, None])        # [Bq, Bk]
+        l_ref[...] = (l_ref[...] * corr[:, None]
+                      + jnp.broadcast_to(p.sum(axis=1)[:, None],
+                                         l_ref.shape))
+        acc_ref[...] = (acc_ref[...] * corr[:, None]
+                        + jax.lax.dot_general(
+                            p, v_ref[0].astype(jnp.float32),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = 256,
+                    block_k: int = 256,
+                    interpret: bool | None = None) -> jax.Array:
+    """Fused single-device attention: no [S, S] score matrix ever reaches
+    HBM (the XLA reference materializes [B, H, S, S], which at S=8k, H=8 is
+    2 GB per batch element). q, k, v: [B, S, H, D] -> [B, S, H, D].
+
+    Complements ring attention: the ring shards the sequence ACROSS devices
+    (ops/attention.ring_attention); this kernel streams k-blocks WITHIN a
+    device. Head dim pads to 128 lanes; sequence pads to the block size
+    (padded k positions are masked, padded q rows are sliced off).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b, s, h, d = q.shape
+    d_pad = _round_up(d, 128)
+    block_q = min(block_q, _round_up(s, 128))
+    block_k = min(block_k, _round_up(s, 128))
+    s_pad = _round_up(s, max(block_q, block_k))
+
+    def prep(x):
+        x = x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        return jnp.pad(x, ((0, 0), (0, s_pad - s), (0, d_pad - d)))
+
+    qp, kp, vp = prep(q), prep(k), prep(v)
+    grid = (b * h, s_pad // block_q, s_pad // block_k)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
+                          s=s, d=d, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad),
+                               lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d_pad), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d_pad), jnp.float32),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 << 20),
+        interpret=interpret,
+    )(qp, kp, vp)
+    out = out[:, :s, :d].reshape(b, h, s, d)
+    return out.transpose(0, 2, 1, 3)
