@@ -1,5 +1,6 @@
 """Featurization layer (reference: featurize/ — SURVEY.md §2.3, 1757 LoC)."""
 
+from .bundling import SparseFeatureBundler, SparseFeatureBundlerModel
 from .clean import CleanMissingData, CleanMissingDataModel, DataConversion
 from .featurize import Featurize, FeaturizeModel
 from .indexers import (CATEGORICAL_META_KEY, IndexToValue, ValueIndexer,
@@ -7,6 +8,8 @@ from .indexers import (CATEGORICAL_META_KEY, IndexToValue, ValueIndexer,
 from .text import MultiNGram, PageSplitter, TextFeaturizer, TextFeaturizerModel
 
 __all__ = [
+    "SparseFeatureBundler",
+    "SparseFeatureBundlerModel",
     "CATEGORICAL_META_KEY", "CleanMissingData", "CleanMissingDataModel",
     "DataConversion", "Featurize", "FeaturizeModel", "IndexToValue",
     "MultiNGram", "PageSplitter", "TextFeaturizer", "TextFeaturizerModel",

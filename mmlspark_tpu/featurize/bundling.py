@@ -1,0 +1,215 @@
+"""Exclusive sparse-feature bundling — dense bundles for the MXU histogram.
+
+Reference analogue: SURVEY.md §7 flags "sparse/CSR ingestion ... bin-packing
+sparse features" as a hard part of the LightGBM-equivalent data plane
+(LGBM_DatasetCreateFromCSRSpark, lightgbm/LightGBMUtils.scala:201-265 CSR
+marshalling). Upstream LightGBM solves it internally with Exclusive Feature
+Bundling (EFB, the LightGBM paper §4): features that are (almost) never
+nonzero on the same row are packed into one column of disjoint bin ranges.
+
+TPU-first adaptation: bundling is a PIPELINE STAGE, not a trainer internal.
+Each bundle becomes one dense int32 CATEGORY column (code 0 = all features
+zero; feature j's nonzero value binned to b => offset_j + b), and the stage
+exports `categoricalSlotIndexes` so a downstream LightGBM trainer searches
+subset splits over the bundle — strictly more expressive than per-feature
+thresholds for the binary/sparse features this targets, and the histogram
+kernel sees a dense narrow matrix instead of a 2^18-wide sparse one. A
+hashed-text matrix (featurize/text.py, 2^18 columns) becomes ~max-row-nnz
+dense columns.
+
+Greedy bundling follows the EFB algorithm: order features by nonzero count,
+place each into the first bundle where added conflicts stay within
+`maxConflictRate * n_rows` (and the bundle's bin budget), else open a new
+bundle.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import params as _p
+from ..core.dataframe import DataFrame
+from ..core.pipeline import Estimator, Model
+
+
+def _to_csc(x):
+    """Accept dense [N, F], scipy CSR/CSC, or a column of per-row sparse
+    vectors; return (csc_matrix, n, f)."""
+    try:
+        import scipy.sparse as sp
+    except ImportError:  # pragma: no cover - scipy ships with sklearn
+        sp = None
+    if sp is not None and sp.issparse(x):
+        csc = x.tocsc()
+        return csc, csc.shape[0], csc.shape[1]
+    x = np.asarray(x)
+    if x.dtype == object and len(x) and hasattr(x[0], "toarray"):
+        import scipy.sparse as sp
+        rows = [r.tocsr() if sp.issparse(r) else sp.csr_matrix(np.asarray(r))
+                for r in x]
+        csc = sp.vstack(rows).tocsc()
+        return csc, csc.shape[0], csc.shape[1]
+    if sp is None:
+        raise ImportError("scipy required for sparse bundling")
+    csc = sp.csc_matrix(x)
+    return csc, x.shape[0], x.shape[1]
+
+
+def greedy_bundles(row_sets: List[np.ndarray], n_rows: int, nnz: np.ndarray,
+                   max_conflict_rate: float, bins_per_feature: np.ndarray,
+                   max_bundle_bins: int) -> List[List[int]]:
+    """EFB greedy packing. row_sets[j] = sorted row indices where feature j
+    is nonzero. Returns bundles as lists of original feature indices."""
+    order = np.argsort(-nnz, kind="stable")
+    budget = max(int(max_conflict_rate * n_rows), 0)
+    bundles: List[List[int]] = []
+    occupied: List[np.ndarray] = []   # [n_rows] bool per bundle: O(nnz_j)
+    bundle_conflicts: List[int] = []  # conflict checks, not O(N log N) set ops
+    bundle_bins: List[int] = []
+    for j in order:
+        if nnz[j] == 0:
+            continue  # never-nonzero features contribute nothing
+        placed = False
+        for bi in range(len(bundles)):
+            if bundle_bins[bi] + bins_per_feature[j] > max_bundle_bins:
+                continue
+            conflicts = int(occupied[bi][row_sets[j]].sum())
+            if bundle_conflicts[bi] + conflicts <= budget:
+                bundles[bi].append(int(j))
+                occupied[bi][row_sets[j]] = True
+                bundle_conflicts[bi] += conflicts
+                bundle_bins[bi] += int(bins_per_feature[j])
+                placed = True
+                break
+        if not placed:
+            occ = np.zeros(n_rows, bool)
+            occ[row_sets[j]] = True
+            bundles.append([int(j)])
+            occupied.append(occ)
+            bundle_conflicts.append(0)
+            bundle_bins.append(int(bins_per_feature[j]))
+    return bundles
+
+
+class SparseFeatureBundler(Estimator):
+    """Learn an exclusive-feature bundling of a sparse feature column.
+
+    inputCol: dense [N, F] array, scipy sparse matrix, or per-row sparse
+    vectors. outputCol: dense [N, n_bundles] int32 category codes. The
+    fitted model's `categorical_indexes()` lists every output column (pass
+    to LightGBM* `categoricalSlotIndexes`).
+    """
+
+    inputCol = _p.Param("inputCol", "sparse feature column", "features")
+    outputCol = _p.Param("outputCol", "bundled dense output column",
+                         "bundled")
+    maxConflictRate = _p.Param(
+        "maxConflictRate",
+        "max fraction of rows where bundled features may collide (EFB "
+        "gamma); colliding rows keep the higher-count feature's code", 0.0,
+        float)
+    numValueBins = _p.Param(
+        "numValueBins",
+        "quantile bins per feature's nonzero values (1 = presence only, "
+        "the right setting for hashed/one-hot input)", 1, int)
+    maxBundleBins = _p.Param(
+        "maxBundleBins",
+        "bin budget per bundle incl. the shared zero bin (keep <= the "
+        "trainer's maxBin)", 255, int)
+
+    def _fit(self, df: DataFrame) -> "SparseFeatureBundlerModel":
+        csc, n, f = _to_csc(df[self.get("inputCol")])
+        k = max(int(self.get("numValueBins")), 1)
+        nnz = np.diff(csc.indptr)
+        row_sets = [np.sort(csc.indices[csc.indptr[j]:csc.indptr[j + 1]])
+                    for j in range(f)]
+        bins_per = np.full(f, k, np.int64)
+        bundles = greedy_bundles(row_sets, n, nnz,
+                                 float(self.get("maxConflictRate")),
+                                 bins_per, int(self.get("maxBundleBins")) - 1)
+        # per-feature nonzero-value quantile edges (k > 1 only)
+        edges = {}
+        if k > 1:
+            for b in bundles:
+                for j in b:
+                    vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
+                    qs = np.quantile(vals, np.linspace(0, 1, k + 1)[1:-1])
+                    edges[j] = np.unique(qs)
+        model = SparseFeatureBundlerModel(
+            bundles=bundles, num_features=f, value_edges=edges,
+            bins_per_feature=int(k))
+        model.set("inputCol", self.get("inputCol"))
+        model.set("outputCol", self.get("outputCol"))
+        return model
+
+
+class SparseFeatureBundlerModel(Model):
+    inputCol = _p.Param("inputCol", "sparse feature column", "features")
+    outputCol = _p.Param("outputCol", "bundled dense output column",
+                         "bundled")
+    bundleSpec = _p.Param("bundleSpec", "fitted bundling description", None,
+                          complex=True)
+
+    def __init__(self, bundles: Optional[List[List[int]]] = None,
+                 num_features: int = 0, value_edges=None,
+                 bins_per_feature: int = 1, **kw):
+        super().__init__(**kw)
+        if bundles is not None:
+            self.set("bundleSpec", {
+                "bundles": [list(map(int, b)) for b in bundles],
+                "num_features": int(num_features),
+                "bins_per_feature": int(bins_per_feature),
+                "value_edges": {int(j): np.asarray(e).tolist()
+                                for j, e in (value_edges or {}).items()},
+            })
+
+    @property
+    def _spec(self):
+        return self.get("bundleSpec")
+
+    @property
+    def num_bundles(self) -> int:
+        return len(self._spec["bundles"])
+
+    def categorical_indexes(self) -> List[int]:
+        """Every output column is categorical — hand to the GBDT trainer."""
+        return list(range(self.num_bundles))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        spec = self._spec
+        csc, n, f = _to_csc(df[self.get("inputCol")])
+        if f != spec["num_features"]:
+            raise ValueError(
+                f"bundler was fitted on {spec['num_features']} features, "
+                f"input has {f}")
+        k = spec["bins_per_feature"]
+
+        def width(j):
+            return (len(spec["value_edges"].get(j, [])
+                        or spec["value_edges"].get(str(j), [])) + 1
+                    if k > 1 else 1)
+
+        out = np.zeros((n, len(spec["bundles"])), np.int32)
+        for bi, bundle in enumerate(spec["bundles"]):
+            # code layout: 0 = every feature zero; feature i of the bundle
+            # owns the contiguous range [start_i, start_i + width_i)
+            starts = np.cumsum([1] + [width(j) for j in bundle[:-1]])
+            col = np.zeros(n, np.int32)
+            # bundle order is descending nnz (EFB insertion order); write in
+            # reverse so on (budgeted, rare) conflicts the higher-count
+            # feature's code prevails
+            for i in reversed(range(len(bundle))):
+                j = bundle[i]
+                rows = csc.indices[csc.indptr[j]:csc.indptr[j + 1]]
+                vals = csc.data[csc.indptr[j]:csc.indptr[j + 1]]
+                if k > 1:
+                    e = np.asarray(spec["value_edges"].get(j, [])
+                                   or spec["value_edges"].get(str(j), []))
+                    code = starts[i] + np.searchsorted(e, vals, side="left")
+                else:
+                    code = np.full(rows.size, starts[i], np.int64)
+                col[rows] = code.astype(np.int32)
+            out[:, bi] = col
+        return df.with_column(self.get("outputCol"), out)

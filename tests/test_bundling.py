@@ -1,0 +1,122 @@
+"""Exclusive sparse-feature bundling (EFB-adapted pipeline stage).
+
+Reference: SURVEY.md §7 "bin-packing sparse features" hard part; upstream
+LightGBM's Exclusive Feature Bundling packs near-mutually-exclusive sparse
+columns so histograms stay narrow. Here bundles are dense categorical
+columns consumed by the GBDT's subset splits.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.featurize import SparseFeatureBundler
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+from conftest import auc
+
+
+def _one_hot_sparse(codes, width):
+    n = len(codes)
+    return sp.csr_matrix(
+        (np.ones(n, np.float32), (np.arange(n), codes)), shape=(n, width))
+
+
+def test_disjoint_features_share_one_bundle():
+    # a one-hot block is perfectly mutually exclusive -> exactly one bundle
+    rng = np.random.default_rng(0)
+    codes = rng.integers(0, 6, 500)
+    x = _one_hot_sparse(codes, 6)
+    df = DataFrame({"features": x, "y": np.zeros(500)})
+    model = SparseFeatureBundler().fit(df)
+    assert model.num_bundles == 1
+    out = np.asarray(model.transform(df)["bundled"])
+    assert out.shape == (500, 1)
+    # each original code maps to a distinct bundle code, injectively
+    mapping = {}
+    for c, b in zip(codes, out[:, 0]):
+        assert mapping.setdefault(int(c), int(b)) == int(b)
+    assert len(set(mapping.values())) == 6
+    assert (out > 0).all()  # every row has exactly one nonzero
+
+
+def test_conflicting_features_split_bundles():
+    rng = np.random.default_rng(1)
+    a = (rng.random(400) < 0.5).astype(np.float32)
+    b = (rng.random(400) < 0.5).astype(np.float32)  # overlaps a ~25% of rows
+    x = sp.csr_matrix(np.stack([a, b], axis=1))
+    df = DataFrame({"features": x, "y": np.zeros(400)})
+    m0 = SparseFeatureBundler(maxConflictRate=0.0).fit(df)
+    assert m0.num_bundles == 2
+    # a generous conflict budget lets them share (conflicting rows keep the
+    # higher-count feature's code)
+    m1 = SparseFeatureBundler(maxConflictRate=0.5).fit(df)
+    assert m1.num_bundles == 1
+
+
+def test_zero_rows_code_zero():
+    x = sp.csr_matrix(np.array([[0, 0], [1, 0], [0, 2]], np.float32))
+    df = DataFrame({"features": x, "y": np.zeros(3)})
+    model = SparseFeatureBundler().fit(df)
+    out = np.asarray(model.transform(df)["bundled"])
+    assert out[0].sum() == 0
+
+
+def test_value_bins():
+    # numValueBins > 1: nonzero magnitudes get quantile codes
+    rng = np.random.default_rng(2)
+    vals = np.where(rng.random(600) < 0.5, 0.0,
+                    rng.uniform(1, 100, 600)).astype(np.float32)
+    x = sp.csr_matrix(vals[:, None])
+    df = DataFrame({"features": x, "y": np.zeros(600)})
+    model = SparseFeatureBundler(numValueBins=4).fit(df)
+    out = np.asarray(model.transform(df)["bundled"])[:, 0]
+    assert out[vals == 0].max(initial=0) == 0
+    assert len(np.unique(out[vals > 0])) == 4  # 4 magnitude codes
+
+
+def test_hashed_text_end_to_end():
+    """The capability this exists for: a wide hashed one-hot space becomes a
+    few dense categorical columns a GBDT can actually train on."""
+    rng = np.random.default_rng(3)
+    n, vocab, width = 1500, 40, 4096
+    # each row: one "token" hashed into a wide space; label depends on token
+    tokens = rng.integers(0, vocab, n)
+    slots = (tokens * 2654435761) % width
+    x = _one_hot_sparse(slots, width)
+    y = (tokens % 3 == 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    bundler = SparseFeatureBundler().fit(df)
+    assert bundler.num_bundles == 1          # one-hot => fully exclusive
+    bdf = bundler.transform(df)
+    clf = LightGBMClassifier(
+        featuresCol="bundled", numIterations=30, numLeaves=31, numTasks=1,
+        maxBin=64, maxCatThreshold=40,
+        categoricalSlotIndexes=bundler.categorical_indexes())
+    model = clf.fit(bdf)
+    p = np.stack(model.transform(bdf)["probability"])[:, 1]
+    a = auc(y, p)
+    assert a > 0.95, a
+
+
+def test_save_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(4)
+    x = _one_hot_sparse(rng.integers(0, 5, 300), 8)
+    df = DataFrame({"features": x, "y": np.zeros(300)})
+    model = SparseFeatureBundler(numValueBins=2).fit(df)
+    p = str(tmp_path / "bundler")
+    model.save(p)
+    from mmlspark_tpu.core.pipeline import PipelineStage
+    loaded = PipelineStage.load(p)
+    a = np.asarray(model.transform(df)["bundled"])
+    b = np.asarray(loaded.transform(df)["bundled"])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_feature_count_mismatch_rejected():
+    x = _one_hot_sparse(np.zeros(10, int), 4)
+    df = DataFrame({"features": x, "y": np.zeros(10)})
+    model = SparseFeatureBundler().fit(df)
+    x2 = _one_hot_sparse(np.zeros(10, int), 5)
+    with pytest.raises(ValueError, match="fitted on 4"):
+        model.transform(DataFrame({"features": x2, "y": np.zeros(10)}))
