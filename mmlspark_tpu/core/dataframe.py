@@ -18,15 +18,25 @@ import numpy as np
 from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 
+def _is_sparse(values: Any) -> bool:
+    return hasattr(values, "toarray") and hasattr(values, "tocsr")
+
+
+def _col_len(values: Any) -> int:
+    return values.shape[0] if _is_sparse(values) else len(values)
+
+
 def _as_column(values: Any) -> np.ndarray:
     """Coerce arbitrary input into a numpy column (1-D scalars or 2-D vectors).
 
-    scipy.sparse matrices densify on ingestion — the CSR marshalling path of
-    the reference (LightGBMUtils.scala:201-265 `LGBM_DatasetCreateFromCSR`):
-    the TPU data plane is dense (the binned matrix in HBM is dense uint8), so
-    sparsity is a host-ingestion format, not a device layout."""
-    if hasattr(values, "toarray") and hasattr(values, "tocsr"):
-        return np.asarray(values.toarray())
+    scipy.sparse matrices stay SPARSE in the frame (CSR, row-sliceable) so a
+    2^18-wide hashed-text matrix never densifies at ingestion; consumers
+    that need dense (the GBDT's CSR marshalling path, reference
+    LightGBMUtils.scala:201-265 `LGBM_DatasetCreateFromCSR`) densify at
+    their own boundary, and `featurize.SparseFeatureBundler` packs wide
+    sparse into narrow dense without ever materializing the wide form."""
+    if _is_sparse(values):
+        return values.tocsr()
     if isinstance(values, np.ndarray):
         if values.dtype.kind == "U":  # normalize strings to object dtype
             return values.astype(object)
@@ -69,10 +79,11 @@ class DataFrame:
             for name, values in data.items():
                 col = _as_column(values)
                 if n is None:
-                    n = len(col)
-                elif len(col) != n:
+                    n = _col_len(col)
+                elif _col_len(col) != n:
                     raise ValueError(
-                        f"column {name!r} has length {len(col)}, expected {n}")
+                        f"column {name!r} has length {_col_len(col)}, "
+                        f"expected {n}")
                 self._cols[name] = col
 
     # ---------------------------------------------------------------- basics
@@ -83,7 +94,7 @@ class DataFrame:
     def __len__(self) -> int:
         if not self._cols:
             return 0
-        return len(next(iter(self._cols.values())))
+        return _col_len(next(iter(self._cols.values())))
 
     count = __len__
 
@@ -139,9 +150,10 @@ class DataFrame:
     def with_column(self, name: str, values: Any,
                     metadata: Optional[Dict[str, Any]] = None) -> "DataFrame":
         col = _as_column(values)
-        if self._cols and len(col) != len(self):
+        if self._cols and _col_len(col) != len(self):
             raise ValueError(
-                f"new column {name!r} has length {len(col)}, expected {len(self)}")
+                f"new column {name!r} has length {_col_len(col)}, "
+                f"expected {len(self)}")
         out = self._shallow_copy()
         out._cols[name] = col
         if metadata is not None:
@@ -191,7 +203,11 @@ class DataFrame:
         out = DataFrame()
         for n in self.columns:
             a, b = self._cols[n], other._cols[n]
-            out._cols[n] = np.concatenate([a, b], axis=0)
+            if _is_sparse(a) or _is_sparse(b):
+                import scipy.sparse as sp
+                out._cols[n] = sp.vstack([a, b]).tocsr()
+            else:
+                out._cols[n] = np.concatenate([a, b], axis=0)
         out._meta = {k: dict(v) for k, v in self._meta.items()}
         return out
 

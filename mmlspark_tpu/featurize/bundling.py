@@ -192,6 +192,27 @@ class SparseFeatureBundlerModel(Model):
                     if k > 1 else 1)
 
         out = np.zeros((n, len(spec["bundles"])), np.int32)
+        if k == 1:
+            # vectorized presence-only path: one COO sweep instead of a
+            # Python loop over every original feature (65k-feature hashed
+            # text: ~18 s -> <1 s)
+            f_bundle = np.full(f, -1, np.int64)
+            f_code = np.zeros(f, np.int64)
+            f_rank = np.zeros(f, np.int64)  # position in bundle (nnz rank)
+            for bi, bundle in enumerate(spec["bundles"]):
+                idx = np.asarray(bundle, np.int64)
+                f_bundle[idx] = bi
+                f_code[idx] = 1 + np.arange(len(bundle))
+                f_rank[idx] = np.arange(len(bundle))
+            coo = csc.tocoo()
+            keep = f_bundle[coo.col] >= 0
+            r, c = coo.row[keep], coo.col[keep]
+            # write lower-rank (higher-nnz) features LAST so they win the
+            # (budgeted, rare) conflicts
+            order = np.argsort(-f_rank[c], kind="stable")
+            r, c = r[order], c[order]
+            out[r, f_bundle[c]] = f_code[c].astype(np.int32)
+            return df.with_column(self.get("outputCol"), out)
         for bi, bundle in enumerate(spec["bundles"]):
             # code layout: 0 = every feature zero; feature i of the bundle
             # owns the contiguous range [start_i, start_i + width_i)

@@ -53,6 +53,11 @@ class TextFeaturizer(Estimator):
     numFeatures = _p.Param("numFeatures", "hash space size", 1 << 18, int)
     useIDF = _p.Param("useIDF", "apply inverse document frequency", True, bool)
     minDocFreq = _p.Param("minDocFreq", "min doc frequency for IDF", 1, int)
+    sparseOutput = _p.Param(
+        "sparseOutput",
+        "emit scipy CSR instead of a dense matrix (for wide hash spaces; "
+        "pair with featurize.SparseFeatureBundler before dense consumers)",
+        False, bool)
 
     def _tokens(self, col) -> List[List[str]]:
         docs = []
@@ -70,8 +75,10 @@ class TextFeaturizer(Estimator):
         nf = int(self.get("numFeatures"))
         idf = None
         if self.get("useIDF"):
-            tf = hashing_tf(docs, nf, binary=True)
-            dfreq = tf.sum(axis=0)
+            # document frequencies via the sparse path: never materializes
+            # the [N, 2^18] dense matrix during fit
+            tf = hashing_tf(docs, nf, binary=True, sparse=True)
+            dfreq = np.asarray(tf.sum(axis=0)).ravel()
             n_docs = len(docs)
             idf = np.log((n_docs + 1.0) / (dfreq + 1.0)).astype(np.float32)
             # terms below the doc-frequency threshold are filtered out (weight
@@ -79,7 +86,8 @@ class TextFeaturizer(Estimator):
             idf[dfreq < self.get("minDocFreq")] = 0.0
         model = TextFeaturizerModel(idf=idf)
         for p in ("inputCol", "outputCol", "useTokenizer", "useStopWordsRemover",
-                  "useNGram", "nGramLength", "binary", "numFeatures"):
+                  "useNGram", "nGramLength", "binary", "numFeatures",
+                  "sparseOutput"):
             model.set(p, self.get(p))
         return model
 
@@ -93,6 +101,10 @@ class TextFeaturizerModel(Model):
     nGramLength = _p.Param("nGramLength", "n-gram length", 2, int)
     binary = _p.Param("binary", "binary term counts", False, bool)
     numFeatures = _p.Param("numFeatures", "hash space size", 1 << 18, int)
+    sparseOutput = _p.Param(
+        "sparseOutput",
+        "emit scipy CSR instead of a dense matrix (for wide hash spaces)",
+        False, bool)
     idf = _p.Param("idf", "idf weights (None = no idf)", None, complex=True)
 
     def __init__(self, idf: Optional[np.ndarray] = None, **kw):
@@ -106,11 +118,15 @@ class TextFeaturizerModel(Model):
                   "nGramLength"):
             feat.set(p, self.get(p))
         docs = feat._tokens(df[self.get("inputCol")])
+        sparse = bool(self.get("sparseOutput"))
         tf = hashing_tf(docs, int(self.get("numFeatures")),
-                        binary=self.get("binary"))
+                        binary=self.get("binary"), sparse=sparse)
         idf = self.get("idf") if self.is_set("idf") else None
         if idf is not None:
-            tf = tf * idf[None, :]
+            if sparse:
+                tf = tf.multiply(np.asarray(idf)[None, :]).tocsr()
+            else:
+                tf = tf * idf[None, :]
         return df.with_column(self.get("outputCol"), tf)
 
 

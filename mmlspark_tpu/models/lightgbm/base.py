@@ -213,7 +213,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
     def _extract_xyw(self, df: DataFrame
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         x = df[self.get("featuresCol")]
-        if x.dtype == object and len(x) and hasattr(x[0], "toarray"):
+        if hasattr(x, "toarray") and hasattr(x, "tocsr"):
+            # sparse matrix column (kept sparse by the DataFrame): the GBDT
+            # device plane is dense binned uint8, so densify here — the
+            # reference's CSR marshalling boundary
+            # (LightGBMUtils.scala:201-265). For genuinely wide sparse, run
+            # featurize.SparseFeatureBundler first instead.
+            x = np.asarray(x.toarray(), np.float32)
+        elif x.dtype == object and len(x) and hasattr(x[0], "toarray"):
             # per-row scipy sparse vectors (the reference's sparse dataset
             # path, LightGBMUtils.scala:201-265) densify at ingestion
             x = np.vstack([np.asarray(r.toarray(), np.float32).ravel()

@@ -100,33 +100,44 @@ def hash_strings(strings: Iterable[str], num_bits: int, seed: int = 0,
 
 
 def hashing_tf(docs: Sequence[Sequence[str]], num_features: int, seed: int = 0,
-               binary: bool = False) -> np.ndarray:
-    """Dense term-frequency matrix by hashed bucket — Spark HashingTF equivalent
-    (used by TextFeaturizer.scala's hashingTF stage). Dense because TPU kernels
-    want dense matrices; num_features defaults are modest (2^18 max)."""
+               binary: bool = False, sparse: bool = False):
+    """Term-frequency matrix by hashed bucket — Spark HashingTF equivalent
+    (used by TextFeaturizer.scala's hashingTF stage). Dense by default (the
+    TPU kernels want dense matrices at modest widths); sparse=True returns
+    scipy CSR for wide spaces (2^18), which the DataFrame keeps sparse and
+    `featurize.SparseFeatureBundler` packs dense."""
     from . import native
     n = len(docs)
-    out = np.zeros((n, num_features), np.float32)
     pow2 = (num_features & (num_features - 1)) == 0
-    if pow2 and native.get_lib() is not None:
-        # native batch path: hash all terms of all docs in one C++ call
-        flat = [str(t) for doc in docs for t in doc]
-        lengths = [len(doc) for doc in docs]
-        if flat:
+    flat = [str(t) for doc in docs for t in doc]
+    lengths = [len(doc) for doc in docs]
+    rows = np.repeat(np.arange(n), lengths)
+    if flat:
+        if pow2 and native.get_lib() is not None:
+            # native batch path: hash all terms of all docs in one C++ call
             buckets = native.hash_strings(flat, num_features - 1, seed)
-            rows = np.repeat(np.arange(n), lengths)
-            if binary:
-                out[rows, buckets] = 1.0
-            else:
-                np.add.at(out, (rows, buckets), 1.0)
+        else:
+            mask = num_features - 1 if pow2 else None
+            buckets = np.fromiter(
+                ((murmur3_32(t.encode("utf-8"), seed) & mask)
+                 if mask is not None
+                 else (murmur3_32(t.encode("utf-8"), seed) % num_features)
+                 for t in flat), dtype=np.int64, count=len(flat))
+    else:
+        buckets = np.zeros(0, np.int64)
+    if sparse:
+        import scipy.sparse as sp
+        out = sp.csr_matrix(
+            (np.ones(len(flat), np.float32), (rows, buckets)),
+            shape=(n, num_features))
+        out.sum_duplicates()
+        if binary:
+            out.data = np.minimum(out.data, 1.0)
         return out
-    mask = num_features - 1 if pow2 else None
-    for i, doc in enumerate(docs):
-        for term in doc:
-            h = murmur3_32(str(term).encode("utf-8"), seed)
-            j = (h & mask) if mask is not None else (h % num_features)
-            if binary:
-                out[i, j] = 1.0
-            else:
-                out[i, j] += 1.0
+    out = np.zeros((n, num_features), np.float32)
+    if len(flat):
+        if binary:
+            out[rows, buckets] = 1.0
+        else:
+            np.add.at(out, (rows, buckets), 1.0)
     return out

@@ -120,3 +120,34 @@ def test_feature_count_mismatch_rejected():
     x2 = _one_hot_sparse(np.zeros(10, int), 5)
     with pytest.raises(ValueError, match="fitted on 4"):
         model.transform(DataFrame({"features": x2, "y": np.zeros(10)}))
+
+
+def test_text_featurizer_sparse_to_gbdt():
+    """The full wide-sparse workflow: TextFeaturizer(sparseOutput=True) emits
+    CSR (2^18 wide, never densified), the bundler packs it, the GBDT trains
+    on categorical bundles (QUICKSTART 'Wide sparse features')."""
+    from mmlspark_tpu.featurize import TextFeaturizer
+    rng = np.random.default_rng(0)
+    pos = "good fine great excellent".split()
+    neg = "bad awful poor terrible".split()
+    texts, y = [], []
+    for _ in range(300):
+        cls = rng.random() < 0.5
+        texts.append(" ".join(rng.choice(pos if cls else neg, 4)))
+        y.append(float(cls))
+    y = np.array(y)
+    df = DataFrame({"text": np.array(texts, object), "label": y})
+    feats = (TextFeaturizer(inputCol="text", outputCol="features",
+                            sparseOutput=True)
+             .fit(df).transform(df))
+    assert sp.issparse(df.with_column("f2", feats["features"])["f2"])
+    assert feats["features"].shape[1] == 1 << 18
+    bundler = SparseFeatureBundler(inputCol="features",
+                                   outputCol="bundled").fit(feats)
+    bdf = bundler.transform(feats)
+    clf = LightGBMClassifier(
+        featuresCol="bundled", numIterations=20, numLeaves=7, numTasks=1,
+        minDataInLeaf=5, maxBin=64,
+        categoricalSlotIndexes=bundler.categorical_indexes())
+    p = np.stack(clf.fit(bdf).transform(bdf)["probability"])[:, 1]
+    assert auc(y, p) > 0.98
