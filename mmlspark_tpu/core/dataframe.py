@@ -26,16 +26,32 @@ def _col_len(values: Any) -> int:
     return values.shape[0] if _is_sparse(values) else len(values)
 
 
+def dense_matrix(col: Any, dtype=np.float32) -> np.ndarray:
+    """Densify a (possibly sparse) feature column at a consumer boundary."""
+    if _is_sparse(col):
+        return np.asarray(col.toarray(), dtype)
+    return np.asarray(col, dtype)
+
+
+#: sparse columns at or below this width densify at ingestion (every stage
+#: consumed dense sparse input historically); wider ones stay CSR for the
+#: SparseFeatureBundler / sparse-TextFeaturizer path
+SPARSE_KEEP_WIDTH = 4096
+
+
 def _as_column(values: Any) -> np.ndarray:
     """Coerce arbitrary input into a numpy column (1-D scalars or 2-D vectors).
 
-    scipy.sparse matrices stay SPARSE in the frame (CSR, row-sliceable) so a
-    2^18-wide hashed-text matrix never densifies at ingestion; consumers
-    that need dense (the GBDT's CSR marshalling path, reference
-    LightGBMUtils.scala:201-265 `LGBM_DatasetCreateFromCSR`) densify at
-    their own boundary, and `featurize.SparseFeatureBundler` packs wide
-    sparse into narrow dense without ever materializing the wide form."""
+    scipy.sparse matrices up to SPARSE_KEEP_WIDTH columns densify at
+    ingestion (the CSR marshalling boundary of the reference,
+    LightGBMUtils.scala:201-265 — every estimator consumes them as dense);
+    WIDER sparse matrices stay CSR (row-sliceable) so a 2^18-wide
+    hashed-text matrix never materializes — feed those through
+    `featurize.SparseFeatureBundler`, which packs them into narrow dense
+    bundles (dense-only estimators raise on a wide sparse column)."""
     if _is_sparse(values):
+        if values.shape[1] <= SPARSE_KEEP_WIDTH:
+            return np.asarray(values.toarray())
         return values.tocsr()
     if isinstance(values, np.ndarray):
         if values.dtype.kind == "U":  # normalize strings to object dtype

@@ -57,11 +57,12 @@ def _to_csc(x):
     return csc, x.shape[0], x.shape[1]
 
 
-def greedy_bundles(row_sets: List[np.ndarray], n_rows: int, nnz: np.ndarray,
+def greedy_bundles(row_sets, n_rows: int, nnz: np.ndarray,
                    max_conflict_rate: float, bins_per_feature: np.ndarray,
                    max_bundle_bins: int) -> List[List[int]]:
-    """EFB greedy packing. row_sets[j] = sorted row indices where feature j
-    is nonzero. Returns bundles as lists of original feature indices."""
+    """EFB greedy packing. row_sets maps (or lists) feature -> row indices
+    where it is nonzero; features with nnz == 0 need no entry. Returns
+    bundles as lists of original feature indices."""
     order = np.argsort(-nnz, kind="stable")
     budget = max(int(max_conflict_rate * n_rows), 0)
     bundles: List[List[int]] = []
@@ -121,10 +122,15 @@ class SparseFeatureBundler(Estimator):
 
     def _fit(self, df: DataFrame) -> "SparseFeatureBundlerModel":
         csc, n, f = _to_csc(df[self.get("inputCol")])
+        csc.eliminate_zeros()
         k = max(int(self.get("numValueBins")), 1)
         nnz = np.diff(csc.indptr)
-        row_sets = [np.sort(csc.indices[csc.indptr[j]:csc.indptr[j + 1]])
-                    for j in range(f)]
+        # only populated columns get a row set (a 2^18 hash space is mostly
+        # empty buckets — greedy_bundles skips nnz==0 anyway); CSC indices
+        # within a column are already sorted, no per-column np.sort needed
+        row_sets = {
+            int(j): csc.indices[csc.indptr[j]:csc.indptr[j + 1]]
+            for j in np.nonzero(nnz)[0]}
         bins_per = np.full(f, k, np.int64)
         bundles = greedy_bundles(row_sets, n, nnz,
                                  float(self.get("maxConflictRate")),

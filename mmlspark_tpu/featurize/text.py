@@ -125,6 +125,10 @@ class TextFeaturizerModel(Model):
         if idf is not None:
             if sparse:
                 tf = tf.multiply(np.asarray(idf)[None, :]).tocsr()
+                # minDocFreq-filtered terms get idf == 0; multiply keeps them
+                # as STORED zeros, which downstream (the bundler) would count
+                # as present — drop them so sparse == dense semantics
+                tf.eliminate_zeros()
             else:
                 tf = tf * idf[None, :]
         return df.with_column(self.get("outputCol"), tf)

@@ -14,7 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 from ...core import params as _p
-from ...core.dataframe import DataFrame
+from ...core.dataframe import DataFrame, dense_matrix
 from .base import LightGBMModelBase, LightGBMParamsBase
 from .booster import Booster
 
@@ -83,7 +83,7 @@ class LightGBMClassificationModel(LightGBMModelBase, _p.HasProbabilityCol,
     getActualNumClasses = get_actual_num_classes
 
     def transform(self, df: DataFrame) -> DataFrame:
-        x = np.asarray(df[self.get("featuresCol")], np.float32)
+        x = dense_matrix(df[self.get("featuresCol")])
         raw = self.booster.raw_predict(x)
         if raw.ndim == 1:  # binary: margins -> [p0, p1]
             prob1 = 1.0 / (1.0 + np.exp(-raw))

@@ -13,7 +13,7 @@ from typing import Optional
 
 import numpy as np
 
-from ...core.dataframe import DataFrame
+from ...core.dataframe import DataFrame, dense_matrix
 from ...core import params as _p
 from .base import LightGBMModelBase, LightGBMParamsBase
 
@@ -67,7 +67,7 @@ class LightGBMRankerModel(LightGBMModelBase):
     """Fitted ranker; prediction column = raw ranking score."""
 
     def transform(self, df: DataFrame) -> DataFrame:
-        x = np.asarray(df[self.get("featuresCol")], np.float32)
+        x = dense_matrix(df[self.get("featuresCol")])
         scores = np.asarray(self.booster.raw_predict(x)).reshape(len(x))
         out = df.with_column(self.get("predictionCol"), scores)
         return self._add_optional_cols(out, x)

@@ -9,7 +9,7 @@ from __future__ import annotations
 import numpy as np
 
 from ...core import params as _p
-from ...core.dataframe import DataFrame
+from ...core.dataframe import DataFrame, dense_matrix
 from .base import LightGBMModelBase, LightGBMParamsBase
 
 
@@ -33,7 +33,7 @@ class LightGBMRegressor(LightGBMParamsBase):
 class LightGBMRegressionModel(LightGBMModelBase):
 
     def transform(self, df: DataFrame) -> DataFrame:
-        x = np.asarray(df[self.get("featuresCol")], np.float32)
+        x = dense_matrix(df[self.get("featuresCol")])
         pred = self.booster.score(x)
         out = df.with_column(self.get("predictionCol"),
                              np.asarray(pred, np.float64))

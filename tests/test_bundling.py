@@ -151,3 +151,20 @@ def test_text_featurizer_sparse_to_gbdt():
         categoricalSlotIndexes=bundler.categorical_indexes())
     p = np.stack(clf.fit(bdf).transform(bdf)["probability"])[:, 1]
     assert auc(y, p) > 0.98
+
+
+def test_sparse_idf_filtered_terms_absent():
+    """minDocFreq-filtered terms (idf == 0) must not appear as stored zeros
+    in the sparse output — the bundler would code them as present."""
+    from mmlspark_tpu.featurize import TextFeaturizer
+    texts = ["common word"] * 5 + ["common rare"]
+    df = DataFrame({"text": np.array(texts, object), "y": np.zeros(6)})
+    m = TextFeaturizer(inputCol="text", outputCol="f", sparseOutput=True,
+                       minDocFreq=2).fit(df)
+    out = m.transform(df)["f"]
+    assert sp.issparse(out)
+    assert (out.data != 0).all()   # no stored zeros
+    dense_m = TextFeaturizer(inputCol="text", outputCol="f",
+                             minDocFreq=2).fit(df)
+    dense = dense_m.transform(df)["f"]
+    np.testing.assert_allclose(np.asarray(out.todense()), dense, atol=1e-6)
