@@ -27,8 +27,18 @@ def _col_len(values: Any) -> int:
 
 
 def dense_matrix(col: Any, dtype=np.float32) -> np.ndarray:
-    """Densify a (possibly sparse) feature column at a consumer boundary."""
+    """Densify a (possibly sparse) feature column at a consumer boundary.
+
+    Wide sparse columns (kept sparse by ingestion) raise instead of silently
+    materializing gigabytes — route those through
+    featurize.SparseFeatureBundler first."""
     if _is_sparse(col):
+        if col.shape[1] > SPARSE_KEEP_WIDTH:
+            raise ValueError(
+                f"refusing to densify a {col.shape[1]}-wide sparse column "
+                f"(> {SPARSE_KEEP_WIDTH}); pack it with "
+                "featurize.SparseFeatureBundler (or densify explicitly "
+                "upstream if you really have the memory)")
         return np.asarray(col.toarray(), dtype)
     return np.asarray(col, dtype)
 

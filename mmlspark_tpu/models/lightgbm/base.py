@@ -162,6 +162,11 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                       "MXU operand dtype for the histogram contraction: "
                       "bf16 (fast, grads rounded ~3 digits) or f32 (exact, "
                       "bit-reproducible vs the scatter oracle)", "bf16")
+    useMissing = Param(
+        "useMissing",
+        "reserve a missing bin for NaN-containing features and LEARN the "
+        "split default direction (upstream use_missing); False = legacy "
+        "NaN-to-lowest-bin behavior", True, bool)
     histRefresh = Param(
         "histRefresh",
         "histogram refresh policy: eager (exact LightGBM leaf-wise, one "
@@ -217,9 +222,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # sparse matrix column (kept sparse by the DataFrame): the GBDT
             # device plane is dense binned uint8, so densify here — the
             # reference's CSR marshalling boundary
-            # (LightGBMUtils.scala:201-265). For genuinely wide sparse, run
-            # featurize.SparseFeatureBundler first instead.
-            x = np.asarray(x.toarray(), np.float32)
+            # (LightGBMUtils.scala:201-265). Wide sparse refuses with a
+            # pointer at featurize.SparseFeatureBundler.
+            from ...core.dataframe import dense_matrix
+            x = dense_matrix(x)
         elif x.dtype == object and len(x) and hasattr(x[0], "toarray"):
             # per-row scipy sparse vectors (the reference's sparse dataset
             # path, LightGBMUtils.scala:201-265) densify at ingestion
@@ -316,6 +322,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             hist_dtype=self.get("histDtype"),
             split_refresh=self.get("histRefresh"),
             categorical_features=tuple(self._categorical_indexes()),
+            missing_features=getattr(self, "_missing_idx", ()),
             cat_smooth=self.get("catSmooth"),
             max_cat_threshold=self.get("maxCatThreshold"),
             axis_name=axis_name,
@@ -401,8 +408,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                            categorical=tuple(self._categorical_indexes()),
                            max_bins_by_feature=(
                                np.asarray(mbbf, np.int64) if mbbf is not None
-                               and len(mbbf) else None))
+                               and len(mbbf) else None),
+                           use_missing=bool(self.get("useMissing")))
         binned = bm.transform(x)
+        # features with a reserved missing bin get both-direction split scans
+        self._missing_idx = tuple(
+            int(j) for j in np.nonzero(bm.missing)[0])
         if _dlg is not None:
             _dlg.after_generate_train_dataset(_bi, self)
 
@@ -452,6 +463,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             raise ValueError("topK must be >= 1 for voting_parallel")
         ndev = self.get("numTasks") or meshlib.device_count()
         serial = (par == "serial" or ndev <= 1)
+        if (par == "voting_parallel" and not serial
+                and getattr(self, "_missing_idx", ())):
+            raise ValueError(
+                "voting_parallel does not support learned missing "
+                "directions and this data contains NaN features "
+                f"{list(self._missing_idx)}; use "
+                "parallelism='data_parallel' or set useMissing=False for "
+                "the legacy NaN-to-lowest-bin behavior")
         key = jax.random.PRNGKey(self.get("seed"))
         is_train = (~is_valid).astype(np.float32)
         axis = meshlib.DATA_AXIS
@@ -648,6 +667,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         feats = np.asarray(trees.split_feat)
         bins = np.asarray(trees.split_bin)
         edges = bm.edges  # [F, B-1]
+        # missing-capable features reserve bin 0: value bin b <-> edge b-1
+        bins = bins - bm.missing[feats].astype(bins.dtype)
         b_idx = np.clip(bins, 0, edges.shape[1] - 1)
         thr = edges[feats, b_idx]
         # replace inf padding edges by the feature's largest finite edge

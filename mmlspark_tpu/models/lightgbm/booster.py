@@ -207,6 +207,7 @@ class Booster:
         arrays["thresholds"] = self.thresholds
         if self.bin_mapper is not None:
             arrays["bin_edges"] = self.bin_mapper.edges
+            arrays["bin_missing"] = np.asarray(self.bin_mapper.missing, bool)
             if getattr(self.bin_mapper, "feature_min", None) is not None:
                 arrays["feature_min"] = self.bin_mapper.feature_min
                 arrays["feature_max"] = self.bin_mapper.feature_max
@@ -226,7 +227,8 @@ class Booster:
         trees = Tree(*[arrays[f"tree_{f}"] for f in Tree._fields])
         bm = (BinMapper(arrays["bin_edges"],
                         tuple(meta.get("categorical", ())),
-                        arrays.get("feature_min"), arrays.get("feature_max"))
+                        arrays.get("feature_min"), arrays.get("feature_max"),
+                        arrays.get("bin_missing"))
               if "bin_edges" in arrays else None)
         return Booster(trees, arrays["thresholds"],
                        np.asarray(meta["init_score"], np.float32),

@@ -35,6 +35,10 @@ class _NodeTree:
         # node id == split step, so categorical info maps 1:1
         self.is_cat = np.asarray(tree.split_is_cat[:self.n_internal]).astype(bool)
         self.cat_mask = np.asarray(tree.split_mask[:self.n_internal]).astype(bool)
+        self.default_left = np.asarray(
+            tree.split_default_left[:self.n_internal]).astype(bool)
+        self.missing_type = np.asarray(
+            tree.split_missing_type[:self.n_internal]).astype(int)
         if self.leaf_count.sum() <= 0:
             # models parsed without leaf_count (older exports): uniform covers
             # are the only honest prior — all-zero covers would silently zero
@@ -66,7 +70,15 @@ class _NodeTree:
             if code < 0 or code >= self.cat_mask.shape[1]:
                 return False  # outside the bitset -> right (LightGBM semantics)
             return bool(self.cat_mask[node, code])
-        return xv <= self.threshold[node]
+        # upstream numerical_decision (tree.h) — the SAME routing as
+        # tree_apply_raw, so SHAP contributions sum to the actual prediction
+        # on rows with missing values
+        mt = self.missing_type[node]
+        is_nan = bool(np.isnan(xv))
+        x0 = 0.0 if is_nan else xv
+        if (mt == 2 and is_nan) or (mt == 1 and (is_nan or abs(x0) <= 1e-35)):
+            return bool(self.default_left[node])
+        return x0 <= self.threshold[node]
 
     def value(self, node: int) -> float:
         """Expected leaf value of the subtree (cover-weighted)."""

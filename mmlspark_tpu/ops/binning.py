@@ -6,8 +6,12 @@ quantile-bins them; `binSampleCount` param in lightgbm/LightGBMParams.scala). He
 explicit and host-side (one-off O(N·F·logB) numpy work); the binned uint8 matrix is what lives
 in HBM and feeds the Pallas/MXU histogram kernels.
 
-Missing handling: NaNs are mapped to bin 0 (equivalent to LightGBM `zero_as_missing=false`,
-`use_missing=false` semantics); default-direction missing routing is a later refinement.
+Missing handling (upstream `use_missing=true`, `zero_as_missing=false`
+semantics): features with NaN observed at fit time reserve bin 0 as the
+missing bin (value bins shift up by one) so the split scan can LEARN the
+default direction; features without training NaNs keep MissingType::None —
+predict-time NaN coerces to the value 0.0. `BinMapper.fit(use_missing=False)`
+restores the legacy NaN-to-lowest-bin behavior.
 """
 
 from __future__ import annotations
@@ -115,13 +119,20 @@ class BinMapper:
     def __init__(self, edges: np.ndarray,
                  categorical: Optional[Tuple[int, ...]] = None,
                  feature_min: Optional[np.ndarray] = None,
-                 feature_max: Optional[np.ndarray] = None):
+                 feature_max: Optional[np.ndarray] = None,
+                 missing: Optional[np.ndarray] = None):
         self.edges = edges
         self.categorical = tuple(sorted(categorical)) if categorical else ()
         # real per-feature value ranges (upstream feature_infos [min:max]);
         # None on mappers restored from pre-0.2 checkpoints
         self.feature_min = feature_min
         self.feature_max = feature_max
+        # numeric features with NaN observed at fit time get a RESERVED
+        # missing bin 0 (value bins shift up by one) — upstream use_missing
+        # semantics, enabling learned default directions; None/absent =
+        # legacy NaN->lowest-bin behavior
+        self.missing = (np.asarray(missing, bool) if missing is not None
+                        else np.zeros(edges.shape[0], bool))
 
     @property
     def max_bins(self) -> int:
@@ -135,7 +146,8 @@ class BinMapper:
     def fit(X: np.ndarray, max_bins: int = 255, sample_count: int = 200_000,
             seed: int = 0,
             categorical: Optional[Tuple[int, ...]] = None,
-            max_bins_by_feature: Optional[np.ndarray] = None) -> "BinMapper":
+            max_bins_by_feature: Optional[np.ndarray] = None,
+            use_missing: bool = True) -> "BinMapper":
         if categorical:
             X = np.asarray(X)
             for j in categorical:
@@ -152,14 +164,54 @@ class BinMapper:
                     if len(X) else None)
             fmax = (np.nanmax(X, axis=0).astype(np.float64)
                     if len(X) else None)
+        f = X.shape[1] if X.ndim == 2 else 0
+        missing = np.zeros(f, bool)
+        if use_missing and len(X) and X.dtype.kind == "f":
+            # full-data NaN scan (a sample could miss rare NaNs, and the
+            # missing bin changes routing semantics for the whole feature)
+            missing = np.isnan(X).any(axis=0)
+            if categorical:
+                missing[list(categorical)] = False  # cats bin by code
+        if missing.any():
+            # reserve one bin for missing: value bins budget drops by 1 (but
+            # never to 0 — compute_bin_edges reads 0 as "uncapped", which
+            # would overflow the trainer's bin range by one)
+            mbbf = (np.asarray(max_bins_by_feature, np.int64).copy()
+                    if max_bins_by_feature is not None
+                    else np.zeros(f, np.int64))
+            cap = np.where(mbbf > 0, np.minimum(mbbf, max_bins), max_bins)
+            max_bins_by_feature = np.where(missing,
+                                           np.maximum(cap - 1, 1), mbbf)
         return BinMapper(compute_bin_edges(X, max_bins, sample_count, seed,
                                            max_bins_by_feature),
-                         categorical, fmin, fmax)
+                         categorical, fmin, fmax, missing)
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         out = apply_bins(X, self.edges)
+        X = np.asarray(X)
+        is_float = X.dtype.kind == "f"
+        if self.missing.any() and is_float:
+            # shift value bins up by one on missing-capable features; NaN
+            # takes the reserved bin 0
+            mjs = np.nonzero(self.missing)[0]
+            sub = X[:, mjs]
+            out[:, mjs] = np.where(np.isnan(sub), 0, out[:, mjs] + 1)
+        elif self.missing.any():
+            out[:, self.missing] += 1   # no NaN possible in int input
+        no_miss = ~self.missing
+        if no_miss.any() and is_float:
+            # NaN on a feature with no training missing = upstream
+            # MissingType::None: treated as the value 0.0. One vectorized
+            # isnan over the non-missing block, early-out when clean (the
+            # overwhelmingly common case).
+            njs = np.nonzero(no_miss)[0]
+            nanmask = np.isnan(X[:, njs])
+            if nanmask.any():
+                for i in np.nonzero(nanmask.any(axis=0))[0]:
+                    j = int(njs[i])
+                    out[nanmask[:, i], j] = int(np.searchsorted(
+                        self.edges[j], 0.0, side="left"))
         if self.categorical:
-            X = np.asarray(X)
             for j in self.categorical:
                 col = np.nan_to_num(X[:, j], nan=0.0)
                 out[:, j] = np.clip(col.astype(np.int64), 0,
@@ -168,8 +220,13 @@ class BinMapper:
 
     def threshold_value(self, feature: int, bin_id: int) -> float:
         """Real-valued threshold for 'bin <= bin_id' splits (for model export:
-        LightGBM text-format `threshold` entries)."""
-        b = int(np.clip(bin_id, 0, self.edges.shape[1] - 1))
+        LightGBM text-format `threshold` entries). On missing-capable
+        features bin 0 is the reserved missing bin, so value bin b maps to
+        edge b-1."""
+        b = int(bin_id)
+        if self.missing[feature]:
+            b -= 1
+        b = int(np.clip(b, 0, self.edges.shape[1] - 1))
         v = self.edges[feature, b]
         if not np.isfinite(v):
             finite = self.edges[feature][np.isfinite(self.edges[feature])]

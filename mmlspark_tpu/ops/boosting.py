@@ -76,6 +76,11 @@ class GBDTConfig(NamedTuple):
     # categorical features (LightGBM one-vs-rest sorted-subset splits;
     # categoricalSlotIndexes in LightGBMParams.scala)
     categorical_features: Tuple[int, ...] = ()
+    # numeric features whose bin 0 is a RESERVED missing bin (NaN observed at
+    # fit — BinMapper.missing): the split scan evaluates BOTH default
+    # directions for these (upstream use_missing semantics) and the learned
+    # direction lands in Tree.split_default_left
+    missing_features: Tuple[int, ...] = ()
     cat_smooth: float = 10.0          # denominator smoothing for g/h sort key
     max_cat_threshold: int = 32       # max categories on the left side
     seed: int = 0
@@ -156,16 +161,21 @@ def _cat_sort_order(hists, cfg: GBDTConfig):
 
 
 def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask):
-    """Masked split-gain table over [L, F, B, 3] histograms -> gain [L, F, B].
+    """Masked split-gain table over [L, F, B, 3] histograms -> [L, F, B, 2].
 
-    feature_mask may be [F] (shared across slots) or [L, F] (per-slot, used by
-    the voting-parallel learner where each slot scans its own voted feature
-    subset). Invalid cells (min_data / min_hessian / masked features) are
-    _NEG_INF. Reference semantics: LightGBM FeatureHistogram::FindBestThreshold
-    / FindBestThresholdCategorical (C++), driven from TrainUtils.scala:220-315.
+    The last axis is the missing-value default direction: 0 = missing goes
+    LEFT (the only direction for features without a reserved missing bin),
+    1 = missing goes RIGHT (evaluated only for cfg.missing_features, whose
+    bin 0 holds the missing stats — upstream use_missing both-direction
+    scan). feature_mask may be [F] (shared across slots) or [L, F]
+    (per-slot, used by the voting-parallel learner). Invalid cells
+    (min_data / min_hessian / masked features) are _NEG_INF. Reference
+    semantics: LightGBM FeatureHistogram::FindBestThreshold(Categorical),
+    driven from TrainUtils.scala:220-315.
     """
     l, f, b, _ = hists.shape
     cat = cfg.categorical_features
+    miss = cfg.missing_features
     if cat:
         is_cat = jnp.zeros((f,), bool).at[jnp.asarray(cat)].set(True)
         order = _cat_sort_order(hists, cfg)
@@ -181,40 +191,66 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask):
     tot_g, tot_h, tot_n = tot[..., 0], tot[..., 1], tot[..., 2]
     right_g, right_h, right_n = tot_g - left_g, tot_h - left_h, tot_n - left_n
 
-    gain = (_split_score(left_g, left_h, cfg.lambda_l1, cfg.lambda_l2)
-            + _split_score(right_g, right_h, cfg.lambda_l1, cfg.lambda_l2)
-            - _split_score(tot_g, tot_h, cfg.lambda_l1, cfg.lambda_l2))
+    def gain_of(lg, lh):
+        return (_split_score(lg, lh, cfg.lambda_l1, cfg.lambda_l2)
+                + _split_score(tot_g - lg, tot_h - lh,
+                               cfg.lambda_l1, cfg.lambda_l2)
+                - _split_score(tot_g, tot_h, cfg.lambda_l1, cfg.lambda_l2))
+
+    gain0 = gain_of(left_g, left_h)
 
     fm = (feature_mask[None, :, None] if feature_mask.ndim == 1
           else feature_mask[:, :, None])
     min_data = max(cfg.min_data_in_leaf, 1)
-    ok = ((left_n >= min_data) & (right_n >= min_data)
-          & (left_h >= cfg.min_sum_hessian_in_leaf)
-          & (right_h >= cfg.min_sum_hessian_in_leaf)
-          & fm)
+
+    def ok_of(ln, lh, rn, rh):
+        return ((ln >= min_data) & (rn >= min_data)
+                & (lh >= cfg.min_sum_hessian_in_leaf)
+                & (rh >= cfg.min_sum_hessian_in_leaf) & fm)
+
+    ok0 = ok_of(left_n, left_h, right_n, right_h)
     if cat:
         # categorical prefixes are capped at max_cat_threshold categories
         prefix_len = jnp.arange(b)[None, None, :] + 1
-        ok = ok & (~is_cat[None, :, None]
-                   | (prefix_len <= cfg.max_cat_threshold))
-    return jnp.where(ok, gain, _NEG_INF)
+        ok0 = ok0 & (~is_cat[None, :, None]
+                     | (prefix_len <= cfg.max_cat_threshold))
+    if miss:
+        is_miss = jnp.zeros((f,), bool).at[jnp.asarray(miss)].set(True)
+        bin_ge1 = (jnp.arange(b) >= 1)[None, None, :]
+        # bin 0 is the reserved missing bin: value splits start at b >= 1 (a
+        # missing-only left side is not expressible as a value threshold)
+        ok0 = ok0 & (~is_miss[None, :, None] | bin_ge1)
+        # direction 1: missing stats (bin 0) move to the right side
+        h0 = hists[:, :, 0, :]                           # [L,F,3]
+        lg1 = left_g - h0[..., 0][:, :, None]
+        lh1 = left_h - h0[..., 1][:, :, None]
+        ln1 = left_n - h0[..., 2][:, :, None]
+        gain1 = gain_of(lg1, lh1)
+        ok1 = (ok_of(ln1, lh1, tot_n - ln1, tot_h - lh1)
+               & is_miss[None, :, None] & bin_ge1)
+        g1 = jnp.where(ok1, gain1, _NEG_INF)
+    else:
+        g1 = jnp.full((l, f, b), _NEG_INF)
+    return jnp.stack([jnp.where(ok0, gain0, _NEG_INF), g1], axis=-1)
 
 
 def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
-    """Vectorized split-gain scan over [L, F, B] histograms.
+    """Vectorized split-gain scan over [L, F, B, 2] gain tables.
 
-    Returns per-slot (best_gain [L], best_feat [L], best_bin [L]).
-    For categorical features `best_bin` is the (sorted-order) prefix length - 1;
-    the caller reconstructs the category subset mask.
+    Returns per-slot (best_gain [L], best_feat [L], best_bin [L],
+    default_left [L] bool). For categorical features `best_bin` is the
+    (sorted-order) prefix length - 1; the caller reconstructs the category
+    subset mask.
     """
     l, f, b, _ = hists.shape
     gain = _split_gain_table(hists, sums, cfg, feature_mask)
-    flat = gain.reshape(l, f * b)
+    flat = gain.reshape(l, f * b * 2)
     best_idx = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
-    best_feat = (best_idx // b).astype(jnp.int32)
-    best_bin = (best_idx % b).astype(jnp.int32)
-    return best_gain, best_feat, best_bin
+    best_feat = (best_idx // (b * 2)).astype(jnp.int32)
+    best_bin = ((best_idx // 2) % b).astype(jnp.int32)
+    default_left = (best_idx % 2) == 0
+    return best_gain, best_feat, best_bin, default_left
 
 
 def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
@@ -263,6 +299,12 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         raise NotImplementedError(
             "lazy histogram refresh does not compose with voting_parallel "
             "(votes must be recast per split); use data_parallel")
+    if voting and cfg.missing_features:
+        raise NotImplementedError(
+            "voting_parallel does not support learned missing directions "
+            "(the voted per-slot feature subsets don't compose with global "
+            "missing-feature indices); use parallelism='data_parallel' or "
+            "set useMissing=False for the legacy NaN-to-lowest-bin behavior")
     lazy = cfg.split_refresh == "lazy"
 
     def psum_(v):
@@ -286,7 +328,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         sums = psum_(local_sums)
         # local vote: best local gain per (slot, feature)
         local_gain = _split_gain_table(local, local_sums, cfg,
-                                       feature_mask).max(axis=2)    # [L,F]
+                                       feature_mask).max(axis=(2, 3))  # [L,F]
         k2 = min(2 * k_top, f)
         _, vote_idx = jax.lax.top_k(local_gain, k2)
         vote_ok = (jnp.take_along_axis(local_gain, vote_idx, axis=1)
@@ -298,10 +340,10 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         _, sel = jax.lax.top_k(votes, k_top)      # [L,k] voted features
         hist_v = psum_(jnp.take_along_axis(
             local, sel[:, :, None, None], axis=1))           # [L,k,B,3]
-        gains, f_idx, bins_ = _best_split_per_slot(
+        gains, f_idx, bins_, dls = _best_split_per_slot(
             hist_v, sums, cfg, feature_mask[sel])
         feats = jnp.take_along_axis(sel, f_idx[:, None], axis=1)[:, 0]
-        return hist_v, sums, gains, feats.astype(jnp.int32), bins_
+        return hist_v, sums, gains, feats.astype(jnp.int32), bins_, dls
 
     depth_of_slot = jnp.zeros((lcap,), jnp.int32)
     slot_of_row = jnp.zeros((n,), jnp.int32)
@@ -312,7 +354,11 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     s_gain = jnp.zeros((lcap - 1,), jnp.float32)
     s_is_cat = jnp.zeros((lcap - 1,), bool)
     s_mask = jnp.zeros((lcap - 1, bm), bool)
+    s_dl = jnp.ones((lcap - 1,), bool)   # learned default direction
     done = jnp.array(False)
+    miss = cfg.missing_features
+    is_miss_f = (jnp.zeros((f,), bool).at[jnp.asarray(miss)].set(True)
+                 if miss else None)
 
     if not voting:
         # data_parallel keeps GLOBAL histograms in the loop carry: the local
@@ -329,7 +375,8 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         g_hists = jnp.zeros((lcap, f, b, 3), jnp.float32).at[0].set(root)
         g_sums = jnp.zeros((lcap, 3), jnp.float32).at[0].set(
             root[0].sum(axis=0))
-        bg, bf_, bb = _best_split_per_slot(g_hists, g_sums, cfg, feature_mask)
+        bg, bf_, bb, bd = _best_split_per_slot(g_hists, g_sums, cfg,
+                                               feature_mask)
         hist_valid = jnp.ones((lcap,), bool)
 
     thresh = cfg.min_gain_to_split + _MIN_GAIN_EPS
@@ -337,13 +384,13 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     def body(s, carry):
         if voting:
             (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-             s_valid, s_gain, s_is_cat, s_mask, done) = carry
-            hists, sums, gains_all, feats_all, bins_all = scan_splits_voting(
-                slot_of_row, feature_mask)
+             s_valid, s_gain, s_is_cat, s_mask, s_dl, done) = carry
+            (hists, sums, gains_all, feats_all, bins_all,
+             dls_all) = scan_splits_voting(slot_of_row, feature_mask)
         else:
             (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-             s_valid, s_gain, s_is_cat, s_mask, done,
-             g_hists, g_sums, bg, bf_, bb, hist_valid) = carry
+             s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
+             g_hists, g_sums, bg, bf_, bb, bd, hist_valid) = carry
         slot_exists = jnp.arange(lcap) <= s
         if cfg.max_depth > 0:
             slot_exists = slot_exists & (depth_of_slot < cfg.max_depth)
@@ -359,21 +406,22 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
                 slot_of_row, *_ = args
                 gh_full = psum_(hist_local(slot_of_row))       # [L,F,B,3]
                 gs = gh_full[:, 0].sum(axis=1)                 # [L,B,3]->[L,3]
-                nbg, nbf, nbb = _best_split_per_slot(gh_full, gs, cfg,
-                                                     feature_mask)
-                return gh_full, gs, nbg, nbf, nbb, jnp.ones((lcap,), bool)
+                nbg, nbf, nbb, nbd = _best_split_per_slot(gh_full, gs, cfg,
+                                                          feature_mask)
+                return (gh_full, gs, nbg, nbf, nbb, nbd,
+                        jnp.ones((lcap,), bool))
 
             def _keep(args):
-                _, g_hists, g_sums, bg, bf_, bb, hist_valid = args
-                return g_hists, g_sums, bg, bf_, bb, hist_valid
+                _, g_hists, g_sums, bg, bf_, bb, bd, hist_valid = args
+                return g_hists, g_sums, bg, bf_, bb, bd, hist_valid
 
-            (g_hists, g_sums, bg, bf_, bb, hist_valid) = jax.lax.cond(
+            (g_hists, g_sums, bg, bf_, bb, bd, hist_valid) = jax.lax.cond(
                 need, _refresh, _keep,
-                (slot_of_row, g_hists, g_sums, bg, bf_, bb, hist_valid))
+                (slot_of_row, g_hists, g_sums, bg, bf_, bb, bd, hist_valid))
 
         if not voting:
             hists = g_hists
-            gains_all, feats_all, bins_all = bg, bf_, bb
+            gains_all, feats_all, bins_all, dls_all = bg, bf_, bb, bd
             avail = slot_exists & hist_valid if lazy else slot_exists
         else:
             avail = slot_exists
@@ -384,6 +432,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
 
         feat_b = feats_all[best_slot]
         bin_b = bins_all[best_slot]
+        dl_b = dls_all[best_slot]
         new_slot = (s + 1).astype(jnp.int32)
 
         col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
@@ -400,6 +449,11 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
             mask = jnp.zeros((bm,), bool)
             feat_cat = jnp.array(False)
             go_right = col > bin_b
+        if miss:
+            # bin 0 of a missing-capable feature = NaN rows: route by the
+            # LEARNED default direction, not the value comparison
+            go_right = jnp.where(is_miss_f[feat_b] & (col == 0),
+                                 ~dl_b, go_right)
         slot_of_row = jnp.where(in_leaf & go_right & do, new_slot, slot_of_row)
 
         child_depth = depth_of_slot[best_slot] + 1
@@ -415,10 +469,11 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         s_gain = s_gain.at[s].set(jnp.where(do, best_gain, 0.0))
         s_is_cat = s_is_cat.at[s].set(feat_cat & do)
         s_mask = s_mask.at[s].set(mask[:bm])
+        s_dl = s_dl.at[s].set(jnp.where(do, dl_b, True))
         done = done | ~do
         if voting:
             return (depth_of_slot, slot_of_row, s_slot, s_feat,
-                    s_bin, s_valid, s_gain, s_is_cat, s_mask, done)
+                    s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl, done)
 
         if lazy:
             # both split products have stale histograms: mark deferred; they
@@ -429,8 +484,8 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
                 jnp.where(do, ~inval, hist_valid[idx2]))
             bg = bg.at[idx2].set(jnp.where(do, _NEG_INF, bg[idx2]))
             return (depth_of_slot, slot_of_row, s_slot, s_feat,
-                    s_bin, s_valid, s_gain, s_is_cat, s_mask, done,
-                    g_hists, g_sums, bg, bf_, bb, hist_valid)
+                    s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
+                    g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
 
         # eager: post-split all-slots pass; only the new child's slice is
         # allreduced, and only the two changed slots are gain-rescanned
@@ -443,22 +498,23 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         g_sums = g_sums.at[new_slot].set(right_sum)
         g_sums = g_sums.at[best_slot].add(-right_sum)
         idx2 = jnp.stack([best_slot, new_slot])
-        pg, pf, pb = _best_split_per_slot(g_hists[idx2], g_sums[idx2], cfg,
-                                          feature_mask)
+        pg, pf, pb, pd = _best_split_per_slot(g_hists[idx2], g_sums[idx2],
+                                              cfg, feature_mask)
         bg = bg.at[idx2].set(jnp.where(do, pg, bg[idx2]))
         bf_ = bf_.at[idx2].set(jnp.where(do, pf, bf_[idx2]))
         bb = bb.at[idx2].set(jnp.where(do, pb, bb[idx2]))
+        bd = bd.at[idx2].set(jnp.where(do, pd, bd[idx2]))
         return (depth_of_slot, slot_of_row, s_slot, s_feat,
-                s_bin, s_valid, s_gain, s_is_cat, s_mask, done,
-                g_hists, g_sums, bg, bf_, bb, hist_valid)
+                s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
+                g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
 
     carry = (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-             s_valid, s_gain, s_is_cat, s_mask, done)
+             s_valid, s_gain, s_is_cat, s_mask, s_dl, done)
     if not voting:
-        carry = carry + (g_hists, g_sums, bg, bf_, bb, hist_valid)
+        carry = carry + (g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
     carry = jax.lax.fori_loop(0, lcap - 1, body, carry)
     (_, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
-     s_is_cat, s_mask, _) = carry[:10]
+     s_is_cat, s_mask, s_dl, _) = carry[:11]
 
     if voting or lazy:
         # post-split leaf stats via a slot-onehot contraction (O(N*L), no
@@ -469,7 +525,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         sums = psum_(jnp.dot(slot_oh.T, gh3,
                              preferred_element_type=jnp.float32))    # [L,3]
     else:
-        sums = carry[11]                                       # carried g_sums
+        sums = carry[12]                                       # carried g_sums
 
     raw_out = _leaf_output(sums[:, 0], sums[:, 1], cfg.lambda_l1,
                            cfg.lambda_l2)
@@ -478,19 +534,29 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         # the poisson/unbalanced-logit stabilizer)
         raw_out = jnp.clip(raw_out, -cfg.max_delta_step, cfg.max_delta_step)
     leaf_value = raw_out * jnp.float32(cfg.learning_rate)
-    # slots that never received rows keep value 0 (their sums are 0)
-    # NaN bins to bin 0 (binning.py) => numeric splits carry default_left=True
-    # + missing_type NaN (decision_type 2|8); categorical splits carry missing
-    # None so raw NaN coerces to category 0 exactly like the binned path
+    # slots that never received rows keep value 0 (their sums are 0).
+    # decision_type per split: missing-capable features carry the LEARNED
+    # default direction + missing_type NaN; features that saw no missing at
+    # fit carry missing_type None (upstream: predict-time NaN coerces to
+    # 0.0, matching BinMapper.transform's bin-of-zero mapping); categorical
+    # splits carry missing None so raw NaN coerces to category 0
+    if miss:
+        split_miss = jnp.where(is_miss_f[s_feat] & ~s_is_cat, 2, 0)
+    else:
+        split_miss = jnp.zeros_like(s_feat)
     tree = Tree(s_slot, s_feat, s_bin, s_valid, s_gain, leaf_value,
                 sums[:, 2], s_is_cat, s_mask,
-                jnp.ones_like(s_valid),
-                jnp.where(s_is_cat, 0, 2).astype(s_feat.dtype))
+                s_dl,
+                split_miss.astype(s_feat.dtype))
     return tree, slot_of_row
 
 
 def tree_apply_binned(tree: Tree, binned: jax.Array) -> jax.Array:
-    """Leaf-slot assignment for rows by replaying splits in order. [N] int32."""
+    """Leaf-slot assignment for rows by replaying splits in order. [N] int32.
+
+    Splits with missing_type NaN (2) treat bin 0 as the reserved missing bin
+    and route it by the LEARNED default direction, matching the training
+    loop and tree_apply_raw."""
     n = binned.shape[0]
     nsplit = tree.split_slot.shape[0]
 
@@ -501,6 +567,9 @@ def tree_apply_binned(tree: Tree, binned: jax.Array) -> jax.Array:
         col = jnp.take(binned, feat, axis=1).astype(jnp.int32)
         mask = (slot == tree.split_slot[s]) & tree.split_valid[s]
         go_right = col > tree.split_bin[s]
+        go_right = jnp.where(
+            (tree.split_missing_type[s] == 2) & (col == 0),
+            ~tree.split_default_left[s], go_right)
         if bm > 1:
             # LightGBM bitset semantics: categories outside the bitset go RIGHT
             in_range = (col >= 0) & (col < bm)

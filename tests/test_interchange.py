@@ -140,24 +140,25 @@ class TestOwnExportCarriesDecisionTypes:
         from mmlspark_tpu.models.lightgbm import LightGBMClassifier
         m = LightGBMClassifier(numIterations=3, numTasks=1).fit(binary_df)
         s = m.booster.model_string()
-        # our numeric splits are default-left + missing NaN = 2|8 = 10
+        # NaN-free training data => upstream MissingType::None with the
+        # default-left bit: decision_type == 2 on every numeric split
         dec_lines = [l for l in s.splitlines()
                      if l.startswith("decision_type=")]
         assert dec_lines
         for line in dec_lines:
             vals = {int(v) for v in line.split("=")[1].split()}
-            assert vals <= {10}, vals
+            assert vals <= {2}, vals
 
     def test_nan_prediction_matches_training_convention(self, binary_df):
-        """NaN routes like bin 0 (left) — raw path must agree with the binned
-        training convention via the exported missing-NaN default-left bits."""
+        """A model trained WITHOUT missing values carries MissingType::None:
+        predict-time NaN coerces to the value 0.0 (upstream tree.h
+        numerical_decision), on both the raw and binned paths."""
         from mmlspark_tpu.models.lightgbm import LightGBMClassifier
         m = LightGBMClassifier(numIterations=5, numTasks=1).fit(binary_df)
         x = np.asarray(binary_df["features"])[:32].copy()
-        # feature 0 at its minimum bins to bin 0 -> same routing as NaN
-        x_min = x.copy()
-        x_min[:, 0] = np.asarray(binary_df["features"])[:, 0].min()
+        x_zero = x.copy()
+        x_zero[:, 0] = 0.0
         x_nan = x.copy()
         x_nan[:, 0] = np.nan
         np.testing.assert_allclose(m.booster.score(x_nan),
-                                   m.booster.score(x_min), rtol=1e-6)
+                                   m.booster.score(x_zero), rtol=1e-6)

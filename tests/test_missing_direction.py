@@ -1,0 +1,139 @@
+"""Learned missing-direction splits (upstream use_missing semantics).
+
+Features with NaN at fit time get a RESERVED missing bin 0; the split scan
+evaluates both default directions and records the winner in
+Tree.split_default_left / missing_type NaN (decision_type bits). Features
+without missing keep MissingType::None (predict NaN == value 0.0).
+Reference: LightGBM FeatureHistogram::FindBestThreshold's two-direction
+missing scan; decision_type encoding in tree.h (parsed by
+models/lightgbm/native_format.py).
+"""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier, LightGBMRegressor
+from conftest import auc
+
+
+def _informative_missing(n=4000, seed=0, p_missing=0.4):
+    """Missingness of feature 0 is itself predictive of the POSITIVE class,
+    while feature 0's observed values point the other way — only a learned
+    missing-RIGHT direction can separate this cleanly."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    is_missing = rng.random(n) < p_missing
+    y = (is_missing | (x[:, 0] > 1.2)).astype(np.float64)
+    x[is_missing, 0] = np.nan
+    return x, y
+
+
+def test_learned_direction_beats_legacy():
+    x, y = _informative_missing()
+    df = DataFrame({"features": x, "label": y})
+    kw = dict(numIterations=20, numLeaves=7, numTasks=1, seed=1)
+    m_new = LightGBMClassifier(useMissing=True, **kw).fit(df)
+    m_old = LightGBMClassifier(useMissing=False, **kw).fit(df)
+    p_new = np.stack(m_new.transform(df)["probability"])[:, 1]
+    p_old = np.stack(m_old.transform(df)["probability"])[:, 1]
+    a_new, a_old = auc(y, p_new), auc(y, p_old)
+    # legacy NaN->lowest-bin merges missing with small values; the learned
+    # direction isolates the missing mass
+    assert a_new > 0.99, a_new
+    assert a_new >= a_old - 1e-6, (a_new, a_old)
+
+
+def test_direction_bits_exported_and_reimported():
+    x, y = _informative_missing(seed=3)
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(numIterations=10, numLeaves=7, numTasks=1).fit(df)
+    trees = m.booster.trees
+    feat0 = (np.asarray(trees.split_feat) == 0) & np.asarray(trees.split_valid)
+    mt = np.asarray(trees.split_missing_type)
+    assert (mt[feat0] == 2).all()       # NaN missing type on the NaN feature
+    other = (np.asarray(trees.split_feat) != 0) & np.asarray(trees.split_valid)
+    assert (mt[other] == 0).all()       # None elsewhere
+    # at least one split should have learned missing-right (the signal
+    # demands it)
+    dl = np.asarray(trees.split_default_left)
+    assert (~dl[feat0]).any()
+
+    # text-format roundtrip preserves NaN routing exactly
+    s = m.booster.model_string()
+    from mmlspark_tpu.models.lightgbm.native_format import parse_model_string
+    b2 = parse_model_string(s)
+    np.testing.assert_allclose(b2.score(x), m.booster.score(x),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_raw_and_binned_paths_agree_on_nan():
+    x, y = _informative_missing(seed=5)
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(numIterations=10, numLeaves=7, numTasks=1).fit(df)
+    # transform (binned-free raw path) must match booster.score on NaN rows
+    p = np.stack(m.transform(df)["probability"])[:, 1]
+    s = m.booster.score(x)
+    np.testing.assert_allclose(p, s, rtol=1e-5, atol=1e-6)
+    assert np.isfinite(p).all()
+
+
+def test_nan_free_models_unchanged_by_flag():
+    """On NaN-free data, useMissing must be a no-op (bit-identical trees) —
+    the guarantee that keeps all golden gates valid."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(2000, 6)).astype(np.float32)
+    y = ((x @ rng.normal(size=6)) > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    kw = dict(numIterations=10, numLeaves=15, numTasks=1, seed=2)
+    a = LightGBMClassifier(useMissing=True, **kw).fit(df)
+    b = LightGBMClassifier(useMissing=False, **kw).fit(df)
+    np.testing.assert_array_equal(np.asarray(a.booster.trees.split_feat),
+                                  np.asarray(b.booster.trees.split_feat))
+    np.testing.assert_array_equal(np.asarray(a.booster.trees.split_bin),
+                                  np.asarray(b.booster.trees.split_bin))
+    np.testing.assert_allclose(np.asarray(a.booster.trees.leaf_value),
+                               np.asarray(b.booster.trees.leaf_value))
+
+
+def test_missing_with_lazy_and_distributed():
+    x, y = _informative_missing(seed=9)
+    df = DataFrame({"features": x, "label": y})
+    kw = dict(numIterations=15, numLeaves=7, seed=4)
+    p1 = np.stack(LightGBMClassifier(numTasks=1, histRefresh="lazy", **kw)
+                  .fit(df).transform(df)["probability"])[:, 1]
+    p8 = np.stack(LightGBMClassifier(numTasks=8, histRefresh="lazy", **kw)
+                  .fit(df).transform(df)["probability"])[:, 1]
+    # psum summation order differs across shard counts: probability-space
+    # noise up to ~1e-4 is summation noise, not a semantic difference
+    np.testing.assert_allclose(p1, p8, atol=1e-4)
+    assert auc(y, p1) > 0.99
+
+
+def test_missing_regression_save_load(tmp_path):
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(3000, 3)).astype(np.float32)
+    miss = rng.random(3000) < 0.3
+    y = np.where(miss, 5.0, x[:, 0]).astype(np.float64)
+    x[miss, 0] = np.nan
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMRegressor(numIterations=30, numLeaves=7, numTasks=1).fit(df)
+    pred = np.asarray(m.transform(df)["prediction"])
+    assert np.abs(pred[miss] - 5.0).mean() < 0.5
+    p = str(tmp_path / "m")
+    m.save(p)
+    from mmlspark_tpu.core.pipeline import PipelineStage
+    m2 = PipelineStage.load(p)
+    np.testing.assert_allclose(np.asarray(m2.transform(df)["prediction"]),
+                               pred, rtol=1e-6)
+
+
+def test_shap_local_accuracy_on_nan_rows():
+    """TreeSHAP must route NaN by the learned direction: contributions (+
+    expected value) sum to the model's raw prediction on missing rows."""
+    x, y = _informative_missing(n=1500, seed=13)
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(numIterations=8, numLeaves=7, numTasks=1).fit(df)
+    rows = x[np.isnan(x[:, 0])][:8]
+    shap = m.booster.features_shap(rows)
+    raw = m.booster.raw_predict(rows)
+    np.testing.assert_allclose(shap.sum(axis=-1), raw, rtol=1e-4, atol=1e-5)
