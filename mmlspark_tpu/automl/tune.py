@@ -151,8 +151,30 @@ class TuneHyperparameters(Estimator, _p.HasLabelCol, _p.HasSeed):
                     metric, scored, label_col))
             return float(np.mean(vals))
 
-        with ThreadPoolExecutor(max_workers=self.get("parallelism")) as ex:
-            metrics = list(ex.map(evaluate, candidates))
+        single_est = len({id(e) for e, _ in candidates}) == 1
+        all_keys = set().union(*[set(ov) for _, ov in candidates]) \
+            if candidates else set()
+        batchable = (single_est and hasattr(candidates[0][0],
+                                            "fit_param_maps")
+                     and all_keys <= set(getattr(candidates[0][0],
+                                                 "_VMAP_PARAM_FIELDS", ())))
+        if batchable:
+            # batched path: one fit(df, paramMaps) per fold — continuous-only
+            # sweeps train every candidate in ONE vmapped XLA program
+            # (fit_param_maps falls back to sequential fits otherwise)
+            est0 = candidates[0][0]
+            maps_all = [dict(ov) for _, ov in candidates]
+            per_cand = np.zeros((len(candidates), len(folds)))
+            for fi, (train_idx, test_idx) in enumerate(folds):
+                fold_models = est0.fit(df.take(train_idx), maps_all)
+                test = df.take(test_idx)
+                for ci, model in enumerate(fold_models):
+                    per_cand[ci, fi] = EvaluationUtils.compute(
+                        metric, model.transform(test), label_col)
+            metrics = [float(v) for v in per_cand.mean(axis=1)]
+        else:
+            with ThreadPoolExecutor(max_workers=self.get("parallelism")) as ex:
+                metrics = list(ex.map(evaluate, candidates))
 
         best_i = _best_index(metrics, larger_better)
         best_est, best_overrides = candidates[best_i]

@@ -24,12 +24,14 @@ from ...core.dataframe import DataFrame
 from ...core import params as _p
 from ...core.pipeline import Estimator, Model
 from ...ops.binning import BinMapper
-from ...ops.boosting import BoostResult, GBDTConfig, Tree, make_train_fn
+from ...ops.boosting import (BoostResult, GBDTConfig, HParams, Tree,
+                             make_train_fn)
 from ...parallel import mesh as meshlib
 from .booster import Booster, concat_boosters
 
 Param = _p.Param
 
+import copy
 import functools
 
 
@@ -40,6 +42,23 @@ def _compiled_serial(cfg: GBDTConfig):
     a fresh closure (round-1 verdict: warm-up fits never warmed anything)."""
     train = make_train_fn(cfg)
     return jax.jit(train), jax.jit(train.chunk)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_serial_vmapped(cfg: GBDTConfig):
+    """One compiled program training a BATCH of continuous-hyperparameter
+    candidates: vmap over (key, HParams), data broadcast. The TPU-first
+    realization of the reference's Estimator.fit(dataset, paramMaps)
+    (SparkML surface; TuneHyperparameters' thread-pool becomes a single
+    batched XLA program)."""
+    train = make_train_fn(cfg)
+
+    def many(binned, y, w, is_train, margin, keys, hp_batch):
+        return jax.vmap(
+            lambda k_, hp_: train(binned, y, w, is_train, margin, k_,
+                                  hp=hp_))(keys, hp_batch)
+
+    return jax.jit(many)
 
 
 @functools.lru_cache(maxsize=64)
@@ -277,12 +296,91 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 f"allowed: {allowed} (or '' for the objective default)")
         return name
 
+    #: estimator param -> HParams field for the vmapped fit(df, paramMaps)
+    #: path; any other key in a param map falls back to sequential fits
+    _VMAP_PARAM_FIELDS = {
+        "learningRate": "learning_rate", "lambdaL1": "lambda_l1",
+        "lambdaL2": "lambda_l2", "minGainToSplit": "min_gain_to_split",
+        "minSumHessianInLeaf": "min_sum_hessian_in_leaf",
+        "minDataInLeaf": "min_data_in_leaf",
+        "baggingFraction": "bagging_fraction"}
+
+    def _supports_vmap_fit(self) -> bool:
+        return True
+
+    def fit(self, df: DataFrame, params=None):
+        """SparkML Estimator.fit surface: `params` may be a single dict (one
+        overridden fit) or a LIST of param maps, returning one model per map
+        (Estimator.fit(dataset, paramMaps) — the surface TuneHyperparameters
+        sweeps, automl/TuneHyperparameters.scala:37-203). Maps touching only
+        continuous hyperparameters train in ONE vmapped XLA program."""
+        if isinstance(params, (list, tuple)):
+            return self.fit_param_maps(df, list(params))
+        return super().fit(df, params)
+
+    def fit_param_maps(self, df: DataFrame, maps):
+        keys = set().union(*[set(m) for m in maps]) if maps else set()
+        ndev = self.get("numTasks") or meshlib.device_count()
+        vmappable = (
+            bool(maps) and keys <= set(self._VMAP_PARAM_FIELDS)
+            and not self.get("earlyStoppingRound")
+            and not self.get("numBatches")
+            and self.get("delegate") is None
+            and not self.get("modelString")
+            and self.get("boostingType") != "dart"  # B x [T, N] delta memory
+            and self._supports_vmap_fit()
+            and (self.get("parallelism") == "serial" or ndev <= 1))
+        if not vmappable:
+            return [self.copy(pm)._fit(df) for pm in maps]
+
+        def val(pm, name):
+            return float(pm.get(name, self.get(name)))
+
+        cols = {field: np.asarray([val(pm, pname) for pm in maps], np.float32)
+                for pname, field in self._VMAP_PARAM_FIELDS.items()}
+        # booster metadata records the user's learningRate even for rf
+        # (training uses 1.0 — rf averages, it does not shrink), matching the
+        # sequential path's exported model strings; python floats, not the
+        # f32-rounded training values, so model_string() output is identical
+        meta_lrs = [val(pm, "learningRate") for pm in maps]
+        if self.get("boostingType") == "rf":
+            if (cols["bagging_fraction"] >= 1.0).any():
+                # per-map rf contract violation: let the sequential path
+                # raise the proper per-candidate error
+                return [self.copy(pm)._fit(df) for pm in maps]
+            cols["learning_rate"] = np.ones(len(maps), np.float32)
+        hp_batch = HParams(**{fld: jnp.asarray(cols[fld])
+                              for fld in HParams._fields})
+        self._hp_batch = hp_batch
+        self._hp_meta_lrs = meta_lrs
+        # bagging STRUCTURE is static: if any candidate bags, the compiled
+        # program must include the bagging mask (prob comes from HParams)
+        self._bagging_fraction_static = float(cols["bagging_fraction"].min())
+        try:
+            model0 = self._fit(df)
+            boosters = self._vmap_boosters
+        finally:
+            self._hp_batch = None
+            self._hp_meta_lrs = None
+            self._vmap_boosters = None
+            self._bagging_fraction_static = None
+        models = [model0]
+        for booster in boosters[1:]:
+            m = copy.copy(model0)
+            m._paramMap = dict(model0._paramMap)
+            m.booster = booster
+            models.append(m)
+        return models
+
     def _make_config(self, num_class: int, axis_name: Optional[str],
                      objective: Optional[str] = None,
                      has_init_score: bool = False) -> GBDTConfig:
         boosting = self.get("boostingType")
+        bag_frac = (self._bagging_fraction_static
+                    if getattr(self, "_bagging_fraction_static", None)
+                    is not None else self.get("baggingFraction"))
         if boosting == "rf" and (self.get("baggingFreq") <= 0
-                                 or self.get("baggingFraction") >= 1.0):
+                                 or bag_frac >= 1.0):
             raise ValueError(
                 "boostingType='rf' requires baggingFreq > 0 and "
                 "baggingFraction < 1.0 (LightGBM random-forest contract)")
@@ -298,7 +396,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             min_data_in_leaf=self.get("minDataInLeaf"),
             min_sum_hessian_in_leaf=self.get("minSumHessianInLeaf"),
             min_gain_to_split=self.get("minGainToSplit"),
-            bagging_fraction=self.get("baggingFraction"),
+            bagging_fraction=bag_frac,
             bagging_freq=self.get("baggingFreq"),
             pos_bagging_fraction=self.get("posBaggingFraction"),
             neg_bagging_fraction=self.get("negBaggingFraction"),
@@ -544,19 +642,49 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         use_chunked = ((delegate is not None or (rounds and has_valid))
                        and self.get("boostingType") != "dart")
 
+        hp_batch = getattr(self, "_hp_batch", None)
+        if hp_batch is not None:
+            # vmapped multi-candidate training (fit(df, paramMaps)): one
+            # compiled program trains every HParams candidate; per-candidate
+            # boosters are stashed for fit_param_maps, the first is returned
+            # so the subclass _fit completes normally
+            assert serial, "vmapped fit is restricted to the serial path"
+            nb = len(jax.tree.leaves(hp_batch)[0])
+            vfull = _compiled_serial_vmapped(cfg)
+            keys = jnp.tile(key[None], (nb,) + (1,) * key.ndim)
+            res_b = jax.tree.map(np.asarray,
+                                 vfull(*data, keys, hp_batch))
+            lrs = getattr(self, "_hp_meta_lrs", None)
+            self._vmap_boosters = []
+            for i in range(nb):
+                res_i = jax.tree.map(lambda a: a[i], res_b)
+                self._vmap_boosters.append(self._assemble_booster(
+                    res_i, bm, num_class, objective, f,
+                    self._select_best_iteration(res_i, has_valid), prev,
+                    learning_rate=(float(lrs[i]) if lrs is not None
+                                   else None)))
+            return self._vmap_boosters[0]
+
         if use_chunked:
             result, best_iter = self._run_chunked(
                 run_chunk, key, n_rows_exec, k, rounds, has_valid, delegate)
         else:
             result = jax.tree.map(np.asarray, run_full(key))
             best_iter = self._select_best_iteration(result, has_valid)
+        return self._assemble_booster(result, bm, num_class, objective, f,
+                                      best_iter, prev)
+
+    def _assemble_booster(self, result: BoostResult, bm, num_class: int,
+                          objective: str, f: int, best_iter, prev,
+                          learning_rate: Optional[float] = None) -> Booster:
         trees = result.trees
         thresholds = self._thresholds_for(trees, bm)
         booster = Booster(trees, thresholds, result.init_score
                           if num_class > 1 else np.float32(result.init_score),
                           objective, num_class, f, bm,
                           self.get("slotNames"), best_iter,
-                          self.get("learningRate"),
+                          (self.get("learningRate") if learning_rate is None
+                           else learning_rate),
                           average_output=(self.get("boostingType") == "rf"))
         if prev is not None:
             booster = concat_boosters(prev, booster)

@@ -114,6 +114,31 @@ class GBDTConfig(NamedTuple):
     eval_metric: str = ""
 
 
+class HParams(NamedTuple):
+    """CONTINUOUS hyperparameters as traced jnp scalars — unlike GBDTConfig
+    (static, baked into the compiled program), these are runtime inputs, so
+    `jax.vmap` over an HParams batch trains MANY configurations in ONE
+    compiled program (the TPU-first realization of the reference's
+    `Estimator.fit(dataset, paramMaps)` surface and TuneHyperparameters'
+    thread-pool, automl/TuneHyperparameters.scala:37-203). Defaults are
+    taken from the config by `HParams.from_config`."""
+    learning_rate: jax.Array
+    lambda_l1: jax.Array
+    lambda_l2: jax.Array
+    min_gain_to_split: jax.Array
+    min_sum_hessian_in_leaf: jax.Array
+    min_data_in_leaf: jax.Array
+    bagging_fraction: jax.Array
+
+    @staticmethod
+    def from_config(cfg: "GBDTConfig") -> "HParams":
+        lr = 1.0 if cfg.boosting_type == "rf" else cfg.learning_rate
+        return HParams(*[jnp.float32(v) for v in (
+            lr, cfg.lambda_l1, cfg.lambda_l2, cfg.min_gain_to_split,
+            cfg.min_sum_hessian_in_leaf, float(cfg.min_data_in_leaf),
+            cfg.bagging_fraction)])
+
+
 class Tree(NamedTuple):
     """One fitted tree in slot representation (see build_tree). Arrays may carry leading
     batch dims for [iteration] or [iteration, class] stacking."""
@@ -160,7 +185,8 @@ def _cat_sort_order(hists, cfg: GBDTConfig):
     return jnp.argsort(-_cat_ratio(hists, cfg), axis=2)           # [L,F,B]
 
 
-def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask):
+def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
+                      hp: "HParams"):
     """Masked split-gain table over [L, F, B, 3] histograms -> [L, F, B, 2].
 
     The last axis is the missing-value default direction: 0 = missing goes
@@ -192,21 +218,21 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask):
     right_g, right_h, right_n = tot_g - left_g, tot_h - left_h, tot_n - left_n
 
     def gain_of(lg, lh):
-        return (_split_score(lg, lh, cfg.lambda_l1, cfg.lambda_l2)
+        return (_split_score(lg, lh, hp.lambda_l1, hp.lambda_l2)
                 + _split_score(tot_g - lg, tot_h - lh,
-                               cfg.lambda_l1, cfg.lambda_l2)
-                - _split_score(tot_g, tot_h, cfg.lambda_l1, cfg.lambda_l2))
+                               hp.lambda_l1, hp.lambda_l2)
+                - _split_score(tot_g, tot_h, hp.lambda_l1, hp.lambda_l2))
 
     gain0 = gain_of(left_g, left_h)
 
     fm = (feature_mask[None, :, None] if feature_mask.ndim == 1
           else feature_mask[:, :, None])
-    min_data = max(cfg.min_data_in_leaf, 1)
+    min_data = jnp.maximum(hp.min_data_in_leaf, 1.0)
 
     def ok_of(ln, lh, rn, rh):
         return ((ln >= min_data) & (rn >= min_data)
-                & (lh >= cfg.min_sum_hessian_in_leaf)
-                & (rh >= cfg.min_sum_hessian_in_leaf) & fm)
+                & (lh >= hp.min_sum_hessian_in_leaf)
+                & (rh >= hp.min_sum_hessian_in_leaf) & fm)
 
     ok0 = ok_of(left_n, left_h, right_n, right_h)
     if cat:
@@ -234,7 +260,8 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask):
     return jnp.stack([jnp.where(ok0, gain0, _NEG_INF), g1], axis=-1)
 
 
-def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
+def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask,
+                         hp: "HParams"):
     """Vectorized split-gain scan over [L, F, B, 2] gain tables.
 
     Returns per-slot (best_gain [L], best_feat [L], best_bin [L],
@@ -243,7 +270,7 @@ def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
     subset mask.
     """
     l, f, b, _ = hists.shape
-    gain = _split_gain_table(hists, sums, cfg, feature_mask)
+    gain = _split_gain_table(hists, sums, cfg, feature_mask, hp)
     flat = gain.reshape(l, f * b * 2)
     best_idx = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
@@ -254,7 +281,8 @@ def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask):
 
 
 def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
-               feature_mask: jax.Array) -> Tuple[Tree, jax.Array]:
+               feature_mask: jax.Array,
+               hp: Optional["HParams"] = None) -> Tuple[Tree, jax.Array]:
     """Grow one leaf-wise tree.
 
     binned: [N, F] int — bin ids (shard-local rows when distributed)
@@ -276,6 +304,8 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     rescans only the two changed slots; lazy mode defers both children and
     re-passes only when the candidate pool dries (cfg.split_refresh).
     """
+    if hp is None:
+        hp = HParams.from_config(cfg)
     n, f = binned.shape
     lcap = cfg.num_leaves
     b = cfg.max_bins
@@ -328,7 +358,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         sums = psum_(local_sums)
         # local vote: best local gain per (slot, feature)
         local_gain = _split_gain_table(local, local_sums, cfg,
-                                       feature_mask).max(axis=(2, 3))  # [L,F]
+                                       feature_mask, hp).max(axis=(2, 3))
         k2 = min(2 * k_top, f)
         _, vote_idx = jax.lax.top_k(local_gain, k2)
         vote_ok = (jnp.take_along_axis(local_gain, vote_idx, axis=1)
@@ -341,7 +371,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         hist_v = psum_(jnp.take_along_axis(
             local, sel[:, :, None, None], axis=1))           # [L,k,B,3]
         gains, f_idx, bins_, dls = _best_split_per_slot(
-            hist_v, sums, cfg, feature_mask[sel])
+            hist_v, sums, cfg, feature_mask[sel], hp)
         feats = jnp.take_along_axis(sel, f_idx[:, None], axis=1)[:, 0]
         return hist_v, sums, gains, feats.astype(jnp.int32), bins_, dls
 
@@ -376,10 +406,10 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         g_sums = jnp.zeros((lcap, 3), jnp.float32).at[0].set(
             root[0].sum(axis=0))
         bg, bf_, bb, bd = _best_split_per_slot(g_hists, g_sums, cfg,
-                                               feature_mask)
+                                               feature_mask, hp)
         hist_valid = jnp.ones((lcap,), bool)
 
-    thresh = cfg.min_gain_to_split + _MIN_GAIN_EPS
+    thresh = hp.min_gain_to_split + _MIN_GAIN_EPS
 
     def body(s, carry):
         if voting:
@@ -407,7 +437,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
                 gh_full = psum_(hist_local(slot_of_row))       # [L,F,B,3]
                 gs = gh_full[:, 0].sum(axis=1)                 # [L,B,3]->[L,3]
                 nbg, nbf, nbb, nbd = _best_split_per_slot(gh_full, gs, cfg,
-                                                          feature_mask)
+                                                          feature_mask, hp)
                 return (gh_full, gs, nbg, nbf, nbb, nbd,
                         jnp.ones((lcap,), bool))
 
@@ -499,7 +529,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         g_sums = g_sums.at[best_slot].add(-right_sum)
         idx2 = jnp.stack([best_slot, new_slot])
         pg, pf, pb, pd = _best_split_per_slot(g_hists[idx2], g_sums[idx2],
-                                              cfg, feature_mask)
+                                              cfg, feature_mask, hp)
         bg = bg.at[idx2].set(jnp.where(do, pg, bg[idx2]))
         bf_ = bf_.at[idx2].set(jnp.where(do, pf, bf_[idx2]))
         bb = bb.at[idx2].set(jnp.where(do, pb, bb[idx2]))
@@ -527,13 +557,13 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     else:
         sums = carry[12]                                       # carried g_sums
 
-    raw_out = _leaf_output(sums[:, 0], sums[:, 1], cfg.lambda_l1,
-                           cfg.lambda_l2)
+    raw_out = _leaf_output(sums[:, 0], sums[:, 1], hp.lambda_l1,
+                           hp.lambda_l2)
     if cfg.max_delta_step > 0:
         # maxDeltaStep: cap the unshrunk leaf output (upstream max_delta_step,
         # the poisson/unbalanced-logit stabilizer)
         raw_out = jnp.clip(raw_out, -cfg.max_delta_step, cfg.max_delta_step)
-    leaf_value = raw_out * jnp.float32(cfg.learning_rate)
+    leaf_value = raw_out * hp.learning_rate
     # slots that never received rows keep value 0 (their sums are 0).
     # decision_type per split: missing-capable features carry the LEARNED
     # default direction + missing_type NaN; features that saw no missing at
@@ -779,7 +809,7 @@ def make_train_fn(cfg: GBDTConfig):
     if dart and multiclass:
         raise NotImplementedError("dart mode is single-output only for now")
 
-    def _env(binned, y, w_all, is_train, init_margin, group_idx):
+    def _env(binned, y, w_all, is_train, init_margin, group_idx, hp):
         """Shared setup: init score, starting margins, and the per-iteration
         `step` closure — used by both the full scan (`train`) and the chunked
         scan (`train.chunk`, host-driven early stopping)."""
@@ -874,16 +904,16 @@ def make_train_fn(cfg: GBDTConfig):
                     # per-class keep probability (pos/negBaggingFraction)
                     p_pos = (cfg.pos_bagging_fraction
                              if cfg.pos_bagging_fraction >= 0.0
-                             else cfg.bagging_fraction)
+                             else hp.bagging_fraction)
                     p_neg = (cfg.neg_bagging_fraction
                              if cfg.neg_bagging_fraction >= 0.0
-                             else cfg.bagging_fraction)
+                             else hp.bagging_fraction)
                     u = jax.random.uniform(k_window, (n,))
                     keep = u < jnp.where(yf > 0.5, p_pos, p_neg)
                     sub = keep.astype(jnp.float32)
                 else:
                     sub = jax.random.bernoulli(
-                        k_window, cfg.bagging_fraction,
+                        k_window, hp.bagging_fraction,
                         (n,)).astype(jnp.float32)
                 row_w = w * sub
 
@@ -898,7 +928,7 @@ def make_train_fn(cfg: GBDTConfig):
                 gh3 = jnp.stack(
                     [gk * row_w, hk * row_w, jnp.where(row_w > 0, 1.0, 0.0)],
                     axis=1).astype(jnp.float32)
-                tree, slot = build_tree(binned, gh3, cfg, fmask)
+                tree, slot = build_tree(binned, gh3, cfg, fmask, hp)
                 # lr_mult: per-iteration learning-rate multiplier relative to
                 # cfg.learning_rate (delegate dynamic learning rate —
                 # LightGBMDelegate.scala getLearningRate, TrainUtils.scala:213+)
@@ -944,14 +974,20 @@ def make_train_fn(cfg: GBDTConfig):
         return step, scores0, init, deltas0, tree_scale0
 
     def train(binned, y, w_all, is_train, init_margin, key, group_idx=None,
-              lr_mult=None):
+              lr_mult=None, hp=None):
         """init_margin [N, K]: per-row starting margins (initScoreCol / warm
         start / batch training — LightGBMBase.scala:29-50, TrainUtils.scala:57-129).
         Zeros when absent. group_idx [NG, G] (lambdarank only): padded
         gather-index group layout from ops.ranking.make_group_layout.
-        lr_mult [T] (optional): per-iteration learning-rate multipliers."""
+        lr_mult [T] (optional): per-iteration learning-rate multipliers.
+        hp (optional HParams of traced scalars): continuous hyperparameters;
+        defaults to the config's values. `jax.vmap` over an HParams batch
+        (shared data in_axes=None) trains many configurations in one
+        program — see models/lightgbm LightGBMBase.fit(df, paramMaps)."""
+        if hp is None:
+            hp = HParams.from_config(cfg)
         step, scores0, init, deltas0, tree_scale0 = _env(
-            binned, y, w_all, is_train, init_margin, group_idx)
+            binned, y, w_all, is_train, init_margin, group_idx, hp)
         lr = (jnp.ones((cfg.num_iterations,), jnp.float32) if lr_mult is None
               else jnp.asarray(lr_mult, jnp.float32))
         (scores, _, tree_scale, _), (trees, train_m, valid_m) = jax.lax.scan(
@@ -965,7 +1001,7 @@ def make_train_fn(cfg: GBDTConfig):
         return BoostResult(trees, init_out, train_m, valid_m)
 
     def train_chunk(binned, y, w_all, is_train, init_margin, key, start,
-                    scores_in, lr_mult, group_idx=None):
+                    scores_in, lr_mult, group_idx=None, hp=None):
         """Run ONE chunk of iterations [start, start+C) where C =
         len(lr_mult), carrying raw scores across chunks.
 
@@ -981,8 +1017,10 @@ def make_train_fn(cfg: GBDTConfig):
             raise NotImplementedError(
                 "chunked early stopping is not supported for dart (dropout "
                 "needs the full prior-tree delta history)")
+        if hp is None:
+            hp = HParams.from_config(cfg)
         step, scores0, init, deltas0, tree_scale0 = _env(
-            binned, y, w_all, is_train, init_margin, group_idx)
+            binned, y, w_all, is_train, init_margin, group_idx, hp)
         scores_start = jnp.where(start == 0, scores0, scores_in)
         c = lr_mult.shape[0]
         its = start + jnp.arange(c)

@@ -1,0 +1,91 @@
+"""Estimator.fit(df, paramMaps) -> list of models (SparkML surface,
+swept by the reference's TuneHyperparameters). Continuous-param maps train
+in ONE vmapped XLA program (ops/boosting.HParams); anything else falls back
+to sequential fits with identical results."""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier, LightGBMRegressor
+from conftest import auc
+
+
+def test_vmapped_matches_sequential(binary_df):
+    maps = [{"learningRate": 0.05, "lambdaL2": 0.0},
+            {"learningRate": 0.1, "lambdaL2": 1.0},
+            {"learningRate": 0.2, "lambdaL2": 10.0, "minDataInLeaf": 50}]
+    est = LightGBMClassifier(numIterations=10, numLeaves=15, numTasks=1,
+                             seed=3)
+    models = est.fit(binary_df, maps)
+    assert len(models) == 3
+    seq = [est.copy(pm).fit(binary_df) for pm in maps]
+    for mv, ms in zip(models, seq):
+        pv = np.stack(mv.transform(binary_df)["probability"])[:, 1]
+        ps = np.stack(ms.transform(binary_df)["probability"])[:, 1]
+        np.testing.assert_allclose(pv, ps, atol=2e-5)
+
+
+def test_vmapped_bagging_fraction(binary_df):
+    maps = [{"baggingFraction": 0.6}, {"baggingFraction": 1.0}]
+    est = LightGBMClassifier(numIterations=10, numLeaves=7, numTasks=1,
+                             baggingFreq=1, baggingFraction=0.8, seed=5)
+    models = est.fit(binary_df, maps)
+    seq = [est.copy(pm).fit(binary_df) for pm in maps]
+    for mv, ms in zip(models, seq):
+        pv = np.stack(mv.transform(binary_df)["probability"])[:, 1]
+        ps = np.stack(ms.transform(binary_df)["probability"])[:, 1]
+        np.testing.assert_allclose(pv, ps, atol=2e-5)
+
+
+def test_non_vmappable_falls_back(binary_df):
+    # numLeaves shapes the program -> sequential fallback, same API result
+    maps = [{"numLeaves": 7}, {"numLeaves": 15}]
+    est = LightGBMClassifier(numIterations=5, numTasks=1)
+    models = est.fit(binary_df, maps)
+    assert len(models) == 2
+    n7 = int(np.asarray(models[0].booster.trees.split_valid).sum(axis=1).max())
+    n15 = int(np.asarray(models[1].booster.trees.split_valid).sum(axis=1).max())
+    assert n7 <= 6 and n15 > n7
+
+
+def test_regressor_param_maps(regression_df):
+    maps = [{"lambdaL2": 0.0}, {"lambdaL2": 100.0}]
+    models = LightGBMRegressor(numIterations=20, numLeaves=15,
+                               numTasks=1).fit(regression_df, maps)
+    p0 = np.asarray(models[0].transform(regression_df)["prediction"])
+    p1 = np.asarray(models[1].transform(regression_df)["prediction"])
+    y = regression_df["label"]
+    # heavy L2 shrinks leaves -> visibly worse train fit
+    mse0 = float(((p0 - y) ** 2).mean())
+    mse1 = float(((p1 - y) ** 2).mean())
+    assert mse0 < mse1
+
+
+def test_models_are_independent(binary_df):
+    maps = [{"learningRate": 0.05}, {"learningRate": 0.3}]
+    models = LightGBMClassifier(numIterations=8, numLeaves=7,
+                                numTasks=1).fit(binary_df, maps)
+    lv0 = np.asarray(models[0].booster.trees.leaf_value)
+    lv1 = np.asarray(models[1].booster.trees.leaf_value)
+    assert not np.allclose(lv0, lv1)
+    # metric records are per-candidate
+    assert models[0].train_metrics is not None
+    assert models[1].train_metrics is not None
+    assert models[0].train_metrics[-1] != models[1].train_metrics[-1]
+
+
+def test_rf_param_maps_contract(binary_df):
+    import pytest
+    est = LightGBMClassifier(boostingType="rf", numIterations=8, numLeaves=7,
+                             numTasks=1, baggingFreq=1, baggingFraction=0.7)
+    # a candidate violating the rf contract raises (via sequential fallback)
+    with pytest.raises(ValueError, match="rf"):
+        est.fit(binary_df, [{"baggingFraction": 1.0}])
+    # valid rf candidates train vmapped; exported metadata keeps the user's
+    # learningRate (training itself uses 1.0 — rf averages, not shrinks)
+    models = est.fit(binary_df, [{"baggingFraction": 0.5},
+                                 {"baggingFraction": 0.8}])
+    assert len(models) == 2
+    for m in models:
+        assert m.booster.average_output
+        assert "[learning_rate: 0.1]" in m.booster.model_string()
