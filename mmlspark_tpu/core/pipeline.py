@@ -96,6 +96,13 @@ class Transformer(PipelineStage):
 class Estimator(PipelineStage):
     def fit(self, df: DataFrame, params: Optional[Dict[str, Any]] = None
             ) -> "Transformer":
+        """SparkML Estimator.fit: `params` may be one param override dict or
+        a LIST of param maps, returning one fitted model per map (the
+        `fit(dataset, paramMaps)` surface TuneHyperparameters sweeps).
+        Subclasses may batch the list form (the GBDT trains continuous-only
+        maps in one vmapped program); the default is sequential fits."""
+        if isinstance(params, (list, tuple)):
+            return [self.copy(dict(pm))._fit(df) for pm in params]
         if params:
             return self.copy(params)._fit(df)
         return self._fit(df)

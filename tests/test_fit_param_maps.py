@@ -89,3 +89,27 @@ def test_rf_param_maps_contract(binary_df):
     for m in models:
         assert m.booster.average_output
         assert "[learning_rate: 0.1]" in m.booster.model_string()
+
+
+def test_multiclass_vmapped(multiclass_df):
+    maps = [{"learningRate": 0.05}, {"learningRate": 0.2}]
+    models = LightGBMClassifier(numIterations=10, numLeaves=7,
+                                numTasks=1).fit(multiclass_df, maps)
+    seq = [LightGBMClassifier(numIterations=10, numLeaves=7, numTasks=1,
+                              **pm).fit(multiclass_df) for pm in maps]
+    for mv, ms in zip(models, seq):
+        pv = np.stack(mv.transform(multiclass_df)["probability"])
+        ps = np.stack(ms.transform(multiclass_df)["probability"])
+        np.testing.assert_allclose(pv, ps, atol=2e-5)
+
+
+def test_every_estimator_supports_param_maps(regression_df):
+    """The base Estimator honors fit(df, paramMaps) sequentially — SparkML
+    surface parity for non-GBDT stages too."""
+    from mmlspark_tpu.models.vw import VowpalWabbitRegressor
+    models = VowpalWabbitRegressor(numPasses=2).fit(
+        regression_df, [{"learningRate": 0.1}, {"learningRate": 1.0}])
+    assert len(models) == 2
+    p0 = np.asarray(models[0].transform(regression_df)["prediction"])
+    p1 = np.asarray(models[1].transform(regression_df)["prediction"])
+    assert not np.allclose(p0, p1)
