@@ -137,3 +137,34 @@ def test_shap_local_accuracy_on_nan_rows():
     shap = m.booster.features_shap(rows)
     raw = m.booster.raw_predict(rows)
     np.testing.assert_allclose(shap.sum(axis=-1), raw, rtol=1e-4, atol=1e-5)
+
+
+def test_missing_cross_param_fuzz():
+    """Missing-direction learning must compose with every boosting mode and
+    refresh policy (FuzzingTest-style breadth: random-ish config crosses must
+    neither crash nor produce non-finite metrics)."""
+    x, y = _informative_missing(n=1200, seed=17, p_missing=0.25)
+    # add a categorical column alongside the NaN feature
+    rng = np.random.default_rng(18)
+    xc = np.concatenate([x, rng.integers(0, 6, (1200, 1)).astype(np.float32)],
+                        axis=1)
+    df = DataFrame({"features": xc, "label": y})
+    cases = [
+        dict(boostingType="goss", topRate=0.3, otherRate=0.2),
+        dict(boostingType="dart"),
+        dict(boostingType="rf", baggingFreq=1, baggingFraction=0.7),
+        dict(histRefresh="lazy"),
+        dict(histRefresh="lazy", boostingType="goss"),
+        dict(categoricalSlotIndexes=[4]),
+        dict(categoricalSlotIndexes=[4], histRefresh="lazy"),
+        dict(featureFraction=0.6, baggingFreq=2, baggingFraction=0.8),
+        dict(maxDepth=3, minDataInLeaf=40),
+        dict(useMissing=False, histRefresh="lazy"),
+    ]
+    for kw in cases:
+        m = LightGBMClassifier(numIterations=6, numLeaves=7, numTasks=1,
+                               **kw).fit(df)
+        tm = m.train_metrics
+        assert tm is not None and np.isfinite(tm).all(), (kw, tm)
+        p = np.stack(m.transform(df)["probability"])[:, 1]
+        assert np.isfinite(p).all(), kw
