@@ -1,0 +1,29 @@
+"""Hyperparameter sweep — the reference's TuneHyperparameters flow
+(notebooks HyperParameterTuning), TPU-first: continuous-param candidates
+train in ONE vmapped XLA program via fit(df, paramMaps)."""
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+
+def main(n=20000, f=15):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((x @ rng.normal(size=f)) > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    train, test = df.random_split([0.8, 0.2], seed=1)
+
+    maps = [{"learningRate": lr, "lambdaL2": l2}
+            for lr in (0.05, 0.1, 0.2) for l2 in (0.0, 10.0)]
+    models = LightGBMClassifier(numIterations=20, numLeaves=15).fit(train,
+                                                                    maps)
+    accs = [float(np.mean(m.transform(test)["prediction"] == test["label"]))
+            for m in models]
+    best = int(np.argmax(accs))
+    print("best candidate:", maps[best], "accuracy", accs[best])
+    return accs[best]
+
+
+if __name__ == "__main__":
+    main()

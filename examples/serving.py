@@ -1,0 +1,39 @@
+"""Model serving — the reference's Spark Serving flow (docs/mmlspark-serving.md):
+fit a model, serve its transform over HTTP with dynamic batching, score a
+request (`readStream.server() ... parseRequest -> pipeline -> makeReply`
+analogue, io/IOImplicits.scala:19-212)."""
+import json
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.io.serving import ServingServer
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+
+def main(n=5000, f=10):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((x @ rng.normal(size=f)) > 0).astype(np.float64)
+    model = LightGBMClassifier(numIterations=10, numLeaves=7).fit(
+        DataFrame({"features": x, "label": y}))
+
+    server = ServingServer(handler=model.transform, reply_col="prediction",
+                           port=0).start()
+    try:
+        server.warmup({"features": [0.0] * f})
+        req = urllib.request.Request(
+            server.url,
+            json.dumps({"features": x[0].tolist()}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        print("served response:", out)
+        return out
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

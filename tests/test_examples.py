@@ -1,0 +1,36 @@
+"""Smoke-run every examples/ script — the analogue of the reference's
+notebook smoke tests (nbtest/NotebookTests.scala, pipeline.yaml E2E job):
+each sample must execute end-to-end on the virtual mesh."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
+
+
+def _run(name):
+    path = os.path.join(EXAMPLES, name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main()
+
+
+def test_gbdt_quickstart():
+    assert _run("gbdt_quickstart.py") > 0.85
+
+
+def test_wide_sparse_text():
+    assert _run("wide_sparse_text.py") > 0.95
+
+
+def test_hyperparam_sweep():
+    assert _run("hyperparam_sweep.py") > 0.85
+
+
+def test_serving():
+    out = _run("serving.py")
+    assert "prediction" in out
