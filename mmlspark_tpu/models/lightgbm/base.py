@@ -62,6 +62,27 @@ def _compiled_serial_vmapped(cfg: GBDTConfig):
 
 
 @functools.lru_cache(maxsize=64)
+def _compiled_sharded_vmapped(cfg: GBDTConfig, ndev: int):
+    """Vmapped candidate batch over the shard_map'd trainer: data sharded
+    over the mesh axis, HParams batched over vmap — B candidates x D shards
+    in one program."""
+    m = meshlib.get_mesh(ndev)
+    axis = meshlib.DATA_AXIS
+    train = make_train_fn(cfg)
+    sharded = jax.shard_map(
+        lambda b, y, w, t, mg, k_, hp_: train(b, y, w, t, mg, k_, hp=hp_),
+        mesh=m, in_specs=(P(axis),) * 5 + (P(), P()),
+        out_specs=P(), check_vma=False)
+
+    def many(binned, y, w, is_train, margin, keys, hp_batch):
+        return jax.vmap(
+            lambda k_, hp_: sharded(binned, y, w, is_train, margin, k_,
+                                    hp_))(keys, hp_batch)
+
+    return jax.jit(many)
+
+
+@functools.lru_cache(maxsize=64)
 def _compiled_sharded(cfg: GBDTConfig, ndev: int, grouped: bool):
     m = meshlib.get_mesh(ndev)
     axis = meshlib.DATA_AXIS
@@ -329,7 +350,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             and not self.get("modelString")
             and self.get("boostingType") != "dart"  # B x [T, N] delta memory
             and self._supports_vmap_fit()
-            and (self.get("parallelism") == "serial" or ndev <= 1))
+            and self.get("parallelism") != "voting_parallel")
         if not vmappable:
             return [self.copy(pm)._fit(df) for pm in maps]
 
@@ -648,9 +669,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # compiled program trains every HParams candidate; per-candidate
             # boosters are stashed for fit_param_maps, the first is returned
             # so the subclass _fit completes normally
-            assert serial, "vmapped fit is restricted to the serial path"
+            assert gidx is None, "vmapped fit does not thread group layouts"
             nb = len(jax.tree.leaves(hp_batch)[0])
-            vfull = _compiled_serial_vmapped(cfg)
+            vfull = (_compiled_serial_vmapped(cfg) if serial
+                     else _compiled_sharded_vmapped(cfg, ndev))
             keys = jnp.tile(key[None], (nb,) + (1,) * key.ndim)
             res_b = jax.tree.map(np.asarray,
                                  vfull(*data, keys, hp_batch))

@@ -113,3 +113,19 @@ def test_every_estimator_supports_param_maps(regression_df):
     p0 = np.asarray(models[0].transform(regression_df)["prediction"])
     p1 = np.asarray(models[1].transform(regression_df)["prediction"])
     assert not np.allclose(p0, p1)
+
+
+def test_vmapped_sharded_matches_serial(binary_df):
+    """Candidate batches over the 8-shard mesh: vmap-of-shard_map trains
+    B x D in one program and matches the single-shard batch."""
+    maps = [{"learningRate": 0.05}, {"learningRate": 0.2, "lambdaL2": 5.0}]
+    est1 = LightGBMClassifier(numIterations=10, numLeaves=15, numTasks=1,
+                              seed=9)
+    est8 = LightGBMClassifier(numIterations=10, numLeaves=15, numTasks=8,
+                              seed=9)
+    m1 = est1.fit(binary_df, maps)
+    m8 = est8.fit(binary_df, maps)
+    for a, b in zip(m1, m8):
+        pa = np.stack(a.transform(binary_df)["probability"])[:, 1]
+        pb = np.stack(b.transform(binary_df)["probability"])[:, 1]
+        np.testing.assert_allclose(pa, pb, atol=1e-4)
