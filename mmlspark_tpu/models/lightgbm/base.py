@@ -45,39 +45,61 @@ def _compiled_serial(cfg: GBDTConfig):
 
 
 @functools.lru_cache(maxsize=64)
-def _compiled_serial_vmapped(cfg: GBDTConfig):
+def _compiled_serial_vmapped(cfg: GBDTConfig, grouped: bool = False):
     """One compiled program training a BATCH of continuous-hyperparameter
-    candidates: vmap over (key, HParams), data broadcast. The TPU-first
-    realization of the reference's Estimator.fit(dataset, paramMaps)
-    (SparkML surface; TuneHyperparameters' thread-pool becomes a single
-    batched XLA program)."""
+    candidates: vmap over (key, HParams), data (and the lambdarank group
+    layout, when present) broadcast. The TPU-first realization of the
+    reference's Estimator.fit(dataset, paramMaps) (SparkML surface;
+    TuneHyperparameters' thread-pool becomes a single batched XLA
+    program)."""
     train = make_train_fn(cfg)
 
-    def many(binned, y, w, is_train, margin, keys, hp_batch):
-        return jax.vmap(
-            lambda k_, hp_: train(binned, y, w, is_train, margin, k_,
-                                  hp=hp_))(keys, hp_batch)
+    if grouped:
+        def many(binned, y, w, is_train, margin, keys, hp_batch, gidx):
+            return jax.vmap(
+                lambda k_, hp_: train(binned, y, w, is_train, margin, k_,
+                                      group_idx=gidx, hp=hp_))(keys, hp_batch)
+    else:
+        def many(binned, y, w, is_train, margin, keys, hp_batch):
+            return jax.vmap(
+                lambda k_, hp_: train(binned, y, w, is_train, margin, k_,
+                                      hp=hp_))(keys, hp_batch)
 
     return jax.jit(many)
 
 
 @functools.lru_cache(maxsize=64)
-def _compiled_sharded_vmapped(cfg: GBDTConfig, ndev: int):
+def _compiled_sharded_vmapped(cfg: GBDTConfig, ndev: int,
+                              grouped: bool = False):
     """Vmapped candidate batch over the shard_map'd trainer: data sharded
     over the mesh axis, HParams batched over vmap — B candidates x D shards
-    in one program."""
+    in one program. `grouped` threads the lambdarank group layout (sharded
+    like the rows)."""
     m = meshlib.get_mesh(ndev)
     axis = meshlib.DATA_AXIS
     train = make_train_fn(cfg)
-    sharded = jax.shard_map(
-        lambda b, y, w, t, mg, k_, hp_: train(b, y, w, t, mg, k_, hp=hp_),
-        mesh=m, in_specs=(P(axis),) * 5 + (P(), P()),
-        out_specs=P(), check_vma=False)
+    if grouped:
+        sharded = jax.shard_map(
+            lambda b, y, w, t, mg, k_, hp_, g_: train(
+                b, y, w, t, mg, k_, group_idx=g_, hp=hp_),
+            mesh=m, in_specs=(P(axis),) * 5 + (P(), P(), P(axis)),
+            out_specs=P(), check_vma=False)
 
-    def many(binned, y, w, is_train, margin, keys, hp_batch):
-        return jax.vmap(
-            lambda k_, hp_: sharded(binned, y, w, is_train, margin, k_,
-                                    hp_))(keys, hp_batch)
+        def many(binned, y, w, is_train, margin, keys, hp_batch, gidx):
+            return jax.vmap(
+                lambda k_, hp_: sharded(binned, y, w, is_train, margin, k_,
+                                        hp_, gidx))(keys, hp_batch)
+    else:
+        sharded = jax.shard_map(
+            lambda b, y, w, t, mg, k_, hp_: train(b, y, w, t, mg, k_,
+                                                  hp=hp_),
+            mesh=m, in_specs=(P(axis),) * 5 + (P(), P()),
+            out_specs=P(), check_vma=False)
+
+        def many(binned, y, w, is_train, margin, keys, hp_batch):
+            return jax.vmap(
+                lambda k_, hp_: sharded(binned, y, w, is_train, margin, k_,
+                                        hp_))(keys, hp_batch)
 
     return jax.jit(many)
 
@@ -669,13 +691,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # compiled program trains every HParams candidate; per-candidate
             # boosters are stashed for fit_param_maps, the first is returned
             # so the subclass _fit completes normally
-            assert gidx is None, "vmapped fit does not thread group layouts"
             nb = len(jax.tree.leaves(hp_batch)[0])
-            vfull = (_compiled_serial_vmapped(cfg) if serial
-                     else _compiled_sharded_vmapped(cfg, ndev))
+            grouped = gidx is not None
+            vfull = (_compiled_serial_vmapped(cfg, grouped) if serial
+                     else _compiled_sharded_vmapped(cfg, ndev, grouped))
             keys = jnp.tile(key[None], (nb,) + (1,) * key.ndim)
-            res_b = jax.tree.map(np.asarray,
-                                 vfull(*data, keys, hp_batch))
+            args = (*data, keys, hp_batch) + ((gidx,) if grouped else ())
+            res_b = jax.tree.map(np.asarray, vfull(*args))
             lrs = getattr(self, "_hp_meta_lrs", None)
             self._vmap_boosters = []
             for i in range(nb):

@@ -37,11 +37,6 @@ class LightGBMRanker(LightGBMParamsBase):
     def _objective_name(self) -> str:
         return "lambdarank"
 
-    def _supports_vmap_fit(self) -> bool:
-        # lambdarank needs group layouts (gidx) which the vmapped serial
-        # runner does not thread; param-map fits fall back to sequential
-        return False
-
     def _fit(self, df: DataFrame) -> "LightGBMRankerModel":
         x, y, w, is_valid, init_score = self._extract_xyw(df)
         gcol = self.get("groupCol")

@@ -129,3 +129,24 @@ def test_vmapped_sharded_matches_serial(binary_df):
         pa = np.stack(a.transform(binary_df)["probability"])[:, 1]
         pb = np.stack(b.transform(binary_df)["probability"])[:, 1]
         np.testing.assert_allclose(pa, pb, atol=1e-4)
+
+
+def test_ranker_param_maps_vmapped():
+    """Lambdarank param maps: the group layout is broadcast across the
+    candidate batch; vmapped results match sequential fits."""
+    from mmlspark_tpu.models.lightgbm import LightGBMRanker
+    rng = np.random.default_rng(21)
+    groups = np.repeat(np.arange(30), 10)
+    x = rng.normal(size=(300, 5)).astype(np.float32)
+    rel = np.clip((x[:, 0] * 2 + rng.normal(size=300) * 0.3), 0, None)
+    y = np.minimum(rel.astype(np.int64), 4).astype(np.float64)
+    df = DataFrame({"features": x, "label": y, "groupId": groups})
+    maps = [{"learningRate": 0.05}, {"learningRate": 0.2}]
+    est = LightGBMRanker(numIterations=8, numLeaves=7, maxBin=16,
+                         minDataInLeaf=2, numTasks=1, seed=2)
+    models = est.fit(df, maps)
+    seq = [est.copy(pm).fit(df) for pm in maps]
+    for mv, ms in zip(models, seq):
+        pv = np.asarray(mv.transform(df)["prediction"])
+        ps = np.asarray(ms.transform(df)["prediction"])
+        np.testing.assert_allclose(pv, ps, atol=2e-5)
