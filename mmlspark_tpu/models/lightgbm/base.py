@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ...core.dataframe import DataFrame
+from ...core.dataframe import DataFrame, dense_matrix
 from ...core import params as _p
 from ...core.pipeline import Estimator, Model
 from ...ops.binning import BinMapper
@@ -44,6 +44,17 @@ def _compiled_serial(cfg: GBDTConfig):
     return jax.jit(train), jax.jit(train.chunk)
 
 
+def _vmapped_many(call):
+    """vmap over (key, HParams) with data (and optional trailing group
+    layout) broadcast: `call(binned, y, w, is_train, margin, key, hp,
+    *rest)` runs one candidate."""
+    def many(binned, y, w, is_train, margin, keys, hp_batch, *rest):
+        return jax.vmap(
+            lambda k_, hp_: call(binned, y, w, is_train, margin, k_, hp_,
+                                 *rest))(keys, hp_batch)
+    return many
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_serial_vmapped(cfg: GBDTConfig, grouped: bool = False):
     """One compiled program training a BATCH of continuous-hyperparameter
@@ -54,18 +65,11 @@ def _compiled_serial_vmapped(cfg: GBDTConfig, grouped: bool = False):
     program)."""
     train = make_train_fn(cfg)
 
-    if grouped:
-        def many(binned, y, w, is_train, margin, keys, hp_batch, gidx):
-            return jax.vmap(
-                lambda k_, hp_: train(binned, y, w, is_train, margin, k_,
-                                      group_idx=gidx, hp=hp_))(keys, hp_batch)
-    else:
-        def many(binned, y, w, is_train, margin, keys, hp_batch):
-            return jax.vmap(
-                lambda k_, hp_: train(binned, y, w, is_train, margin, k_,
-                                      hp=hp_))(keys, hp_batch)
+    def call(b, y, w, t, mg, k_, hp_, *rest):
+        return train(b, y, w, t, mg, k_,
+                     group_idx=rest[0] if rest else None, hp=hp_)
 
-    return jax.jit(many)
+    return jax.jit(_vmapped_many(call))
 
 
 @functools.lru_cache(maxsize=64)
@@ -78,30 +82,14 @@ def _compiled_sharded_vmapped(cfg: GBDTConfig, ndev: int,
     m = meshlib.get_mesh(ndev)
     axis = meshlib.DATA_AXIS
     train = make_train_fn(cfg)
-    if grouped:
-        sharded = jax.shard_map(
-            lambda b, y, w, t, mg, k_, hp_, g_: train(
-                b, y, w, t, mg, k_, group_idx=g_, hp=hp_),
-            mesh=m, in_specs=(P(axis),) * 5 + (P(), P(), P(axis)),
-            out_specs=P(), check_vma=False)
+    specs = (P(axis),) * 5 + (P(), P()) + ((P(axis),) if grouped else ())
+    sharded = jax.shard_map(
+        lambda b, y, w, t, mg, k_, hp_, *rest: train(
+            b, y, w, t, mg, k_,
+            group_idx=rest[0] if rest else None, hp=hp_),
+        mesh=m, in_specs=specs, out_specs=P(), check_vma=False)
 
-        def many(binned, y, w, is_train, margin, keys, hp_batch, gidx):
-            return jax.vmap(
-                lambda k_, hp_: sharded(binned, y, w, is_train, margin, k_,
-                                        hp_, gidx))(keys, hp_batch)
-    else:
-        sharded = jax.shard_map(
-            lambda b, y, w, t, mg, k_, hp_: train(b, y, w, t, mg, k_,
-                                                  hp=hp_),
-            mesh=m, in_specs=(P(axis),) * 5 + (P(), P()),
-            out_specs=P(), check_vma=False)
-
-        def many(binned, y, w, is_train, margin, keys, hp_batch):
-            return jax.vmap(
-                lambda k_, hp_: sharded(binned, y, w, is_train, margin, k_,
-                                        hp_))(keys, hp_batch)
-
-    return jax.jit(many)
+    return jax.jit(_vmapped_many(sharded))
 
 
 @functools.lru_cache(maxsize=64)
@@ -286,7 +274,6 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # reference's CSR marshalling boundary
             # (LightGBMUtils.scala:201-265). Wide sparse refuses with a
             # pointer at featurize.SparseFeatureBundler.
-            from ...core.dataframe import dense_matrix
             x = dense_matrix(x)
         elif x.dtype == object and len(x) and hasattr(x[0], "toarray"):
             # per-row scipy sparse vectors (the reference's sparse dataset
@@ -362,8 +349,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         return super().fit(df, params)
 
     def fit_param_maps(self, df: DataFrame, maps):
+        def sequential():
+            return [self.copy(pm)._fit(df) for pm in maps]
+
         keys = set().union(*[set(m) for m in maps]) if maps else set()
-        ndev = self.get("numTasks") or meshlib.device_count()
         vmappable = (
             bool(maps) and keys <= set(self._VMAP_PARAM_FIELDS)
             and not self.get("earlyStoppingRound")
@@ -374,7 +363,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             and self._supports_vmap_fit()
             and self.get("parallelism") != "voting_parallel")
         if not vmappable:
-            return [self.copy(pm)._fit(df) for pm in maps]
+            return sequential()
 
         def val(pm, name):
             return float(pm.get(name, self.get(name)))
@@ -390,7 +379,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             if (cols["bagging_fraction"] >= 1.0).any():
                 # per-map rf contract violation: let the sequential path
                 # raise the proper per-candidate error
-                return [self.copy(pm)._fit(df) for pm in maps]
+                return sequential()
             cols["learning_rate"] = np.ones(len(maps), np.float32)
         hp_batch = HParams(**{fld: jnp.asarray(cols[fld])
                               for fld in HParams._fields})
