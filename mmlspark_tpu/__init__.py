@@ -6,7 +6,7 @@ pipeline-composition surface but runs every heavy path as JAX/XLA/Pallas program
 `jax.sharding.Mesh` of TPU chips. See SURVEY.md for the layer-by-layer mapping.
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 from .core.dataframe import DataFrame
 from .core.params import Param, Params
