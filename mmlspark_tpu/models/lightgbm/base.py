@@ -62,7 +62,14 @@ def _compiled_serial_vmapped(cfg: GBDTConfig, grouped: bool = False):
     layout, when present) broadcast. The TPU-first realization of the
     reference's Estimator.fit(dataset, paramMaps) (SparkML surface;
     TuneHyperparameters' thread-pool becomes a single batched XLA
-    program)."""
+    program).
+
+    split_scan='compact' degrades to 'full' here: under vmap, its
+    lax.switch bucket ladder lowers to executing EVERY branch and
+    selecting, which is slower than the full scan it replaces. Trees are
+    identical either way."""
+    if cfg.split_scan == "compact":
+        cfg = cfg._replace(split_scan="full")
     train = make_train_fn(cfg)
 
     def call(b, y, w, t, mg, k_, hp_, *rest):
@@ -78,7 +85,10 @@ def _compiled_sharded_vmapped(cfg: GBDTConfig, ndev: int,
     """Vmapped candidate batch over the shard_map'd trainer: data sharded
     over the mesh axis, HParams batched over vmap — B candidates x D shards
     in one program. `grouped` threads the lambdarank group layout (sharded
-    like the rows)."""
+    like the rows). split_scan='compact' degrades to 'full' here (see
+    _compiled_serial_vmapped)."""
+    if cfg.split_scan == "compact":
+        cfg = cfg._replace(split_scan="full")
     m = meshlib.get_mesh(ndev)
     axis = meshlib.DATA_AXIS
     train = make_train_fn(cfg)
@@ -224,6 +234,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         "with current histograms, re-histogram only when that pool dries — "
         "~one pass per tree level, new children enter the pool one refresh "
         "late; TPU-native optimization, no reference analogue)", "eager")
+    histScan = Param(
+        "histScan",
+        "per-split histogram construction (eager refresh only): full (one "
+        "all-slots pass over every row per split) or compact (rows kept "
+        "partitioned by leaf; each split histograms only the parent's "
+        "segment in a pow2-bucketed masked 2-slot pass — the TPU analogue "
+        "of upstream's DataPartition + smaller-child trick, exact leaf-wise "
+        "semantics at ~N*depth instead of N*(L-1) histogram work)", "full")
     slotNames = Param("slotNames", "feature slot names", None)
     categoricalSlotIndexes = Param("categoricalSlotIndexes",
                                    "indexes of categorical features", None)
@@ -451,6 +469,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             or self.get("histChunk"),
             hist_dtype=self.get("histDtype"),
             split_refresh=self.get("histRefresh"),
+            split_scan=self.get("histScan"),
             categorical_features=tuple(self._categorical_indexes()),
             missing_features=getattr(self, "_missing_idx", ()),
             cat_smooth=self.get("catSmooth"),
@@ -565,6 +584,20 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             raise ValueError(
                 f"histRefresh must be eager or lazy, got "
                 f"{self.get('histRefresh')!r}")
+        if self.get("histScan") not in ("full", "compact"):
+            raise ValueError(
+                f"histScan must be full or compact, got "
+                f"{self.get('histScan')!r}")
+        if self.get("histScan") == "compact":
+            if self.get("histRefresh") == "lazy":
+                raise ValueError(
+                    "histScan='compact' requires histRefresh='eager' (lazy "
+                    "has no per-split pass to compact)")
+            if self.get("parallelism") == "voting_parallel":
+                raise ValueError(
+                    "histScan='compact' does not compose with "
+                    "parallelism='voting_parallel' (voting needs full local "
+                    "histograms per slot)")
         if ((self.get("posBaggingFraction") >= 0
              or self.get("negBaggingFraction") >= 0)
                 and (objective or self._objective_name()) != "binary"):

@@ -69,37 +69,22 @@ def _store_sidecar(key: str, val: Tuple[str, int]) -> None:
         pass
 
 
-def _dispatch_overhead() -> float:
-    """Median wall seconds of a dispatch+fetch of a trivial jit program —
-    the per-call floor that must be subtracted from kernel timings. On
-    tunneled backends (axon) this is a network round trip (~60 ms), which
-    would otherwise swamp every candidate's real execution time."""
-    import jax
-    import jax.numpy as jnp
-
-    fn = jax.jit(lambda x: x + 1.0)
-    x = jnp.float32(0.0)
-    float(fn(x))
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        float(fn(x))
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
-
-
 def measure_hist(method: str, chunk: int, n: int, f: int, b: int, l: int,
-                 dtype: str = "bf16", repeats: int = 3, inner: int = 8,
-                 overhead_s: Optional[float] = None) -> float:
+                 dtype: str = "bf16", repeats: int = 3,
+                 inner: int = 16) -> float:
     """Median seconds per all-slots histogram pass at the given shape.
 
-    Timing methodology for remote/tunneled backends, where both pitfalls were
-    hit in round 2: (a) `block_until_ready` can return before the computation
-    finishes (0.02 ms/pass readings for a 1M-row pass), so the barrier is a
-    host FETCH of a scalar; (b) each dispatch+fetch pays the tunnel round
-    trip (~60 ms), so `inner` passes run inside ONE jit program via lax.scan
-    (gh perturbed per step to defeat CSE) and the measured dispatch overhead
-    is subtracted before dividing."""
+    Timing methodology for remote/tunneled backends, where three pitfalls
+    were hit in round 2: (a) `block_until_ready` can return before the
+    computation finishes (0.02 ms/pass readings for a 1M-row pass), so the
+    barrier is a host FETCH of a scalar; (b) each dispatch+fetch pays the
+    tunnel round trip (~60 ms), so passes run inside ONE jit program via
+    lax.scan (gh perturbed per step to defeat CSE); (c) subtracting a
+    separately-measured dispatch overhead is unstable when the relay jitters
+    by more than the probe's compute (the recorded 0.00 ms/pass sweeps), so
+    the per-pass time is the DIFFERENCE between a 3*inner-pass and an
+    inner-pass program — the round trip cancels within each pair instead of
+    across separate calibration calls."""
     import jax
     import jax.numpy as jnp
     from .histogram import hist_slots
@@ -109,24 +94,28 @@ def measure_hist(method: str, chunk: int, n: int, f: int, b: int, l: int,
     slot = jnp.asarray(rng.integers(0, l, (n,)), jnp.int32)
     gh = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
 
-    def k_passes(bi, sl, g):
-        def body(acc, j):
-            gj = g * (1.0 + 1e-6 * j.astype(jnp.float32))
-            h = hist_slots(bi, sl, gj, l, b, method, chunk, dtype)
-            return acc + jnp.sum(h), None
-        acc, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(inner))
-        return acc
+    def k_passes(k):
+        def run(bi, sl, g):
+            def body(acc, j):
+                gj = g * (1.0 + 1e-6 * j.astype(jnp.float32))
+                h = hist_slots(bi, sl, gj, l, b, method, chunk, dtype)
+                return acc + jnp.sum(h), None
+            acc, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(k))
+            return acc
+        return jax.jit(run)
 
-    fn = jax.jit(k_passes)
-    float(fn(binned, slot, gh))                       # compile + settle
-    if overhead_s is None:
-        overhead_s = _dispatch_overhead()
-    times = []
+    fn1, fn3 = k_passes(inner), k_passes(3 * inner)
+    float(fn1(binned, slot, gh))                      # compile + settle
+    float(fn3(binned, slot, gh))
+    diffs = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        float(fn(binned, slot, gh))
-        times.append(time.perf_counter() - t0)
-    return max(float(np.median(times)) - overhead_s, 1e-9) / inner
+        float(fn1(binned, slot, gh))
+        t1 = time.perf_counter()
+        float(fn3(binned, slot, gh))
+        t2 = time.perf_counter()
+        diffs.append((t2 - t1) - (t1 - t0))
+    return max(float(np.median(diffs)), 1e-9) / (2 * inner)
 
 
 def pick_hist_config(n: int, f: int, b: int, l: int, dtype: str = "bf16",
@@ -152,13 +141,11 @@ def pick_hist_config(n: int, f: int, b: int, l: int, dtype: str = "bf16",
         return best
 
     n_probe = int(min(n, probe_rows))
-    overhead = _dispatch_overhead()
     results = {}
     for method, chunk in _ACCEL_CANDIDATES:
         try:
             results[(method, chunk)] = measure_hist(method, chunk, n_probe,
-                                                    f, b, l, dtype,
-                                                    overhead_s=overhead)
+                                                    f, b, l, dtype)
         except Exception:  # noqa: BLE001 - a kernel variant may not lower
             continue
     if not results:

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import hist_slots
+from .histogram import hist_slots, resolve_hist_method
 from .objectives import Objective, get_objective
 
 _NEG_INF = -1e30
@@ -106,6 +106,17 @@ class GBDTConfig(NamedTuple):
     # leaves) — it trades interconnect for compute, so prefer eager on
     # bandwidth-bound multi-host meshes
     split_refresh: str = "eager"
+    # per-split histogram construction (eager refresh only). "full" = one
+    # all-slots pass over every row per split; "compact" = rows are kept
+    # PARTITIONED by leaf (a permutation with one contiguous segment per
+    # slot, the TPU equivalent of LightGBM's DataPartition), and each split
+    # histograms only the parent's segment, padded to a power-of-two bucket
+    # under lax.switch so every shape is static. One masked 2-slot pass
+    # yields BOTH children exactly (no sibling-subtraction cancellation), so
+    # per-tree histogram work drops from (L-1) full passes to ~sum of parent
+    # segment sizes (~= N * avg depth, the same work model as upstream's
+    # smaller-child trick) while split selection stays exact leaf-wise.
+    split_scan: str = "full"
     # evaluation metric (LightGBMParams.scala:310-342 `metric`): "" = the
     # objective's default. Canonical names: l1 l2 rmse mape auc
     # binary_logloss binary_error multi_logloss multi_error ndcg. Metrics
@@ -282,7 +293,8 @@ def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask,
 
 def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
                feature_mask: jax.Array,
-               hp: Optional["HParams"] = None) -> Tuple[Tree, jax.Array]:
+               hp: Optional["HParams"] = None,
+               bins_t: Optional[jax.Array] = None) -> Tuple[Tree, jax.Array]:
     """Grow one leaf-wise tree.
 
     binned: [N, F] int — bin ids (shard-local rows when distributed)
@@ -336,13 +348,36 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
             "missing-feature indices); use parallelism='data_parallel' or "
             "set useMissing=False for the legacy NaN-to-lowest-bin behavior")
     lazy = cfg.split_refresh == "lazy"
+    if cfg.split_scan not in ("full", "compact"):
+        raise ValueError(
+            f"split_scan must be 'full' or 'compact', got "
+            f"{cfg.split_scan!r}")
+    compact = cfg.split_scan == "compact"
+    if compact and (voting or lazy):
+        raise NotImplementedError(
+            "split_scan='compact' replaces the per-split full pass of the "
+            "eager data_parallel path; it does not compose with "
+            "voting_parallel (needs full local histograms to vote) or "
+            "split_refresh='lazy' (has no per-split pass to compact)")
 
     def psum_(v):
         return jax.lax.psum(v, cfg.axis_name) if cfg.axis_name else v
 
+    resolved_method = resolve_hist_method(cfg.hist_method)
+    if bins_t is None and resolved_method == "pallas":
+        # transpose+pad the bins operand here (invariant across every full
+        # histogram pass of this tree) instead of relying on XLA
+        # loop-invariant code motion to hoist it out of the split fori_loop.
+        # make_train_fn passes bins_t built ONCE PER FIT, hoisting it out of
+        # the boosting-iteration scan as well.
+        from .pallas_kernels import prepare_bins_t
+        bins_t = prepare_bins_t(binned, b, lcap, 3, cfg.hist_chunk)
+    bins_t_full = bins_t if resolved_method == "pallas" else None
+
     def hist_local(slot_of_row):
-        return hist_slots(binned, slot_of_row, gh3, lcap, b, cfg.hist_method,
-                          cfg.hist_chunk, cfg.hist_dtype)   # [L, F, B, 3]
+        return hist_slots(binned, slot_of_row, gh3, lcap, b, resolved_method,
+                          cfg.hist_chunk, cfg.hist_dtype,
+                          bins_t=bins_t_full)   # [L, F, B, 3]
 
     def scan_splits_voting(slot_of_row, feature_mask):
         """Voting-parallel split scan: one all-slots LOCAL histogram pass;
@@ -409,6 +444,22 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
                                                feature_mask, hp)
         hist_valid = jnp.ones((lcap,), bool)
 
+    if compact:
+        # bucket ladder for the parent-segment lax.switch: powers of two
+        # from 4096 (smaller segments just use the smallest bucket — a
+        # 4096-row pass is ~free) up to pow2ceil(n). perm is padded by the
+        # largest bucket so a segment slice can never run off the end.
+        pmax = 1 << max(int(max(n - 1, 1)).bit_length(), 7)
+        pmin = min(4096, pmax)
+        bucket_sizes = []
+        p_ = pmin
+        while p_ <= pmax:
+            bucket_sizes.append(p_)
+            p_ *= 2
+        perm = jnp.pad(jnp.arange(n, dtype=jnp.int32), (0, pmax))
+        seg_start = jnp.zeros((lcap,), jnp.int32)
+        seg_len = jnp.zeros((lcap,), jnp.int32).at[0].set(n)
+
     thresh = hp.min_gain_to_split + _MIN_GAIN_EPS
 
     def body(s, carry):
@@ -417,6 +468,11 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
              s_valid, s_gain, s_is_cat, s_mask, s_dl, done) = carry
             (hists, sums, gains_all, feats_all, bins_all,
              dls_all) = scan_splits_voting(slot_of_row, feature_mask)
+        elif compact:
+            (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
+             s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
+             g_hists, g_sums, bg, bf_, bb, bd, hist_valid,
+             perm, seg_start, seg_len) = carry
         else:
             (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
              s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
@@ -517,16 +573,83 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
                     s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
                     g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
 
-        # eager: post-split all-slots pass; only the new child's slice is
-        # allreduced, and only the two changed slots are gain-rescanned
-        local = hist_local(slot_of_row)
-        right = psum_(jnp.take(local, new_slot, axis=0))       # [F,B,3]
-        right = jnp.where(do, right, 0.0)
-        right_sum = right[0].sum(axis=0)
-        g_hists = g_hists.at[new_slot].set(right)
-        g_hists = g_hists.at[best_slot].add(-right)            # sibling subtr.
-        g_sums = g_sums.at[new_slot].set(right_sum)
-        g_sums = g_sums.at[best_slot].add(-right_sum)
+        if compact:
+            # compact scan: the parent's rows live in perm[st:st+ln]; pad
+            # that segment to the next power-of-two bucket (static shapes
+            # for XLA) and build BOTH children's histograms in one masked
+            # 2-slot pass over just those rows, partitioning the segment
+            # in the same branch. Shard-local segment lengths may pick
+            # different buckets per device — the branches contain no
+            # collectives, so SPMD divergence is safe; the psum happens on
+            # the uniform [2, F, B, 3] result below.
+            st = jnp.clip(seg_start[best_slot], 0, max(n - 1, 0))
+            ln = seg_len[best_slot]
+            gr8 = go_right.astype(jnp.int8)          # [N] original row order
+            sizes_arr = jnp.asarray(bucket_sizes, jnp.int32)
+            kidx = jnp.minimum(jnp.sum((sizes_arr < ln).astype(jnp.int32)),
+                               len(bucket_sizes) - 1)
+
+            def mk_branch(p_):
+                def br(perm, gr8, gh3):
+                    seg = jax.lax.dynamic_slice(perm, (st,), (p_,))
+                    pos = jnp.arange(p_, dtype=jnp.int32)
+                    valid = pos < ln
+                    gr = (gr8[seg] > 0) & valid
+                    lf = valid & ~gr
+                    cl = jnp.cumsum(lf.astype(jnp.int32))
+                    cr = jnp.cumsum(gr.astype(jnp.int32))
+                    n_left = cl[p_ - 1]
+                    # stable partition: left rows keep order at the front,
+                    # right rows at the back; overhang (rows of later
+                    # segments caught by the pow2 slice) stays put
+                    npos = jnp.where(lf, cl - 1, n_left + cr - 1)
+                    npos = jnp.where(valid, npos, p_)           # drop
+                    seg_p = jnp.zeros((p_,), jnp.int32).at[npos].set(
+                        seg, mode="drop")
+                    merged = jnp.where(valid, seg_p, seg)
+                    perm2 = jax.lax.dynamic_update_slice(perm, merged, (st,))
+                    bi_seg = jnp.take(binned, seg, axis=0)      # [P, F]
+                    gh_seg = jnp.take(gh3, seg, axis=0) * valid[:, None]
+                    h2 = hist_slots(bi_seg, gr.astype(jnp.int32), gh_seg,
+                                    2, b, resolved_method, cfg.hist_chunk,
+                                    cfg.hist_dtype)             # [2, F, B, 3]
+                    return perm2, h2, n_left
+                return br
+
+            perm2, h2, n_left = jax.lax.switch(
+                kidx, [mk_branch(p_) for p_ in bucket_sizes],
+                perm, gr8, gh3)
+            h2 = psum_(h2)
+            left_h, right_h = h2[0], h2[1]
+            perm = jnp.where(do, perm2, perm)
+            seg_start = seg_start.at[new_slot].set(
+                jnp.where(do, st + n_left, seg_start[new_slot]))
+            seg_len = seg_len.at[new_slot].set(
+                jnp.where(do, ln - n_left, seg_len[new_slot]))
+            seg_len = seg_len.at[best_slot].set(
+                jnp.where(do, n_left, seg_len[best_slot]))
+            # both children measured directly — no sibling-subtraction
+            # cancellation; parent hist is simply replaced
+            g_hists = g_hists.at[new_slot].set(
+                jnp.where(do, right_h, 0.0))
+            g_hists = g_hists.at[best_slot].set(
+                jnp.where(do, left_h, g_hists[best_slot]))
+            g_sums = g_sums.at[new_slot].set(
+                jnp.where(do, right_h[0].sum(axis=0), g_sums[new_slot]))
+            g_sums = g_sums.at[best_slot].set(
+                jnp.where(do, left_h[0].sum(axis=0), g_sums[best_slot]))
+        else:
+            # eager full scan: post-split all-slots pass; only the new
+            # child's slice is allreduced, the parent updates by sibling
+            # subtraction, and only the two changed slots are rescanned
+            local = hist_local(slot_of_row)
+            right = psum_(jnp.take(local, new_slot, axis=0))   # [F,B,3]
+            right = jnp.where(do, right, 0.0)
+            right_sum = right[0].sum(axis=0)
+            g_hists = g_hists.at[new_slot].set(right)
+            g_hists = g_hists.at[best_slot].add(-right)        # sibling sub
+            g_sums = g_sums.at[new_slot].set(right_sum)
+            g_sums = g_sums.at[best_slot].add(-right_sum)
         idx2 = jnp.stack([best_slot, new_slot])
         pg, pf, pb, pd = _best_split_per_slot(g_hists[idx2], g_sums[idx2],
                                               cfg, feature_mask, hp)
@@ -534,14 +657,19 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         bf_ = bf_.at[idx2].set(jnp.where(do, pf, bf_[idx2]))
         bb = bb.at[idx2].set(jnp.where(do, pb, bb[idx2]))
         bd = bd.at[idx2].set(jnp.where(do, pd, bd[idx2]))
-        return (depth_of_slot, slot_of_row, s_slot, s_feat,
-                s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
-                g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
+        out = (depth_of_slot, slot_of_row, s_slot, s_feat,
+               s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
+               g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
+        if compact:
+            out = out + (perm, seg_start, seg_len)
+        return out
 
     carry = (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
              s_valid, s_gain, s_is_cat, s_mask, s_dl, done)
     if not voting:
         carry = carry + (g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
+    if compact:
+        carry = carry + (perm, seg_start, seg_len)
     carry = jax.lax.fori_loop(0, lcap - 1, body, carry)
     (_, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
      s_is_cat, s_mask, s_dl, _) = carry[:11]
@@ -697,6 +825,12 @@ def make_train_fn(cfg: GBDTConfig):
         cfg.objective, cfg.num_class, alpha=cfg.alpha,
         tweedie_variance_power=cfg.tweedie_variance_power)
     multiclass = cfg.objective in ("multiclass", "multiclassova")
+    if multiclass and cfg.split_scan == "compact":
+        # per-class trees are built under jax.vmap, where lax.switch lowers
+        # to executing EVERY bucket branch and selecting — the compact scan
+        # would do ~2*pow2ceil(N) rows of work per split instead of ~the
+        # parent segment. Fall back to the full scan (identical trees).
+        cfg = cfg._replace(split_scan="full")
     k = cfg.num_class if multiclass else 1
     if ranking:
         from . import ranking as _rk
@@ -818,6 +952,17 @@ def make_train_fn(cfg: GBDTConfig):
         w_valid = w_all * (1.0 - is_train)  # validation-metric weight
         yf = y.astype(jnp.float32)
 
+        if resolve_hist_method(cfg.hist_method) == "pallas":
+            # bins operand pre-layout for the pallas kernel, built ONCE PER
+            # FIT — hoisted out of the boosting-iteration scan AND the
+            # per-split fori_loop, neither of which XLA's loop-invariant
+            # code motion is guaranteed to cross
+            from .pallas_kernels import prepare_bins_t
+            bins_t = prepare_bins_t(binned, cfg.max_bins, cfg.num_leaves, 3,
+                                    cfg.hist_chunk)
+        else:
+            bins_t = None
+
         if ranking:
             assert group_idx is not None, "lambdarank requires group_idx"
             from .ranking import ndcg_per_group, _gather_padded
@@ -928,7 +1073,8 @@ def make_train_fn(cfg: GBDTConfig):
                 gh3 = jnp.stack(
                     [gk * row_w, hk * row_w, jnp.where(row_w > 0, 1.0, 0.0)],
                     axis=1).astype(jnp.float32)
-                tree, slot = build_tree(binned, gh3, cfg, fmask, hp)
+                tree, slot = build_tree(binned, gh3, cfg, fmask, hp,
+                                        bins_t=bins_t)
                 # lr_mult: per-iteration learning-rate multiplier relative to
                 # cfg.learning_rate (delegate dynamic learning rate —
                 # LightGBMDelegate.scala getLearningRate, TrainUtils.scala:213+)

@@ -161,8 +161,13 @@ def hist_slots_scatter(binned: jax.Array, slot: jax.Array, gh: jax.Array,
 
 def hist_slots(binned: jax.Array, slot: jax.Array, gh: jax.Array,
                num_slots: int, num_bins: int, method: str = "auto",
-               chunk: int = 8192, dtype: str = "bf16") -> jax.Array:
-    """Dispatch the all-slots histogram build. gh channels: [grad, hess, mask]."""
+               chunk: int = 8192, dtype: str = "bf16",
+               bins_t: Optional[jax.Array] = None) -> jax.Array:
+    """Dispatch the all-slots histogram build. gh channels: [grad, hess, mask].
+
+    bins_t: optional pre-laid-out transposed bins (pallas_kernels.
+    prepare_bins_t) — used by the pallas path only, so hot loops pay the
+    [N, F] transpose once per fit instead of once per pass."""
     method = resolve_hist_method(method)
     if method == "onehot":
         return hist_slots_onehot(binned, slot, gh, num_slots, num_bins,
@@ -172,7 +177,7 @@ def hist_slots(binned: jax.Array, slot: jax.Array, gh: jax.Array,
     if method == "pallas":
         from .pallas_kernels import hist_slots_pallas
         return hist_slots_pallas(binned, slot, gh, num_slots, num_bins,
-                                 block_rows=chunk, dtype=dtype)
+                                 block_rows=chunk, dtype=dtype, bins_t=bins_t)
     raise ValueError(f"unknown histogram method {method!r}")
 
 

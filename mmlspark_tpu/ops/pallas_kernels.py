@@ -92,32 +92,11 @@ def _hist_slots_kernel(bins_ref, ghs_ref, out_ref, *,
             out_ref[f0 + p, :, :] += res[p * b_pad:(p + 1) * b_pad]
 
 
-def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
-                      num_slots: int, num_bins: int,
-                      block_rows: int = 4096, feat_tile: int = 32,
-                      dtype: str = "bf16",
-                      interpret: bool | None = None) -> jax.Array:
-    """All-slots Pallas histogram.
-
-    binned [N, F] int, slot [N] int32, gh [N, C] f32
-    -> [L, F, B, C] f32 where L = num_slots.
-
-    dtype: MXU operand dtype — 'bf16' rounds gradients to ~3 decimal digits
-    (one-hot side is exact either way, accumulation is always f32); 'f32'
-    keeps exact operands for bit-reproducibility with the scatter oracle
-    (near-tie split gains can flip under bf16).
-
-    Rows pad to the 128-multiple block (padded rows carry zero gh => zero
-    contribution); features pad to the tile multiple with bin id == B_pad,
-    which matches no one-hot row. On CPU backends runs in interpret mode so
-    virtual-mesh tests exercise the same code path.
-    """
-    n, f = binned.shape
-    c = gh.shape[1]
-    assert c <= 7, "gh channel pack rides one 8-sublane operand"
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
-
+def _pallas_layout(n: int, f: int, c: int, num_slots: int, num_bins: int,
+                   block_rows: int, feat_tile: int):
+    """Static layout decisions shared by the kernel call and the
+    `prepare_bins_t` pre-layout helper (so a caller can build the transposed
+    bins operand ONCE per fit instead of once per pass)."""
     b_pad = _round_up(num_bins, 8)
     w_pad = _round_up(num_slots * c, 128)
     block_rows = _round_up(block_rows, 128)
@@ -140,12 +119,73 @@ def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
     budget = 24 << 20
     while block_rows > 128 and temp_bytes_per_row * block_rows > budget:
         block_rows = max(128, _round_up(block_rows // 2, 128))
-
     pad_n = (-n) % block_rows
     f_pad = _round_up(f, feat_tile)
-    # transposed bins [F_pad, N_pad]: loop-invariant wrt the boosting loop
-    bins_t = jnp.pad(binned.astype(jnp.int8 if bins_i8 else jnp.int32).T,
-                     ((0, f_pad - f), (0, pad_n)), constant_values=b_pad)
+    return b_pad, w_pad, block_rows, feat_tile, pack, bins_i8, pad_n, f_pad
+
+
+def prepare_bins_t(binned: jax.Array, num_bins: int, num_slots: int,
+                   channels: int = 3, block_rows: int = 4096,
+                   feat_tile: int = 32) -> jax.Array:
+    """Pre-layout the transposed bins operand [F_pad, N_pad] for
+    `hist_slots_pallas(bins_t=...)`.
+
+    The transpose+pad moves the whole dataset (~N*F bytes); it is invariant
+    across every histogram pass of a fit, so callers on the hot path build it
+    once (make_train_fn hoists it out of BOTH the boosting-iteration scan and
+    the per-split fori_loop, where XLA's loop-invariant code motion is not
+    guaranteed to reach across the nesting). Feature padding uses bin id ==
+    B_pad, which matches no one-hot row; row padding is harmless because
+    padded rows carry zero gh."""
+    n, f = binned.shape
+    (b_pad, _, _, _, _, bins_i8, pad_n, f_pad) = _pallas_layout(
+        n, f, channels, num_slots, num_bins, block_rows, feat_tile)
+    return jnp.pad(binned.astype(jnp.int8 if bins_i8 else jnp.int32).T,
+                   ((0, f_pad - f), (0, pad_n)), constant_values=b_pad)
+
+
+def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
+                      num_slots: int, num_bins: int,
+                      block_rows: int = 4096, feat_tile: int = 32,
+                      dtype: str = "bf16",
+                      interpret: bool | None = None,
+                      bins_t: jax.Array | None = None) -> jax.Array:
+    """All-slots Pallas histogram.
+
+    binned [N, F] int, slot [N] int32, gh [N, C] f32
+    -> [L, F, B, C] f32 where L = num_slots.
+
+    dtype: MXU operand dtype — 'bf16' rounds gradients to ~3 decimal digits
+    (one-hot side is exact either way, accumulation is always f32); 'f32'
+    keeps exact operands for bit-reproducibility with the scatter oracle
+    (near-tie split gains can flip under bf16).
+
+    bins_t: optional pre-laid-out transposed bins from `prepare_bins_t`
+    (same num_bins/block_rows/feat_tile) — hot-path callers pass it to pay
+    the transpose once per fit instead of once per pass.
+
+    Rows pad to the 128-multiple block (padded rows carry zero gh => zero
+    contribution); features pad to the tile multiple with bin id == B_pad,
+    which matches no one-hot row. On CPU backends runs in interpret mode so
+    virtual-mesh tests exercise the same code path.
+    """
+    n, f = binned.shape
+    c = gh.shape[1]
+    assert c <= 7, "gh channel pack rides one 8-sublane operand"
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    (b_pad, w_pad, block_rows, feat_tile, pack, bins_i8, pad_n,
+     f_pad) = _pallas_layout(n, f, c, num_slots, num_bins, block_rows,
+                             feat_tile)
+    if bins_t is None:
+        # transposed bins [F_pad, N_pad]: loop-invariant wrt the boosting loop
+        bins_t = jnp.pad(binned.astype(jnp.int8 if bins_i8 else jnp.int32).T,
+                         ((0, f_pad - f), (0, pad_n)), constant_values=b_pad)
+    else:
+        assert bins_t.shape == (f_pad, n + pad_n), (
+            f"bins_t laid out as {bins_t.shape}, kernel expects "
+            f"{(f_pad, n + pad_n)} — prepare_bins_t config mismatch")
     ghs = jnp.concatenate(
         [gh.astype(jnp.float32).T,
          slot.astype(jnp.float32)[None, :],

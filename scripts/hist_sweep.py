@@ -16,14 +16,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     import jax
-    from mmlspark_tpu.ops.autotune import _dispatch_overhead, measure_hist
+    from mmlspark_tpu.ops.autotune import measure_hist
 
     dev = jax.devices()[0]
     print(f"backend: {dev.platform} ({dev})", flush=True)
-    overhead = _dispatch_overhead()
     inner = 8
-    print(f"dispatch+fetch overhead: {overhead * 1e3:.1f} ms "
-          f"(subtracted; {inner} passes amortized per timed call)", flush=True)
+    print(f"paired-difference timing ({inner} vs {3 * inner} scan-amortized "
+          f"passes; relay round trip cancels per pair)", flush=True)
     n, f, b, l = 1_000_000, 28, 64, 31
 
     candidates = [("onehot", c, d) for c in (2048, 8192, 32768)
@@ -38,7 +37,7 @@ def main() -> None:
         try:
             t0 = time.perf_counter()
             sec = measure_hist(method, chunk, n, f, b, l, dtype,
-                               inner=inner, overhead_s=overhead)
+                               inner=inner)
             total_s = time.perf_counter() - t0
             ms = sec * 1e3
             rows.append((method, chunk, dtype, ms, total_s))

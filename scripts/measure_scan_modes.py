@@ -1,0 +1,72 @@
+"""Measure per-iteration training cost of the three split-scan modes on the
+live chip: eager/full, eager/compact, lazy. Run from the repo root.
+
+Methodology (docs/KERNELS.md): per-iter = (wall(24 iters) - wall(4 iters))/20
+so setup, dispatch RTT and compile are excluded; min over repeats to shed
+shared-pool throttling noise. Writes one line per mode to stdout and appends
+to docs/PERF_scan_modes.log.
+"""
+
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.ops.boosting import GBDTConfig, make_train_fn
+
+LOG = os.path.join(os.path.dirname(__file__), "..", "docs",
+                   "PERF_scan_modes.log")
+
+
+def main(n=1_000_000, f=28, b=64, lcap=31):
+    rng = np.random.default_rng(0)
+    binned = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.int8))
+    coef = rng.normal(size=f)
+    yv = jnp.asarray(((np.asarray(binned, np.float32) @ coef)
+                      > coef.sum() * b / 2).astype(np.float32))
+    w = jnp.ones((n,), jnp.float32)
+    it_ = jnp.ones((n,), jnp.float32)
+    margin = jnp.zeros((n, 1), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+    lines = [f"== {time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())} "
+             f"on {dev} n={n} f={f} b={b} L={lcap}"]
+
+    for refresh, scan in (("eager", "compact"), ("eager", "full"),
+                          ("lazy", "full")):
+        cfg = GBDTConfig(num_iterations=24, num_leaves=lcap, max_bins=b,
+                         hist_method="pallas", hist_chunk=4096,
+                         split_refresh=refresh, split_scan=scan,
+                         objective="binary")
+        tr24 = make_train_fn(cfg)
+        tr4 = make_train_fn(cfg._replace(num_iterations=4))
+        f24 = jax.jit(lambda *a: jax.tree_util.tree_leaves(tr24(*a))[0].sum())
+        f4 = jax.jit(lambda *a: jax.tree_util.tree_leaves(tr4(*a))[0].sum())
+        t0 = time.time()
+        float(f24(binned, yv, w, it_, margin, key))
+        float(f4(binned, yv, w, it_, margin, key))
+        compile_s = time.time() - t0
+        t24, t4 = [], []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(f4(binned, yv, w, it_, margin, key))
+            t4.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            float(f24(binned, yv, w, it_, margin, key))
+            t24.append(time.perf_counter() - t0)
+        per = (min(t24) - min(t4)) / 20 * 1e3
+        line = (f"{refresh}/{scan}: per-iter {per:7.2f} ms "
+                f"(compile+first {compile_s:.0f}s, 4it {min(t4):.2f}s, "
+                f"24it {min(t24):.2f}s)")
+        print(line, flush=True)
+        lines.append(line)
+
+    with open(LOG, "a") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""On-chip microprofile of the GBDT per-split bookkeeping.
+
+The measured fit decomposition (docs/PERF.md) at 1M x 28 x 100 iters is
+~116 ms/iter = 31 all-slots passes x 2.9 ms + ~26 ms of split bookkeeping
+(~0.9 ms/split).  The histogram pass is near its formulation's arithmetic
+floor, so the bookkeeping is the next target.  This script isolates the
+candidate costs on the live chip:
+
+  1. column gather  col = binned[:, feat]  with a TRACED feat
+     (XLA gather over the minor axis) vs the transposed layout
+     dynamic_slice(bins_t, (feat, 0), (1, N)) (contiguous read)
+  2. slot_of_row update (where over [N])
+  3. _best_split_per_slot on 2 slots
+  4. a full scan-amortized fit at numLeaves in {2, 31} to re-derive the
+     per-split slope
+
+Timing methodology matches docs/KERNELS.md: scan-amortized repeats inside
+one jit program, host-fetch barrier, dispatch RTT subtracted via a null
+program.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, *args, reps=50):
+    """Paired-difference scan-amortized wall per call.
+
+    The scanned body must DEPEND on the step index, or XLA's while-loop
+    invariant code motion hoists fn out and the timing divides one execution
+    by reps (this bit the first version of this script): the first float
+    argument is perturbed by 1e-6*j per step. The per-call time is
+    (wall(3k) - wall(k)) / 2k so the relay round trip cancels per pair."""
+
+    def mk(k):
+        @jax.jit
+        def many(*a):
+            def body(c, j):
+                aj = [x * (1.0 + 1e-6 * j.astype(jnp.float32))
+                      if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                      else x for x in a]
+                out = fn(*aj)
+                leaf = jax.tree_util.tree_leaves(out)[0]
+                return c + leaf.reshape(-1)[0].astype(jnp.float32), None
+            c, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(k))
+            return c
+        return many
+
+    m1, m3 = mk(reps), mk(3 * reps)
+    float(m1(*args))                         # compile; fetch = barrier
+    float(m3(*args))
+    d = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(m1(*args))
+        t1 = time.perf_counter()
+        float(m3(*args))
+        d.append((time.perf_counter() - t1) - (t1 - t0))
+    import numpy as _np
+    return float(_np.median(d)) / (2 * reps) * 1e3   # ms/call
+
+
+def main():
+    n, f, b, lcap = 1_000_000, 28, 64, 31
+    rng = np.random.default_rng(0)
+    binned = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.int8))
+    bins_t = jnp.asarray(np.ascontiguousarray(np.asarray(binned).T))
+    slot = jnp.asarray(rng.integers(0, lcap, size=(n,), dtype=np.int32))
+    gh3 = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    feat = jnp.int32(13)
+    thresh = jnp.int32(31)
+
+    print(f"device: {jax.devices()[0]}")
+
+    def gather_minor(binned, feat):
+        return jnp.take(binned, feat, axis=1).astype(jnp.int32)
+
+    def slice_t(bins_t, feat):
+        return jax.lax.dynamic_slice(bins_t, (feat, 0), (1, bins_t.shape[1]))[0].astype(jnp.int32)
+
+    print(f"col gather [N,F] minor-axis : {timed(gather_minor, binned, feat):8.3f} ms")
+    print(f"col slice  [F,N] contiguous : {timed(slice_t, bins_t, feat):8.3f} ms")
+
+    def slot_update(slot, col):
+        go_right = col > thresh
+        return jnp.where((slot == 3) & go_right, 31, slot)
+
+    col = slice_t(bins_t, feat)
+    print(f"slot_of_row where update    : {timed(slot_update, slot, col):8.3f} ms")
+
+    from mmlspark_tpu.ops.boosting import GBDTConfig, HParams, _best_split_per_slot
+    cfg = GBDTConfig(num_iterations=1, num_leaves=lcap, max_bins=b)
+    hp = HParams.from_config(cfg)
+    hists = jnp.asarray(rng.normal(size=(2, f, b, 3)).astype(np.float32))
+    sums = hists[:, 0].sum(axis=1)
+    fmask = jnp.ones((f,), bool)
+
+    def rescan(hists, sums):
+        return _best_split_per_slot(hists, sums, cfg, fmask, hp)
+
+    print(f"_best_split_per_slot (2 sl) : {timed(rescan, hists, sums):8.3f} ms")
+
+    hists_l = jnp.asarray(rng.normal(size=(lcap, f, b, 3)).astype(np.float32))
+    sums_l = hists_l[:, 0].sum(axis=1)
+
+    def rescan_all(hists, sums):
+        return _best_split_per_slot(hists, sums, cfg, fmask, hp)
+
+    print(f"_best_split_per_slot (31sl) : {timed(rescan_all, hists_l, sums_l):8.3f} ms")
+
+    from mmlspark_tpu.ops.histogram import hist_slots_onehot
+    from mmlspark_tpu.ops.pallas_kernels import hist_slots_pallas
+    print(f"hist pallas all-slots pass  : "
+          f"{timed(lambda b_, s, g: hist_slots_pallas(b_, s, g, lcap, b), binned, slot, gh3, reps=20):8.3f} ms")
+
+    # leaf-stat onehot contraction (lazy/voting epilogue)
+    def leaf_sums(slot, gh3):
+        oh = (slot[:, None] == jnp.arange(lcap)[None, :]).astype(jnp.float32)
+        return jnp.dot(oh.T, gh3, preferred_element_type=jnp.float32)
+
+    print(f"leaf-sums onehot contraction: {timed(leaf_sums, slot, gh3, reps=20):8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

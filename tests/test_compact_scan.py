@@ -1,0 +1,167 @@
+"""histScan='compact' — exact leaf-wise training with segment-bucketed
+per-split histograms (the TPU analogue of upstream LightGBM's DataPartition
++ smaller-child histogram trick, lightgbm C++ `data_partition.hpp` driven
+from TrainUtils.scala:220-315).
+
+The compact scan must reproduce the full scan's trees EXACTLY (same split
+features/bins; leaf values within fp-summation noise): both build fresh
+histograms for every current leaf before each split — only the set of rows
+each pass touches differs."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier, LightGBMRegressor
+
+from conftest import auc
+
+
+def _binary(n=12000, f=8, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    coef = rng.normal(size=f)
+    y = ((x @ coef + 0.4 * x[:, 0] * x[:, 1]
+          + rng.normal(scale=0.5, size=n)) > 0).astype(np.float64)
+    return DataFrame({"features": x, "label": y}), x, y
+
+
+class TestCompactMatchesFull:
+    def test_identical_trees_binary(self):
+        df, x, y = _binary()
+        kw = dict(numIterations=15, numLeaves=15, maxBin=32, numTasks=1,
+                  seed=3)
+        mf = LightGBMClassifier(histScan="full", **kw).fit(df)
+        mc = LightGBMClassifier(histScan="compact", **kw).fit(df)
+        tf, tc = mf.booster.trees, mc.booster.trees
+        np.testing.assert_array_equal(np.asarray(tf.split_feat),
+                                      np.asarray(tc.split_feat))
+        np.testing.assert_array_equal(np.asarray(tf.split_bin),
+                                      np.asarray(tc.split_bin))
+        np.testing.assert_array_equal(np.asarray(tf.split_valid),
+                                      np.asarray(tc.split_valid))
+        np.testing.assert_allclose(mf.booster.score(x), mc.booster.score(x),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_regressor_parity(self):
+        rng = np.random.default_rng(11)
+        n, f = 8000, 6
+        x = rng.normal(size=(n, f)).astype(np.float32)
+        y = (x @ rng.normal(size=f) + rng.normal(scale=0.3, size=n)
+             ).astype(np.float64)
+        df = DataFrame({"features": x, "label": y})
+        kw = dict(numIterations=12, numLeaves=12, maxBin=32, numTasks=1)
+        pf = LightGBMRegressor(histScan="full", **kw).fit(df) \
+            .booster.raw_predict(x)
+        pc = LightGBMRegressor(histScan="compact", **kw).fit(df) \
+            .booster.raw_predict(x)
+        np.testing.assert_allclose(pf, pc, rtol=1e-4, atol=1e-4)
+
+    def test_distributed_compact_matches_serial(self):
+        df, x, _ = _binary(n=6000)
+        kw = dict(numIterations=8, numLeaves=7, maxBin=32, seed=5,
+                  histScan="compact")
+        serial = LightGBMClassifier(numTasks=1, **kw).fit(df)
+        dist = LightGBMClassifier(numTasks=8, **kw).fit(df)
+        np.testing.assert_allclose(serial.booster.raw_predict(x),
+                                   dist.booster.raw_predict(x),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_categorical_and_missing(self):
+        rng = np.random.default_rng(23)
+        n = 6000
+        xc = rng.integers(0, 6, size=n)
+        xn = rng.normal(size=(n, 3)).astype(np.float32)
+        xn[rng.random(n) < 0.15, 0] = np.nan       # missing-capable feature
+        x = np.column_stack([xc.astype(np.float32), xn])
+        y = ((xc % 2 == 0) ^ (np.nan_to_num(xn[:, 0]) > 0.2)
+             ).astype(np.float64)
+        df = DataFrame({"features": x, "label": y})
+        kw = dict(numIterations=10, numLeaves=15, maxBin=16, numTasks=1,
+                  categoricalSlotIndexes=[0])
+        mf = LightGBMClassifier(histScan="full", **kw).fit(df)
+        mc = LightGBMClassifier(histScan="compact", **kw).fit(df)
+        np.testing.assert_array_equal(
+            np.asarray(mf.booster.trees.split_feat),
+            np.asarray(mc.booster.trees.split_feat))
+        np.testing.assert_allclose(mf.booster.score(x), mc.booster.score(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_goss_rows_with_zero_weight_in_segments(self):
+        # GOSS zeroes row weights mid-tree; zero-weight rows still live in
+        # leaf segments and must contribute nothing to bucket histograms
+        df, x, y = _binary(n=8000)
+        kw = dict(numIterations=10, numLeaves=15, maxBin=32, numTasks=1,
+                  boostingType="goss", seed=9)
+        mf = LightGBMClassifier(histScan="full", **kw).fit(df)
+        mc = LightGBMClassifier(histScan="compact", **kw).fit(df)
+        np.testing.assert_allclose(mf.booster.score(x), mc.booster.score(x),
+                                   rtol=1e-3, atol=1e-3)
+        assert auc(y, mc.booster.score(x)) > 0.9
+
+    def test_tiny_data_and_deep_tree(self):
+        # n far below the smallest bucket; more leaves than useful splits
+        df, x, _ = _binary(n=300)
+        kw = dict(numIterations=5, numLeaves=31, maxBin=16, numTasks=1,
+                  minDataInLeaf=1)
+        mf = LightGBMClassifier(histScan="full", **kw).fit(df)
+        mc = LightGBMClassifier(histScan="compact", **kw).fit(df)
+        np.testing.assert_allclose(mf.booster.score(x), mc.booster.score(x),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestCompactFallbacks:
+    def test_multiclass_falls_back_to_full(self):
+        # per-class trees are vmapped; lax.switch under vmap executes every
+        # bucket branch, so make_train_fn degrades compact -> full there
+        # (identical trees either way — this pins that it still trains)
+        rng = np.random.default_rng(31)
+        n, f = 3000, 6
+        x = rng.normal(size=(n, f)).astype(np.float32)
+        y = (np.argmax(x[:, :3] + 0.3 * rng.normal(size=(n, 3)), axis=1)
+             ).astype(np.float64)
+        df = DataFrame({"features": x, "label": y})
+        kw = dict(numIterations=8, numLeaves=7, maxBin=16, numTasks=1)
+        mf = LightGBMClassifier(histScan="full", **kw).fit(df)
+        mc = LightGBMClassifier(histScan="compact", **kw).fit(df)
+        np.testing.assert_allclose(
+            mf.booster.raw_predict(x), mc.booster.raw_predict(x),
+            rtol=1e-5, atol=1e-5)
+
+    def test_param_maps_sweep_with_compact(self):
+        # the vmapped fit(df, paramMaps) path degrades compact -> full; the
+        # sweep must train and match per-candidate sequential compact fits
+        df, x, _ = _binary(n=4000)
+        est = LightGBMClassifier(numIterations=6, numLeaves=7, maxBin=16,
+                                 numTasks=1, histScan="compact")
+        maps = [{"learningRate": lr} for lr in (0.05, 0.2)]
+        models = est.fit(df, maps)
+        assert len(models) == 2
+        for m, pm in zip(models, maps):
+            seq = LightGBMClassifier(numIterations=6, numLeaves=7, maxBin=16,
+                                     numTasks=1, histScan="compact",
+                                     learningRate=pm["learningRate"]).fit(df)
+            np.testing.assert_allclose(m.booster.raw_predict(x),
+                                       seq.booster.raw_predict(x),
+                                       rtol=1e-4, atol=1e-4)
+
+
+class TestCompactValidation:
+    def test_rejects_lazy(self):
+        df, _, _ = _binary(n=500)
+        with pytest.raises((NotImplementedError, ValueError)):
+            LightGBMClassifier(numIterations=2, numTasks=1, histScan="compact",
+                               histRefresh="lazy").fit(df)
+
+    def test_rejects_voting(self):
+        df, _, _ = _binary(n=500)
+        with pytest.raises((NotImplementedError, ValueError)):
+            LightGBMClassifier(numIterations=2, numTasks=8,
+                               histScan="compact",
+                               parallelism="voting_parallel").fit(df)
+
+    def test_rejects_unknown(self):
+        df, _, _ = _binary(n=500)
+        with pytest.raises(ValueError):
+            LightGBMClassifier(numIterations=2, numTasks=1,
+                               histScan="banana").fit(df)
