@@ -160,41 +160,22 @@ def main():
              "hist_kernel": f"{hist_method}/{hist_chunk}",
              "train_auc_sample": round(auc, 4), "device": str(devs[0])}
 
-    # secondary: histScan='compact' (exact leaf-wise semantics — upstream's
-    # smaller-child work model, ~N*depth histogram rows per tree instead of
-    # N*(L-1); tests pin tree-identical output vs the full scan). Guarded by
-    # the time budget and a try: its lax.switch bucket ladder compiles many
-    # pallas instances, which is unproven on the production toolchain.
-    if on_accel and time.time() - t_start < 300:
-        try:
-            c_clf = LightGBMClassifier(
-                numIterations=iters, numLeaves=leaves, maxBin=bins,
-                histMethod=hist_method, histChunk=hist_chunk, numTasks=1,
-                histScan="compact")
-            c_clf.fit(df)                         # compile
-            c_walls, c_model = timed_fits(c_clf, 2, t_start + 420)
-            c_wall = min(c_walls)
-            c_auc = roc_auc_score(y[idx], c_model.booster.score(x[idx]))
-            extra["compact_rows_iter_per_s"] = round(n * iters / c_wall, 1)
-            extra["compact_wall_s"] = [round(wv, 2) for wv in c_walls]
-            extra["compact_auc_sample"] = round(c_auc, 4)
-        except Exception as e:  # noqa: BLE001 - secondary must not kill bench
-            extra["compact_error"] = str(e)[:300]
-
     # secondary: lazy histogram refresh (histRefresh='lazy', ~1 pass per tree
     # level instead of per split; measured 2x end-to-end). Reported as extras
     # only — the primary metric stays exact leaf-wise, the reference's
-    # semantics. Skipped when the primary already consumed the time budget:
-    # the driver may bound the bench, and an unprinted JSON line is worse
-    # than a missing extra.
-    if on_accel and time.time() - t_start < 420:
+    # semantics. The PROVEN extra runs before the unproven compact one so a
+    # compact compile hang/failure can't cost the lazy numbers. Each extra
+    # is skipped when earlier work already consumed the time budget: the
+    # driver may bound the bench, and an unprinted JSON line is worse than
+    # a missing extra.
+    if on_accel and time.time() - t_start < 360:
         try:
             lazy_clf = LightGBMClassifier(
                 numIterations=iters, numLeaves=leaves, maxBin=bins,
                 histMethod=hist_method, histChunk=hist_chunk, numTasks=1,
                 histRefresh="lazy")
             lazy_clf.fit(df)                      # compile
-            lazy_walls, lazy_model = timed_fits(lazy_clf, 2, t_start + 540)
+            lazy_walls, lazy_model = timed_fits(lazy_clf, 2, t_start + 480)
             lazy_wall = min(lazy_walls)
             lazy_auc = roc_auc_score(y[idx], lazy_model.booster.score(x[idx]))
             extra["lazy_rows_iter_per_s"] = round(n * iters / lazy_wall, 1)
@@ -202,6 +183,27 @@ def main():
             extra["lazy_auc_sample"] = round(lazy_auc, 4)
         except Exception as e:  # noqa: BLE001 - secondary must not kill bench
             extra["lazy_error"] = str(e)[:300]
+
+    # secondary: histScan='compact' (exact leaf-wise semantics — upstream's
+    # smaller-child work model, ~N*depth histogram rows per tree instead of
+    # N*(L-1); tests pin tree-identical output vs the full scan). Last: its
+    # lax.switch bucket ladder compiles many pallas instances, which is
+    # unproven on the production toolchain.
+    if on_accel and time.time() - t_start < 420:
+        try:
+            c_clf = LightGBMClassifier(
+                numIterations=iters, numLeaves=leaves, maxBin=bins,
+                histMethod=hist_method, histChunk=hist_chunk, numTasks=1,
+                histScan="compact")
+            c_clf.fit(df)                         # compile
+            c_walls, c_model = timed_fits(c_clf, 2, t_start + 560)
+            c_wall = min(c_walls)
+            c_auc = roc_auc_score(y[idx], c_model.booster.score(x[idx]))
+            extra["compact_rows_iter_per_s"] = round(n * iters / c_wall, 1)
+            extra["compact_wall_s"] = [round(wv, 2) for wv in c_walls]
+            extra["compact_auc_sample"] = round(c_auc, 4)
+        except Exception as e:  # noqa: BLE001 - secondary must not kill bench
+            extra["compact_error"] = str(e)[:300]
     error = None
     if init_err is not None:
         extra["backend_fallback"] = f"cpu after init error: {init_err}"[:500]

@@ -179,9 +179,8 @@ def hist_slots_pallas(binned: jax.Array, slot: jax.Array, gh: jax.Array,
      f_pad) = _pallas_layout(n, f, c, num_slots, num_bins, block_rows,
                              feat_tile)
     if bins_t is None:
-        # transposed bins [F_pad, N_pad]: loop-invariant wrt the boosting loop
-        bins_t = jnp.pad(binned.astype(jnp.int8 if bins_i8 else jnp.int32).T,
-                         ((0, f_pad - f), (0, pad_n)), constant_values=b_pad)
+        bins_t = prepare_bins_t(binned, num_bins, num_slots, c, block_rows,
+                                feat_tile)
     else:
         assert bins_t.shape == (f_pad, n + pad_n), (
             f"bins_t laid out as {bins_t.shape}, kernel expects "

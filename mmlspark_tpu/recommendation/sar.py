@@ -37,6 +37,16 @@ class SAR(Estimator):
     timeDecayCoeff = _p.Param("timeDecayCoeff",
                               "half-life in days for affinity decay", 30, int)
     alpha = _p.Param("alpha", "weight of rating in affinity", 1.0, float)
+    startTime = _p.Param(
+        "startTime", "decay reference time (string, parsed with "
+        "startTimeFormat); default = the latest event time", None)
+    startTimeFormat = _p.Param(
+        "startTimeFormat", "Java SimpleDateFormat pattern for startTime "
+        "(SAR.scala setStartTimeFormat)", "yyyy/MM/dd'T'h:mm:ss")
+    activityTimeFormat = _p.Param(
+        "activityTimeFormat", "Java SimpleDateFormat pattern for string "
+        "timeCol values; numeric timeCol = epoch seconds",
+        "yyyy/MM/dd'T'h:mm:ss")
 
     def _fit(self, df: DataFrame) -> "SARModel":
         users = np.asarray(df[self.get("userCol")], np.int64)
@@ -48,11 +58,21 @@ class SAR(Estimator):
                    else np.ones(len(df), np.float64))
 
         # --- user-item affinity with time decay (SAR.scala:84-121):
-        # a(u,i) = sum_events rating * 2^(-(t_ref - t) / half_life)
+        # a(u,i) = sum_events rating * 2^(-minutes(t_ref - t) / half_life);
+        # upstream truncates the difference to whole MINUTES (Java long
+        # division by 1000*60, SAR.scala:90-93) — replicated here so the
+        # TLC golden affinities match bit-for-bit
         if self.get("timeCol") and self.get("timeCol") in df:
-            t = np.asarray(df[self.get("timeCol")], np.float64)
-            half_life_s = float(self.get("timeDecayCoeff")) * 86400.0
-            decay = np.exp2(-(t.max() - t) / half_life_s)
+            t_raw = df[self.get("timeCol")]
+            t = _to_epoch_seconds(t_raw, self.get("activityTimeFormat"))
+            if self.get("startTime"):
+                ref = _parse_java_datetime(self.get("startTime"),
+                                           self.get("startTimeFormat"))
+            else:
+                ref = t.max()
+            half_life_min = float(self.get("timeDecayCoeff")) * 24.0 * 60.0
+            minutes = np.trunc((ref - t) / 60.0)
+            decay = np.exp2(-minutes / half_life_min)
         else:
             decay = np.ones(len(df), np.float64)
         affinity = np.zeros((n_users, n_items), np.float32)
@@ -86,6 +106,34 @@ class SAR(Estimator):
         for p in ("userCol", "itemCol"):
             model.set(p, self.get(p))
         return model
+
+
+def _java_fmt_to_strptime(fmt: str) -> str:
+    """Translate the Java SimpleDateFormat subset the reference uses
+    (SAR.scala startTimeFormat/activityTimeFormat defaults and the TLC
+    test's yyyy/MM/dd'T'h:mm:ss) into a strptime pattern. 'h' is Java's
+    12-hour field, but SimpleDateFormat parses leniently so h:mm:ss accepts
+    24-hour values — %H reproduces that for the formats in play."""
+    out = fmt.replace("'T'", "T")
+    for java, py in (("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+                     ("HH", "%H"), ("hh", "%H"), ("h", "%H"),
+                     ("mm", "%M"), ("ss", "%S")):
+        out = out.replace(java, py)
+    return out
+
+
+def _parse_java_datetime(value: str, fmt: str) -> float:
+    from datetime import datetime, timezone
+    dt = datetime.strptime(str(value), _java_fmt_to_strptime(fmt))
+    return dt.replace(tzinfo=timezone.utc).timestamp()
+
+
+def _to_epoch_seconds(col, fmt: str) -> np.ndarray:
+    arr = np.asarray(col)
+    if np.issubdtype(arr.dtype, np.number):
+        return arr.astype(np.float64)
+    return np.asarray([_parse_java_datetime(v, fmt) for v in arr],
+                      np.float64)
 
 
 @jax.jit

@@ -32,40 +32,49 @@ def main(n=1_000_000, f=28, b=64, lcap=31):
     key = jax.random.PRNGKey(0)
     dev = jax.devices()[0]
     print("device:", dev, flush=True)
-    lines = [f"== {time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())} "
-             f"on {dev} n={n} f={f} b={b} L={lcap}"]
-
-    for refresh, scan in (("eager", "compact"), ("eager", "full"),
-                          ("lazy", "full")):
-        cfg = GBDTConfig(num_iterations=24, num_leaves=lcap, max_bins=b,
-                         hist_method="pallas", hist_chunk=4096,
-                         split_refresh=refresh, split_scan=scan,
-                         objective="binary")
-        tr24 = make_train_fn(cfg)
-        tr4 = make_train_fn(cfg._replace(num_iterations=4))
-        f24 = jax.jit(lambda *a: jax.tree_util.tree_leaves(tr24(*a))[0].sum())
-        f4 = jax.jit(lambda *a: jax.tree_util.tree_leaves(tr4(*a))[0].sum())
-        t0 = time.time()
-        float(f24(binned, yv, w, it_, margin, key))
-        float(f4(binned, yv, w, it_, margin, key))
-        compile_s = time.time() - t0
-        t24, t4 = [], []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(f4(binned, yv, w, it_, margin, key))
-            t4.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            float(f24(binned, yv, w, it_, margin, key))
-            t24.append(time.perf_counter() - t0)
-        per = (min(t24) - min(t4)) / 20 * 1e3
-        line = (f"{refresh}/{scan}: per-iter {per:7.2f} ms "
-                f"(compile+first {compile_s:.0f}s, 4it {min(t4):.2f}s, "
-                f"24it {min(t24):.2f}s)")
-        print(line, flush=True)
-        lines.append(line)
-
     with open(LOG, "a") as fh:
-        fh.write("\n".join(lines) + "\n")
+        fh.write(f"== {time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())}"
+                 f" on {dev} n={n} f={f} b={b} L={lcap}\n")
+
+    # proven modes first, the unproven compact compile last, each mode
+    # fenced by its own try — one failure must not lose the others'
+    # measurements (the healthy-pool window this runs in is rare), and the
+    # log is appended after EVERY mode for the same reason
+    for refresh, scan in (("eager", "full"), ("lazy", "full"),
+                          ("eager", "compact")):
+        try:
+            cfg = GBDTConfig(num_iterations=24, num_leaves=lcap, max_bins=b,
+                             hist_method="pallas", hist_chunk=4096,
+                             split_refresh=refresh, split_scan=scan,
+                             objective="binary")
+            tr24 = make_train_fn(cfg)
+            tr4 = make_train_fn(cfg._replace(num_iterations=4))
+            f24 = jax.jit(
+                lambda *a: jax.tree_util.tree_leaves(tr24(*a))[0].sum())
+            f4 = jax.jit(
+                lambda *a: jax.tree_util.tree_leaves(tr4(*a))[0].sum())
+            t0 = time.time()
+            float(f24(binned, yv, w, it_, margin, key))
+            float(f4(binned, yv, w, it_, margin, key))
+            compile_s = time.time() - t0
+            t24, t4 = [], []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(f4(binned, yv, w, it_, margin, key))
+                t4.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                float(f24(binned, yv, w, it_, margin, key))
+                t24.append(time.perf_counter() - t0)
+            per = (min(t24) - min(t4)) / 20 * 1e3
+            line = (f"{refresh}/{scan}: per-iter {per:7.2f} ms "
+                    f"(compile+first {compile_s:.0f}s, 4it {min(t4):.2f}s, "
+                    f"24it {min(t24):.2f}s)")
+        except Exception as e:  # noqa: BLE001 - keep the other modes
+            line = (f"{refresh}/{scan}: FAILED "
+                    f"{type(e).__name__}: {str(e)[:200]}")
+        print(line, flush=True)
+        with open(LOG, "a") as fh:
+            fh.write(line + "\n")
 
 
 if __name__ == "__main__":

@@ -10,14 +10,16 @@ candidate costs on the live chip:
      (XLA gather over the minor axis) vs the transposed layout
      dynamic_slice(bins_t, (feat, 0), (1, N)) (contiguous read)
   2. slot_of_row update (where over [N])
-  3. _best_split_per_slot on 2 slots
-  4. a full scan-amortized fit at numLeaves in {2, 31} to re-derive the
-     per-split slope
+  3. _best_split_per_slot on 2 and 31 slots
+  4. the all-slots pallas pass and the lazy-mode leaf-sums contraction
 
-Timing methodology matches docs/KERNELS.md: scan-amortized repeats inside
-one jit program, host-fetch barrier, dispatch RTT subtracted via a null
-program.
-"""
+Timing methodology (docs/KERNELS.md): paired-difference of two
+scan-amortized jit programs so the relay round trip cancels, with the
+workload EXPLICITLY step-dependent — every fn takes the scan index j as its
+first argument and must fold it into an input, otherwise XLA's while-loop
+invariant code motion hoists the body and the reading is garbage (both
+earlier versions of this script hit exactly that: float-only perturbation
+left the integer workloads hoisted and reporting ~0)."""
 
 import time
 
@@ -28,22 +30,13 @@ import jax.numpy as jnp
 
 
 def timed(fn, *args, reps=50):
-    """Paired-difference scan-amortized wall per call.
-
-    The scanned body must DEPEND on the step index, or XLA's while-loop
-    invariant code motion hoists fn out and the timing divides one execution
-    by reps (this bit the first version of this script): the first float
-    argument is perturbed by 1e-6*j per step. The per-call time is
-    (wall(3k) - wall(k)) / 2k so the relay round trip cancels per pair."""
+    """Paired-difference scan-amortized ms per call of fn(j, *args)."""
 
     def mk(k):
         @jax.jit
         def many(*a):
             def body(c, j):
-                aj = [x * (1.0 + 1e-6 * j.astype(jnp.float32))
-                      if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
-                      else x for x in a]
-                out = fn(*aj)
+                out = fn(j, *a)
                 leaf = jax.tree_util.tree_leaves(out)[0]
                 return c + leaf.reshape(-1)[0].astype(jnp.float32), None
             c, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(k))
@@ -60,8 +53,7 @@ def timed(fn, *args, reps=50):
         t1 = time.perf_counter()
         float(m3(*args))
         d.append((time.perf_counter() - t1) - (t1 - t0))
-    import numpy as _np
-    return float(_np.median(d)) / (2 * reps) * 1e3   # ms/call
+    return float(np.median(d)) / (2 * reps) * 1e3   # ms/call
 
 
 def main():
@@ -71,58 +63,60 @@ def main():
     bins_t = jnp.asarray(np.ascontiguousarray(np.asarray(binned).T))
     slot = jnp.asarray(rng.integers(0, lcap, size=(n,), dtype=np.int32))
     gh3 = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
-    feat = jnp.int32(13)
-    thresh = jnp.int32(31)
 
     print(f"device: {jax.devices()[0]}")
 
-    def gather_minor(binned, feat):
-        return jnp.take(binned, feat, axis=1).astype(jnp.int32)
+    def gather_minor(j, binned):
+        return jnp.take(binned, j % f, axis=1).astype(jnp.int32)
 
-    def slice_t(bins_t, feat):
-        return jax.lax.dynamic_slice(bins_t, (feat, 0), (1, bins_t.shape[1]))[0].astype(jnp.int32)
+    def slice_t(j, bins_t):
+        return jax.lax.dynamic_slice(
+            bins_t, (j % f, 0), (1, bins_t.shape[1]))[0].astype(jnp.int32)
 
-    print(f"col gather [N,F] minor-axis : {timed(gather_minor, binned, feat):8.3f} ms")
-    print(f"col slice  [F,N] contiguous : {timed(slice_t, bins_t, feat):8.3f} ms")
+    print(f"col gather [N,F] minor-axis : {timed(gather_minor, binned):8.3f} ms")
+    print(f"col slice  [F,N] contiguous : {timed(slice_t, bins_t):8.3f} ms")
 
-    def slot_update(slot, col):
-        go_right = col > thresh
-        return jnp.where((slot == 3) & go_right, 31, slot)
+    def slot_update(j, slot, col):
+        go_right = col > (j % b)
+        return jnp.where((slot == j % lcap) & go_right, 31, slot)
 
-    col = slice_t(bins_t, feat)
+    col = jnp.take(binned, 13, axis=1).astype(jnp.int32)
     print(f"slot_of_row where update    : {timed(slot_update, slot, col):8.3f} ms")
 
-    from mmlspark_tpu.ops.boosting import GBDTConfig, HParams, _best_split_per_slot
+    from mmlspark_tpu.ops.boosting import (GBDTConfig, HParams,
+                                           _best_split_per_slot)
     cfg = GBDTConfig(num_iterations=1, num_leaves=lcap, max_bins=b)
     hp = HParams.from_config(cfg)
-    hists = jnp.asarray(rng.normal(size=(2, f, b, 3)).astype(np.float32))
-    sums = hists[:, 0].sum(axis=1)
     fmask = jnp.ones((f,), bool)
 
-    def rescan(hists, sums):
-        return _best_split_per_slot(hists, sums, cfg, fmask, hp)
+    for slots in (2, lcap):
+        hists = jnp.asarray(rng.normal(size=(slots, f, b, 3)).astype(np.float32))
+        sums = hists[:, 0].sum(axis=1)
 
-    print(f"_best_split_per_slot (2 sl) : {timed(rescan, hists, sums):8.3f} ms")
+        def rescan(j, hists, sums):
+            return _best_split_per_slot(
+                hists * (1.0 + 1e-6 * j.astype(jnp.float32)), sums, cfg,
+                fmask, hp)
 
-    hists_l = jnp.asarray(rng.normal(size=(lcap, f, b, 3)).astype(np.float32))
-    sums_l = hists_l[:, 0].sum(axis=1)
+        print(f"_best_split_per_slot ({slots:2d} sl): "
+              f"{timed(rescan, hists, sums):8.3f} ms")
 
-    def rescan_all(hists, sums):
-        return _best_split_per_slot(hists, sums, cfg, fmask, hp)
-
-    print(f"_best_split_per_slot (31sl) : {timed(rescan_all, hists_l, sums_l):8.3f} ms")
-
-    from mmlspark_tpu.ops.histogram import hist_slots_onehot
     from mmlspark_tpu.ops.pallas_kernels import hist_slots_pallas
+
+    def pallas_pass(j, binned, slot, gh3):
+        g = gh3 * (1.0 + 1e-6 * j.astype(jnp.float32))
+        return hist_slots_pallas(binned, slot, g, lcap, b)
+
     print(f"hist pallas all-slots pass  : "
-          f"{timed(lambda b_, s, g: hist_slots_pallas(b_, s, g, lcap, b), binned, slot, gh3, reps=20):8.3f} ms")
+          f"{timed(pallas_pass, binned, slot, gh3, reps=20):8.3f} ms")
 
-    # leaf-stat onehot contraction (lazy/voting epilogue)
-    def leaf_sums(slot, gh3):
+    def leaf_sums(j, slot, gh3):
+        g = gh3 * (1.0 + 1e-6 * j.astype(jnp.float32))
         oh = (slot[:, None] == jnp.arange(lcap)[None, :]).astype(jnp.float32)
-        return jnp.dot(oh.T, gh3, preferred_element_type=jnp.float32)
+        return jnp.dot(oh.T, g, preferred_element_type=jnp.float32)
 
-    print(f"leaf-sums onehot contraction: {timed(leaf_sums, slot, gh3, reps=20):8.3f} ms")
+    print(f"leaf-sums onehot contraction: "
+          f"{timed(leaf_sums, slot, gh3, reps=20):8.3f} ms")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,122 @@
+"""SAR parity against the reference's own golden record (SARSpec TLC tests).
+
+The reference vendors the TLC sample usage log (demoUsage.csv.gz) plus the
+expected item-item similarity matrices for cooccurrence/lift/jaccard at
+support thresholds 1 and 3 (sim_*.csv.gz) and the expected top-10
+recommendations for one user (userpred_*_userid_only.csv.gz), and asserts
+its SAR reproduces them EXACTLY (SARSpec.scala test_affinity_matrices /
+test_product_recommendations). The same fixtures are vendored here
+(tests/fixtures/sar/, public test data from the reference repo) and gated
+the same way — direct evidence of parity with the reference implementation,
+not a self-referential golden.
+
+Reference decay semantics replicated: startTime "2015/06/09T19:39:37"
+(format yyyy/MM/dd'T'h:mm:ss), half-life timeDecayCoeff=30 days, and the
+difference truncated to whole minutes (SAR.scala:90-93 Java long division).
+"""
+
+import csv
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.recommendation import SAR, RecommendationIndexer
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures", "sar")
+START = "2015/06/09T19:39:37"
+USER = "0003000098E85347"
+
+
+def _read_csv_gz(name):
+    with gzip.open(os.path.join(FIX, name), "rt", newline="") as fh:
+        return list(csv.reader(fh))
+
+
+@pytest.fixture(scope="module")
+def usage():
+    rows = _read_csv_gz("demoUsage.csv.gz")
+    head, body = rows[0], rows[1:]
+    assert head == ["userId", "productId", "timestamp"]
+    users = np.asarray([r[0] for r in body])
+    items = np.asarray([r[1] for r in body])
+    times = np.asarray([r[2] for r in body])
+    return DataFrame({"userId": users, "productId": items,
+                      "timestamp": times})
+
+
+@pytest.fixture(scope="module")
+def indexed(usage):
+    idx = RecommendationIndexer(userInputCol="userId",
+                                itemInputCol="productId").fit(usage)
+    return idx, idx.transform(usage)
+
+
+def _fit_sar(indexed_df, threshold, kind):
+    return SAR(userCol="user_idx", itemCol="item_idx", ratingCol="__none__",
+               timeCol="timestamp", supportThreshold=threshold,
+               similarityFunction=kind, timeDecayCoeff=30,
+               startTime=START).fit(indexed_df)
+
+
+_SIM_CASES = [(1, "cooccurrence", "sim_count1.csv.gz"),
+              (1, "lift", "sim_lift1.csv.gz"),
+              (1, "jaccard", "sim_jac1.csv.gz"),
+              (3, "cooccurrence", "sim_count3.csv.gz"),
+              (3, "lift", "sim_lift3.csv.gz"),
+              (3, "jaccard", "sim_jac3.csv.gz")]
+
+
+@pytest.mark.parametrize("threshold,kind,fixture", _SIM_CASES)
+def test_similarity_matches_reference_golden(indexed, threshold, kind,
+                                             fixture):
+    idx, tdf = indexed
+    model = _fit_sar(tdf, threshold, kind)
+    sim = model.get_item_similarity()                  # [I, I] float32
+    name_of = idx.get("itemLevels")
+    pos = {n: i for i, n in enumerate(name_of)}
+
+    rows = _read_csv_gz(fixture)
+    col_names = rows[0][1:]
+    checked = 0
+    for row in rows[1:]:
+        i = pos[row[0]]
+        got = sim[i]
+        want = np.asarray([float(v) for v in row[1:]], np.float32)
+        j = np.asarray([pos[c] for c in col_names])
+        np.testing.assert_allclose(got[j], want, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"{fixture} row {row[0]}")
+        checked += len(j)
+    assert checked >= 100 * 100   # the full 101x101 grid was compared
+
+
+_PRED_CASES = [(3, "cooccurrence", "userpred_count3_userid_only.csv.gz"),
+               (3, "lift", "userpred_lift3_userid_only.csv.gz"),
+               (3, "jaccard", "userpred_jac3_userid_only.csv.gz")]
+
+
+@pytest.mark.parametrize("threshold,kind,fixture", _PRED_CASES)
+def test_top10_recommendations_match_reference(indexed, threshold, kind,
+                                               fixture):
+    idx, tdf = indexed
+    model = _fit_sar(tdf, threshold, kind)
+    items = idx.get("itemLevels")
+    users = idx.get("userLevels")
+    uid = users.index(USER)
+
+    # our recommendForAllUsers masks seen items to -inf, which equals the
+    # reference test's request-(10+len(seen))-then-filter-seen protocol
+    recs = model.recommend_for_all_users(10)
+    row = recs["recommendations"][list(recs[model.get("userCol")]).index(uid)]
+    got_names = [items[r["item"]] for r in row][:10]
+    got_scores = [r["rating"] for r in row][:10]
+
+    want = _read_csv_gz(fixture)[1]
+    want_names, want_scores = want[1:11], [float(v) for v in want[11:21]]
+    assert want[0] == USER
+    assert got_names == want_names, (
+        f"{fixture}: got {got_names} want {want_names}")
+    np.testing.assert_allclose(got_scores, want_scores, atol=5e-4,
+                               err_msg=fixture)   # reference rounds to 3dp
