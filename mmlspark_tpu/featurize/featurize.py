@@ -55,6 +55,16 @@ class Featurize(Estimator):
                     continue
             if col.ndim == 2:
                 plan.append({"col": name, "kind": "vector", "n": col.shape[1]})
+            elif np.issubdtype(col.dtype, np.datetime64):
+                # calendar expansion (AssembleFeatures.scala:374-398):
+                # date -> [epoch_ms, year, ISO day-of-week, month, day];
+                # timestamp adds [hour, minute, second]. Day-resolution
+                # columns are dates, finer resolutions are timestamps.
+                is_date = np.datetime_data(col.dtype)[0] in ("D", "W", "M",
+                                                             "Y")
+                plan.append({"col": name,
+                             "kind": "date" if is_date else "timestamp",
+                             "n": 5 if is_date else 8})
             elif col.dtype == object and len(col) and isinstance(col[0], str):
                 # low-cardinality strings: one-hot over observed levels beats
                 # hashing (the reference hashes into a 2^18 SPARSE vector —
@@ -85,6 +95,37 @@ class Featurize(Estimator):
         model = FeaturizeModel(plan=plan)
         model.set("outputCol", self.get("outputCol"))
         return model
+
+
+def _calendar_parts(col, with_time: bool) -> np.ndarray:
+    """Expand a datetime64 column into the reference's calendar features
+    (AssembleFeatures.scala:374-398): [epoch_ms, year, ISO day-of-week
+    (Mon=1..Sun=7), month, day-of-month] (+ [hour, minute, second] for
+    timestamps). NaT rows encode as all-zeros (the date analogue of the
+    numeric path's missing handling — int64-min garbage must never leak
+    into the feature matrix). Note the assembled output is float32, which
+    quantizes modern epoch_ms values to ~131 s granularity; the calendar
+    part slots are exact, and downstream GBDT binning is insensitive to
+    the epoch quantization."""
+    t = np.asarray(col)
+    nat = np.isnat(t)
+    t = np.where(nat, np.datetime64(0, np.datetime_data(t.dtype)[0]), t)
+    ms = t.astype("datetime64[ms]").astype(np.int64)
+    days = t.astype("datetime64[D]").astype(np.int64)
+    years = t.astype("datetime64[Y]").astype(np.int64) + 1970
+    months = t.astype("datetime64[M]").astype(np.int64) % 12 + 1
+    month_start = t.astype("datetime64[M]").astype("datetime64[D]")
+    dom = (t.astype("datetime64[D]") - month_start).astype(np.int64) + 1
+    dow = (days + 3) % 7 + 1                      # 1970-01-01 was Thursday=4
+    cols = [ms.astype(np.float64), years, dow, months, dom]
+    if with_time:
+        sec_of_day = (t.astype("datetime64[s]").astype(np.int64)
+                      - days * 86400)
+        cols += [sec_of_day // 3600, sec_of_day // 60 % 60, sec_of_day % 60]
+    out = np.stack([np.asarray(c, np.float64) for c in cols],
+                   axis=1).astype(np.float32)
+    out[nat] = 0.0
+    return out
 
 
 def _lookup_levels(col, levels_list):
@@ -142,6 +183,8 @@ class FeaturizeModel(Model):
                 out = np.zeros((n, spec["n"]), np.float32)
                 out[np.arange(n), buckets] += 1.0
                 parts.append(out)
+            elif kind in ("date", "timestamp"):
+                parts.append(_calendar_parts(col, kind == "timestamp"))
             else:
                 raise ValueError(f"unknown encoding kind {kind!r}")
         assembled = np.concatenate(parts, axis=1) if parts else np.zeros((n, 0),

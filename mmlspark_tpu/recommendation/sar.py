@@ -132,6 +132,8 @@ def _to_epoch_seconds(col, fmt: str) -> np.ndarray:
     arr = np.asarray(col)
     if np.issubdtype(arr.dtype, np.number):
         return arr.astype(np.float64)
+    if np.issubdtype(arr.dtype, np.datetime64):
+        return arr.astype("datetime64[s]").astype(np.float64)
     return np.asarray([_parse_java_datetime(v, fmt) for v in arr],
                       np.float64)
 

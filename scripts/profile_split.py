@@ -111,8 +111,11 @@ def main():
           f"{timed(pallas_pass, binned, slot, gh3, reps=20):8.3f} ms")
 
     def leaf_sums(j, slot, gh3):
+        # fold j into BOTH operands — a j-invariant slot would let LICM
+        # hoist the one-hot materialization and underreport the epilogue
         g = gh3 * (1.0 + 1e-6 * j.astype(jnp.float32))
-        oh = (slot[:, None] == jnp.arange(lcap)[None, :]).astype(jnp.float32)
+        s = (slot + j) % lcap
+        oh = (s[:, None] == jnp.arange(lcap)[None, :]).astype(jnp.float32)
         return jnp.dot(oh.T, g, preferred_element_type=jnp.float32)
 
     print(f"leaf-sums onehot contraction: "

@@ -1,0 +1,158 @@
+"""Featurize parity against the reference's golden record (VerifyFeaturize).
+
+The reference vendors golden assembled-feature vectors for several input
+type mixes (src/test/resources/benchmarks/benchmark*.json) and asserts its
+Featurize reproduces them. The same files are vendored here
+(tests/fixtures/featurize/) and gated by CONTENT: the reference's exact
+slot ordering is an internal AssembleFeatures convention, so the gate
+matches the multiset of per-slot columns (every encoded value must appear,
+order-free) — numeric passthrough of long/double/bool/int/byte/float,
+sparse+dense vector flattening with NaN passthrough, and the calendar
+expansion of date/timestamp columns (AssembleFeatures.scala:374-398).
+
+Epoch-millisecond slots are excluded from the date golden: the reference
+recorded them under the CI machine's JVM-local timezone (EST — e.g.
+2017-07-07 encodes as 1.4994E12 = that date's midnight at UTC-4), while
+this build's expansion is timezone-naive.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.featurize import Featurize
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures", "featurize")
+
+
+def _load(name):
+    with open(os.path.join(FIX, name)) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def _vec(cell):
+    """Spark ML vector JSON: type 1 = dense values, type 0 = sparse."""
+    if cell["type"] == 1:
+        return np.asarray(cell["values"], np.float64)
+    out = np.zeros(cell["size"], np.float64)
+    out[np.asarray(cell["indices"], int)] = cell["values"]
+    return out
+
+
+def _golden_matrix(rows):
+    return np.stack([_vec(r["testColumn"]) for r in rows])
+
+
+def _assert_column_multisets_equal(ours, golden, atol=1e-5):
+    """Order-free content equality: every golden slot column must match one
+    of our slot columns, bijectively."""
+    assert ours.shape == golden.shape, (ours.shape, golden.shape)
+
+    def key(m):
+        canon = np.where(np.isnan(m), 1e18, np.round(m / atol) * atol)
+        return sorted(tuple(canon[:, j]) for j in range(m.shape[1]))
+
+    ko, kg = key(ours), key(golden)
+    for a, b in zip(ko, kg):
+        np.testing.assert_allclose(a, b, atol=atol)
+
+
+def test_basic_data_types_golden():
+    rows = _load("benchmarkBasicDataTypes.json")
+    df = DataFrame({
+        "col1": np.asarray([r["col1"] for r in rows], np.int64),
+        "col2": np.asarray([r["col2"] for r in rows], np.float64),
+        "col3": np.asarray([r["col3"] for r in rows], bool),
+        "col4": np.asarray([r["col4"] for r in rows], np.int32),
+        "col5": np.asarray([r["col5"] for r in rows], np.int8),
+        "col6": np.asarray([r["col6"] for r in rows], np.float32),
+    })
+    model = Featurize(inputCols=["col1", "col2", "col3", "col4", "col5",
+                                 "col6"], outputCol="out").fit(df)
+    ours = np.asarray(model.transform(df)["out"], np.float64)
+    _assert_column_multisets_equal(ours, _golden_matrix(rows))
+
+
+def test_vector_columns_golden():
+    rows = _load("benchmarkVectors.json")
+    df = DataFrame({
+        "col1": np.stack([_vec(r["col1"]) for r in rows]),
+        "col2": np.asarray([r["col2"] for r in rows], np.float64),
+        "col3": np.asarray([r["col3"] for r in rows], np.float64),
+        "col4": np.asarray([r["col4"] for r in rows], np.int64),
+        "col5": np.stack([_vec(r["col5"]) for r in rows]),
+    })
+    model = Featurize(inputCols=["col1", "col2", "col3", "col4", "col5"],
+                      outputCol="out").fit(df)
+    ours = np.asarray(model.transform(df)["out"], np.float64)
+    golden = _golden_matrix(rows)
+    # The golden (and the vector passthrough) carries NaN through — compare
+    # with NaN-aware canonicalization inside the multiset matcher. But the
+    # reference's scalar col2/col3 passthrough means our numeric
+    # mean-imputation must not fire here (no missing scalars in this data).
+    _assert_column_multisets_equal(ours, golden)
+
+
+def test_date_timestamp_calendar_expansion_golden():
+    rows = _load("benchmarkDate.json")
+    # reconstruct the inputs from the golden's own local calendar parts so
+    # the comparison is timezone-free: golden layout per row is
+    # [ts_epoch_ms, ts_year, ts_dow, ts_month, ts_day, ts_hour, ts_min,
+    #  ts_sec] + [col1, col3] + [date_epoch_ms, date_year, date_dow,
+    #  date_month, date_day] + [col2] in SOME order; we rebuild date /
+    #  timestamp values from the string columns interpreted naively.
+    dates = np.asarray([r["date"] for r in rows], "datetime64[D]")
+    ts = np.asarray([r["timestamp"][:23] for r in rows], "datetime64[ms]")
+    df = DataFrame({
+        "col1": np.asarray([r["col1"] for r in rows], np.int64),
+        "col2": np.asarray([r["col2"] for r in rows], np.float64),
+        "col3": np.asarray([r["col3"] for r in rows], np.float64),
+        "date": dates,
+        "timestamp": ts,
+    })
+    model = Featurize(inputCols=["col1", "col2", "col3", "date",
+                                 "timestamp"], outputCol="out").fit(df)
+    ours = np.asarray(model.transform(df)["out"], np.float64)
+    golden = _golden_matrix(rows)
+    assert ours.shape == golden.shape            # 3 scalars + 5 + 8 slots
+    # drop the two epoch-ms slots on both sides (timezone-dependent in the
+    # golden). Ours sit at known plan positions: inputCols order gives
+    # [col1, col2, col3, date0..date4, ts0..ts7] => epochs at 3 and 8. The
+    # golden's date epoch is the only >1e9 column; its timestamp epoch is
+    # the column at a CONSTANT offset (the recording TZ) from our naive one.
+    our_epochs = [3, 8]
+    g_date_epoch = [j for j in range(golden.shape[1])
+                    if np.abs(golden[:, j]).max() > 1e9]
+    assert len(g_date_epoch) == 1
+    diffs = golden - ours[:, 8][:, None]
+    g_ts_epoch = [j for j in range(golden.shape[1])
+                  if j not in g_date_epoch
+                  and np.ptp(diffs[:, j]) == 0.0
+                  and abs(diffs[0, j]) >= 3600_000]
+    assert len(g_ts_epoch) == 1, g_ts_epoch
+    keep_o = [j for j in range(ours.shape[1]) if j not in our_epochs]
+    keep_g = [j for j in range(golden.shape[1])
+              if j not in g_date_epoch + g_ts_epoch]
+    _assert_column_multisets_equal(ours[:, keep_o], golden[:, keep_g])
+
+
+def test_timestamp_parts_explicit():
+    # pin the expansion layout itself (not just content): 1969-12-31T19:00:01
+    # naive -> [epoch_ms, 1969, 3 (Wednesday), 12, 31, 19, 0, 1]
+    ts = np.asarray(["1969-12-31T19:00:01"], "datetime64[ms]")
+    df = DataFrame({"t": ts})
+    model = Featurize(inputCols=["t"], outputCol="out").fit(df)
+    out = np.asarray(model.transform(df)["out"], np.float64)[0]
+    np.testing.assert_allclose(
+        out, [-17999000.0, 1969, 3, 12, 31, 19, 0, 1])
+
+
+def test_date_parts_explicit():
+    d = np.asarray(["2017-07-07"], "datetime64[D]")   # a Friday
+    df = DataFrame({"d": d})
+    model = Featurize(inputCols=["d"], outputCol="out").fit(df)
+    out = np.asarray(model.transform(df)["out"], np.float64)[0]
+    np.testing.assert_allclose(out, [1499385600000.0, 2017, 5, 7, 7])
