@@ -161,11 +161,16 @@ class RankingAdapter(Estimator):
             self.set("recommender", recommender)
 
     def _fit(self, df: DataFrame) -> "RankingAdapterModel":
-        inner = self.get("recommender").fit(df)
+        est = self.get("recommender")
+        inner = est.fit(df)
         model = RankingAdapterModel(inner_model=inner)
         model.set("k", self.get("k"))
         model.set("userCol", inner.get("userCol"))
         model.set("itemCol", inner.get("itemCol"))
+        try:
+            model.set("ratingCol", est.get("ratingCol"))
+        except ValueError:   # recommender without a ratingCol param
+            pass
         return model
 
 
@@ -175,6 +180,8 @@ class RankingAdapterModel(Model):
     k = _p.Param("k", "recommendations per user", 10, int)
     userCol = _p.Param("userCol", "user column", "user")
     itemCol = _p.Param("itemCol", "item column", "item")
+    ratingCol = _p.Param("ratingCol", "rating column (label ordering)",
+                         "rating")
 
     def __init__(self, inner_model=None, **kw):
         super().__init__(**kw)
@@ -182,20 +189,36 @@ class RankingAdapterModel(Model):
             self.set("innerModel", inner_model)
 
     def transform(self, df: DataFrame) -> DataFrame:
+        """Reference semantics (RankingAdapterModel.transform,
+        RankingAdapter.scala:117-141): label = the user's TOP-K observed
+        items ordered by (rating desc, item asc) — not every observed item
+        — and prediction = the recommender's raw (unfiltered) top-k, i.e.
+        recommendForAllUsers with seen items INCLUDED."""
         ucol, icol = self.get("userCol"), self.get("itemCol")
-        recs = self.get("innerModel").recommend_for_all_users(self.get("k"))
+        k = self.get("k")
+        import inspect
+        inner = self.get("innerModel")
+        sig = inspect.signature(inner.recommend_for_all_users)
+        if "remove_seen" in sig.parameters:
+            recs = inner.recommend_for_all_users(k, remove_seen=False)
+        else:               # recommender without a seen-filter option
+            recs = inner.recommend_for_all_users(k)
         rec_map: Dict[int, List] = {
             int(u): [r["item"] for r in rl]
             for u, rl in zip(recs[ucol], recs["recommendations"])}
         users = np.asarray(df[ucol], np.int64)
         items = np.asarray(df[icol], np.int64)
+        rcol = self.get("ratingCol")
+        ratings = (np.asarray(df[rcol], np.float64) if rcol in df
+                   else np.ones(len(users), np.float64))
         uniq = np.unique(users)
-        truth = {int(u): items[users == u].tolist() for u in uniq}
         preds = np.empty(len(uniq), dtype=object)
         labels = np.empty(len(uniq), dtype=object)
         for i, u in enumerate(uniq):
+            mask = users == u
+            order = sorted(zip(-ratings[mask], items[mask]))
+            labels[i] = [int(it) for _, it in order[:k]]
             preds[i] = rec_map.get(int(u), [])
-            labels[i] = truth[int(u)]
         return DataFrame({ucol: uniq, "prediction": preds, "label": labels})
 
 

@@ -174,13 +174,25 @@ class SARModel(Model):
 
     getItemSimilarity = get_item_similarity
 
-    def recommend_for_all_users(self, num_items: int) -> DataFrame:
+    def recommend_for_all_users(self, num_items: int,
+                                remove_seen: bool = True) -> DataFrame:
         """Reference: SARModel.recommendForAllUsers (:23-169). Output rows:
-        (user, recommendations=[{item, rating}...])."""
-        scores = np.asarray(_sar_scores(
-            jnp.asarray(self.get("affinity")),
-            jnp.asarray(self.get("similarity")),
-            jnp.asarray(self.get("seen"))))
+        (user, recommendations=[{item, rating}...]).
+
+        remove_seen=True (default) masks items the user already interacted
+        with; remove_seen=False reproduces the reference's raw
+        affinity @ similarity top-k (SARModel.scala recommendForAll does
+        not filter seen items — its tests filter manually), which
+        RankingAdapterModel relies on for metric parity."""
+        if remove_seen:
+            scores = np.asarray(_sar_scores(
+                jnp.asarray(self.get("affinity")),
+                jnp.asarray(self.get("similarity")),
+                jnp.asarray(self.get("seen"))))
+        else:
+            scores = np.asarray(_affinity_scores(
+                jnp.asarray(self.get("affinity")),
+                jnp.asarray(self.get("similarity"))))
         k = min(num_items, scores.shape[1])
         neg, idx = jax.lax.top_k(jnp.asarray(scores), k)
         top_scores, top_items = np.asarray(neg), np.asarray(idx)

@@ -104,16 +104,20 @@ def test_ranking_metrics_hand_computed():
 
 
 def test_ranking_adapter_and_evaluator():
+    # reference protocol (SARSpec.scala:36-51): the adapter evaluates the
+    # recommender's UNFILTERED top-k against the top-k observed items on
+    # the same data it was fit on — generalization-style held-out checks
+    # must mask seen items themselves (SARModel.recommend_for_all_users
+    # remove_seen=True), which the adapter deliberately does not
     rng = np.random.default_rng(3)
     df = _block_data(rng)
-    train, test = df.random_split([0.8, 0.2], seed=1)
     adapter = RankingAdapter(recommender=SAR(supportThreshold=2), k=10)
-    fitted = adapter.fit(train)
-    out = fitted.transform(test)
+    fitted = adapter.fit(df)
+    out = fitted.transform(df)
     assert set(out.columns) >= {"user", "prediction", "label"}
     ev = RankingEvaluator(k=10, metricName="ndcgAt", nItems=40)
     ndcg = ev.evaluate(out)
-    assert 0.15 < ndcg <= 1.0, ndcg  # block structure is recoverable
+    assert 0.3 < ndcg <= 1.0, ndcg  # own-history recovery scores high
 
 
 def test_ranking_train_validation_split():
