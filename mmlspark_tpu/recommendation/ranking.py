@@ -112,18 +112,24 @@ class AdvancedRankingMetrics:
             distinct.update(pred[:self.k])
         return len(distinct) / max(self.n_items, 1)
 
+    def _table(self):
+        return {"ndcgAt": self.ndcg_at, "map": self.mean_average_precision,
+                "precisionAtk": self.precision_at_k,
+                "recallAtK": self.recall_at_k,
+                "diversityAtK": self.diversity_at_k,
+                "maxDiversity": self.max_diversity,
+                "mrr": self.mean_reciprocal_rank,
+                "fcp": self.fraction_concordant_pairs}
+
     def get(self, name: str) -> float:
-        table = {"ndcgAt": self.ndcg_at, "map": self.mean_average_precision,
-                 "precisionAtk": self.precision_at_k,
-                 "recallAtK": self.recall_at_k,
-                 "diversityAtK": self.diversity_at_k,
-                 "maxDiversity": self.max_diversity,
-                 "mrr": self.mean_reciprocal_rank,
-                 "fcp": self.fraction_concordant_pairs}
+        table = self._table()
         if name not in table:
             raise ValueError(f"unknown ranking metric {name!r}; "
                              f"known: {sorted(table)}")
         return table[name]()
+
+    def all(self) -> Dict[str, float]:
+        return {name: fn() for name, fn in self._table().items()}
 
 
 class RankingEvaluator(Evaluator):
@@ -136,11 +142,20 @@ class RankingEvaluator(Evaluator):
                              "column of recommended item lists", "prediction")
     labelCol = _p.Param("labelCol", "column of relevant item lists", "label")
 
-    def evaluate(self, df: DataFrame) -> float:
-        m = AdvancedRankingMetrics(
+    def _metrics(self, df: DataFrame) -> AdvancedRankingMetrics:
+        return AdvancedRankingMetrics(
             df[self.get("predictionCol")], df[self.get("labelCol")],
             self.get("k"), self.get("nItems"))
-        return m.get(self.get("metricName"))
+
+    def evaluate(self, df: DataFrame) -> float:
+        return self._metrics(df).get(self.get("metricName"))
+
+    def get_metrics_map(self, df: DataFrame) -> Dict[str, float]:
+        """Every ranking metric at once (RankingEvaluator.getMetricsMap —
+        the surface RankingEvaluatorSpec drives)."""
+        return self._metrics(df).all()
+
+    getMetricsMap = get_metrics_map
 
     def is_larger_better(self) -> bool:
         return True

@@ -1,0 +1,63 @@
+"""RankingEvaluator parity with RankingEvaluatorSpec's exact constants.
+
+Replicates the reference's four evaluator scenarios
+(RankingEvaluatorSpec.scala:12-83) and pins every asserted value —
+all-hits, all-misses, reversed order (fcp = 1/3: only the middle position
+agrees), and a prediction list longer than the label set (recallAtK and
+precisionAtk halve while ndcg/map stay 1)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.recommendation import RankingEvaluator
+
+
+def _df(pred, label):
+    p = np.empty(1, object)
+    l_ = np.empty(1, object)
+    p[0], l_[0] = list(pred), list(label)
+    return DataFrame({"prediction": p, "label": l_})
+
+
+def _map(pred, label, k, n_items):
+    ev = RankingEvaluator(k=k, nItems=n_items)
+    return ev.get_metrics_map(_df(pred, label))
+
+
+def test_all_true():
+    m = _map([1, 2, 3], [1, 2, 3], k=3, n_items=3)
+    for name in ("map", "maxDiversity", "diversityAtK", "ndcgAt",
+                 "precisionAtk", "mrr", "fcp"):
+        assert m[name] == 1.0, (name, m[name])
+
+
+def test_all_miss():
+    m = _map([4, 5, 6], [1, 2, 3], k=3, n_items=6)
+    assert m["map"] == 0.0
+    assert m["maxDiversity"] == 1.0
+    assert m["diversityAtK"] == 0.5
+    assert m["ndcgAt"] == 0.0
+    assert m["precisionAtk"] == 0.0
+    assert m["mrr"] == 0.0
+    assert m["fcp"] == 0.0
+
+
+def test_order():
+    m = _map([3, 2, 1], [1, 2, 3], k=3, n_items=3)
+    for name in ("map", "maxDiversity", "diversityAtK", "ndcgAt",
+                 "precisionAtk", "mrr"):
+        assert m[name] == 1.0, (name, m[name])
+    assert m["fcp"] == pytest.approx(0.3333333333333333, abs=1e-15)
+
+
+def test_extra():
+    m = _map([1, 2, 3, 4, 5, 6], [1, 2, 3], k=6, n_items=6)
+    assert m["map"] == 1.0
+    assert m["maxDiversity"] == 1.0
+    assert m["diversityAtK"] == 1.0
+    assert m["recallAtK"] == 0.5
+    assert m["ndcgAt"] == 1.0
+    assert m["precisionAtk"] == 0.5
+    assert m["mrr"] == 1.0
+    assert m["fcp"] == 1.0
