@@ -5,8 +5,10 @@ from .dnn import DNNModel, GraphModel, ImageFeaturizer
 from .image import (ImageSetAugmenter, ImageTransformer,
                     ResizeImageTransformer, UnrollImage)
 from .resnet import ModelDownloader, ModelSchema, ResNet, load_params, save_params
-from .transformer import (TransformerEncoderModel, encoder_forward,
-                          init_encoder_params)
+from .transformer import (TransformerClassificationModel,
+                          TransformerEncoderClassifier,
+                          TransformerEncoderModel, encoder_forward,
+                          init_encoder_params, make_tp_dp_train_step)
 
 __all__ = [
     "DNNModel", "GraphModel", "ImageFeaturizer",
@@ -14,4 +16,6 @@ __all__ = [
     "ImageSetAugmenter",
     "ResNet", "ModelDownloader", "ModelSchema", "load_params", "save_params",
     "TransformerEncoderModel", "encoder_forward", "init_encoder_params",
+    "TransformerEncoderClassifier", "TransformerClassificationModel",
+    "make_tp_dp_train_step",
 ]

@@ -24,7 +24,7 @@ import numpy as np
 
 from ...core import params as _p
 from ...core.dataframe import DataFrame
-from ...core.pipeline import Model
+from ...core.pipeline import Estimator, Model
 from ...ops.attention import (attention_reference, flash_attention,
                               ring_attention_sharded)
 
@@ -89,6 +89,257 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
     return x
 
 
+def init_head_params(key, d_model: int, num_out: int):
+    scale = np.sqrt(2.0 / (d_model + num_out))
+    return {"w": jax.random.normal(key, (d_model, num_out)) * scale,
+            "b": jnp.zeros((num_out,))}
+
+
+def _shard_layer(lp, tp_rank, tp, num_heads):
+    """Megatron-style tensor-parallel slice of one encoder layer: qkv/ff1
+    column-parallel (output dim split over the model axis, head-aligned for
+    qkv), proj/ff2 row-parallel (input dim split); LN replicated."""
+    d = lp["qkv"]["w"].shape[0]
+    hd = d // num_heads
+    h_loc = num_heads // tp
+    # qkv.w [D, 3D] column order is (3, H, hd) after the forward reshape —
+    # slice the H dim so each shard owns whole heads
+    qkv_w = lp["qkv"]["w"].reshape(d, 3, num_heads, hd)[
+        :, :, tp_rank * h_loc:(tp_rank + 1) * h_loc]
+    qkv_b = lp["qkv"]["b"].reshape(3, num_heads, hd)[
+        :, tp_rank * h_loc:(tp_rank + 1) * h_loc]
+    dloc = h_loc * hd
+    f = lp["ff1"]["w"].shape[1]
+    floc = f // tp
+    return {
+        "qkv": {"w": qkv_w.reshape(d, 3 * dloc),
+                "b": qkv_b.reshape(3 * dloc)},
+        # row-parallel biases stay REPLICATED (full value on every shard,
+        # added OUTSIDE the psum): a b/tp-per-shard split would receive the
+        # full bias gradient on each fraction and amplify the update by tp
+        "proj": {"w": lp["proj"]["w"][tp_rank * dloc:(tp_rank + 1) * dloc],
+                 "b": lp["proj"]["b"]},
+        "ff1": {"w": lp["ff1"]["w"][:, tp_rank * floc:(tp_rank + 1) * floc],
+                "b": lp["ff1"]["b"][tp_rank * floc:(tp_rank + 1) * floc]},
+        "ff2": {"w": lp["ff2"]["w"][tp_rank * floc:(tp_rank + 1) * floc],
+                "b": lp["ff2"]["b"]},
+        "ln1": lp["ln1"], "ln2": lp["ln2"],
+    }
+
+
+def shard_encoder_params(params, tp_rank: int, tp: int, num_heads: int):
+    return {"layers": [_shard_layer(lp, tp_rank, tp, num_heads)
+                       for lp in params["layers"]]}
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _copy_to_model_shards(x, axis):
+    """Megatron's 'f' operator: identity forward, psum backward. Placed at
+    every column-parallel branch INPUT — each shard's backward only sees its
+    own branch's cotangent, so the residual stream (and everything upstream:
+    layer norms, earlier layers) needs the branch contributions summed over
+    the model axis to receive the full gradient."""
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, g):
+    return (jax.lax.psum(g, axis),)
+
+
+_copy_to_model_shards.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _reduce_from_model_shards(x, axis):
+    """Megatron's 'g' operator: psum forward, identity backward (the
+    cotangent of a sum is replicated to every contributor)."""
+    return jax.lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, g):
+    return (g,)
+
+
+_reduce_from_model_shards.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+def _encoder_forward_tp(params, x, num_heads_local, model_axis,
+                        causal=False):
+    """Encoder forward on tensor-parallel layer shards: attention over the
+    LOCAL heads and MLP over the LOCAL hidden slice, with ONE psum over the
+    model axis per residual branch (the Megatron pattern: column-parallel
+    then row-parallel matmuls, communication only at the row-parallel
+    output, conjugate f/g operators making the per-shard backward exact).
+    Everything else is replicated across the model axis."""
+    b, s, d = x.shape
+    for lp in params["layers"]:
+        h = _copy_to_model_shards(_layer_norm(x, lp["ln1"]), model_axis)
+        dloc = lp["qkv"]["w"].shape[1] // 3
+        hd = dloc // num_heads_local
+        qkv = _apply(lp["qkv"], h).reshape(b, s, 3, num_heads_local, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = attention_reference(q, k, v, causal=causal)
+        part = att.reshape(b, s, dloc) @ lp["proj"]["w"]
+        x = x + _reduce_from_model_shards(part, model_axis) + lp["proj"]["b"]
+        h = _copy_to_model_shards(_layer_norm(x, lp["ln2"]), model_axis)
+        part = jax.nn.gelu(_apply(lp["ff1"], h)) @ lp["ff2"]["w"]
+        x = x + _reduce_from_model_shards(part, model_axis) + lp["ff2"]["b"]
+    return x
+
+
+def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
+                          num_classes: int, causal: bool = False,
+                          data_axis: Optional[str] = None,
+                          model_axis: Optional[str] = None):
+    """One distributed transformer training step over a 2-D (data, model)
+    mesh: batch data-parallel, layers tensor-parallel (Megatron split),
+    Adam, softmax cross-entropy on the mean-pooled encoding.
+
+    No reference analogue — the reference's deep path is inference-only
+    (cntk/CNTKModel.scala evaluates a broadcast frozen graph). Training is
+    TPU-native surface: jax.grad INSIDE shard_map differentiates straight
+    through the tensor-parallel psums (their transpose is the correct
+    replicated cotangent), and gradients psum over the data axis only —
+    tensor-parallel shards own disjoint parameter slices, and replicated
+    LN/head parameters see identical activations on every model shard, so
+    their gradients already agree across the model axis.
+
+    Returns (step, shard_params) where
+      step(local_params, opt_state, x_local, y_local) is shard_map'd over
+      the mesh and jitted; call it with per-device-sharded arrays.
+    """
+    import optax
+    from ...parallel import mesh as meshlib
+    data_axis = data_axis or meshlib.DATA_AXIS
+    model_axis = model_axis or meshlib.MODEL_AXIS
+    tx = optax.adam(learning_rate)
+    from jax.sharding import PartitionSpec as P
+    tp = mesh.shape[model_axis]
+    n_dp = mesh.shape[data_axis]
+    if num_heads % tp:
+        raise ValueError(
+            f"num_heads {num_heads} must divide evenly over the model axis "
+            f"({tp} shards) — tensor-parallel slices own whole heads")
+    nh_loc = num_heads // tp
+
+    def loss_fn(params, x, y):
+        enc = _encoder_forward_tp(params["encoder"], x, nh_loc, model_axis,
+                                  causal)
+        pooled = enc.mean(axis=1)
+        logits = pooled @ params["head"]["w"] + params["head"]["b"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(y, num_classes)
+        # per-shard SUM: the data-axis psum then divides by the global
+        # batch so the result equals the full-batch mean loss
+        return -jnp.sum(onehot * logp)
+
+    def step(params, opt_state, x, y):
+        # params/opt_state arrive with a size-1 leading model-shard axis
+        # (the host-side stack sharded over the model axis) — peel it for
+        # compute, restore it for the output specs
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        opt_state = jax.tree_util.tree_map(lambda a: a[0], opt_state)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        loss = jax.lax.psum(loss, data_axis)
+        grads = jax.lax.psum(grads, data_axis)
+        denom = x.shape[0] * n_dp
+        loss = loss / denom
+        grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        lift = lambda a: a[None]
+        return (jax.tree_util.tree_map(lift, params),
+                jax.tree_util.tree_map(lift, opt_state), loss)
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(model_axis), P(model_axis),
+                  P(data_axis), P(data_axis)),
+        out_specs=(P(model_axis), P(model_axis), P()),
+        check_vma=False)
+
+    def shard_params(full_params, head):
+        """Host-side split of full parameters (+ fresh Adam state) into the
+        per-model-shard stacked layout the step consumes (leading axis =
+        model shards)."""
+        shards = [
+            {"encoder": shard_encoder_params(full_params, r, tp, num_heads),
+             "head": head}
+            for r in range(tp)]
+        opt_shards = [tx.init(s) for s in shards]
+        stack = lambda *xs: jnp.stack(xs)
+        return (jax.tree_util.tree_map(stack, *shards),
+                jax.tree_util.tree_map(stack, *opt_shards))
+
+    return jax.jit(sharded), shard_params
+
+
+def unshard_encoder_params(stacked_encoder, num_heads: int):
+    """Inverse of shard_encoder_params on the stacked (leading axis = model
+    shards) layout: reassemble the full encoder parameter pytree."""
+    layers = []
+    n_layers = len(stacked_encoder["layers"])
+    for i in range(n_layers):
+        lp = stacked_encoder["layers"][i]
+        tp, d, w3 = lp["qkv"]["w"].shape
+        h_loc = num_heads // tp
+        hd = w3 // 3 // h_loc
+        qkv_w = jnp.concatenate(
+            [np.asarray(lp["qkv"]["w"][r]).reshape(d, 3, h_loc, hd)
+             for r in range(tp)], axis=2).reshape(d, 3 * num_heads * hd)
+        qkv_b = jnp.concatenate(
+            [np.asarray(lp["qkv"]["b"][r]).reshape(3, h_loc, hd)
+             for r in range(tp)], axis=1).reshape(3 * num_heads * hd)
+        layers.append({
+            "qkv": {"w": qkv_w, "b": qkv_b},
+            "proj": {"w": jnp.concatenate(list(lp["proj"]["w"]), axis=0),
+                     "b": lp["proj"]["b"][0]},
+            "ff1": {"w": jnp.concatenate(list(lp["ff1"]["w"]), axis=1),
+                    "b": jnp.concatenate(list(lp["ff1"]["b"]), axis=0)},
+            "ff2": {"w": jnp.concatenate(list(lp["ff2"]["w"]), axis=0),
+                    "b": lp["ff2"]["b"][0]},
+            "ln1": {"g": lp["ln1"]["g"][0], "b": lp["ln1"]["b"][0]},
+            "ln2": {"g": lp["ln2"]["g"][0], "b": lp["ln2"]["b"][0]},
+        })
+    return {"layers": layers}
+
+
+def make_single_train_step(num_heads: int, learning_rate: float,
+                           num_classes: int, causal: bool = False):
+    """Unsharded reference trainer (same loss/optimizer as the tp x dp
+    step) — the numerical anchor the distributed step is tested against."""
+    import optax
+    tx = optax.adam(learning_rate)
+
+    def loss_fn(params, x, y):
+        enc = encoder_forward(params["encoder"], x, num_heads, causal,
+                              attention_impl="reference")
+        pooled = enc.mean(axis=1)
+        logits = pooled @ params["head"]["w"] + params["head"]["b"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(jax.nn.one_hot(y, num_classes) * logp,
+                                 axis=-1))
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def init_opt(params):
+        return tx.init(params)
+
+    return step, init_opt
+
+
 class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
     """Sequence scorer: inputCol holds [S, D] float sequences (stacked
     [N, S, D] or object column); outputCol receives the encoded [S, D]
@@ -151,3 +402,159 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
         for i in range(len(df)):
             obj[i] = out[i]
         return df.with_column(self.get("outputCol"), obj)
+
+
+class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
+                                   _p.HasLabelCol):
+    """Train a transformer-encoder classifier over a 2-D (data x model)
+    device mesh: batches data-parallel, layers tensor-parallel
+    (make_tp_dp_train_step), softmax cross-entropy on the mean-pooled
+    encoding, Adam.
+
+    Beyond-reference surface: the reference's deep-learning path only
+    EVALUATES broadcast frozen graphs (cntk/CNTKModel.scala:30-140,
+    SURVEY §2.1) — its training story stops at classical models. This is
+    the TPU-native extension: the same Estimator/Model pipeline contract,
+    with the distributed step exercised by __graft_entry__.dryrun_multichip
+    on the (data, model) mesh.
+    """
+
+    numLayers = _p.Param("numLayers", "encoder layers", 2, int)
+    dModel = _p.Param("dModel", "model width", 32, int)
+    numHeads = _p.Param("numHeads", "attention heads", 4, int)
+    dFF = _p.Param("dFF", "feed-forward width", 64, int)
+    numClasses = _p.Param("numClasses", "output classes (0 = infer)", 0, int)
+    learningRate = _p.Param("learningRate", "Adam learning rate", 1e-3,
+                            float)
+    epochs = _p.Param("epochs", "training epochs", 5, int)
+    batchSize = _p.Param("batchSize", "global batch size", 32, int)
+    causal = _p.Param("causal", "causal masking", False)
+    dataParallel = _p.Param("dataParallel",
+                            "data-parallel mesh extent (0/1 = single device)",
+                            0, int)
+    modelParallel = _p.Param("modelParallel",
+                             "tensor-parallel mesh extent", 1, int)
+    seed = _p.Param("seed", "init/shuffle seed", 0, int)
+
+    def __init__(self, **kw):
+        super().__init__()
+        kw.setdefault("inputCol", "sequence")
+        kw.setdefault("labelCol", "label")
+        self._set(**kw)
+
+    def _sequences(self, df: DataFrame) -> np.ndarray:
+        col = df[self.get("inputCol")]
+        if col.dtype == object:
+            return np.stack([np.asarray(v, np.float32) for v in col])
+        return np.asarray(col, np.float32)
+
+    def _fit(self, df: DataFrame) -> "TransformerClassificationModel":
+        from ...parallel import mesh as meshlib
+        x = self._sequences(df)
+        y = np.asarray(df[self.get("labelCol")]).astype(np.int32)
+        n, s, d = x.shape
+        nc = self.get("numClasses") or int(y.max()) + 1
+        nh = self.get("numHeads")
+        key = jax.random.PRNGKey(self.get("seed"))
+        k_enc, k_head = jax.random.split(key)
+        params = init_encoder_params(k_enc, self.get("numLayers"),
+                                     self.get("dModel"), nh,
+                                     self.get("dFF"))
+        if d != self.get("dModel"):
+            raise ValueError(
+                f"input feature width {d} != dModel {self.get('dModel')}")
+        head = init_head_params(k_head, d, nc)
+
+        dp = self.get("dataParallel") or 1
+        tp = self.get("modelParallel") or 1
+        # cap at the dataset size (and round to the data-parallel extent) so
+        # small datasets still train instead of silently skipping every step
+        bs = min(max(self.get("batchSize"), dp), n)
+        bs -= bs % dp
+        if bs < dp:
+            raise ValueError(
+                f"{n} rows cannot fill a {dp}-way data-parallel batch")
+        rng = np.random.default_rng(self.get("seed"))
+        lr = self.get("learningRate")
+
+        if dp * tp > 1:
+            if nh % tp:
+                raise ValueError(f"numHeads {nh} not divisible by "
+                                 f"modelParallel {tp}")
+            mesh = meshlib.get_mesh(
+                dp * tp, axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS),
+                shape=(dp, tp))
+            step, shard = make_tp_dp_train_step(
+                mesh, nh, lr, nc, self.get("causal"))
+            p_sh, o_sh = shard(params, head)
+            for _ in range(self.get("epochs")):
+                order = rng.permutation(n)
+                for lo in range(0, n - bs + 1, bs):
+                    idx = order[lo:lo + bs]
+                    p_sh, o_sh, loss = step(p_sh, o_sh,
+                                            jnp.asarray(x[idx]),
+                                            jnp.asarray(y[idx]))
+            full = unshard_encoder_params(
+                jax.tree_util.tree_map(np.asarray, p_sh)["encoder"], nh)
+            head_f = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[0], p_sh["head"])
+        else:
+            step, init_opt = make_single_train_step(
+                nh, lr, nc, self.get("causal"))
+            p = {"encoder": params, "head": head}
+            o = init_opt(p)
+            for _ in range(self.get("epochs")):
+                order = rng.permutation(n)
+                for lo in range(0, n - bs + 1, bs):
+                    idx = order[lo:lo + bs]
+                    p, o, loss = step(p, o, jnp.asarray(x[idx]),
+                                      jnp.asarray(y[idx]))
+            full, head_f = p["encoder"], p["head"]
+
+        model = TransformerClassificationModel(
+            weights=jax.tree_util.tree_map(np.asarray, full),
+            head=jax.tree_util.tree_map(np.asarray, head_f))
+        model.set("numHeads", nh)
+        model.set("causal", self.get("causal"))
+        model.set("inputCol", self.get("inputCol"))
+        return model
+
+
+class TransformerClassificationModel(Model, _p.HasInputCol):
+    """Mean-pool + linear head over the fitted encoder; emits prediction
+    and probability columns (the DNNModel/ProbabilisticClassifier output
+    convention)."""
+
+    numHeads = _p.Param("numHeads", "attention heads", 4, int)
+    causal = _p.Param("causal", "causal masking", False)
+    weights = _p.Param("weights", "encoder parameter pytree", None,
+                       complex=True)
+    head = _p.Param("head", "classifier head {w, b}", None, complex=True)
+
+    def __init__(self, weights=None, head=None, **kw):
+        super().__init__()
+        kw.setdefault("inputCol", "sequence")
+        self._set(**kw)
+        if weights is not None:
+            self._set(weights=weights, head=head)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        col = df[self.get("inputCol")]
+        if col.dtype == object:
+            x = np.stack([np.asarray(v, np.float32) for v in col])
+        else:
+            x = np.asarray(col, np.float32)
+
+        @jax.jit
+        def fwd(p, h, xb):
+            enc = encoder_forward(p, xb, self.get("numHeads"),
+                                  self.get("causal"),
+                                  attention_impl="reference")
+            logits = enc.mean(axis=1) @ h["w"] + h["b"]
+            return jax.nn.softmax(logits, axis=-1)
+
+        proba = np.asarray(fwd(self.get("weights"), self.get("head"),
+                               jnp.asarray(x)))
+        out = df.with_column("probability", proba)
+        return out.with_column("prediction",
+                               proba.argmax(axis=1).astype(np.float64))
