@@ -89,6 +89,14 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
     return x
 
 
+def _stack_sequences(col) -> np.ndarray:
+    """Object column of [S, D] arrays (or an already-stacked [N, S, D]
+    column) -> float32 [N, S, D]."""
+    if col.dtype == object:
+        return np.stack([np.asarray(v, np.float32) for v in col])
+    return np.asarray(col, np.float32)
+
+
 def init_head_params(key, d_model: int, num_out: int):
     scale = np.sqrt(2.0 / (d_model + num_out))
     return {"w": jax.random.normal(key, (d_model, num_out)) * scale,
@@ -110,6 +118,11 @@ def _shard_layer(lp, tp_rank, tp, num_heads):
         :, tp_rank * h_loc:(tp_rank + 1) * h_loc]
     dloc = h_loc * hd
     f = lp["ff1"]["w"].shape[1]
+    if f % tp:
+        raise ValueError(
+            f"feed-forward width {f} must divide evenly over the model "
+            f"axis ({tp} shards) — a silent f//tp truncation would drop "
+            f"hidden units")
     floc = f // tp
     return {
         "qkv": {"w": qkv_w.reshape(d, 3 * dloc),
@@ -388,12 +401,7 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
                                causal=causal))(p, x)
 
     def transform(self, df: DataFrame) -> DataFrame:
-        col = df[self.get("inputCol")]
-        if col.dtype == object:
-            x = jnp.asarray(np.stack([np.asarray(v, np.float32)
-                                      for v in col]))
-        else:
-            x = jnp.asarray(np.asarray(col, np.float32))
+        x = jnp.asarray(_stack_sequences(df[self.get("inputCol")]))
         out = np.asarray(self._forward(x))
         if self.get("pool") == "mean":
             out = out.mean(axis=1)
@@ -443,10 +451,7 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
         self._set(**kw)
 
     def _sequences(self, df: DataFrame) -> np.ndarray:
-        col = df[self.get("inputCol")]
-        if col.dtype == object:
-            return np.stack([np.asarray(v, np.float32) for v in col])
-        return np.asarray(col, np.float32)
+        return _stack_sequences(df[self.get("inputCol")])
 
     def _fit(self, df: DataFrame) -> "TransformerClassificationModel":
         from ...parallel import mesh as meshlib
@@ -539,11 +544,10 @@ class TransformerClassificationModel(Model, _p.HasInputCol):
             self._set(weights=weights, head=head)
 
     def transform(self, df: DataFrame) -> DataFrame:
-        col = df[self.get("inputCol")]
-        if col.dtype == object:
-            x = np.stack([np.asarray(v, np.float32) for v in col])
-        else:
-            x = np.asarray(col, np.float32)
+        if self.get("weights") is None or self.get("head") is None:
+            raise ValueError("TransformerClassificationModel needs fitted "
+                             "`weights` and `head` parameter pytrees")
+        x = _stack_sequences(df[self.get("inputCol")])
 
         @jax.jit
         def fwd(p, h, xb):
@@ -558,3 +562,56 @@ class TransformerClassificationModel(Model, _p.HasInputCol):
         out = df.with_column("probability", proba)
         return out.with_column("prediction",
                                proba.argmax(axis=1).astype(np.float64))
+
+
+def make_sp_train_step(mesh, num_heads: int, learning_rate: float,
+                       num_classes: int, causal: bool = False,
+                       seq_axis: Optional[str] = None):
+    """Sequence-parallel transformer training over the mesh: the SEQUENCE
+    axis is sharded (the long-context regime — activations for contexts far
+    beyond one chip's HBM), parameters replicated, attention via the
+    ppermute ring (ops/attention.ring_attention_sharded), whose reverse-mode
+    transpose JAX derives exactly (ppermute transposes to the inverse
+    rotation, so gradients ride the ring backwards).
+
+    Gradient bookkeeping: encoder parameters act on LOCAL positions, so each
+    shard holds a partial gradient — psum over the sequence axis. The head
+    consumes the globally-pooled (replicated) encoding, so its gradients
+    are already identical on every shard and must NOT be summed. The global
+    mean-pool uses the psum-forward/identity-backward 'g' operator so the
+    per-shard backward stays exact.
+
+    Returns (step, init_opt): step(params, opt_state, x_sharded, y) with
+    x [B, S, D] sharded on S over the axis; params/opt_state replicated.
+    """
+    import optax
+    from ...parallel import mesh as meshlib
+    from jax.sharding import PartitionSpec as P
+    seq_axis = seq_axis or meshlib.DATA_AXIS
+    n_sp = mesh.shape[seq_axis]
+    tx = optax.adam(learning_rate)
+
+    def loss_fn(params, x_local, y):
+        enc = encoder_forward(params["encoder"], x_local, num_heads, causal,
+                              axis_name=seq_axis)
+        s_glob = x_local.shape[1] * n_sp
+        pooled = _reduce_from_model_shards(enc.sum(axis=1),
+                                           seq_axis) / s_glob
+        logits = pooled @ params["head"]["w"] + params["head"]["b"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(jax.nn.one_hot(y, num_classes) * logp,
+                                 axis=-1))
+
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        grads = {"encoder": jax.lax.psum(grads["encoder"], seq_axis),
+                 "head": grads["head"]}
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(None, seq_axis, None), P()),
+        out_specs=(P(), P(), P()), check_vma=False)
+
+    return jax.jit(sharded), tx.init

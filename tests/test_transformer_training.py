@@ -214,3 +214,85 @@ def test_rejects_indivisible_heads():
         TransformerEncoderClassifier(
             numLayers=1, dModel=8, numHeads=3, dFF=16, epochs=1,
             dataParallel=2, modelParallel=2).fit(df)
+
+
+def test_sp_gradients_match_single_device():
+    """Sequence-parallel training gate: gradients through the ppermute ring
+    (reverse-mode rides the ring backwards) at identical parameters must
+    match the dense single-device formulation."""
+    from mmlspark_tpu.models.deep.transformer import (encoder_forward,
+                                                      make_sp_train_step)
+    nh, nc = 2, 3
+    rng = np.random.default_rng(17)
+    x = rng.normal(size=(4, 16, 8)).astype(np.float32)   # S=16 over 8 shards
+    y = np.argmax(x.mean(axis=1)[:, :nc], axis=1).astype(np.int64)
+    key = jax.random.PRNGKey(4)
+    enc = init_encoder_params(key, 2, 8, nh, 16)
+    head = init_head_params(jax.random.fold_in(key, 5), 8, nc)
+    p0 = {"encoder": enc, "head": head}
+
+    def single_loss(p, xb, yb):
+        e = encoder_forward(p["encoder"], xb, nh, attention_impl="reference")
+        logits = e.mean(axis=1) @ p["head"]["w"] + p["head"]["b"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(jax.nn.one_hot(yb, nc) * logp, axis=-1))
+
+    l0, g_single = jax.value_and_grad(single_loss)(p0, jnp.asarray(x),
+                                                   jnp.asarray(y))
+
+    mesh = meshlib.get_mesh(8)
+    step, init_opt = make_sp_train_step(mesh, nh, 1e-2, nc)
+    o0 = init_opt(p0)
+    p1, o1, loss = step(p0, o0, jnp.asarray(x), jnp.asarray(y))
+    assert float(loss) == pytest.approx(float(l0), rel=1e-5)
+
+    # direct gradient comparison (a post-Adam param diff would amplify
+    # fp-level grad noise through sign(g) in the eps regime): rebuild the
+    # step's gradient computation and psum encoder grads over the ring axis
+    from jax.sharding import PartitionSpec as P
+    from mmlspark_tpu.models.deep.transformer import \
+        _reduce_from_model_shards
+
+    def sp_loss(p, x_local, yb):
+        e = encoder_forward(p["encoder"], x_local, nh,
+                            axis_name=meshlib.DATA_AXIS)
+        pooled = _reduce_from_model_shards(e.sum(axis=1),
+                                           meshlib.DATA_AXIS) / 16
+        logits = pooled @ p["head"]["w"] + p["head"]["b"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(jax.nn.one_hot(yb, nc) * logp, axis=-1))
+
+    def sp_grads(p, xb, yb):
+        g = jax.grad(sp_loss)(p, xb, yb)
+        return {"encoder": jax.lax.psum(g["encoder"], meshlib.DATA_AXIS),
+                "head": g["head"]}
+
+    g_sp = jax.jit(jax.shard_map(
+        sp_grads, mesh=mesh,
+        in_specs=(P(), P(None, meshlib.DATA_AXIS, None), P()),
+        out_specs=P(), check_vma=False))(p0, jnp.asarray(x),
+                                         jnp.asarray(y))
+    for a, b in zip(jax.tree_util.tree_leaves(g_single),
+                    jax.tree_util.tree_leaves(
+                        jax.tree_util.tree_map(np.asarray, g_sp))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=2e-6)
+
+
+def test_sp_loss_decreases():
+    from mmlspark_tpu.models.deep.transformer import make_sp_train_step
+    nh, nc = 2, 2
+    rng = np.random.default_rng(19)
+    x = rng.normal(size=(8, 8, 8)).astype(np.float32)
+    y = (x.mean(axis=1)[:, 0] > 0).astype(np.int64)
+    key = jax.random.PRNGKey(6)
+    p = {"encoder": init_encoder_params(key, 1, 8, nh, 16),
+         "head": init_head_params(jax.random.fold_in(key, 8), 8, nc)}
+    mesh = meshlib.get_mesh(8)
+    step, init_opt = make_sp_train_step(mesh, nh, 1e-2, nc)
+    o = init_opt(p)
+    losses = []
+    for _ in range(12):
+        p, o, loss = step(p, o, jnp.asarray(x), jnp.asarray(y))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
