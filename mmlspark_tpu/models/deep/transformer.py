@@ -61,17 +61,40 @@ def _apply(p, x):
     return x @ p["w"] + p["b"]
 
 
+def sinusoidal_positions(start: jax.Array, s: int, d: int) -> jax.Array:
+    """[s, d] sinusoidal positional encodings for GLOBAL positions
+    [start, start+s) — `start` may be traced, so a sequence-parallel shard
+    encodes its own slice of the global position space."""
+    pos = start + jnp.arange(s)[:, None].astype(jnp.float32)
+    dim = jnp.arange(0, d, 2)[None, :].astype(jnp.float32)
+    angle = pos / jnp.power(10000.0, dim / d)
+    pe = jnp.zeros((s, d))
+    pe = pe.at[:, 0::2].set(jnp.sin(angle))
+    pe = pe.at[:, 1::2].set(jnp.cos(angle[:, : d // 2]))
+    return pe
+
+
 def encoder_forward(params, x: jax.Array, num_heads: int,
                     causal: bool = False,
                     axis_name: Optional[str] = None,
-                    attention_impl: str = "flash") -> jax.Array:
+                    attention_impl: str = "flash",
+                    positional: bool = False) -> jax.Array:
     """Pre-LN encoder stack. x: [B, S, D] (shard-local S when axis_name is
     set — every non-attention op is position-wise, so only attention needs
     the ring). Single-device attention uses the fused Pallas flash kernel
     (no [S, S] score matrix in HBM); attention_impl="reference" keeps the
-    dense XLA path for cross-checks."""
+    dense XLA path for cross-checks. positional=True adds sinusoidal
+    encodings — under sequence parallelism each shard offsets by its
+    GLOBAL start position, so sharded and dense runs encode identically."""
     b, s, d = x.shape
     hd = d // num_heads
+    if positional:
+        if axis_name is None:
+            start = jnp.int32(0)
+        else:
+            start = jax.lax.axis_index(axis_name) * s
+        x = x + sinusoidal_positions(start.astype(jnp.float32), s,
+                                     d)[None, :, :]
     for lp in params["layers"]:
         h = _layer_norm(x, lp["ln1"])
         qkv = _apply(lp["qkv"], h).reshape(b, s, 3, num_heads, hd)
@@ -365,6 +388,10 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
 
     numHeads = _p.Param("numHeads", "attention heads", 4, int)
     causal = _p.Param("causal", "causal (autoregressive) masking", False)
+    positionalEncoding = _p.Param(
+        "positionalEncoding", "add sinusoidal positional encodings (global "
+        "positions — sequence-parallel shards offset by their slice start)",
+        False)
     pool = _p.Param("pool", "output pooling: none | mean", "none")
     numTasks = _p.Param("numTasks",
                         "sequence-parallel shards; 0/1 = single device", 0,
@@ -378,27 +405,40 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
         kw.setdefault("outputCol", "encoded")
         self._set(**kw)
 
-    def _forward(self, x: jax.Array) -> jax.Array:
+    def _compiled(self):
+        """Cache the jitted forward per static config — rebuilding the
+        shard_map/jit closure every call would retrace + recompile on each
+        transform."""
         from ...parallel import mesh as meshlib
-        p = self.get("weights")
-        if p is None:
-            raise ValueError("TransformerEncoderModel needs `weights` "
-                             "(init_encoder_params or a loaded checkpoint)")
         nh = self.get("numHeads")
         causal = self.get("causal")
         ndev = self.get("numTasks")
+        pos = self.get("positionalEncoding")
+        key = (nh, causal, ndev, pos)
+        cached = getattr(self, "_fwd_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         if ndev and ndev > 1:
             from jax.sharding import PartitionSpec as P
             mesh = meshlib.get_mesh(ndev)
             axis = meshlib.DATA_AXIS
-            fn = jax.shard_map(
+            fn = jax.jit(jax.shard_map(
                 partial(encoder_forward, num_heads=nh, causal=causal,
-                        axis_name=axis),
+                        axis_name=axis, positional=pos),
                 mesh=mesh, in_specs=(P(), P(None, axis, None)),
-                out_specs=P(None, axis, None), check_vma=False)
-            return jax.jit(fn)(p, x)
-        return jax.jit(partial(encoder_forward, num_heads=nh,
-                               causal=causal))(p, x)
+                out_specs=P(None, axis, None), check_vma=False))
+        else:
+            fn = jax.jit(partial(encoder_forward, num_heads=nh,
+                                 causal=causal, positional=pos))
+        self._fwd_cache = (key, fn)
+        return fn
+
+    def _forward(self, x: jax.Array) -> jax.Array:
+        p = self.get("weights")
+        if p is None:
+            raise ValueError("TransformerEncoderModel needs `weights` "
+                             "(init_encoder_params or a loaded checkpoint)")
+        return self._compiled()(p, x)
 
     def transform(self, df: DataFrame) -> DataFrame:
         x = jnp.asarray(_stack_sequences(df[self.get("inputCol")]))
@@ -566,7 +606,8 @@ class TransformerClassificationModel(Model, _p.HasInputCol):
 
 def make_sp_train_step(mesh, num_heads: int, learning_rate: float,
                        num_classes: int, causal: bool = False,
-                       seq_axis: Optional[str] = None):
+                       seq_axis: Optional[str] = None,
+                       positional: bool = False):
     """Sequence-parallel transformer training over the mesh: the SEQUENCE
     axis is sharded (the long-context regime — activations for contexts far
     beyond one chip's HBM), parameters replicated, attention via the
@@ -593,7 +634,7 @@ def make_sp_train_step(mesh, num_heads: int, learning_rate: float,
 
     def loss_fn(params, x_local, y):
         enc = encoder_forward(params["encoder"], x_local, num_heads, causal,
-                              axis_name=seq_axis)
+                              axis_name=seq_axis, positional=positional)
         s_glob = x_local.shape[1] * n_sp
         pooled = _reduce_from_model_shards(enc.sum(axis=1),
                                            seq_axis) / s_glob

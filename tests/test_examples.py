@@ -34,3 +34,7 @@ def test_hyperparam_sweep():
 def test_serving():
     out = _run("serving.py")
     assert "prediction" in out
+
+
+def test_distributed_transformer():
+    assert _run("distributed_transformer.py") > 0.7

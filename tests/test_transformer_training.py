@@ -296,3 +296,26 @@ def test_sp_loss_decreases():
         p, o, loss = step(p, o, jnp.asarray(x), jnp.asarray(y))
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_positional_encoding_sharded_matches_dense():
+    """positionalEncoding under sequence parallelism: each shard offsets by
+    its GLOBAL start position, so the 8-shard ring encoding must equal the
+    dense single-device encoding of the same sequence."""
+    from mmlspark_tpu.models.deep.transformer import TransformerEncoderModel
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(2, 32, 8)).astype(np.float32)
+    key = jax.random.PRNGKey(9)
+    w = init_encoder_params(key, 2, 8, 2, 16)
+    dense = TransformerEncoderModel(numHeads=2, weights=w,
+                                    positionalEncoding=True)
+    ringm = TransformerEncoderModel(numHeads=2, weights=w, numTasks=8,
+                                    positionalEncoding=True)
+    df = DataFrame({"sequence": np.asarray(x)})
+    a = np.stack(list(dense.transform(df)["encoded"]))
+    b = np.stack(list(ringm.transform(df)["encoded"]))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    # and positional encodings actually change the output
+    plain = TransformerEncoderModel(numHeads=2, weights=w)
+    c = np.stack(list(plain.transform(df)["encoded"]))
+    assert np.abs(a - c).max() > 1e-3
