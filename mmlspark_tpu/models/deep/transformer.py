@@ -583,22 +583,34 @@ class TransformerClassificationModel(Model, _p.HasInputCol):
         if weights is not None:
             self._set(weights=weights, head=head)
 
+    def _compiled(self):
+        """Cache the jitted forward per static config — defining @jax.jit
+        inside transform would retrace + recompile on every call (the same
+        cache discipline as TransformerEncoderModel._compiled)."""
+        nh, causal = self.get("numHeads"), self.get("causal")
+        key = (nh, causal)
+        cached = getattr(self, "_fwd_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+
+        @jax.jit
+        def fwd(p, h, xb):
+            enc = encoder_forward(p, xb, nh, causal,
+                                  attention_impl="reference")
+            logits = enc.mean(axis=1) @ h["w"] + h["b"]
+            return jax.nn.softmax(logits, axis=-1)
+
+        self._fwd_cache = (key, fwd)
+        return fwd
+
     def transform(self, df: DataFrame) -> DataFrame:
         if self.get("weights") is None or self.get("head") is None:
             raise ValueError("TransformerClassificationModel needs fitted "
                              "`weights` and `head` parameter pytrees")
         x = _stack_sequences(df[self.get("inputCol")])
-
-        @jax.jit
-        def fwd(p, h, xb):
-            enc = encoder_forward(p, xb, self.get("numHeads"),
-                                  self.get("causal"),
-                                  attention_impl="reference")
-            logits = enc.mean(axis=1) @ h["w"] + h["b"]
-            return jax.nn.softmax(logits, axis=-1)
-
-        proba = np.asarray(fwd(self.get("weights"), self.get("head"),
-                               jnp.asarray(x)))
+        proba = np.asarray(self._compiled()(self.get("weights"),
+                                            self.get("head"),
+                                            jnp.asarray(x)))
         out = df.with_column("probability", proba)
         return out.with_column("prediction",
                                proba.argmax(axis=1).astype(np.float64))

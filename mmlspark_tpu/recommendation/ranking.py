@@ -211,12 +211,15 @@ class RankingAdapterModel(Model):
         recommendForAllUsers with seen items INCLUDED."""
         ucol, icol = self.get("userCol"), self.get("itemCol")
         k = self.get("k")
-        import inspect
         inner = self.get("innerModel")
-        sig = inspect.signature(inner.recommend_for_all_users)
-        if "remove_seen" in sig.parameters:
+        try:
             recs = inner.recommend_for_all_users(k, remove_seen=False)
-        else:               # recommender without a seen-filter option
+        except TypeError as e:
+            # only fall back when the TypeError is the signature rejecting
+            # the kwarg — a TypeError raised INSIDE a supporting recommender
+            # must propagate, not silently flip to the seen-filtered path
+            if "remove_seen" not in str(e):
+                raise
             recs = inner.recommend_for_all_users(k)
         rec_map: Dict[int, List] = {
             int(u): [r["item"] for r in rl]

@@ -113,13 +113,33 @@ def _java_fmt_to_strptime(fmt: str) -> str:
     (SAR.scala startTimeFormat/activityTimeFormat defaults and the TLC
     test's yyyy/MM/dd'T'h:mm:ss) into a strptime pattern. 'h' is Java's
     12-hour field, but SimpleDateFormat parses leniently so h:mm:ss accepts
-    24-hour values — %H reproduces that for the formats in play."""
-    out = fmt.replace("'T'", "T")
+    24-hour values — %H reproduces that for the formats in play.
+
+    Pattern letters outside the supported subset (e.g. 'a' AM/PM, 'z'
+    timezone) raise rather than silently parsing to wrong epoch seconds.
+    """
+    import re
+    literals: list = []
+
+    def _hide(m):
+        literals.append(m.group(1))
+        return "\x00%d\x00" % (len(literals) - 1)
+
+    # SimpleDateFormat: '' is a literal apostrophe (inside or outside a
+    # quoted section) — protect it before the quoted-section scan
+    out = re.sub(r"'([^']*)'", _hide, fmt.replace("''", "\x01"))
     for java, py in (("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
                      ("HH", "%H"), ("hh", "%H"), ("h", "%H"),
                      ("mm", "%M"), ("ss", "%S")):
         out = out.replace(java, py)
-    return out
+    bad = sorted(set(re.findall(r"[A-Za-z]", re.sub(r"%[A-Za-z]", "", out))))
+    if bad:
+        raise ValueError(
+            f"unsupported SimpleDateFormat token(s) {bad} in {fmt!r}; "
+            "supported subset: yyyy MM dd HH hh h mm ss + quoted literals")
+    for i, lit in enumerate(literals):
+        out = out.replace("\x00%d\x00" % i, lit)
+    return out.replace("\x01", "'")
 
 
 def _parse_java_datetime(value: str, fmt: str) -> float:

@@ -146,3 +146,18 @@ def test_recommendation_indexer():
     df2 = DataFrame({"user": np.array(["zzz"], dtype=object),
                      "item": np.array(["x"], dtype=object)})
     assert model.transform(df2)["user_idx"][0] == -1
+
+
+def test_java_datetime_format_rejects_unsupported_tokens():
+    """A SimpleDateFormat outside the supported subset must raise, not
+    silently parse to wrong epoch seconds (e.g. 'a' AM/PM marker)."""
+    from mmlspark_tpu.recommendation.sar import _java_fmt_to_strptime
+    assert _java_fmt_to_strptime("yyyy/MM/dd'T'h:mm:ss") == "%Y/%m/%dT%H:%M:%S"
+    assert _java_fmt_to_strptime("yyyyMMdd") == "%Y%m%d"
+    with pytest.raises(ValueError, match="unsupported"):
+        _java_fmt_to_strptime("yyyy/MM/dd h:mm:ss a")
+    with pytest.raises(ValueError, match="unsupported"):
+        _java_fmt_to_strptime("yyyy-MM-dd'T'HH:mm:ssz")
+    from mmlspark_tpu.recommendation.sar import _java_fmt_to_strptime as f
+    assert f("yyyy''MM") == "%Y'%m"
+    assert f("yyyy'T'MM") == "%YT%m"
