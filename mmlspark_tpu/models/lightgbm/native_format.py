@@ -30,7 +30,8 @@ def _parse_tree_block(lines: Dict[str, str]):
         return num_leaves, (np.zeros(0, int), np.zeros(0), np.zeros(0, int),
                             np.zeros(0, int), lv, lcnt,
                             np.zeros(0, bool), np.zeros((0, 1), bool),
-                            np.zeros(0, bool), np.zeros(0, int))
+                            np.zeros(0, bool), np.zeros(0, int),
+                            np.zeros(0))
     sf = np.array([int(v) for v in lines["split_feature"].split()])
     thr = np.array([float(v) for v in lines["threshold"].split()])
     lc = np.array([int(v) for v in lines["left_child"].split()])
@@ -38,6 +39,8 @@ def _parse_tree_block(lines: Dict[str, str]):
     lv = np.array([float(v) for v in lines["leaf_value"].split()])
     lcnt = (np.array([float(v) for v in lines["leaf_count"].split()])
             if "leaf_count" in lines else np.zeros(len(lv)))
+    gain = (np.array([float(v) for v in lines["split_gain"].split()])
+            if "split_gain" in lines else np.zeros(len(sf)))
     # decision_type (upstream tree.h): bit0 categorical, bit1 default_left,
     # bits2-3 missing type (0 None, 1 Zero, 2 NaN)
     dec = (np.array([int(v) for v in lines["decision_type"].split()])
@@ -65,14 +68,14 @@ def _parse_tree_block(lines: Dict[str, str]):
     else:
         masks = np.zeros((n_splits, 1), bool)
     return num_leaves, (sf, thr, lc, rc, lv, lcnt, is_cat, masks,
-                        default_left, missing_type)
+                        default_left, missing_type, gain)
 
 
 def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int,
                     mask_width: int = 1):
     """Convert LightGBM node arrays to padded slot/replay arrays."""
     (sf, thr, lc, rc, lv, lcnt, node_cat, node_masks, node_dl,
-     node_mt) = arrays
+     node_mt, node_gain) = arrays
     n_splits = len(sf)
     lcap = max_leaves
     split_slot = np.zeros(lcap - 1, np.int32)
@@ -105,6 +108,7 @@ def _nodes_to_slots(num_leaves: int, arrays, max_leaves: int,
         split_feat[step] = sf[node]
         thresholds[step] = thr[node]
         split_valid[step] = True
+        split_gain[step] = node_gain[node]
         split_dl[step] = bool(node_dl[node])
         split_mt[step] = int(node_mt[node])
         if node_cat[node]:

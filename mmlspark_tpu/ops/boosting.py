@@ -808,6 +808,58 @@ def _goss_weights(key, g_abs, cfg: GBDTConfig):
     return w.astype(jnp.float32)
 
 
+def binned_weighted_auc(scores, y, w, k=1024, axis_name=None):
+    """Distributed weighted AUC via a fixed score histogram: per-bin
+    positive/negative weights are psum-able across shards, and the ROC
+    integral over k sigmoid-space bins (with the within-bin tie correction
+    pos*neg/2) is exact to bin resolution. This is the shard-decomposable
+    formulation — exact rank-based AUC would need a global sort
+    (replaces upstream's in-C++ exact AUC, LightGBMBooster.scala eval path).
+
+    Error bound (pinned by tests/test_binned_auc.py): only pairs whose
+    scores land in the SAME sigmoid-space bin can be mis-scored — each
+    same-bin (pos, neg) pair contributes 0.5 instead of its exact 0, 0.5,
+    or 1 — so
+
+        |binned - exact| <= 0.5 * sum_b pos_b * neg_b / (P * N)
+
+    where pos_b/neg_b are the per-bin positive/negative weights and P, N
+    the totals. With k=1024, any score distribution spread over more than
+    a few bins (sigmoid-space width >> 1e-3) makes the bound negligible;
+    the adversarial extreme — ALL scores inside one bin — collapses the
+    estimate to 0.5 exactly as the bound predicts. Early stopping on
+    metric='auc' consumes this estimator, so improvements smaller than the
+    bound at near-constant score distributions are not trustworthy signal.
+    """
+    chunk = 8192
+    p = jax.nn.sigmoid(scores)
+    b = jnp.clip((p * k).astype(jnp.int32), 0, k - 1)
+    pn = jnp.stack([w * y, w * (1.0 - y)], axis=1)       # [N, 2]
+    pad = (-b.shape[0]) % chunk
+    if pad:
+        b = jnp.pad(b, (0, pad))
+        pn = jnp.pad(pn, ((0, pad), (0, 0)))             # zero weight
+    bc = b.reshape(-1, chunk)
+    pnc = pn.reshape(-1, chunk, 2)
+    iota = jnp.arange(k, dtype=jnp.int32)
+
+    def body(acc, xs):
+        bt, pt = xs
+        oh = (bt[:, None] == iota[None, :]).astype(jnp.bfloat16)
+        return acc + jnp.dot(oh.T, pt.astype(jnp.bfloat16),
+                             preferred_element_type=jnp.float32), None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((k, 2), jnp.float32),
+                          (bc, pnc))
+    if axis_name:
+        acc = jax.lax.psum(acc, axis_name)
+    pos, neg = acc[:, 0], acc[:, 1]
+    cum_neg = jnp.cumsum(neg) - neg                      # negatives below
+    num = jnp.sum(pos * cum_neg + pos * neg * 0.5)
+    den = jnp.maximum(jnp.sum(pos) * jnp.sum(neg), 1e-12)
+    return num / den
+
+
 def make_train_fn(cfg: GBDTConfig):
     """Build the jit-able full training program.
 
@@ -846,38 +898,8 @@ def make_train_fn(cfg: GBDTConfig):
         return psum(jnp.sum(v * w)) / jnp.maximum(psum(jnp.sum(w)), 1e-12)
 
     def binned_auc(scores, y, w, k=1024):
-        """Distributed weighted AUC via a fixed score histogram: per-bin
-        positive/negative weights are psum-able across shards, and the ROC
-        integral over 1024 sigmoid-space bins (with the within-bin tie
-        correction pos*neg/2) is exact to bin resolution. This is the
-        shard-decomposable formulation — exact rank-based AUC would need a
-        global sort."""
-        chunk = 8192
-        p = jax.nn.sigmoid(scores)
-        b = jnp.clip((p * k).astype(jnp.int32), 0, k - 1)
-        pn = jnp.stack([w * y, w * (1.0 - y)], axis=1)       # [N, 2]
-        pad = (-b.shape[0]) % chunk
-        if pad:
-            b = jnp.pad(b, (0, pad))
-            pn = jnp.pad(pn, ((0, pad), (0, 0)))             # zero weight
-        bc = b.reshape(-1, chunk)
-        pnc = pn.reshape(-1, chunk, 2)
-        iota = jnp.arange(k, dtype=jnp.int32)
-
-        def body(acc, xs):
-            bt, pt = xs
-            oh = (bt[:, None] == iota[None, :]).astype(jnp.bfloat16)
-            return acc + jnp.dot(oh.T, pt.astype(jnp.bfloat16),
-                                 preferred_element_type=jnp.float32), None
-
-        acc, _ = jax.lax.scan(body, jnp.zeros((k, 2), jnp.float32),
-                              (bc, pnc))
-        acc = psum(acc)
-        pos, neg = acc[:, 0], acc[:, 1]
-        cum_neg = jnp.cumsum(neg) - neg                      # negatives below
-        num = jnp.sum(pos * cum_neg + pos * neg * 0.5)
-        den = jnp.maximum(jnp.sum(pos) * jnp.sum(neg), 1e-12)
-        return num / den
+        return binned_weighted_auc(scores, y, w, k=k,
+                                   axis_name=cfg.axis_name)
 
     def metric_of(scores, y, w):
         # global (cross-shard) metric via weighted-mean decomposition
