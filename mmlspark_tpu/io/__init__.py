@@ -7,7 +7,8 @@ from .http import (AsyncClient, CustomInputParser, CustomOutputParser,
                    HTTPRequestData, HTTPResponseData, HTTPTransformer,
                    JSONInputParser, JSONOutputParser, SimpleHTTPTransformer,
                    StringOutputParser, send_with_retries)
-from .serving import ServingServer, ServingUDFs, make_reply, parse_request
+from .serving import (HTTPStreamSource, ServingServer, ServingUDFs,
+                      make_reply, parse_request)
 from .shared import (PartitionConsolidator, RateLimiter, SharedSingleton,
                      SharedVariable)
 from .streaming import FileStreamSource, StreamingQuery
@@ -21,7 +22,8 @@ __all__ = [
     "SimpleHTTPTransformer", "JSONInputParser", "JSONOutputParser",
     "StringOutputParser", "CustomInputParser", "CustomOutputParser",
     "AsyncClient", "send_with_retries",
-    "ServingServer", "ServingUDFs", "parse_request", "make_reply",
+    "ServingServer", "ServingUDFs", "HTTPStreamSource", "parse_request",
+    "make_reply",
     "SharedSingleton", "SharedVariable", "PartitionConsolidator",
     "RateLimiter",
     "read_binary_files", "read_images", "decode_image", "write_to_powerbi",

@@ -43,6 +43,48 @@ class _PendingRequest:
         self.response: Optional[Dict[str, Any]] = None
 
 
+def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
+                        request_timeout: float, host: str,
+                        port: int) -> ThreadingHTTPServer:
+    """Shared HTTP front door for ServingServer and HTTPStreamSource: POST
+    bodies become _PendingRequests handed to `enqueue`; the socket thread
+    blocks on the request's event until a dispatcher/commit sets the reply
+    (JVMSharedServer's handler role, DistributedHTTPSource.scala:151-168).
+    Returns the bound (but not yet serving) server; callers start
+    `serve_forever` on a daemon thread."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            pend = _PendingRequest(str(_uuid.uuid4()), body,
+                                   dict(self.headers), self.path)
+            enqueue(pend)
+            ok = pend.event.wait(request_timeout)
+            if not ok:
+                self.send_response(504)
+                self.end_headers()
+                return
+            resp = pend.response
+            self.send_response(resp["status"])
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(resp["body"])))
+            self.end_headers()
+            self.wfile.write(resp["body"])
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    class Server(ThreadingHTTPServer):
+        # burst tolerance: default backlog of 5 resets concurrent connects
+        # (the reference uses 100-thread executor pools —
+        # DistributedHTTPSource.scala)
+        request_queue_size = 128
+        daemon_threads = True
+
+    return Server((host, port), Handler)
+
+
 def parse_request(requests: List[_PendingRequest],
                   vector_cols=()) -> DataFrame:
     """JSON request bodies -> DataFrame (IOImplicits.parseRequest:126+).
@@ -110,38 +152,9 @@ class ServingServer:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingServer":
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_POST(self):
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                pend = _PendingRequest(str(_uuid.uuid4()), body,
-                                       dict(self.headers), self.path)
-                outer._queue.put(pend)
-                ok = pend.event.wait(outer.request_timeout)
-                if not ok:
-                    self.send_response(504)
-                    self.end_headers()
-                    return
-                resp = pend.response
-                self.send_response(resp["status"])
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(resp["body"])))
-                self.end_headers()
-                self.wfile.write(resp["body"])
-
-            def log_message(self, *a):  # quiet
-                pass
-
-        class Server(ThreadingHTTPServer):
-            # burst tolerance: default backlog of 5 resets concurrent
-            # connects (the reference uses 100-thread executor pools —
-            # DistributedHTTPSource.scala)
-            request_queue_size = 128
-            daemon_threads = True
-
-        self._httpd = Server((self.host, self.port), Handler)
+        self._httpd = _make_http_listener(self._queue.put,
+                                          self.request_timeout,
+                                          self.host, self.port)
         self.port = self._httpd.server_address[1]  # resolve port 0
         t_http = threading.Thread(target=self._httpd.serve_forever,
                                   daemon=True)
@@ -226,6 +239,141 @@ class ServingServer:
             for pend in batch:
                 pend.response = {"status": 500, "body": body}
                 pend.event.set()
+
+
+class HTTPStreamSource:
+    """Serving as a REPLAYABLE micro-batch streaming source.
+
+    Reference: DistributedHTTPSource.scala:274-288 (`getBatch` drains held
+    requests into rows keyed by request uuid) + :384-403 (`DistributedHTTPSink
+    .addBatch` replies per uuid on the owning JVM), with the offset log
+    committing AFTER addBatch — a crash between them replays the batch.
+
+    This source exposes the same contract as `FileStreamSource`
+    (`read_batch` / `commit` / `rollback` / `batch_id`), so the existing
+    `StreamingQuery` loop drives it unchanged:
+
+    - `read_batch()` drains pending HTTP requests into a DataFrame (columns
+      `id` + parsed JSON fields) and STAGES them; clients keep blocking.
+    - the sink calls `respond(batch_id, rid, body)` per row — replies are
+      HELD, not sent.
+    - `commit()` releases the staged replies to the clients and retires the
+      batch (the offset-log commit).
+    - `rollback()` discards staged replies and REQUEUES the requests at the
+      front of the queue — the next `read_batch` replays them, so a failed
+      pipeline/sink never drops a request (at-least-once, bounded by each
+      client's `request_timeout`).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_batch_size: int = 64, request_timeout: float = 30.0,
+                 vector_cols=()):
+        self.host, self.port = host, port
+        self.max_batch_size = max_batch_size
+        self.request_timeout = request_timeout
+        self.vector_cols = tuple(vector_cols)
+        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
+        # guards enqueue vs rollback's drain-and-requeue: without it a
+        # concurrent POST can jump ahead of a replayed batch
+        self._qlock = threading.Lock()
+        self._staged: List[_PendingRequest] = []
+        self._replies: Dict[str, Dict[str, Any]] = {}
+        self._batch_id = -1
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def _enqueue(self, pend: _PendingRequest) -> None:
+        with self._qlock:
+            self._queue.put(pend)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "HTTPStreamSource":
+        self._httpd = _make_http_listener(self._enqueue,
+                                          self.request_timeout,
+                                          self.host, self.port)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/"
+
+    # -------------------------------------------------------------- offsets
+    @property
+    def batch_id(self) -> int:
+        return self._batch_id
+
+    def read_batch(self) -> Optional[DataFrame]:
+        if self._staged:
+            raise RuntimeError("previous batch neither committed nor "
+                               "rolled back")
+        batch: List[_PendingRequest] = []
+        while len(batch) < self.max_batch_size:
+            try:
+                batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if not batch:
+            return None
+        self._batch_id += 1
+        self._staged = batch
+        self._replies = {}
+        return parse_request(batch, self.vector_cols)
+
+    def respond(self, batch_id: int, rid: str, body: bytes,
+                status: int = 200) -> None:
+        """Stage one reply (HTTPSink `respond(batchId, uuid, response)`).
+        Held until commit — a rollback discards it and replays the request."""
+        if batch_id != self._batch_id:
+            raise ValueError(f"respond for batch {batch_id} but current "
+                             f"batch is {self._batch_id}")
+        self._replies[rid] = {"status": status, "body": body}
+
+    def commit(self) -> None:
+        """Release staged replies to their clients and retire the batch.
+        Requests with no staged reply get 500 — a sink that commits without
+        responding must not leave clients hanging until timeout."""
+        err = json.dumps({"error": "no reply produced"}).encode()
+        for pend in self._staged:
+            pend.response = self._replies.get(
+                pend.rid, {"status": 500, "body": err})
+            pend.event.set()
+        self._staged = []
+        self._replies = {}
+
+    def rollback(self) -> None:
+        """Discard staged replies and requeue the requests (front-of-queue
+        order preserved) — the failed-batch replay path. Atomic w.r.t.
+        concurrent POSTs: the enqueue lock is held across the drain and
+        re-put so no new request can slot in ahead of the replayed batch."""
+        requeue = self._staged
+        self._staged = []
+        self._replies = {}
+        with self._qlock:
+            old = []
+            while True:
+                try:
+                    old.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            for pend in requeue + old:
+                self._queue.put(pend)
+
+    # ------------------------------------------------------- sink utilities
+    def reply_sink(self, reply_col: str):
+        """foreachBatch-style sink closing over this source: serializes
+        `reply_col` per row and stages replies keyed by the id column."""
+        def sink(batch_id: int, df: DataFrame) -> None:
+            bodies = make_reply(df, reply_col)
+            for rid, body in zip(df["id"], bodies):
+                self.respond(batch_id, rid, body)
+        return sink
 
 
 class ServingUDFs:

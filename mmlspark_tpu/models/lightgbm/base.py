@@ -655,13 +655,16 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 run_chunk = (lambda k, s, sc, lr:
                              jchunk(*data, k, s, sc, lr, gidx))
             n_rows_exec = binned.shape[0]
-        elif groups is not None:
-            # group-aligned sharding: whole query groups per device
-            # (repartitionByGroupingColumn equivalent, LightGBMRanker.scala:77+)
-            from ...ops.ranking import make_sharded_group_layout
+        else:
             cfg = self._make_config(num_class, axis, objective, has_init)
             m = meshlib.get_mesh(ndev)
             nd = m.shape[axis]
+            place = lambda a: meshlib.place_global(m, a, P(axis))
+            key = meshlib.place_global(m, key, P())
+        if not serial and groups is not None:
+            # group-aligned sharding: whole query groups per device
+            # (repartitionByGroupingColumn equivalent, LightGBMRanker.scala:77+)
+            from ...ops.ranking import make_sharded_group_layout
             lay = make_sharded_group_layout(groups, nd)
 
             def take_pad(arr, fill=0.0):
@@ -670,27 +673,24 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 out[ok] = arr[lay.order[ok]]
                 return out
 
-            gidx = jnp.asarray(lay.group_idx)
+            gidx = place(lay.group_idx)
             w_pad = take_pad(w)  # padding rows (order == -1) get weight 0
-            data = (jnp.asarray(take_pad(binned)),
-                    jnp.asarray(take_pad(np.asarray(y, np.float64))),
-                    jnp.asarray(w_pad), jnp.asarray(take_pad(is_train)),
-                    jnp.asarray(take_pad(margin)))
+            data = (place(take_pad(binned)),
+                    place(take_pad(np.asarray(y, np.float64))),
+                    place(w_pad), place(take_pad(is_train)),
+                    place(take_pad(margin)))
             jfull, jchunk = _compiled_sharded(cfg, ndev, True)
             run_full = lambda k: jfull(*data, k, gidx)
             run_chunk = lambda k, s, sc, lr: jchunk(*data, k, s, sc, lr, gidx)
             n_rows_exec = lay.order.shape[0]
-        else:
-            cfg = self._make_config(num_class, axis, objective, has_init)
-            m = meshlib.get_mesh(ndev)
-            nd = m.shape[axis]
+        elif not serial:
             binned_p, _ = meshlib.pad_to_multiple(binned, nd)
             y_p, _ = meshlib.pad_to_multiple(np.asarray(y, np.float64), nd)
             w_p, _ = meshlib.pad_to_multiple(w, nd)  # padding rows weight 0
             t_p, _ = meshlib.pad_to_multiple(is_train, nd)
             m_p, _ = meshlib.pad_to_multiple(margin, nd)
-            data = (jnp.asarray(binned_p), jnp.asarray(y_p), jnp.asarray(w_p),
-                    jnp.asarray(t_p), jnp.asarray(m_p))
+            data = (place(binned_p), place(y_p), place(w_p),
+                    place(t_p), place(m_p))
             jfull, jchunk = _compiled_sharded(cfg, ndev, False)
             run_full = lambda k: jfull(*data, k)
             run_chunk = lambda k, s, sc, lr: jchunk(*data, k, s, sc, lr)

@@ -74,6 +74,28 @@ def _factor(n: int, ndims: int) -> Tuple[int, ...]:
     return tuple(dims)
 
 
+def place_global(mesh: Mesh, arr, spec) -> jax.Array:
+    """Multi-controller-safe device placement of a host array that EVERY
+    process holds in full (the test/bootstrap topology: each host computes
+    the same host-side prep, then contributes only its addressable shards).
+
+    Single-process: plain ``jnp.asarray`` — jit handles placement. Multi-
+    process: ``jax.make_array_from_callback`` builds one GLOBAL jax.Array
+    whose shards live on each process's local devices; collectives inside
+    shard_map then ride the cross-process (DCN-analogue) channel. A
+    committed single-device array (what ``jnp.asarray`` produces) is NOT
+    valid input to a global-mesh program, which is why the sharded fit
+    paths route through here.
+    """
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return jnp.asarray(arr)
+    arr = np.asarray(arr)
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Rows sharded over the data axis, everything else replicated."""
     spec = [None] * ndim

@@ -179,3 +179,126 @@ class TestAtLeastOnce:
         src2 = FileStreamSource(str(d), format="binary",
                                 checkpoint_dir=str(ck))
         assert src2.read_batch() is None
+
+
+class TestServingReplay:
+    """Serving as a replayable micro-batch source (VERDICT r2 #7) —
+    DistributedHTTPSource.scala:274-288 getBatch/respond coupling with
+    offset commit AFTER addBatch: a failed batch must replay, and replies
+    must be held until commit."""
+
+    def _post(self, url, payload, out, i):
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out[i] = (r.status, json.loads(r.read()))
+        except urllib.error.HTTPError as e:
+            out[i] = (e.code, None)
+        except Exception as e:  # noqa: BLE001
+            out[i] = ("error", str(e))
+
+    def test_replies_held_until_commit(self):
+        import threading
+        import time
+        from mmlspark_tpu.io import HTTPStreamSource
+        src = HTTPStreamSource(port=0).start()
+        try:
+            out = {}
+            t = threading.Thread(target=self._post,
+                                 args=(src.url, {"x": 1.0}, out, 0))
+            t.start()
+            deadline = time.time() + 10
+            df = None
+            while df is None and time.time() < deadline:
+                df = src.read_batch()
+                time.sleep(0.01)
+            assert df is not None and len(df) == 1
+            src.respond(src.batch_id, df["id"][0],
+                        json.dumps({"y": 2.0}).encode())
+            # reply staged but NOT released: client must still be blocked
+            time.sleep(0.2)
+            assert 0 not in out, "reply leaked before commit"
+            src.commit()
+            t.join(10)
+            assert out[0][0] == 200 and out[0][1] == {"y": 2.0}
+        finally:
+            src.stop()
+
+    def test_failed_batch_replays_through_streaming_query(self):
+        import threading
+        from mmlspark_tpu.io import HTTPStreamSource, StreamingQuery
+        src = HTTPStreamSource(port=0).start()
+        attempts = {"n": 0}
+
+        def flaky_pipeline(df):
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                raise RuntimeError("transient failure")  # batch must replay
+            return df.with_column(
+                "score", np.asarray(df["x"], np.float64) * 10.0)
+
+        q = StreamingQuery(src, flaky_pipeline, src.reply_sink("score"),
+                           poll_interval_s=0.02).start()
+        try:
+            out = {}
+            threads = [threading.Thread(target=self._post,
+                                        args=(src.url, {"x": float(i)},
+                                              out, i))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(20)
+            assert attempts["n"] >= 2, "failure path never exercised"
+            assert sorted(out) == [0, 1, 2]
+            for i in range(3):
+                status, body = out[i]
+                assert status == 200, (i, out[i])
+                assert body == {"score": i * 10.0}
+            assert q.last_error is not None  # the transient was recorded
+        finally:
+            q.stop()
+            src.stop()
+
+    def test_rollback_requeues_in_order(self):
+        import threading
+        import time
+        from mmlspark_tpu.io import HTTPStreamSource
+        src = HTTPStreamSource(port=0).start()
+        try:
+            out = {}
+            threads = [threading.Thread(target=self._post,
+                                        args=(src.url, {"x": float(i)},
+                                              out, i))
+                       for i in range(2)]
+            threads[0].start()
+            time.sleep(0.3)  # ensure request 0 queues first
+            threads[1].start()
+            deadline = time.time() + 10
+            df = None
+            while (df is None or len(df) < 2) and time.time() < deadline:
+                if df is not None:
+                    src.rollback()  # put partial batch back
+                df = src.read_batch()
+                time.sleep(0.05)
+            assert df is not None and len(df) == 2
+            first_batch = src.batch_id
+            src.rollback()
+            df2 = src.read_batch()
+            assert src.batch_id == first_batch + 1
+            # replay preserves arrival order
+            np.testing.assert_array_equal(np.asarray(df2["x"], np.float64),
+                                          np.asarray(df["x"], np.float64))
+            src.respond(src.batch_id, df2["id"][0],
+                        json.dumps({"ok": 1}).encode())
+            src.commit()  # second row gets the no-reply 500
+            for t in threads:
+                t.join(10)
+            statuses = sorted(v[0] for v in out.values())
+            assert statuses == [200, 500], statuses
+        finally:
+            src.stop()
