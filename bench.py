@@ -121,26 +121,52 @@ def main():
     else:
         hist_method, hist_chunk = "scatter", 512
 
-    clf = LightGBMClassifier(numIterations=iters, numLeaves=leaves,
-                             maxBin=bins, histMethod=hist_method,
-                             histChunk=hist_chunk, numTasks=1)
-    # Warm-up = one full fit of the IDENTICAL program (same shapes, same static
-    # config), so the timed fits below hit the compile cache and measure
-    # execution only.
-    t0 = time.time()
-    clf.fit(df)
-    warm_wall = time.time() - t0
+    # Primary mode selection (round-2 verdict #1): histScan='compact'
+    # reproduces the full scan's trees EXACTLY at upstream's smaller-child
+    # work model (~N*depth histogram rows/tree vs N*(L-1)) — promote it to
+    # primary when it compiles on the production toolchain; eager/full is
+    # the fallback. Both are exact leaf-wise semantics, so the primary
+    # metric never mixes semantics. 'lazy' (approximate refresh) stays a
+    # secondary extra.
+    def make_clf(**extra_kw):
+        return LightGBMClassifier(numIterations=iters, numLeaves=leaves,
+                                  maxBin=bins, histMethod=hist_method,
+                                  histChunk=hist_chunk, numTasks=1,
+                                  **extra_kw)
+
+    scan_mode = "full"
+    clf = make_clf()
+    if on_accel:
+        try:
+            c_probe = make_clf(histScan="compact")
+            t0 = time.time()
+            c_probe.fit(df)                  # compile + first run
+            warm_wall = time.time() - t0
+            scan_mode, clf = "compact", c_probe
+        except Exception as e:  # noqa: BLE001 - fall back to eager/full
+            scan_mode = f"full (compact failed: {str(e)[:120]})"
+            t0 = time.time()
+            clf.fit(df)
+            warm_wall = time.time() - t0
+    else:
+        # Warm-up = one full fit of the IDENTICAL program (same shapes, same
+        # static config), so the timed fits below hit the compile cache and
+        # measure execution only.
+        t0 = time.time()
+        clf.fit(df)
+        warm_wall = time.time() - t0
 
     # The shared pool throttles unpredictably (measured 1.9x swings between
     # IDENTICAL back-to-back fits), so every metric is the MIN over repeated
     # timed fits — standard practice for noisy benchmarking — with every
     # individual wall recorded in extras. A deadline bounds the repeats so a
     # degraded chip can't run the bench past the driver's patience.
-    def timed_fits(c, k, deadline):
+    def timed_fits(c, k, deadline, data=None):
+        d = df if data is None else data
         walls, mdl = [], None
         for _ in range(k):
             t0 = time.time()
-            mdl = c.fit(df)
+            mdl = c.fit(d)
             walls.append(time.time() - t0)
             if time.time() + walls[-1] > deadline:
                 break
@@ -156,7 +182,7 @@ def main():
 
     extra = {"wall_s": round(wall, 2), "warm_wall_s": round(warm_wall, 2),
              "all_wall_s": [round(w, 2) for w in walls],
-             "n": n, "iters": iters,
+             "n": n, "iters": iters, "hist_scan": scan_mode,
              "hist_kernel": f"{hist_method}/{hist_chunk}",
              "train_auc_sample": round(auc, 4), "device": str(devs[0])}
 
@@ -184,26 +210,52 @@ def main():
         except Exception as e:  # noqa: BLE001 - secondary must not kill bench
             extra["lazy_error"] = str(e)[:300]
 
-    # secondary: histScan='compact' (exact leaf-wise semantics — upstream's
-    # smaller-child work model, ~N*depth histogram rows per tree instead of
-    # N*(L-1); tests pin tree-identical output vs the full scan). Last: its
-    # lax.switch bucket ladder compiles many pallas instances, which is
-    # unproven on the production toolchain.
-    if on_accel and time.time() - t_start < 420:
+    # secondary: eager/full when compact won primary (quantifies the
+    # compact speedup at identical trees on the same chip/session)
+    if on_accel and scan_mode == "compact" and time.time() - t_start < 420:
         try:
-            c_clf = LightGBMClassifier(
-                numIterations=iters, numLeaves=leaves, maxBin=bins,
-                histMethod=hist_method, histChunk=hist_chunk, numTasks=1,
-                histScan="compact")
-            c_clf.fit(df)                         # compile
-            c_walls, c_model = timed_fits(c_clf, 2, t_start + 560)
-            c_wall = min(c_walls)
-            c_auc = roc_auc_score(y[idx], c_model.booster.score(x[idx]))
-            extra["compact_rows_iter_per_s"] = round(n * iters / c_wall, 1)
-            extra["compact_wall_s"] = [round(wv, 2) for wv in c_walls]
-            extra["compact_auc_sample"] = round(c_auc, 4)
+            f_clf = make_clf()
+            f_clf.fit(df)                         # compile
+            f_walls, _ = timed_fits(f_clf, 2, t_start + 540)
+            extra["full_rows_iter_per_s"] = round(
+                n * iters / min(f_walls), 1)
+            extra["full_wall_s"] = [round(wv, 2) for wv in f_walls]
         except Exception as e:  # noqa: BLE001 - secondary must not kill bench
-            extra["compact_error"] = str(e)[:300]
+            extra["full_error"] = str(e)[:300]
+
+    # extra: HIGGS-scale run — BASELINE.json defines the north-star metric
+    # at 11M x 28 x 100 (int8 bins ~ 310 MB HBM; fits one v5e chip). One
+    # warm fit + up to 2 timed fits with the primary mode.
+    if on_accel and time.time() - t_start < 480:
+        try:
+            n11 = 11_000_000
+            x11 = rng.normal(size=(n11, f)).astype(np.float32)
+            y11 = ((x11 @ coef + 0.5 * x11[:, 0] * x11[:, 1]
+                    + rng.normal(scale=1.0, size=n11)) > 0).astype(np.float64)
+            df11 = DataFrame({"features": x11, "label": y11})
+            clf11 = (make_clf(histScan="compact") if scan_mode == "compact"
+                     else make_clf())
+            t0 = time.time()
+            m11 = clf11.fit(df11)
+            first11 = time.time() - t0
+            walls11 = [first11]
+            # compile is shared with the 4M program only if shapes match
+            # (they don't) — so fit again for an execution-only number if
+            # time remains
+            if time.time() + first11 < t_start + 900:
+                w2, m11 = timed_fits(clf11, 1, t_start + 960, data=df11)
+                walls11 += w2
+            idx11 = rng.choice(n11, 100_000, replace=False)
+            auc11 = roc_auc_score(y11[idx11], m11.booster.score(x11[idx11]))
+            extra["higgs11m_rows_iter_per_s"] = round(
+                n11 * iters / min(walls11), 1)
+            extra["higgs11m_wall_s"] = [round(wv, 2) for wv in walls11]
+            extra["higgs11m_vs_baseline"] = round(
+                n11 * iters / min(walls11) / BASELINE, 4)
+            extra["higgs11m_auc_sample"] = round(auc11, 4)
+            del x11, y11, df11
+        except Exception as e:  # noqa: BLE001 - extra must not kill bench
+            extra["higgs11m_error"] = str(e)[:300]
     error = None
     if init_err is not None:
         extra["backend_fallback"] = f"cpu after init error: {init_err}"[:500]

@@ -1,0 +1,85 @@
+"""ImageFeaturizer transfer learning, end-to-end — the reference's flagship
+deep-learning sample (notebooks "ImageFeaturizer" / BASELINE config 4
+"ResNet-50 transfer learning"; image/ImageFeaturizer.scala:40-191,
+cntk/CNTKModel.scala:30-140 hot loop -> one jitted batched forward here).
+
+Pipeline: raw variable-size images -> ImageTransformer (resize) ->
+ImageFeaturizer (headless ResNet, `cutOutputLayers=1` pooled features; the
+`setModel(zoo-name)` path) -> TrainClassifier(LightGBM) on the embeddings.
+
+`main(zoo="ResNet50", n=512)` is the benchmark shape; the default
+ResNet18-ish/64px keeps the smoke test fast on CPU. Returns test accuracy;
+also reports the jitted-forward images/s (the CNTKModel-replacement metric
+recorded in docs/PERF.md).
+"""
+import time
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.deep import (ImageFeaturizer, ImageTransformer,
+                                      ModelDownloader)
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+from mmlspark_tpu.train import TrainClassifier
+
+
+def make_images(rng, n, base=48):
+    """Two visually distinct classes at varying input sizes: class 0 =
+    bright vertical stripes, class 1 = dark horizontal stripes + noise."""
+    imgs = np.empty(n, dtype=object)
+    labels = np.zeros(n, np.float64)
+    for i in range(n):
+        h = base + int(rng.integers(0, 32))
+        w = base + int(rng.integers(0, 32))
+        img = rng.integers(0, 60, (h, w, 3)).astype(np.uint8)
+        if i % 2 == 0:
+            img[:, ::4] = (220, 180, 40)
+        else:
+            img[::4, :] = (40, 60, 180)
+            labels[i] = 1.0
+        imgs[i] = img
+    return imgs, labels
+
+
+def main(zoo="ResNet18-ish", n=96, batch=16):
+    rng = np.random.default_rng(0)
+    gm = ModelDownloader().download_by_name(zoo)
+    side = gm.schema.input_dims[0]
+    imgs, labels = make_images(rng, n)
+    df = DataFrame({"image": imgs, "label": labels})
+
+    resize = ImageTransformer(inputCol="image",
+                              outputCol="resized").resize(side, side)
+    featurize = ImageFeaturizer(model=gm, inputCol="resized",
+                                outputCol="features", cutOutputLayers=1,
+                                batchSize=batch)
+    train, test = df.random_split([0.75, 0.25], seed=7)
+
+    def embed(d):
+        # keep only (embedding, label): the raw image columns served their
+        # purpose once the featurizer has run
+        out = featurize.transform(resize.transform(d))
+        return out.drop("image").drop("resized")
+
+    t0 = time.time()
+    train_f = embed(train)
+    featurize_wall = time.time() - t0
+    clf = TrainClassifier(model=LightGBMClassifier(numIterations=30,
+                                                   numLeaves=15),
+                          labelCol="label").fit(train_f)
+
+    out = clf.transform(embed(test))
+    acc = float((out["scored_labels"] == test["label"]).mean())
+
+    # steady-state jitted forward throughput (compile excluded: the train
+    # pass above already compiled this batch shape)
+    t0 = time.time()
+    embed(train)
+    steady = time.time() - t0
+    print(f"{zoo}: test acc {acc:.3f}; featurize first {featurize_wall:.2f}s"
+          f", steady {steady:.2f}s = {len(train) / steady:.1f} images/s")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

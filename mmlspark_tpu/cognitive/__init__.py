@@ -7,7 +7,8 @@ from .services import (NER, OCR, AnalyzeImage, AzureSearchWriter,
                        DetectFace, DetectLastAnomaly, FindSimilarFace,
                        GenerateThumbnails, GroupFaces, IdentifyFaces,
                        KeyPhraseExtractor, LanguageDetector, RecognizeText,
-                       SpeechToText, TagImage, TextSentiment, VerifyFaces)
+                       SpeechToText, SpeechToTextStreaming, TagImage,
+                       TextSentiment, VerifyFaces)
 
 __all__ = [
     "CognitiveServicesBase", "ServiceParam",
@@ -18,4 +19,5 @@ __all__ = [
     "IdentifyFaces",
     "DetectLastAnomaly", "DetectAnomalies",
     "BingImageSearch", "AzureSearchWriter", "SpeechToText",
+    "SpeechToTextStreaming",
 ]

@@ -331,3 +331,116 @@ class SpeechToText(CognitiveServicesBase):
     def prepare_entity(self, df, i):
         data = df[self.get("audioBytesCol")][i]
         return bytes(data) if data is not None else None
+
+
+class SpeechToTextStreaming(SpeechToText):
+    """Streaming transcription: chunked-transfer REST upload with interim
+    hypotheses — the client-level analogue of the native-SDK streaming path
+    (cognitive/SpeechToTextSDK.scala:66, the one §2.1 component the REST
+    `SpeechToText` alone did not cover).
+
+    Protocol: the audio column's bytes are uploaded with
+    `Transfer-Encoding: chunked` in `chunkSize`-byte chunks (the SDK streams
+    ~100ms audio frames the same way), and the service answers with
+    newline-delimited JSON events, read incrementally off the socket:
+      {"type": "speech.hypothesis", "Text": ...}   interim partial results
+      {"type": "speech.phrase", "DisplayText": ..., "Offset": ...,
+       "Duration": ...}                            finalized segments
+    (the event names mirror the Speech SDK's `Recognizing`/`Recognized`
+    callbacks surfaced by SpeechToTextSDK's flushing serializer).
+
+    Output: `outputCol` holds the list of finalized phrase dicts per row;
+    `hypothesesCol` the interim texts. `on_event(row_idx, event)` fires as
+    each event arrives — the streaming consumption surface (the SDK's
+    subscriber callbacks); it sees hypotheses before transform returns.
+    """
+
+    chunkSize = _p.Param("chunkSize", "upload chunk bytes", 32768, int)
+    hypothesesCol = _p.Param("hypothesesCol",
+                             "interim hypothesis texts column", "hypotheses")
+
+    def __init__(self, on_event=None, **kw):
+        super().__init__(**kw)
+        self._on_event = on_event
+
+    def _stream_row(self, df: DataFrame, i: int):
+        """Upload one row's audio chunked and consume its event stream.
+        Returns (finals, hypotheses, error)."""
+        import http.client
+        from urllib.parse import urlencode, urlsplit
+
+        finals: list = []
+        hyps: list = []
+        chunk_size = int(self.get("chunkSize"))
+        audio = self.prepare_entity(df, i)
+        if audio is None:
+            return finals, hyps, None
+        parts = urlsplit(self.base_url())
+        qs = urlencode(self.url_params(df, i))
+        path = (parts.path or "/") + ("?" + qs if qs else "")
+        conn_cls = (http.client.HTTPSConnection if parts.scheme == "https"
+                    else http.client.HTTPConnection)
+        conn = conn_cls(parts.netloc, timeout=self.get("timeout"))
+        try:
+            conn.putrequest("POST", path)
+            for k, v in self.headers(df, i).items():
+                conn.putheader(k, v)
+            conn.putheader("Transfer-Encoding", "chunked")
+            conn.endheaders()
+            for start in range(0, len(audio), chunk_size):
+                chunk = audio[start:start + chunk_size]
+                conn.send(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+            conn.send(b"0\r\n\r\n")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return finals, hyps, (
+                    f"{resp.status} "
+                    f"{resp.read(200).decode('utf-8', 'replace')}")
+            # read events incrementally as the service emits them
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue
+                if self._on_event is not None:
+                    self._on_event(i, event)
+                if event.get("type") == "speech.hypothesis":
+                    hyps.append(event.get("Text", ""))
+                elif event.get("type") == "speech.phrase":
+                    finals.append(
+                        {k: event[k] for k in
+                         ("DisplayText", "Offset", "Duration")
+                         if k in event})
+        except (OSError, http.client.HTTPException) as e:
+            # per-row failures land in errorCol, never abort the batch
+            # (the CognitiveServicesBase contract)
+            return finals, hyps, str(e)
+        finally:
+            conn.close()
+        return finals, hyps, None
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        from concurrent.futures import ThreadPoolExecutor
+
+        n = len(df)
+        finals = np.empty(n, dtype=object)
+        hyps = np.empty(n, dtype=object)
+        errors = np.empty(n, dtype=object)
+        workers = max(1, int(self.get("concurrency")))
+        if n and workers > 1:
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                results = list(pool.map(lambda i: self._stream_row(df, i),
+                                        range(n)))
+        else:
+            results = [self._stream_row(df, i) for i in range(n)]
+        for i, (fi, hi, ei) in enumerate(results):
+            finals[i], hyps[i], errors[i] = fi, hi, ei
+        out = df.with_column(self.get("outputCol"), finals)
+        out = out.with_column(self.get("hypothesesCol"), hyps)
+        return out.with_column(self.get("errorCol"), errors)

@@ -1,0 +1,70 @@
+"""ImageFeaturizer forward throughput on chip (round-2 verdict #5).
+
+Measures the jitted ResNet-50 headless forward (the CNTKModel.scala:30-140
+hot-loop replacement) in images/s at the zoo's native 224x224 input, with
+the docs/KERNELS.md paired-difference methodology so the relay RTT cancels.
+Appends results to stdout for docs/PERF.md.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    if devs[0].platform == "cpu":
+        print("no accelerator — refusing to record CPU numbers as TPU")
+        return 1
+
+    from mmlspark_tpu.models.deep import ModelDownloader
+
+    gm = ModelDownloader().download_by_name("ResNet50")
+    h, w, c = gm.schema.input_dims
+    rng = np.random.default_rng(0)
+
+    print("| batch | device ms/batch | images/s | date |")
+    print("|---|---|---|---|")
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    for batch in (8, 32, 64):
+        xb = jnp.asarray(rng.normal(size=(batch, h, w, c)), jnp.float32)
+
+        fwd = jax.jit(lambda v, x_: gm.module.apply(
+            v, x_, capture="pool")[1]["pool"])
+
+        def k_calls(k):
+            def run(x_):
+                def body(acc, j):
+                    xj = x_ * (1.0 + 1e-6 * j.astype(jnp.float32))
+                    return acc + jnp.sum(fwd(gm.variables, xj)), None
+                acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                                      jnp.arange(k))
+                return acc
+            return jax.jit(run)
+
+        inner = 8
+        fn1, fn3 = k_calls(inner), k_calls(3 * inner)
+        float(fn1(xb))
+        float(fn3(xb))
+        diffs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fn1(xb))
+            t1 = time.perf_counter()
+            float(fn3(xb))
+            t2 = time.perf_counter()
+            diffs.append(((t2 - t1) - (t1 - t0)) / (2 * inner))
+        per_batch = float(np.median(diffs))
+        print(f"| {batch} | {per_batch * 1e3:.2f} | "
+              f"{batch / per_batch:.0f} | {stamp} |", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""On-device serving dispatch measurement (round-2 verdict #4).
+
+Bounds the TPU-resident serving latency the relay hides: the reference's
+continuous-mode claim is sub-millisecond (README.md:23,
+docs/mmlspark-serving.md:93), and docs/SERVING.md's p50 0.127 ms was
+measured on the CPU host because the ~65 ms tunnel RTT swamps any direct
+HTTP measurement against the chip.
+
+Methodology = docs/KERNELS.md paired-difference timing: the per-call device
+cost of the resident scoring program is the difference between a 3k-call and
+a k-call lax.scan program (RTT cancels within each pair); the host fetch of
+a scalar is the barrier. Reported per batch size: device time per call,
+derived requests/s, plus the one-way dispatch overhead estimate.
+
+Writes a markdown row block to stdout; append to docs/SERVING.md.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    if devs[0].platform == "cpu":
+        print("no accelerator — refusing to record CPU numbers as TPU")
+        return 1
+
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+    rng = np.random.default_rng(0)
+    f = 28
+    x = rng.normal(size=(200_000, f)).astype(np.float32)
+    y = ((x @ rng.normal(size=f)) > 0).astype(np.float64)
+    model = LightGBMClassifier(numIterations=100, numLeaves=31,
+                               maxBin=64, numTasks=1).fit(
+        DataFrame({"features": x, "label": y}))
+    booster = model.booster
+
+    # resident device-side scoring program on pre-binned features: the
+    # serving hot call (Booster.score's jit core without host binning)
+    from mmlspark_tpu.ops.boosting import Tree, tree_predict_binned
+
+    t_used = booster._used_iters()
+    trees = Tree(*[jnp.asarray(a[:t_used]) for a in booster.trees])
+
+    def score_once(binned_batch):
+        def tree_body(acc, t):
+            tr = jax.tree.map(lambda a: a[t], trees)
+            return acc + tree_predict_binned(tr, binned_batch), None
+        acc, _ = jax.lax.scan(
+            tree_body, jnp.zeros(binned_batch.shape[0], jnp.float32),
+            jnp.arange(t_used))
+        return jax.nn.sigmoid(acc + booster.init_score)
+
+    rows = []
+    for batch in (1, 8, 64, 256, 1024):
+        binned = jnp.asarray(
+            booster.bin_mapper.transform(x[:batch]).astype(np.uint8))
+
+        def k_calls(k):
+            def run(b):
+                def body(acc, j):
+                    bj = jnp.clip(b + j.astype(jnp.uint8) % 1, 0, 255)
+                    return acc + jnp.sum(score_once(bj)), None
+                acc, _ = jax.lax.scan(body, jnp.float32(0.0),
+                                      jnp.arange(k))
+                return acc
+            return jax.jit(run)
+
+        inner = 32
+        fn1, fn3 = k_calls(inner), k_calls(3 * inner)
+        float(fn1(binned))    # compile + settle
+        float(fn3(binned))
+        diffs = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(fn1(binned))
+            t1 = time.perf_counter()
+            float(fn3(binned))
+            t2 = time.perf_counter()
+            diffs.append(((t2 - t1) - (t1 - t0)) / (2 * inner))
+        per_call = float(np.median(diffs))
+        rows.append((batch, per_call))
+        print(f"batch {batch:5d}: device {per_call * 1e3:8.3f} ms/call "
+              f"= {batch / per_call:10.0f} rows/s", flush=True)
+
+    # one-way dispatch overhead: wall of a trivial fetch
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(jnp.float32(1.0) + 1.0)
+    rtt = (time.perf_counter() - t0) / 5
+    print(f"dispatch+fetch round trip ~ {rtt * 1e3:.1f} ms (relay)")
+    print()
+    print("| batch | device ms/call | rows/s | date |")
+    print("|---|---|---|---|")
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    for batch, per_call in rows:
+        print(f"| {batch} | {per_call * 1e3:.3f} | "
+              f"{batch / per_call:.0f} | {stamp} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,10 @@ EOF
   then
     echo "== chip healthy $(date -u +%FT%TZ) — running measurements"
     python scripts/measure_scan_modes.py
+    echo "== serving $(date -u +%FT%TZ)"
+    python scripts/measure_serving_tpu.py
+    echo "== image featurizer $(date -u +%FT%TZ)"
+    python scripts/measure_image_featurizer.py
     echo "== bench $(date -u +%FT%TZ)"
     python bench.py
     echo "== watcher done $(date -u +%FT%TZ)"

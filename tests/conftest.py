@@ -25,6 +25,40 @@ assert jax.devices()[0].platform == "cpu"
 import numpy as np
 import pytest
 
+# Fast/slow test tiers (round-2 verdict #3; the analogue of the reference's
+# per-package CI split, pipeline.yaml:240-330): modules dominated by heavy
+# fits or multi-process launches are marked slow wholesale; individual
+# @pytest.mark.slow marks cover heavy tests in otherwise-fast modules.
+#   fast tier: python -m pytest -m "not slow"   (< 5 min on 1 vCPU)
+#   full:      python -m pytest tests/          (timings in docs/COMPONENTS.md)
+SLOW_MODULES = {
+    "test_benchmarks", "test_benchmarks_real", "test_compact_scan",
+    "test_deep", "test_delegate_early_stop", "test_examples",
+    "test_fit_param_maps", "test_lightgbm_extra", "test_metrics_param",
+    "test_missing_direction", "test_multihost", "test_transformer_training",
+}
+# heavy tests inside otherwise-fast modules (measured >= ~7s on 1 vCPU)
+SLOW_TESTS = {
+    ("test_downloader", "TestEndToEndModelDownloader"),
+    ("test_distributed_serving", "test_two_process_fleet"),
+    ("test_lightgbm", "TestVotingParallel"),
+    ("test_lightgbm", "test_distributed_matches_serial"),
+    ("test_ranker", "test_ranker_distributed_matches_serial"),
+    ("test_vw_fidelity", "TestInteractionsEndToEnd"),
+    ("test_vw_fidelity", "TestRound2Params"),
+    ("test_categorical", "test_warmstart_merge_different_leaf_caps"),
+    ("test_transformer", "test_causal_sequence_parallel"),
+    ("test_transformer", "test_save_load_roundtrip"),
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__
+        if mod in SLOW_MODULES or any(
+                m == mod and part in item.nodeid for m, part in SLOW_TESTS):
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session")
 def binary_df():

@@ -163,3 +163,98 @@ def test_error_column_on_failure():
     out = t.transform(df)
     assert out["s"][0] is None
     assert out["error"][0] is not None
+
+
+class TestSpeechToTextStreaming:
+    """Chunked-transfer streaming transcription against a mock service that
+    verifies the CHUNKED upload on the wire and streams NDJSON events back
+    (SpeechToTextSDK.scala:66 client-level analogue)."""
+
+    @pytest.fixture()
+    def speech_service(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        captured = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                assert self.headers.get("Transfer-Encoding") == "chunked"
+                chunks = []
+                while True:
+                    size = int(self.rfile.readline().strip(), 16)
+                    data = self.rfile.read(size)
+                    self.rfile.readline()  # trailing CRLF
+                    if size == 0:
+                        break
+                    chunks.append(data)
+                captured["chunks"] = chunks
+                captured["path"] = self.path
+                captured["key"] = self.headers.get(
+                    "Ocp-Apim-Subscription-Key")
+                body = b"".join(
+                    json.dumps(e).encode() + b"\n" for e in [
+                        {"type": "speech.hypothesis", "Text": "hel"},
+                        {"type": "speech.hypothesis", "Text": "hello wor"},
+                        {"type": "speech.phrase",
+                         "DisplayText": "Hello world.",
+                         "Offset": 0, "Duration": 12300000},
+                        {"type": "speech.hypothesis", "Text": "how ar"},
+                        {"type": "speech.phrase",
+                         "DisplayText": "How are you?",
+                         "Offset": 12300000, "Duration": 9000000},
+                    ])
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}/speech", captured
+        httpd.shutdown()
+        httpd.server_close()
+
+    def test_chunked_upload_and_interim_hypotheses(self, speech_service):
+        from mmlspark_tpu.cognitive import SpeechToTextStreaming
+        url, captured = speech_service
+        audio = bytes(range(256)) * 300   # 76800 bytes -> 3 chunks @ 32768
+        events = []
+        stt = SpeechToTextStreaming(
+            url=url, subscriptionKey="k123", outputCol="phrases",
+            on_event=lambda i, e: events.append((i, e["type"])))
+        df = DataFrame({"audio": np.array([audio], dtype=object)})
+        out = stt.transform(df)
+        # chunked upload actually happened, in chunkSize pieces
+        assert len(captured["chunks"]) == 3
+        assert b"".join(captured["chunks"]) == audio
+        assert captured["key"] == "k123"
+        assert "language=en-US" in captured["path"]
+        # finals + interims separated
+        phrases = out["phrases"][0]
+        assert [p["DisplayText"] for p in phrases] == [
+            "Hello world.", "How are you?"]
+        assert phrases[0]["Duration"] == 12300000
+        assert out["hypotheses"][0] == ["hel", "hello wor", "how ar"]
+        assert out["error"][0] is None
+        # the callback streamed: hypotheses seen before/with finals, in order
+        assert [t for _, t in events].count("speech.hypothesis") == 3
+        assert events[0][1] == "speech.hypothesis"
+
+    def test_missing_audio_and_error_status(self, speech_service):
+        from mmlspark_tpu.cognitive import SpeechToTextStreaming
+        url, _ = speech_service
+        stt = SpeechToTextStreaming(url=url, outputCol="phrases")
+        df = DataFrame({"audio": np.array([None], dtype=object)})
+        out = stt.transform(df)
+        assert out["phrases"][0] == [] and out["hypotheses"][0] == []
+        # unreachable service -> error column, no raise
+        stt2 = SpeechToTextStreaming(url="http://127.0.0.1:9/x",
+                                     outputCol="phrases", timeout=2.0)
+        df2 = DataFrame({"audio": np.array([b"abc"], dtype=object)})
+        out2 = stt2.transform(df2)
+        assert out2["error"][0] is not None

@@ -38,3 +38,20 @@ def test_serving():
 
 def test_distributed_transformer():
     assert _run("distributed_transformer.py") > 0.7
+
+
+def test_lime_explain():
+    assert _run("lime_explain.py") is True
+
+
+def test_sar_recommender():
+    assert _run("sar_recommender.py") > 0.5
+
+
+@pytest.mark.slow
+def test_image_featurizer():
+    assert _run("image_featurizer.py") > 0.8
+
+
+def test_streaming_replay():
+    assert _run("streaming_replay.py") is True
