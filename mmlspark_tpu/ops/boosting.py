@@ -860,6 +860,30 @@ def binned_weighted_auc(scores, y, w, k=1024, axis_name=None):
     return num / den
 
 
+def exact_weighted_auc(scores, y, w):
+    """Exact rank-based weighted AUC with the standard tie credit
+    (pos*neg/2 within equal-score groups), jit-friendly: one sort +
+    segment sums, O(n log n). This is the metric upstream computes in C++
+    (metric/binary_metric.hpp AUCMetric) and backs `metric='auc'` on the
+    SERIAL path, where the global sort is available; the distributed path
+    keeps the shard-decomposable `binned_weighted_auc` (global sort would
+    need an all-gather of every score)."""
+    n = scores.shape[0]
+    order = jnp.argsort(scores)
+    s = scores[order]
+    pos = (w * y)[order]
+    neg = (w * (1.0 - y))[order]
+    # equal-score runs become segments; ties get the pos*neg/2 credit
+    new_seg = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               (s[1:] != s[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(new_seg)
+    seg_neg = jax.ops.segment_sum(neg, seg, num_segments=n)
+    cum_before = jnp.cumsum(seg_neg) - seg_neg
+    num = jnp.sum(pos * (cum_before[seg] + 0.5 * seg_neg[seg]))
+    den = jnp.maximum(jnp.sum(pos) * jnp.sum(neg), 1e-12)
+    return num / den
+
+
 def make_train_fn(cfg: GBDTConfig):
     """Build the jit-able full training program.
 
@@ -897,9 +921,12 @@ def make_train_fn(cfg: GBDTConfig):
     def wmean(v, w):
         return psum(jnp.sum(v * w)) / jnp.maximum(psum(jnp.sum(w)), 1e-12)
 
-    def binned_auc(scores, y, w, k=1024):
-        return binned_weighted_auc(scores, y, w, k=k,
-                                   axis_name=cfg.axis_name)
+    def auc_metric(scores, y, w):
+        # serial: exact rank AUC (upstream parity); sharded: binned
+        # histogram AUC, exact to bin resolution (documented bound)
+        if cfg.axis_name is None:
+            return exact_weighted_auc(scores, y, w)
+        return binned_weighted_auc(scores, y, w, axis_name=cfg.axis_name)
 
     def metric_of(scores, y, w):
         # global (cross-shard) metric via weighted-mean decomposition
@@ -923,7 +950,7 @@ def make_train_fn(cfg: GBDTConfig):
                 logp, y[:, None].astype(jnp.int32), axis=1)[:, 0]
             return wmean(-picked, w)
         if name == "auc":
-            return 1.0 - binned_auc(scores, y, w)
+            return 1.0 - auc_metric(scores, y, w)
         if name == "binary_error":
             pred = (scores > 0.0).astype(jnp.float32)
             return wmean(jnp.abs(pred - y), w)

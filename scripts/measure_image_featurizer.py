@@ -35,8 +35,8 @@ def main():
     for batch in (8, 32, 64):
         xb = jnp.asarray(rng.normal(size=(batch, h, w, c)), jnp.float32)
 
-        fwd = jax.jit(lambda v, x_: gm.module.apply(
-            v, x_, capture="pool")[1]["pool"])
+        # apply(..., capture="pool") returns the pooled features directly
+        fwd = jax.jit(lambda v, x_: gm.module.apply(v, x_, capture="pool"))
 
         def k_calls(k):
             def run(x_):

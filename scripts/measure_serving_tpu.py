@@ -68,7 +68,10 @@ def main():
         def k_calls(k):
             def run(b):
                 def body(acc, j):
-                    bj = jnp.clip(b + j.astype(jnp.uint8) % 1, 0, 255)
+                    # j-dependent perturbation so XLA cannot hoist the
+                    # loop-invariant call out of the scan (defeats CSE;
+                    # bins stay in range for maxBin=64)
+                    bj = jnp.clip(b + (j % 2).astype(jnp.uint8), 0, 63)
                     return acc + jnp.sum(score_once(bj)), None
                 acc, _ = jax.lax.scan(body, jnp.float32(0.0),
                                       jnp.arange(k))

@@ -33,7 +33,7 @@ import pytest
 #   full:      python -m pytest tests/          (timings in docs/COMPONENTS.md)
 SLOW_MODULES = {
     "test_benchmarks", "test_benchmarks_real", "test_compact_scan",
-    "test_deep", "test_delegate_early_stop", "test_examples",
+    "test_deep", "test_delegate_early_stop",
     "test_fit_param_maps", "test_lightgbm_extra", "test_metrics_param",
     "test_missing_direction", "test_multihost", "test_transformer_training",
 }
@@ -49,6 +49,9 @@ SLOW_TESTS = {
     ("test_categorical", "test_warmstart_merge_different_leaf_caps"),
     ("test_transformer", "test_causal_sequence_parallel"),
     ("test_transformer", "test_save_load_roundtrip"),
+    ("test_examples", "test_distributed_transformer"),
+    ("test_examples", "test_hyperparam_sweep"),
+    ("test_examples", "test_gbdt_quickstart"),
 }
 
 

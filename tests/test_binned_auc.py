@@ -126,3 +126,44 @@ def test_binned_auc_perfect_and_random():
     assert _binned(perfect, y, w) > 0.999
     random_scores = rng.normal(size=n)
     assert abs(_binned(random_scores, y, w) - 0.5) < 0.03
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_exact_weighted_auc_matches_reference(case):
+    """The serial-path exact AUC (one jit sort + segment sums) must equal
+    the O(n log n) numpy reference bit-for-bit-ish on every adversarial
+    case, ties included."""
+    import zlib
+    from mmlspark_tpu.ops.boosting import exact_weighted_auc
+    rng = np.random.default_rng(zlib.crc32(case.encode()) ^ 1)
+    n = 3000
+    scores = np.asarray(CASES[case](rng, n), np.float64)
+    y = (scores + rng.normal(scale=np.std(scores) + 1e-9, size=n)
+         > np.median(scores)).astype(np.float64)
+    w = rng.uniform(0.2, 2.0, n)
+    ref = _exact_weighted_auc(np.float32(scores).astype(np.float64),
+                              y, np.float32(w).astype(np.float64))
+    got = float(exact_weighted_auc(jnp.asarray(scores, jnp.float32),
+                                   jnp.asarray(y, jnp.float32),
+                                   jnp.asarray(w, jnp.float32)))
+    assert abs(got - ref) < 2e-5, (got, ref)
+
+
+def test_exact_auc_zero_weight_rows_ignored():
+    """Padding rows (w=0) must not affect the serial exact AUC — the
+    masking discipline the sharded fit relies on."""
+    from mmlspark_tpu.ops.boosting import exact_weighted_auc
+    rng = np.random.default_rng(5)
+    scores = rng.normal(size=500)
+    y = (scores + rng.normal(size=500) > 0).astype(np.float64)
+    w = np.ones(500)
+    base = float(exact_weighted_auc(jnp.asarray(scores, jnp.float32),
+                                    jnp.asarray(y, jnp.float32),
+                                    jnp.asarray(w, jnp.float32)))
+    s2 = np.concatenate([scores, rng.normal(size=100)])
+    y2 = np.concatenate([y, np.ones(100)])
+    w2 = np.concatenate([w, np.zeros(100)])
+    padded = float(exact_weighted_auc(jnp.asarray(s2, jnp.float32),
+                                      jnp.asarray(y2, jnp.float32),
+                                      jnp.asarray(w2, jnp.float32)))
+    assert abs(base - padded) < 1e-6
