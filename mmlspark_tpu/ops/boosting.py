@@ -827,9 +827,11 @@ def binned_weighted_auc(scores, y, w, k=1024, axis_name=None):
     the totals. With k=1024, any score distribution spread over more than
     a few bins (sigmoid-space width >> 1e-3) makes the bound negligible;
     the adversarial extreme — ALL scores inside one bin — collapses the
-    estimate to 0.5 exactly as the bound predicts. Early stopping on
-    metric='auc' consumes this estimator, so improvements smaller than the
-    bound at near-constant score distributions are not trustworthy signal.
+    estimate to 0.5 exactly as the bound predicts. DISTRIBUTED
+    (cfg.axis_name set) `metric='auc'` — including early stopping —
+    consumes this estimator, so improvements smaller than the bound at
+    near-constant score distributions are not trustworthy signal there;
+    the serial path uses `exact_weighted_auc` and has no such bound.
     """
     chunk = 8192
     p = jax.nn.sigmoid(scores)
@@ -856,8 +858,9 @@ def binned_weighted_auc(scores, y, w, k=1024, axis_name=None):
     pos, neg = acc[:, 0], acc[:, 1]
     cum_neg = jnp.cumsum(neg) - neg                      # negatives below
     num = jnp.sum(pos * cum_neg + pos * neg * 0.5)
-    den = jnp.maximum(jnp.sum(pos) * jnp.sum(neg), 1e-12)
-    return num / den
+    den = jnp.sum(pos) * jnp.sum(neg)
+    # single-class set: undefined — 0.5 by convention (matches exact path)
+    return jnp.where(den > 0, num / jnp.maximum(den, 1e-12), 0.5)
 
 
 def exact_weighted_auc(scores, y, w):
@@ -880,8 +883,10 @@ def exact_weighted_auc(scores, y, w):
     seg_neg = jax.ops.segment_sum(neg, seg, num_segments=n)
     cum_before = jnp.cumsum(seg_neg) - seg_neg
     num = jnp.sum(pos * (cum_before[seg] + 0.5 * seg_neg[seg]))
-    den = jnp.maximum(jnp.sum(pos) * jnp.sum(neg), 1e-12)
-    return num / den
+    den = jnp.sum(pos) * jnp.sum(neg)
+    # single-class set: AUC is undefined — 0.5 by convention (upstream
+    # AUCMetric semantics), never a confident 0 or 1
+    return jnp.where(den > 0, num / jnp.maximum(den, 1e-12), 0.5)
 
 
 def make_train_fn(cfg: GBDTConfig):

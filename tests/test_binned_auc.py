@@ -1,7 +1,9 @@
-"""Exact-vs-binned AUC guard (VERDICT r2 weak #7): `binned_weighted_auc`
-(ops/boosting.py) backs `metric='auc'` — including distributed early
-stopping — so its divergence from exact rank AUC must be bounded and the
-bound must hold on adversarial near-tie score distributions.
+"""AUC metric guards (VERDICT r2 weak #7): `metric='auc'` is backed by
+`exact_weighted_auc` on the serial path (global sort available) and by the
+shard-decomposable `binned_weighted_auc` on the distributed path — so the
+binned estimator's divergence from exact rank AUC must be bounded on
+adversarial near-tie score distributions, and the serial exact form must
+match an independent reference implementation.
 
 Reference anchor: upstream LightGBM computes exact AUC in C++
 (metric/binary_metric.hpp); the TPU build trades exactness for a
@@ -167,3 +169,18 @@ def test_exact_auc_zero_weight_rows_ignored():
                                       jnp.asarray(y2, jnp.float32),
                                       jnp.asarray(w2, jnp.float32)))
     assert abs(base - padded) < 1e-6
+
+
+def test_single_class_degenerate_returns_half():
+    """All-positive / all-negative sets: AUC is undefined — both estimators
+    return 0.5 by convention, never a confident 0 or 1."""
+    from mmlspark_tpu.ops.boosting import exact_weighted_auc
+    rng = np.random.default_rng(9)
+    scores = rng.normal(size=100)
+    w = np.ones(100)
+    for y in (np.ones(100), np.zeros(100)):
+        e = float(exact_weighted_auc(jnp.asarray(scores, jnp.float32),
+                                     jnp.asarray(y, jnp.float32),
+                                     jnp.asarray(w, jnp.float32)))
+        b = _binned(scores, y, w)
+        assert e == 0.5 and b == 0.5, (y[0], e, b)
