@@ -26,7 +26,8 @@ from ...core import params as _p
 from ...core.dataframe import DataFrame
 from ...core.pipeline import Estimator, Model
 from ...ops.attention import (attention_reference, flash_attention,
-                              ring_attention_sharded)
+                              ring_attention_sharded,
+                              ulysses_attention_sharded)
 
 
 def init_encoder_params(key, num_layers: int, d_model: int, num_heads: int,
@@ -81,9 +82,12 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
                     positional: bool = False) -> jax.Array:
     """Pre-LN encoder stack. x: [B, S, D] (shard-local S when axis_name is
     set — every non-attention op is position-wise, so only attention needs
-    the ring). Single-device attention uses the fused Pallas flash kernel
-    (no [S, S] score matrix in HBM); attention_impl="reference" keeps the
-    dense XLA path for cross-checks. positional=True adds sinusoidal
+    a cross-shard strategy). Single-device attention uses the fused Pallas
+    flash kernel (no [S, S] score matrix in HBM); attention_impl=
+    "reference" keeps the dense XLA path for cross-checks. Sharded
+    (axis_name set): attention_impl="ulysses" picks the all-to-all
+    head-sharding strategy (needs num_heads divisible by the axis size),
+    anything else the ppermute ring. positional=True adds sinusoidal
     encodings — under sequence parallelism each shard offsets by its
     GLOBAL start position, so sharded and dense runs encode identically."""
     b, s, d = x.shape
@@ -104,6 +108,9 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
                 att = flash_attention(q, k, v, causal=causal)
             else:
                 att = attention_reference(q, k, v, causal=causal)
+        elif attention_impl == "ulysses":
+            att = ulysses_attention_sharded(q, k, v, axis_name,
+                                            causal=causal)
         else:
             att = ring_attention_sharded(q, k, v, axis_name, causal=causal)
         x = x + _apply(lp["proj"], att.reshape(b, s, d))
@@ -381,13 +388,19 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
     [N, S, D] or object column); outputCol receives the encoded [S, D]
     sequence (or its mean-pooled [D] vector with pool='mean').
 
-    numTasks > 1 shards the SEQUENCE axis over the mesh and runs ring
-    attention — the long-context path. Weights live host-side in a pytree
-    (`params`), loadable from the downloader/zoo like DNNModel weights.
+    numTasks > 1 shards the SEQUENCE axis over the mesh — the long-context
+    path — with `sequenceAttention` choosing the cross-shard strategy:
+    'ring' (ppermute K/V rotation, any head count) or 'ulysses'
+    (all-to-all head sharding, heads divisible by the axis). Weights live
+    host-side in a pytree (`params`), loadable from the downloader/zoo
+    like DNNModel weights.
     """
 
     numHeads = _p.Param("numHeads", "attention heads", 4, int)
     causal = _p.Param("causal", "causal (autoregressive) masking", False)
+    sequenceAttention = _p.Param(
+        "sequenceAttention",
+        "sequence-parallel attention strategy: ring | ulysses", "ring")
     positionalEncoding = _p.Param(
         "positionalEncoding", "add sinusoidal positional encodings (global "
         "positions — sequence-parallel shards offset by their slice start)",
@@ -414,7 +427,11 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
         causal = self.get("causal")
         ndev = self.get("numTasks")
         pos = self.get("positionalEncoding")
-        key = (nh, causal, ndev, pos)
+        seq_attn = self.get("sequenceAttention")
+        if seq_attn not in ("ring", "ulysses"):
+            raise ValueError(f"sequenceAttention must be 'ring' or "
+                             f"'ulysses', got {seq_attn!r}")
+        key = (nh, causal, ndev, pos, seq_attn)
         cached = getattr(self, "_fwd_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -424,7 +441,8 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
             axis = meshlib.DATA_AXIS
             fn = jax.jit(jax.shard_map(
                 partial(encoder_forward, num_heads=nh, causal=causal,
-                        axis_name=axis, positional=pos),
+                        axis_name=axis, positional=pos,
+                        attention_impl=seq_attn),
                 mesh=mesh, in_specs=(P(), P(None, axis, None)),
                 out_specs=P(None, axis, None), check_vma=False))
         else:
@@ -619,13 +637,16 @@ class TransformerClassificationModel(Model, _p.HasInputCol):
 def make_sp_train_step(mesh, num_heads: int, learning_rate: float,
                        num_classes: int, causal: bool = False,
                        seq_axis: Optional[str] = None,
-                       positional: bool = False):
+                       positional: bool = False,
+                       attention_impl: str = "ring"):
     """Sequence-parallel transformer training over the mesh: the SEQUENCE
     axis is sharded (the long-context regime — activations for contexts far
     beyond one chip's HBM), parameters replicated, attention via the
-    ppermute ring (ops/attention.ring_attention_sharded), whose reverse-mode
-    transpose JAX derives exactly (ppermute transposes to the inverse
-    rotation, so gradients ride the ring backwards).
+    ppermute ring (ops/attention.ring_attention_sharded, default) or the
+    all-to-all ulysses path (attention_impl="ulysses"); both reverse-mode
+    transposes JAX derives exactly (ppermute transposes to the inverse
+    rotation so gradients ride the ring backwards; all_to_all transposes
+    to the opposite all_to_all).
 
     Gradient bookkeeping: encoder parameters act on LOCAL positions, so each
     shard holds a partial gradient — psum over the sequence axis. The head
@@ -640,13 +661,17 @@ def make_sp_train_step(mesh, num_heads: int, learning_rate: float,
     import optax
     from ...parallel import mesh as meshlib
     from jax.sharding import PartitionSpec as P
+    if attention_impl not in ("ring", "ulysses"):
+        raise ValueError(f"attention_impl must be 'ring' or 'ulysses', "
+                         f"got {attention_impl!r}")
     seq_axis = seq_axis or meshlib.DATA_AXIS
     n_sp = mesh.shape[seq_axis]
     tx = optax.adam(learning_rate)
 
     def loss_fn(params, x_local, y):
         enc = encoder_forward(params["encoder"], x_local, num_heads, causal,
-                              axis_name=seq_axis, positional=positional)
+                              axis_name=seq_axis, positional=positional,
+                              attention_impl=attention_impl)
         s_glob = x_local.shape[1] * n_sp
         pooled = _reduce_from_model_shards(enc.sum(axis=1),
                                            seq_axis) / s_glob

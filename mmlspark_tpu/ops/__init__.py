@@ -1,7 +1,9 @@
 """TPU compute kernels: GBDT engine, histograms, attention, binning, ranking."""
 
-from .attention import attention_reference, ring_attention
+from .attention import (attention_reference, ring_attention,
+                        ulysses_attention)
 from .histogram import build_histogram, hist_slots
 
-__all__ = ["attention_reference", "ring_attention", "build_histogram",
+__all__ = ["attention_reference", "ring_attention",
+           "ulysses_attention", "build_histogram",
            "hist_slots"]

@@ -116,6 +116,61 @@ def ring_attention(q, k, v, mesh, axis_name: str = "data",
     return fn(q, k, v)
 
 
+def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                              axis_name: str,
+                              causal: bool = False) -> jax.Array:
+    """Shard-local Ulysses (all-to-all) sequence parallelism body (call
+    inside shard_map/pjit). The complementary long-context strategy to the
+    ppermute ring: one all-to-all converts the SEQUENCE sharding into a
+    HEAD sharding (each device receives the FULL sequence for H/P of the
+    heads), exact attention runs locally per head group, and a second
+    all-to-all restores sequence sharding.
+
+    q, k, v: [B, S_local, H, D] with H divisible by the axis size.
+
+    Trade-off vs the ring (DeepSpeed-Ulysses, arXiv:2309.14509): 4
+    all-to-alls of O(B*S_local*H*D) activations per call (q, k, v in, one
+    out) vs the ring's P-1 ppermutes of K/V — fewer, larger collectives
+    (better when ICI latency dominates and H >= P), at the cost of holding
+    full-S K/V per device (the ring never materializes more than one
+    remote block). No reference analogue — SURVEY.md §5 records the
+    reference has no sequence parallelism at all.
+    """
+    p_count = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % p_count:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by the '{axis_name}' "
+            f"axis ({p_count} devices); use ring attention otherwise")
+
+    def seq_to_heads(x):
+        # [B, S_loc, H, D] --all_to_all(H->S)--> [B, S_loc*P, H/P, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_reference(qg, kg, vg, causal=causal)
+    # [B, S, H/P, D] --all_to_all(S->H)--> [B, S_loc, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "data",
+                      causal: bool = False) -> jax.Array:
+    """Driver: shard q/k/v over `axis_name` on the sequence dimension and
+    run the all-to-all path. q,k,v: [B, S, H, D]; S divisible by the axis
+    size, H divisible by the axis size."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(ulysses_attention_sharded, axis_name=axis_name,
+                causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
 # ---------------------------------------------------------------------------
 # Single-device flash attention (Pallas)
 # ---------------------------------------------------------------------------
