@@ -241,7 +241,8 @@ def _encoder_forward_tp(params, x, num_heads_local, model_axis,
 def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
                           num_classes: int, causal: bool = False,
                           data_axis: Optional[str] = None,
-                          model_axis: Optional[str] = None):
+                          model_axis: Optional[str] = None,
+                          zero1: bool = False):
     """One distributed transformer training step over a 2-D (data, model)
     mesh: batch data-parallel, layers tensor-parallel (Megatron split),
     Adam, softmax cross-entropy on the mean-pooled encoding.
@@ -254,6 +255,16 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
     tensor-parallel shards own disjoint parameter slices, and replicated
     LN/head parameters see identical activations on every model shard, so
     their gradients already agree across the model axis.
+
+    zero1=True shards the Adam state over the DATA axis (ZeRO stage 1 /
+    the scaling-book optimizer-sharding recipe): the data-axis psum of
+    gradients becomes a psum_scatter (reduce_scatter), each dp rank runs
+    Adam on its 1/dp slice of the flattened parameter vector, and one
+    tiled all_gather rebuilds the replicated parameters — identical math
+    to the replicated optimizer (regression-gated), with per-device
+    optimizer memory cut by the data-axis size and the psum's O(|g|)
+    traffic replaced by reduce_scatter + all_gather of the same total
+    volume.
 
     Returns (step, shard_params) where
       step(local_params, opt_state, x_local, y_local) is shard_map'd over
@@ -302,25 +313,73 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
         return (jax.tree_util.tree_map(lift, params),
                 jax.tree_util.tree_map(lift, opt_state), loss)
 
-    sharded = jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(P(model_axis), P(model_axis),
-                  P(data_axis), P(data_axis)),
-        out_specs=(P(model_axis), P(model_axis), P()),
-        check_vma=False)
+    def step_zero1(params, opt_state, x, y):
+        # ZeRO-1: Adam moments live only on the dp rank that owns the
+        # slice. The SAME `tx` drives the update — applied to the flat
+        # gradient shard — so any optimizer-config change flows to both
+        # paths by construction (adam's update is elementwise and ignores
+        # params, which makes the flat-shard application exact).
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        opt_state = jax.tree_util.tree_map(lambda a: a[0, 0], opt_state)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        loss = jax.lax.psum(loss, data_axis)
+        denom = x.shape[0] * n_dp
+        loss = loss / denom
+        from jax.flatten_util import ravel_pytree
+        flat_g, _ = ravel_pytree(grads)
+        size = flat_g.shape[0]
+        pad = (-size) % n_dp
+        flat_g = jnp.pad(flat_g, (0, pad)) / denom
+        # reduce_scatter: rank d receives the dp-sum of chunk d only
+        g_shard = jax.lax.psum_scatter(flat_g, data_axis,
+                                       scatter_dimension=0, tiled=True)
+        upd_shard, opt_state = tx.update(g_shard, opt_state)
+        upd_full = jax.lax.all_gather(upd_shard, data_axis,
+                                      tiled=True)[:size]
+        flat_p, unravel = ravel_pytree(params)
+        params = unravel(flat_p + upd_full)
+        lift = lambda a: a[None]
+        lift2 = lambda a: a[None, None]
+        return (jax.tree_util.tree_map(lift, params),
+                jax.tree_util.tree_map(lift2, opt_state), loss)
+
+    if zero1:
+        opt_spec = P(model_axis, data_axis)
+        sharded = jax.shard_map(
+            step_zero1, mesh=mesh,
+            in_specs=(P(model_axis), opt_spec,
+                      P(data_axis), P(data_axis)),
+            out_specs=(P(model_axis), opt_spec, P()),
+            check_vma=False)
+    else:
+        sharded = jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(model_axis), P(model_axis),
+                      P(data_axis), P(data_axis)),
+            out_specs=(P(model_axis), P(model_axis), P()),
+            check_vma=False)
 
     def shard_params(full_params, head):
         """Host-side split of full parameters (+ fresh Adam state) into the
         per-model-shard stacked layout the step consumes (leading axis =
-        model shards)."""
+        model shards; zero1 also chunks the flat optimizer state over the
+        data axis: [tp, dp, chunk])."""
         shards = [
             {"encoder": shard_encoder_params(full_params, r, tp, num_heads),
              "head": head}
             for r in range(tp)]
-        opt_shards = [tx.init(s) for s in shards]
         stack = lambda *xs: jnp.stack(xs)
-        return (jax.tree_util.tree_map(stack, *shards),
-                jax.tree_util.tree_map(stack, *opt_shards))
+        stacked = jax.tree_util.tree_map(stack, *shards)
+        if not zero1:
+            opt_shards = [tx.init(s) for s in shards]
+            return stacked, jax.tree_util.tree_map(stack, *opt_shards)
+        from jax.flatten_util import ravel_pytree
+        size = ravel_pytree(shards[0])[0].shape[0]
+        chunk = -(-size // n_dp)
+        opt0 = tx.init(jnp.zeros((chunk,), jnp.float32))
+        tile = lambda a: jnp.broadcast_to(
+            jnp.asarray(a)[None, None], (tp, n_dp) + jnp.shape(a))
+        return stacked, jax.tree_util.tree_map(tile, opt0)
 
     return jax.jit(sharded), shard_params
 
