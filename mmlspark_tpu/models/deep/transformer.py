@@ -21,6 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.flatten_util import ravel_pytree
 
 from ...core import params as _p
 from ...core.dataframe import DataFrame
@@ -79,7 +80,8 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
                     causal: bool = False,
                     axis_name: Optional[str] = None,
                     attention_impl: str = "flash",
-                    positional: bool = False) -> jax.Array:
+                    positional: bool = False,
+                    remat: bool = False) -> jax.Array:
     """Pre-LN encoder stack. x: [B, S, D] (shard-local S when axis_name is
     set — every non-attention op is position-wise, so only attention needs
     a cross-shard strategy). Single-device attention uses the fused Pallas
@@ -99,7 +101,7 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
             start = jax.lax.axis_index(axis_name) * s
         x = x + sinusoidal_positions(start.astype(jnp.float32), s,
                                      d)[None, :, :]
-    for lp in params["layers"]:
+    def layer(x, lp):
         h = _layer_norm(x, lp["ln1"])
         qkv = _apply(lp["qkv"], h).reshape(b, s, 3, num_heads, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -115,7 +117,17 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
             att = ring_attention_sharded(q, k, v, axis_name, causal=causal)
         x = x + _apply(lp["proj"], att.reshape(b, s, d))
         h = _layer_norm(x, lp["ln2"])
-        x = x + _apply(lp["ff2"], jax.nn.gelu(_apply(lp["ff1"], h)))
+        return x + _apply(lp["ff2"], jax.nn.gelu(_apply(lp["ff1"], h)))
+
+    if remat:
+        # rematerialisation: drop per-layer activations on the forward pass
+        # and recompute them in the backward — activation memory falls from
+        # O(layers) to O(1) residual streams (+ the recomputed layer),
+        # trading ~1/3 more FLOPs. The long-context lever: HBM, not MXU, is
+        # the training-batch ceiling.
+        layer = jax.checkpoint(layer)
+    for lp in params["layers"]:
+        x = layer(x, lp)
     return x
 
 
@@ -215,15 +227,18 @@ _reduce_from_model_shards.defvjp(_reduce_fwd, _reduce_bwd)
 
 
 def _encoder_forward_tp(params, x, num_heads_local, model_axis,
-                        causal=False):
+                        causal=False, remat=False):
     """Encoder forward on tensor-parallel layer shards: attention over the
     LOCAL heads and MLP over the LOCAL hidden slice, with ONE psum over the
     model axis per residual branch (the Megatron pattern: column-parallel
     then row-parallel matmuls, communication only at the row-parallel
     output, conjugate f/g operators making the per-shard backward exact).
-    Everything else is replicated across the model axis."""
+    Everything else is replicated across the model axis. remat=True
+    recomputes each layer in the backward pass (jax.checkpoint) — the
+    activation-memory lever for deep stacks."""
     b, s, d = x.shape
-    for lp in params["layers"]:
+
+    def layer(x, lp):
         h = _copy_to_model_shards(_layer_norm(x, lp["ln1"]), model_axis)
         dloc = lp["qkv"]["w"].shape[1] // 3
         hd = dloc // num_heads_local
@@ -234,7 +249,12 @@ def _encoder_forward_tp(params, x, num_heads_local, model_axis,
         x = x + _reduce_from_model_shards(part, model_axis) + lp["proj"]["b"]
         h = _copy_to_model_shards(_layer_norm(x, lp["ln2"]), model_axis)
         part = jax.nn.gelu(_apply(lp["ff1"], h)) @ lp["ff2"]["w"]
-        x = x + _reduce_from_model_shards(part, model_axis) + lp["ff2"]["b"]
+        return x + _reduce_from_model_shards(part, model_axis) + lp["ff2"]["b"]
+
+    if remat:
+        layer = jax.checkpoint(layer)
+    for lp in params["layers"]:
+        x = layer(x, lp)
     return x
 
 
@@ -242,7 +262,8 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
                           num_classes: int, causal: bool = False,
                           data_axis: Optional[str] = None,
                           model_axis: Optional[str] = None,
-                          zero1: bool = False):
+                          zero1: bool = False,
+                          remat: bool = False):
     """One distributed transformer training step over a 2-D (data, model)
     mesh: batch data-parallel, layers tensor-parallel (Megatron split),
     Adam, softmax cross-entropy on the mean-pooled encoding.
@@ -286,7 +307,7 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
 
     def loss_fn(params, x, y):
         enc = _encoder_forward_tp(params["encoder"], x, nh_loc, model_axis,
-                                  causal)
+                                  causal, remat=remat)
         pooled = enc.mean(axis=1)
         logits = pooled @ params["head"]["w"] + params["head"]["b"]
         logp = jax.nn.log_softmax(logits, axis=-1)
@@ -295,18 +316,22 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
         # batch so the result equals the full-batch mean loss
         return -jnp.sum(onehot * logp)
 
-    def step(params, opt_state, x, y):
-        # params/opt_state arrive with a size-1 leading model-shard axis
-        # (the host-side stack sharded over the model axis) — peel it for
-        # compute, restore it for the output specs
+    def peeled_loss_and_grads(params, x, y):
+        # params arrive with a size-1 leading model-shard axis (the
+        # host-side stack sharded over the model axis) — peel it for
+        # compute. Shared by both optimizer paths so the loss/gradient
+        # semantics cannot drift between them.
         params = jax.tree_util.tree_map(lambda a: a[0], params)
-        opt_state = jax.tree_util.tree_map(lambda a: a[0], opt_state)
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-        loss = jax.lax.psum(loss, data_axis)
-        grads = jax.lax.psum(grads, data_axis)
         denom = x.shape[0] * n_dp
-        loss = loss / denom
-        grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+        loss = jax.lax.psum(loss, data_axis) / denom
+        return params, grads, loss, denom
+
+    def step(params, opt_state, x, y):
+        params, grads, loss, denom = peeled_loss_and_grads(params, x, y)
+        opt_state = jax.tree_util.tree_map(lambda a: a[0], opt_state)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, data_axis) / denom, grads)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         lift = lambda a: a[None]
@@ -314,30 +339,30 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
                 jax.tree_util.tree_map(lift, opt_state), loss)
 
     def step_zero1(params, opt_state, x, y):
-        # ZeRO-1: Adam moments live only on the dp rank that owns the
-        # slice. The SAME `tx` drives the update — applied to the flat
-        # gradient shard — so any optimizer-config change flows to both
-        # paths by construction (adam's update is elementwise and ignores
-        # params, which makes the flat-shard application exact).
-        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        # ZeRO-1: optimizer state lives only on the dp rank that owns the
+        # slice. The SAME `tx` drives the update, applied to the owned
+        # (gradient shard, parameter shard) pair and finished with
+        # optax.apply_updates — so params-dependent transforms (weight
+        # decay) and dtype handling behave exactly as on the replicated
+        # path; only WHERE the state lives differs.
+        params, grads, loss, _denom = peeled_loss_and_grads(params, x, y)
         opt_state = jax.tree_util.tree_map(lambda a: a[0, 0], opt_state)
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-        loss = jax.lax.psum(loss, data_axis)
-        denom = x.shape[0] * n_dp
-        loss = loss / denom
-        from jax.flatten_util import ravel_pytree
         flat_g, _ = ravel_pytree(grads)
         size = flat_g.shape[0]
         pad = (-size) % n_dp
-        flat_g = jnp.pad(flat_g, (0, pad)) / denom
+        flat_g = jnp.pad(flat_g, (0, pad)) / _denom
         # reduce_scatter: rank d receives the dp-sum of chunk d only
         g_shard = jax.lax.psum_scatter(flat_g, data_axis,
                                        scatter_dimension=0, tiled=True)
-        upd_shard, opt_state = tx.update(g_shard, opt_state)
-        upd_full = jax.lax.all_gather(upd_shard, data_axis,
-                                      tiled=True)[:size]
         flat_p, unravel = ravel_pytree(params)
-        params = unravel(flat_p + upd_full)
+        chunk = g_shard.shape[0]
+        rank = jax.lax.axis_index(data_axis)
+        p_shard = jax.lax.dynamic_slice_in_dim(
+            jnp.pad(flat_p, (0, pad)), rank * chunk, chunk)
+        upd_shard, opt_state = tx.update(g_shard, opt_state, p_shard)
+        p_shard = optax.apply_updates(p_shard, upd_shard)
+        flat_p = jax.lax.all_gather(p_shard, data_axis, tiled=True)[:size]
+        params = unravel(flat_p)
         lift = lambda a: a[None]
         lift2 = lambda a: a[None, None]
         return (jax.tree_util.tree_map(lift, params),
@@ -373,7 +398,6 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
         if not zero1:
             opt_shards = [tx.init(s) for s in shards]
             return stacked, jax.tree_util.tree_map(stack, *opt_shards)
-        from jax.flatten_util import ravel_pytree
         size = ravel_pytree(shards[0])[0].shape[0]
         chunk = -(-size // n_dp)
         opt0 = tx.init(jnp.zeros((chunk,), jnp.float32))
@@ -697,7 +721,8 @@ def make_sp_train_step(mesh, num_heads: int, learning_rate: float,
                        num_classes: int, causal: bool = False,
                        seq_axis: Optional[str] = None,
                        positional: bool = False,
-                       attention_impl: str = "ring"):
+                       attention_impl: str = "ring",
+                       remat: bool = False):
     """Sequence-parallel transformer training over the mesh: the SEQUENCE
     axis is sharded (the long-context regime — activations for contexts far
     beyond one chip's HBM), parameters replicated, attention via the
@@ -730,7 +755,7 @@ def make_sp_train_step(mesh, num_heads: int, learning_rate: float,
     def loss_fn(params, x_local, y):
         enc = encoder_forward(params["encoder"], x_local, num_heads, causal,
                               axis_name=seq_axis, positional=positional,
-                              attention_impl=attention_impl)
+                              attention_impl=attention_impl, remat=remat)
         s_glob = x_local.shape[1] * n_sp
         pooled = _reduce_from_model_shards(enc.sum(axis=1),
                                            seq_axis) / s_glob
