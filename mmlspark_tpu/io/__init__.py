@@ -1,8 +1,8 @@
 """Data plane: HTTP stack, serving, file readers (reference: io/, 16 files +
 Spark Serving, 5 files)."""
 
-from .files import (decode_image, read_binary_files, read_images,
-                    write_to_powerbi)
+from .files import (decode_image, read_binary_files, read_csv,
+                    read_images, read_libsvm, write_to_powerbi)
 from .http import (AsyncClient, CustomInputParser, CustomOutputParser,
                    HTTPRequestData, HTTPResponseData, HTTPTransformer,
                    JSONInputParser, JSONOutputParser, SimpleHTTPTransformer,
@@ -26,7 +26,8 @@ __all__ = [
     "make_reply",
     "SharedSingleton", "SharedVariable", "PartitionConsolidator",
     "RateLimiter",
-    "read_binary_files", "read_images", "decode_image", "write_to_powerbi",
+    "read_binary_files", "read_images", "read_csv", "read_libsvm",
+    "decode_image", "write_to_powerbi",
     "FileStreamSource", "StreamingQuery",
     "ServingCoordinator", "DistributedServingServer", "ServiceInfo",
     "fetch_routes", "register_with_retries",

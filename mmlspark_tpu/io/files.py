@@ -14,7 +14,7 @@ from __future__ import annotations
 import fnmatch
 import json
 import os
-from typing import List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -135,3 +135,116 @@ def _plain(v):
     if isinstance(v, np.floating):
         return float(v)
     return v
+
+
+def read_csv(path: str, header: bool = True, sep: str = ",",
+             column_names: Optional[List[str]] = None) -> DataFrame:
+    """CSV -> DataFrame (the `spark.read.csv` role; reference pipelines load
+    every benchmark dataset this way — Benchmarks.scala readCSV).
+
+    Purely numeric files take a C++ fast path (utils/native.parse_csv_f32 —
+    the host data-loader role the reference delegates to Spark's reader);
+    anything else falls back to python csv with per-column type inference
+    (float64 where every non-empty value parses, else object strings;
+    empty fields become NaN / None).
+    """
+    import csv as _csv
+
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    if raw.startswith(b"\xef\xbb\xbf"):  # UTF-8 BOM
+        raw = raw[3:]
+    first_nl = raw.find(b"\n")
+    if first_nl < 0:
+        first_nl = len(raw)
+    header_line = raw[:first_nl].rstrip(b"\r").decode("utf-8")
+    # csv-parse the header so quoted fields containing the separator can't
+    # misalign columns against the csv.reader fallback
+    parsed_header = next(iter(_csv.reader([header_line], delimiter=sep)),
+                         [])
+    body_after_header = raw[first_nl + 1:]
+    if column_names is not None:
+        names = list(column_names)
+        # header=True still means the file HAS a header row to skip
+        body_b = body_after_header if header else raw
+    elif header:
+        names = [c.strip() for c in parsed_header]
+        body_b = body_after_header
+    else:
+        names = [f"_c{i}" for i in range(len(parsed_header))]
+        body_b = raw
+    n_rows = body_b.count(b"\n") + (
+        0 if body_b.endswith(b"\n") or not body_b else 1)
+    from ..utils.native import parse_csv_f32
+    mat = parse_csv_f32(body_b, n_rows, len(names), sep=sep)
+    if mat is not None:
+        return DataFrame({name: mat[:, j].astype(np.float64)
+                          for j, name in enumerate(names)})
+
+    rows = [r for r in _csv.reader(body_b.decode("utf-8").splitlines(),
+                                   delimiter=sep) if r]
+    cols: Dict[str, Any] = {}
+    for j, name in enumerate(names):
+        vals = [r[j].strip() if j < len(r) else "" for r in rows]
+        try:
+            cols[name] = np.asarray(
+                [float(v) if v != "" else np.nan for v in vals], np.float64)
+        except ValueError:
+            cols[name] = np.asarray(
+                [v if v != "" else None for v in vals], dtype=object)
+    return DataFrame(cols)
+
+
+def read_libsvm(path: str, n_features: Optional[int] = None,
+                features_col: str = "features",
+                label_col: str = "label") -> DataFrame:
+    """LibSVM/SVMLight text -> DataFrame with a CSR features column (the
+    `spark.read.format("libsvm")` role — upstream LightGBM's canonical
+    dataset format, LGBM_DatasetCreateFromCSRSpark ingestion analogue).
+
+    Lines: `<label> [qid:<q>] <index>:<value> ...`. Indices may be 1-based
+    (the LibSVM convention) or 0-based — detected from the file minimum.
+    `qid:` tokens (the ranking format) become a `group` column. Comments
+    after `#` are ignored. The column stays sparse above the ingestion
+    densify threshold, dense below it (core/dataframe rules).
+    """
+    labels: List[float] = []
+    groups: List[int] = []
+    indptr = [0]
+    indices: List[int] = []
+    values: List[float] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                idx, val = tok.split(":", 1)
+                if idx == "qid":
+                    groups.append(int(val))
+                    continue
+                indices.append(int(idx))
+                values.append(float(val))
+            indptr.append(len(indices))
+    if not labels:
+        raise ValueError(f"no rows in {path!r}")
+    if groups and len(groups) != len(labels):
+        raise ValueError(f"{path!r}: {len(groups)} qid tokens for "
+                         f"{len(labels)} rows — ranking files need one per "
+                         "row")
+    idx_arr = np.asarray(indices, np.int64)
+    one_based = bool(len(idx_arr)) and idx_arr.min() >= 1
+    if one_based:
+        idx_arr = idx_arr - 1
+    width = n_features or (int(idx_arr.max()) + 1 if len(idx_arr) else 0)
+    from scipy.sparse import csr_matrix
+    mat = csr_matrix(
+        (np.asarray(values, np.float32), idx_arr,
+         np.asarray(indptr, np.int64)),
+        shape=(len(labels), width))
+    data = {features_col: mat, label_col: np.asarray(labels, np.float64)}
+    if groups:
+        data["group"] = np.asarray(groups, np.int64)
+    return DataFrame(data)

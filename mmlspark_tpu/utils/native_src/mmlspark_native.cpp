@@ -10,6 +10,8 @@
 // Exposed with a plain C ABI and loaded from Python via ctypes (no pybind11).
 
 #include <cstdint>
+#include <cstdlib>
+#include <limits>
 #include <cstring>
 #include <cmath>
 #include <algorithm>
@@ -141,6 +143,65 @@ void mml_unroll_chw(const uint8_t* src, int64_t h, int64_t w, int64_t c,
     for (int64_t y = 0; y < h; y++)
       for (int64_t x = 0; x < w; x++)
         dst[k * h * w + y * w + x] = src[(y * w + x) * c + k] * scale[k] + shift[k];
+}
+
+// ---------------------------------------------------------- csv parsing
+// Numeric-CSV fast path (the host data-loader role Spark's csv reader
+// plays for the reference; BinaryFileFormat.scala is the binary analogue).
+// Parses `n_rows * n_cols` floats from a comma/`sep`-separated text
+// buffer into `out` (row-major float32). Empty fields and the literal
+// strings na/nan (any case) become NaN. Returns the number of rows
+// actually parsed (stops early on a malformed row, so the caller can
+// fall back for the remainder or raise).
+int64_t mml_parse_csv_f32(const char* buf, int64_t len, char sep,
+                          int64_t n_rows, int64_t n_cols, float* out) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = 0;
+  while (row < n_rows && p < end) {
+    // skip blank lines (the python fallback drops them too; a mismatch in
+    // parsed-row count makes the caller fall back, keeping both paths
+    // consistent on files with interior blanks)
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    for (int64_t c = 0; c < n_cols; ++c) {
+      // field start: skip spaces
+      while (p < end && *p == ' ') ++p;
+      const char* fs = p;
+      while (p < end && *p != sep && *p != '\n' && *p != '\r') ++p;
+      int64_t flen = p - fs;
+      float v;
+      if (flen == 0 ||
+          (flen == 2 && (fs[0] == 'n' || fs[0] == 'N') &&
+           (fs[1] == 'a' || fs[1] == 'A')) ||
+          (flen == 3 && (fs[0] == 'n' || fs[0] == 'N') &&
+           (fs[1] == 'a' || fs[1] == 'A') &&
+           (fs[2] == 'n' || fs[2] == 'N'))) {
+        v = std::numeric_limits<float>::quiet_NaN();
+      } else {
+        char* fe = nullptr;
+        v = strtof(fs, &fe);
+        // strtof may read past sep only if the field is malformed; any
+        // unconsumed non-space chars inside the field abort the fast path
+        const char* q = fe;
+        while (q < fs + flen && *q == ' ') ++q;
+        if (fe == fs || q != fs + flen) return row;
+      }
+      out[row * n_cols + c] = v;
+      if (c + 1 < n_cols) {
+        if (p >= end || *p != sep) return row;
+        ++p;  // consume sep
+      }
+    }
+    // consume end of line (accept \r\n, \n, or EOF)
+    if (p < end && *p == '\r') ++p;
+    if (p < end) {
+      if (*p != '\n') return row;
+      ++p;
+    }
+    ++row;
+  }
+  return row;
 }
 
 }  // extern "C"

@@ -52,6 +52,8 @@ SLOW_TESTS = {
     ("test_examples", "test_distributed_transformer"),
     ("test_examples", "test_hyperparam_sweep"),
     ("test_examples", "test_gbdt_quickstart"),
+    ("test_attention", "test_sp_training_with_ulysses_matches_ring"),
+    ("test_attention", "test_gradients_flow_through_all_to_all"),
 }
 
 

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu import DataFrame
+from mmlspark_tpu.io import read_csv, read_libsvm
 from mmlspark_tpu.io import (HTTPRequestData, HTTPTransformer,
                              JSONOutputParser, PartitionConsolidator,
                              ServingServer, SharedSingleton,
@@ -207,3 +208,140 @@ def test_powerbi_writer(echo_server):
     n = write_to_powerbi(df, url + "/echo", batch_size=10)
     assert n == 3
     assert state["requests"] - before == 3
+
+
+class TestReadCSV:
+    """spark.read.csv role (Benchmarks.scala readCSV): numeric C++ fast
+    path + python fallback with type inference."""
+
+    def test_numeric_fast_path(self, tmp_path):
+        p = tmp_path / "num.csv"
+        p.write_text("a,b,label\n1.5,2,0\n-3,4e2,1\n,nan,0\n")
+        df = read_csv(str(p))
+        assert df.columns == ["a", "b", "label"]
+        np.testing.assert_allclose(df["b"], [2.0, 400.0, np.nan])
+        assert np.isnan(df["a"][2])
+        np.testing.assert_allclose(df["label"], [0, 1, 0])
+
+    def test_fast_path_matches_python_fallback(self, tmp_path):
+        import os as _os
+        rng = np.random.default_rng(0)
+        mat = rng.normal(size=(200, 5))
+        p = tmp_path / "m.csv"
+        p.write_text("\n".join(
+            ",".join(f"{v:.9g}" for v in row) for row in mat) + "\n")
+        fast = read_csv(str(p), header=False)
+        env = dict(_os.environ)
+        try:
+            _os.environ["MMLSPARK_TPU_NO_NATIVE"] = "1"
+            from mmlspark_tpu.utils import native as _n
+            old = _n._lib, _n._tried
+            _n._lib, _n._tried = None, False
+            slow = read_csv(str(p), header=False)
+            _n._lib, _n._tried = old
+        finally:
+            _os.environ.clear()
+            _os.environ.update(env)
+        for c in fast.columns:
+            np.testing.assert_allclose(fast[c], slow[c], rtol=1e-6)
+
+    def test_mixed_types_fall_back(self, tmp_path):
+        p = tmp_path / "mixed.csv"
+        p.write_text("name,score\nalice,1.5\nbob,\n")
+        df = read_csv(str(p))
+        assert list(df["name"]) == ["alice", "bob"]
+        assert df["score"][1] != df["score"][1]  # NaN
+        assert df["name"].dtype == object
+
+    def test_no_header_and_fit(self, tmp_path):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(400, 4))
+        y = (x @ [1, -1, 2, 0.5] > 0).astype(float)
+        p = tmp_path / "train.csv"
+        p.write_text("".join(
+            ",".join(f"{v:.6g}" for v in row) + f",{int(t)}\n"
+            for row, t in zip(x, y)))
+        df = read_csv(str(p), header=False)
+        assert len(df) == 400 and len(df.columns) == 5
+        from mmlspark_tpu.train import TrainClassifier
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+        model = TrainClassifier(model=LightGBMClassifier(numIterations=20),
+                                labelCol="_c4").fit(df)
+        out = model.transform(df)
+        assert (out["scored_labels"] == df["_c4"]).mean() > 0.9
+
+
+class TestReadLibSVM:
+    """spark.read.format('libsvm') role — upstream LightGBM's canonical
+    text dataset format (CSR ingestion)."""
+
+    def test_one_based_sparse(self, tmp_path):
+        p = tmp_path / "a.libsvm"
+        p.write_text("1 1:0.5 3:2.0 # comment\n0 2:1.5\n1 1:1.0 4:-1\n")
+        df = read_libsvm(str(p))
+        feats = df["features"]
+        dense = feats.toarray() if hasattr(feats, "toarray") \
+            else np.stack(feats)
+        np.testing.assert_allclose(
+            dense, [[0.5, 0, 2.0, 0], [0, 1.5, 0, 0], [1.0, 0, 0, -1]])
+        np.testing.assert_allclose(df["label"], [1, 0, 1])
+
+    def test_zero_based_and_fit(self, tmp_path):
+        rng = np.random.default_rng(2)
+        lines = []
+        for i in range(300):
+            x0, x2 = rng.normal(), rng.normal()
+            label = int(x0 - x2 > 0)
+            lines.append(f"{label} 0:{x0:.5f} 2:{x2:.5f}")
+        p = tmp_path / "b.libsvm"
+        p.write_text("\n".join(lines) + "\n")
+        df = read_libsvm(str(p), n_features=3)
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+        m = LightGBMClassifier(numIterations=25).fit(df)
+        out = m.transform(df)
+        assert (np.asarray(out["prediction"]) == df["label"]).mean() > 0.9
+
+
+class TestReaderEdgeCases:
+    """Review-driven edge cases: the fast path and fallback must agree."""
+
+    def test_column_names_with_header_skips_header_row(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("a,b\n1,2\n3,4\n")
+        df = read_csv(str(p), column_names=["x", "y"])  # header=True default
+        assert len(df) == 2
+        np.testing.assert_allclose(df["x"], [1, 3])
+        df2 = read_csv(str(p), column_names=["x", "y"], header=False)
+        assert len(df2) == 3 and df2["x"].dtype == object  # 'a' row kept
+
+    def test_quoted_header_fields(self, tmp_path):
+        p = tmp_path / "q.csv"
+        p.write_text('id,"name, first",score\n1,"x, y",2\n')
+        df = read_csv(str(p))
+        assert df.columns == ["id", "name, first", "score"]
+        assert list(df["name, first"]) == ["x, y"]
+        np.testing.assert_allclose(df["score"], [2.0])
+
+    def test_blank_interior_line_consistent(self, tmp_path):
+        p = tmp_path / "blank.csv"
+        p.write_text("v\n1\n\n2\n")
+        df = read_csv(str(p))
+        np.testing.assert_allclose(df["v"], [1, 2])  # blank dropped
+
+    def test_exotic_separator_falls_back(self, tmp_path):
+        p = tmp_path / "sep.csv"
+        p.write_text("a b\n1 2\n")
+        df = read_csv(str(p), sep=" ")
+        np.testing.assert_allclose(df["a"], [1.0])
+        np.testing.assert_allclose(df["b"], [2.0])
+
+    def test_libsvm_qid_ranking_format(self, tmp_path):
+        p = tmp_path / "rank.libsvm"
+        p.write_text("2 qid:1 1:0.5 2:1.0\n1 qid:1 1:0.1\n0 qid:2 2:0.7\n")
+        df = read_libsvm(str(p))
+        np.testing.assert_array_equal(df["group"], [1, 1, 2])
+        np.testing.assert_allclose(df["label"], [2, 1, 0])
+        feats = df["features"]
+        dense = feats.toarray() if hasattr(feats, "toarray") \
+            else np.stack(feats)
+        np.testing.assert_allclose(dense[0], [0.5, 1.0])
