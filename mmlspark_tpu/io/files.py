@@ -142,11 +142,11 @@ def read_csv(path: str, header: bool = True, sep: str = ",",
     """CSV -> DataFrame (the `spark.read.csv` role; reference pipelines load
     every benchmark dataset this way — Benchmarks.scala readCSV).
 
-    Purely numeric files take a C++ fast path (utils/native.parse_csv_f32 —
+    Purely numeric files take a C++ fast path (utils/native.parse_csv_f64 —
     the host data-loader role the reference delegates to Spark's reader);
     anything else falls back to python csv with per-column type inference
     (float64 where every non-empty value parses, else object strings;
-    empty fields become NaN / None).
+    empty/na/nan fields become NaN on both paths).
     """
     import csv as _csv
 
@@ -162,33 +162,41 @@ def read_csv(path: str, header: bool = True, sep: str = ",",
     # misalign columns against the csv.reader fallback
     parsed_header = next(iter(_csv.reader([header_line], delimiter=sep)),
                          [])
-    body_after_header = raw[first_nl + 1:]
     if column_names is not None:
         names = list(column_names)
         # header=True still means the file HAS a header row to skip
-        body_b = body_after_header if header else raw
+        offset = first_nl + 1 if header else 0
     elif header:
         names = [c.strip() for c in parsed_header]
-        body_b = body_after_header
+        offset = first_nl + 1
     else:
         names = [f"_c{i}" for i in range(len(parsed_header))]
-        body_b = raw
-    n_rows = body_b.count(b"\n") + (
-        0 if body_b.endswith(b"\n") or not body_b else 1)
-    from ..utils.native import parse_csv_f32
-    mat = parse_csv_f32(body_b, n_rows, len(names), sep=sep)
+        offset = 0
+    offset = min(offset, len(raw))
+    n_rows = raw.count(b"\n", offset) + (
+        0 if raw.endswith(b"\n") or offset >= len(raw) else 1)
+    from ..utils.native import parse_csv_f64
+    mat = parse_csv_f64(raw, n_rows, len(names), sep=sep, offset=offset)
     if mat is not None:
-        return DataFrame({name: mat[:, j].astype(np.float64)
+        return DataFrame({name: mat[:, j]
                           for j, name in enumerate(names)})
 
-    rows = [r for r in _csv.reader(body_b.decode("utf-8").splitlines(),
-                                   delimiter=sep) if r]
+    def _tofloat(v: str) -> float:
+        # keep the fast path's missing-token convention: '', na, nan (any
+        # case) are NaN on BOTH paths so dtype never depends on which
+        # parser ran
+        if v == "" or v.lower() in ("na", "nan"):
+            return np.nan
+        return float(v)
+
+    rows = [r for r in
+            _csv.reader(raw[offset:].decode("utf-8").splitlines(),
+                        delimiter=sep) if r]
     cols: Dict[str, Any] = {}
     for j, name in enumerate(names):
         vals = [r[j].strip() if j < len(r) else "" for r in rows]
         try:
-            cols[name] = np.asarray(
-                [float(v) if v != "" else np.nan for v in vals], np.float64)
+            cols[name] = np.asarray([_tofloat(v) for v in vals], np.float64)
         except ValueError:
             cols[name] = np.asarray(
                 [v if v != "" else None for v in vals], dtype=object)

@@ -263,7 +263,8 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
                           data_axis: Optional[str] = None,
                           model_axis: Optional[str] = None,
                           zero1: bool = False,
-                          remat: bool = False):
+                          remat: bool = False,
+                          compute_dtype=None):
     """One distributed transformer training step over a 2-D (data, model)
     mesh: batch data-parallel, layers tensor-parallel (Megatron split),
     Adam, softmax cross-entropy on the mean-pooled encoding.
@@ -276,6 +277,13 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
     tensor-parallel shards own disjoint parameter slices, and replicated
     LN/head parameters see identical activations on every model shard, so
     their gradients already agree across the model axis.
+
+    compute_dtype=jnp.bfloat16 runs the forward/backward in bf16 (the
+    MXU-native dtype — 2x the matmul rate and half the activation HBM of
+    f32 on TPU) while parameters, gradients-as-accumulated, and optimizer
+    state stay f32 (mixed-precision master-weight discipline: the cast
+    happens inside the loss, so jax.grad accumulates cotangents back into
+    f32 leaves). Loss curves track f32 to bf16's ~3 decimal digits.
 
     zero1=True shards the Adam state over the DATA axis (ZeRO stage 1 /
     the scaling-book optimizer-sharding recipe): the data-axis psum of
@@ -306,9 +314,18 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
     nh_loc = num_heads // tp
 
     def loss_fn(params, x, y):
-        enc = _encoder_forward_tp(params["encoder"], x, nh_loc, model_axis,
+        enc_params = params["encoder"]
+        if compute_dtype is not None:
+            # ONLY the encoder compute drops precision; the head (and the
+            # loss math) stays f32, and the master params are untouched —
+            # jax.grad accumulates the bf16 cotangents back into f32 leaves
+            # through the cast's transpose
+            enc_params = jax.tree_util.tree_map(
+                lambda a: a.astype(compute_dtype), enc_params)
+            x = x.astype(compute_dtype)
+        enc = _encoder_forward_tp(enc_params, x, nh_loc, model_axis,
                                   causal, remat=remat)
-        pooled = enc.mean(axis=1)
+        pooled = enc.mean(axis=1).astype(jnp.float32)
         logits = pooled @ params["head"]["w"] + params["head"]["b"]
         logp = jax.nn.log_softmax(logits, axis=-1)
         onehot = jax.nn.one_hot(y, num_classes)

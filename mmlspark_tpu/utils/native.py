@@ -79,10 +79,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.mml_unroll_chw.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
-        lib.mml_parse_csv_f32.argtypes = [
+        lib.mml_parse_csv_f64.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_char,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
-        lib.mml_parse_csv_f32.restype = ctypes.c_int64
+        lib.mml_parse_csv_f64.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -161,13 +161,15 @@ def unroll_chw(img: np.ndarray, scale: np.ndarray, shift: np.ndarray) -> np.ndar
     return (chw * scale[:, None, None] + shift[:, None, None]).reshape(-1)
 
 
-def parse_csv_f32(text: bytes, n_rows: int, n_cols: int,
-                  sep: str = ",") -> Optional[np.ndarray]:
+def parse_csv_f64(text: bytes, n_rows: int, n_cols: int,
+                  sep: str = ",", offset: int = 0) -> Optional[np.ndarray]:
     """Numeric-CSV fast path: parse a comma-separated text buffer of
-    n_rows x n_cols floats into a row-major float32 matrix via the C++
-    kernel. Returns None when the native library is unavailable OR the
-    buffer is not purely numeric (the kernel stops at the first malformed
-    row) — callers fall back to the python parser."""
+    n_rows x n_cols numbers into a row-major float64 matrix via the C++
+    kernel (float64 so the dtype matches the python fallback). `offset`
+    skips a header prefix without slicing (one less full-buffer copy).
+    Returns None when the native library is unavailable OR the buffer is
+    not purely numeric (the kernel stops at the first malformed row) —
+    callers fall back to the python parser."""
     lib = get_lib()
     if lib is None or n_rows == 0 or n_cols == 0:
         return None
@@ -177,10 +179,11 @@ def parse_csv_f32(text: bytes, n_rows: int, n_cols: int,
         return None          # exotic separator -> python fallback
     if len(sep_b) != 1:
         return None
-    # strtof needs a terminated buffer: guarantee a sentinel past the end
+    # strtod needs a terminated buffer: guarantee a sentinel past the end
     buf = np.frombuffer(text + b"\n\0", np.uint8)
-    out = np.empty((n_rows, n_cols), np.float32)
-    parsed = lib.mml_parse_csv_f32(buf.ctypes.data, len(text),
+    out = np.empty((n_rows, n_cols), np.float64)
+    parsed = lib.mml_parse_csv_f64(buf.ctypes.data + offset,
+                                   len(text) - offset,
                                    sep_b, n_rows, n_cols,
                                    out.ctypes.data)
     if parsed != n_rows:

@@ -148,13 +148,14 @@ void mml_unroll_chw(const uint8_t* src, int64_t h, int64_t w, int64_t c,
 // ---------------------------------------------------------- csv parsing
 // Numeric-CSV fast path (the host data-loader role Spark's csv reader
 // plays for the reference; BinaryFileFormat.scala is the binary analogue).
-// Parses `n_rows * n_cols` floats from a comma/`sep`-separated text
-// buffer into `out` (row-major float32). Empty fields and the literal
-// strings na/nan (any case) become NaN. Returns the number of rows
-// actually parsed (stops early on a malformed row, so the caller can
-// fall back for the remainder or raise).
-int64_t mml_parse_csv_f32(const char* buf, int64_t len, char sep,
-                          int64_t n_rows, int64_t n_cols, float* out) {
+// Parses `n_rows * n_cols` numbers from a comma/`sep`-separated text
+// buffer into `out` (row-major float64 — matching the python fallback's
+// dtype so out-of-float32-range values do not silently become inf/0).
+// Empty fields and the literal strings na/nan (any case) become NaN.
+// Returns the number of rows actually parsed (stops early on a malformed
+// row, so the caller can fall back for the remainder or raise).
+int64_t mml_parse_csv_f64(const char* buf, int64_t len, char sep,
+                          int64_t n_rows, int64_t n_cols, double* out) {
   const char* p = buf;
   const char* end = buf + len;
   int64_t row = 0;
@@ -165,22 +166,25 @@ int64_t mml_parse_csv_f32(const char* buf, int64_t len, char sep,
     while (p < end && (*p == '\n' || *p == '\r')) ++p;
     if (p >= end) break;
     for (int64_t c = 0; c < n_cols; ++c) {
-      // field start: skip spaces
-      while (p < end && *p == ' ') ++p;
+      // field start: skip spaces — unless space IS the separator, where
+      // merging consecutive seps would diverge from csv.reader's
+      // empty-field semantics (such rows abort to the fallback instead)
+      if (sep != ' ')
+        while (p < end && *p == ' ') ++p;
       const char* fs = p;
       while (p < end && *p != sep && *p != '\n' && *p != '\r') ++p;
       int64_t flen = p - fs;
-      float v;
+      double v;
       if (flen == 0 ||
           (flen == 2 && (fs[0] == 'n' || fs[0] == 'N') &&
            (fs[1] == 'a' || fs[1] == 'A')) ||
           (flen == 3 && (fs[0] == 'n' || fs[0] == 'N') &&
            (fs[1] == 'a' || fs[1] == 'A') &&
            (fs[2] == 'n' || fs[2] == 'N'))) {
-        v = std::numeric_limits<float>::quiet_NaN();
+        v = std::numeric_limits<double>::quiet_NaN();
       } else {
         char* fe = nullptr;
-        v = strtof(fs, &fe);
+        v = strtod(fs, &fe);
         // strtof may read past sep only if the field is malformed; any
         // unconsumed non-space chars inside the field abort the fast path
         const char* q = fe;

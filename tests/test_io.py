@@ -345,3 +345,33 @@ class TestReaderEdgeCases:
         dense = feats.toarray() if hasattr(feats, "toarray") \
             else np.stack(feats)
         np.testing.assert_allclose(dense[0], [0.5, 1.0])
+
+    def test_float64_range_and_na_tokens_consistent(self, tmp_path):
+        import os as _os
+        p = tmp_path / "range.csv"
+        p.write_text("v\n1e120\nna\n1e-60\n")
+        fast = read_csv(str(p))
+        env = dict(_os.environ)
+        try:
+            _os.environ["MMLSPARK_TPU_NO_NATIVE"] = "1"
+            from mmlspark_tpu.utils import native as _n
+            old = _n._lib, _n._tried
+            _n._lib, _n._tried = None, False
+            slow = read_csv(str(p))
+            _n._lib, _n._tried = old
+        finally:
+            _os.environ.clear()
+            _os.environ.update(env)
+        for df in (fast, slow):
+            assert df["v"].dtype == np.float64
+            assert df["v"][0] == 1e120          # not inf
+            assert np.isnan(df["v"][1])
+            assert df["v"][2] == 1e-60          # not 0
+        np.testing.assert_allclose(fast["v"], slow["v"])
+
+    def test_space_sep_double_space_consistent(self, tmp_path):
+        p = tmp_path / "sp.csv"
+        p.write_text("a b\n1  2\n")
+        df = read_csv(str(p), sep=" ")
+        # csv.reader semantics: the double space is an empty field -> NaN
+        assert np.isnan(df["b"][0])
