@@ -178,7 +178,9 @@ def read_csv(path: str, header: bool = True, sep: str = ",",
     from ..utils.native import parse_csv_f64
     mat = parse_csv_f64(raw, n_rows, len(names), sep=sep, offset=offset)
     if mat is not None:
-        return DataFrame({name: mat[:, j]
+        # contiguous copies: a column VIEW would pin the whole matrix in
+        # memory for as long as any single column lives
+        return DataFrame({name: np.ascontiguousarray(mat[:, j])
                           for j, name in enumerate(names)})
 
     def _tofloat(v: str) -> float:

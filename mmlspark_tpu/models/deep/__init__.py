@@ -3,7 +3,7 @@ downloader/). The CNTK JNI eval engine becomes a jitted flax forward pass."""
 
 from .dnn import DNNModel, GraphModel, ImageFeaturizer
 from .image import (ImageSetAugmenter, ImageTransformer,
-                    ResizeImageTransformer, UnrollImage)
+                    ResizeImageTransformer, UnrollBinaryImage, UnrollImage)
 from .resnet import ModelDownloader, ModelSchema, ResNet, load_params, save_params
 from .transformer import (TransformerClassificationModel,
                           TransformerEncoderClassifier,
@@ -13,6 +13,7 @@ from .transformer import (TransformerClassificationModel,
 __all__ = [
     "DNNModel", "GraphModel", "ImageFeaturizer",
     "ImageTransformer", "ResizeImageTransformer", "UnrollImage",
+    "UnrollBinaryImage",
     "ImageSetAugmenter",
     "ResNet", "ModelDownloader", "ModelSchema", "load_params", "save_params",
     "TransformerEncoderModel", "encoder_forward", "init_encoder_params",

@@ -201,6 +201,39 @@ class UnrollImage(Transformer, _p.HasInputCol, _p.HasOutputCol):
                               np.stack(rows).astype(np.float32))
 
 
+class UnrollBinaryImage(Transformer, _p.HasInputCol, _p.HasOutputCol):
+    """Encoded image BYTES -> (optional resize) -> flat CHW float vector in
+    one stage (image/UnrollImage.scala `UnrollBinaryImage`: the binary-file
+    shortcut that skips the intermediate image column). Rows whose bytes
+    fail to decode emit None (the reference's null-passthrough)."""
+
+    height = _p.Param("height", "resize height (0 = keep)", 0, int)
+    width = _p.Param("width", "resize width (0 = keep)", 0, int)
+
+    def __init__(self, **kw):
+        kw.setdefault("inputCol", "bytes")
+        kw.setdefault("outputCol", "features")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        from ...io.files import decode_image
+        h, w = self.get("height"), self.get("width")
+        out = np.empty(len(df), dtype=object)
+        for i, blob in enumerate(df[self.get("inputCol")]):
+            img = decode_image(bytes(blob)) if blob is not None else None
+            if img is None:
+                out[i] = None
+                continue
+            if h and w and img.shape[:2] != (h, w):
+                # the SAME resize as ResizeImageTransformer so the
+                # one-stage shortcut is feature-identical to the two-stage
+                # pipeline (no train/serve skew between the two routes)
+                img = resize_image(img, h, w)
+            out[i] = np.asarray(img).transpose(2, 0, 1).ravel().astype(
+                np.float32)
+        return df.with_column(self.get("outputCol"), out)
+
+
 class ImageSetAugmenter(Transformer, _p.HasInputCol, _p.HasOutputCol):
     """Emit original + flipped variants (image/ImageSetAugmenter.scala:15-80).
     Output has more rows than input (originals first, then each enabled flip)."""

@@ -10,7 +10,8 @@ from .classifier import (VowpalWabbitClassifier,
 from .contextual_bandit import (ContextualBanditMetrics,
                                 VowpalWabbitContextualBandit,
                                 VowpalWabbitContextualBanditModel)
-from .featurizer import VowpalWabbitFeaturizer, VowpalWabbitInteractions
+from .featurizer import (VectorZipper, VowpalWabbitFeaturizer,
+                         VowpalWabbitInteractions)
 from .sparse import SparseFeatures
 
 __all__ = [
@@ -19,6 +20,6 @@ __all__ = [
     "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
     "VowpalWabbitContextualBandit", "VowpalWabbitContextualBanditModel",
     "ContextualBanditMetrics",
-    "VowpalWabbitFeaturizer", "VowpalWabbitInteractions",
+    "VowpalWabbitFeaturizer", "VowpalWabbitInteractions", "VectorZipper",
     "SparseFeatures",
 ]

@@ -242,3 +242,27 @@ class VowpalWabbitInteractions(Transformer, _p.HasInputCols, _p.HasOutputCol,
         return df.with_column(self.get("outputCol"), packed.to_object_column(),
                               metadata={"numFeatures": mask + 1,
                                         "sparse": True})
+
+
+class VectorZipper(Transformer, _p.HasInputCols, _p.HasOutputCol):
+    """Zip several columns row-wise into one array column
+    (vw/VectorZipper.scala:37 — the namespace-assembly helper that feeds
+    multi-namespace VW examples; generic enough for any consumer that
+    wants a per-row sequence of column values)."""
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "zipped")
+        super().__init__(**kw)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        cols = self.get("inputCols")
+        if not cols:
+            raise ValueError("VectorZipper needs inputCols")
+        missing = [c for c in cols if c not in df]
+        if missing:
+            raise KeyError(f"VectorZipper: missing columns {missing}")
+        series = [df[c] for c in cols]
+        out = np.empty(len(df), dtype=object)
+        for i in range(len(df)):
+            out[i] = [s[i] for s in series]
+        return df.with_column(self.get("outputCol"), out)

@@ -115,3 +115,37 @@ def test_dnn_model_pickle_roundtrip(tmp_path):
     gm2 = pickle.loads(pickle.dumps(gm))
     o2 = DNNModel(model=gm2).transform(df)["output"]
     np.testing.assert_allclose(o1, o2, atol=1e-5)
+
+
+def test_unroll_binary_image():
+    """Bytes -> decode -> resize -> CHW unroll in one stage
+    (UnrollImage.scala UnrollBinaryImage); undecodable rows emit None."""
+    import io as _io
+    from PIL import Image
+    from mmlspark_tpu.models.deep import UnrollBinaryImage
+    rng = np.random.default_rng(0)
+    blobs = np.empty(3, dtype=object)
+    for i in range(2):
+        img = Image.fromarray(rng.integers(0, 255, (40 + 10 * i, 30, 3),
+                                           dtype=np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="PNG")
+        blobs[i] = buf.getvalue()
+    blobs[2] = b"not an image"
+    df = DataFrame({"bytes": blobs})
+    out = UnrollBinaryImage(height=16, width=16).transform(df)
+    feats = out["features"]
+    assert feats[0].shape == (3 * 16 * 16,) and feats[0].dtype == np.float32
+    assert feats[1].shape == (3 * 16 * 16,)
+    assert feats[2] is None
+
+
+def test_vector_zipper():
+    from mmlspark_tpu.models.vw import VectorZipper
+    df = DataFrame({"a": np.array([1.0, 2.0]),
+                    "b": np.array(["x", "y"], dtype=object)})
+    out = VectorZipper(inputCols=["a", "b"]).transform(df)
+    assert out["zipped"][0] == [1.0, "x"] and out["zipped"][1] == [2.0, "y"]
+    import pytest as _pytest
+    with _pytest.raises(KeyError):
+        VectorZipper(inputCols=["a", "zzz"]).transform(df)
