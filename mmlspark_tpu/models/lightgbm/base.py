@@ -210,10 +210,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
     histChunk = Param("histChunk", "rows per histogram chunk", 512, int)
     metric = Param("metric",
                    "evaluation metric ('' = objective default): l1/mae, "
-                   "l2/mse, rmse, mape, auc, binary_logloss, binary_error, "
-                   "multi_logloss, multi_error, ndcg "
+                   "l2/mse, rmse, mape, auc, auc_exact, binary_logloss, "
+                   "binary_error, multi_logloss, multi_error, ndcg "
                    "(LightGBMParams.scala:310-342); auc/ndcg are reported "
-                   "as 1 - value (lower-is-better convention)", "")
+                   "as 1 - value (lower-is-better convention). Distributed "
+                   "'auc' is binned (documented bound); 'auc_exact' "
+                   "all_gathers scores for exact rank AUC at O(N) traffic "
+                   "per eval (serial fits are always exact)", "")
     isProvideTrainingMetric = Param(
         "isProvideTrainingMetric",
         "compat: per-iteration train metrics are always computed here and "
@@ -323,7 +326,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         "softmax": "multi_logloss", "lambdarank": "ndcg",
     }
     _METRICS_BY_KIND = {
-        "binary": ("auc", "binary_logloss", "binary_error"),
+        "binary": ("auc", "auc_exact", "binary_logloss",
+                   "binary_error"),
         "multiclass": ("multi_logloss", "multi_error"),
         "regression": ("l1", "l2", "rmse", "mape"),
         "ranking": ("ndcg",),
