@@ -1,0 +1,92 @@
+"""Cognitive-services pipeline walkthrough — the reference's "Cognitive
+Services" notebooks (cognitive/CognitiveServiceBase.scala:258-330,
+TextAnalytics transformers) run against a LOCAL mock endpoint so the sample
+executes without Azure keys or egress; swap `url` for the real service to go
+live.
+
+Flow: product reviews -> TextSentiment -> KeyPhraseExtractor -> assemble a
+tiny "voice of customer" table. Demonstrates ServiceParam scalar-vs-column
+values, per-row error isolation (one malformed row does not fail the batch),
+and the Lambda -> HTTPTransformer -> JSONOutputParser internal pipeline the
+transformers share.
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.cognitive import (KeyPhraseExtractor, ServiceParam,
+                                    TextSentiment)
+
+REVIEWS = [
+    "The new keyboard is fantastic, best purchase this year",
+    "Terrible battery life and the screen flickers",
+    "Decent value for the price",
+]
+SENTIMENTS = ["positive", "negative", "neutral"]
+PHRASES = [["new keyboard", "best purchase"],
+           ["battery life", "screen"],
+           ["value", "price"]]
+
+
+def start_mock():
+    """Local stand-in for the Azure Text Analytics endpoint."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            docs = json.loads(self.rfile.read(n))["documents"]
+            if "sentiment" in self.path:
+                payload = {"documents": [
+                    {"id": d["id"],
+                     "sentiment": SENTIMENTS[REVIEWS.index(d["text"])]}
+                    for d in docs]}
+            else:
+                payload = {"documents": [
+                    {"id": d["id"],
+                     "keyPhrases": PHRASES[REVIEWS.index(d["text"])]}
+                    for d in docs]}
+            out = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def main():
+    httpd, url = start_mock()
+    try:
+        df = DataFrame({"review": np.array(REVIEWS, dtype=object)})
+
+        sent = TextSentiment(url=url + "/text/analytics/v3.0/sentiment",
+                             subscriptionKey=ServiceParam.value("demo-key"),
+                             textCol="review", outputCol="sentiment")
+        kp = KeyPhraseExtractor(url=url + "/text/analytics/v3.0/keyPhrases",
+                                subscriptionKey=ServiceParam.value("demo-key"),
+                                textCol="review", outputCol="phrases")
+        out = kp.transform(sent.transform(df))
+
+        rows = []
+        for i in range(len(out)):
+            rows.append((out["sentiment"][i]["sentiment"],
+                         ", ".join(out["phrases"][i])))
+            print(f"[{rows[-1][0]:8s}] {REVIEWS[i][:46]:46s} "
+                  f"-> {rows[-1][1]}")
+        return [r[0] for r in rows]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""CyberML access-anomaly walkthrough — the reference's `cyber` package
+sample (src/main/python/mmlspark/cyber/anomaly/collaborative_filtering.py:
+44-988 `AccessAnomaly`, complement_access.py:148).
+
+Setup: two tenants; in each, users access resources inside their own
+department's pool. After fitting the per-tenant ALS access model, we score
+(a) held-out NORMAL accesses (same department) and (b) planted
+CROSS-DEPARTMENT accesses — lateral movement, the canonical insider-threat
+signal. The anomaly score is the standardized negative affinity, so the
+cross-department accesses should score clearly higher.
+
+Returns mean(anomalous score) - mean(normal score).
+"""
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.cyber import AccessAnomaly, ComplementAccessTransformer
+
+
+def simulate(rng, n_users=60, n_res=40, events_per_user=30):
+    """Department d users access department d resources (2 departments)."""
+    tenants, users, resources = [], [], []
+    for tenant in ("contoso", "fabrikam"):
+        for u in range(n_users):
+            dept = u % 2
+            pool = np.arange(n_res // 2) + dept * (n_res // 2)
+            for r in rng.choice(pool, size=events_per_user):
+                tenants.append(tenant)
+                users.append(u)
+                resources.append(int(r))
+    return DataFrame({"tenant": np.array(tenants, dtype=object),
+                      "user": np.array(users), "res": np.array(resources)})
+
+
+def main(n_users=60, n_res=40):
+    rng = np.random.default_rng(3)
+    df = simulate(rng, n_users=n_users, n_res=n_res)
+
+    model = AccessAnomaly(tenantCol="tenant", userCol="user", resCol="res",
+                          rankParam=8, maxIter=12, regParam=0.5).fit(df)
+
+    # (a) held-out normal accesses: same-department pairs not necessarily
+    # seen in training
+    n_eval, half = 200, n_res // 2
+    users_n = rng.integers(0, n_users, n_eval)
+    res_n = np.array([rng.integers(0, half) + (u % 2) * half
+                      for u in users_n])
+    normal = DataFrame({"tenant": np.array(["contoso"] * n_eval, dtype=object),
+                        "user": users_n, "res": res_n})
+    # (b) planted cross-department accesses (lateral movement)
+    res_x = np.array([rng.integers(0, half) + (1 - u % 2) * half
+                      for u in users_n])
+    lateral = DataFrame({"tenant": np.array(["contoso"] * n_eval,
+                                            dtype=object),
+                         "user": users_n, "res": res_x})
+
+    s_norm = model.transform(normal)["anomaly_score"]
+    s_lat = model.transform(lateral)["anomaly_score"]
+    gap = float(np.nanmean(s_lat) - np.nanmean(s_norm))
+    print(f"normal accesses   mean score: {np.nanmean(s_norm):+.2f}")
+    print(f"lateral movement  mean score: {np.nanmean(s_lat):+.2f}")
+    print(f"separation: {gap:.2f} standard deviations")
+
+    # ComplementAccessTransformer: sample never-seen pairs for evaluation
+    comp = ComplementAccessTransformer(tenantCol="tenant",
+                                       indexedColNames=["user", "res"],
+                                       complementsetFactor=1).transform(df)
+    print(f"complement sample: {len(comp)} unseen (user, res) pairs")
+    return gap
+
+
+if __name__ == "__main__":
+    main()

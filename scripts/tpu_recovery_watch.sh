@@ -19,13 +19,19 @@ float((x @ x).sum())
 EOF
   then
     echo "== chip healthy $(date -u +%FT%TZ) — running measurements"
-    python scripts/measure_scan_modes.py
+    if ! python -u scripts/quick_fit_probe.py; then
+      echo "== quick fit probe FAILED $(date -u +%FT%TZ); back to probing"
+      sleep 120
+      continue
+    fi
+    echo "== scan modes $(date -u +%FT%TZ)"
+    python -u scripts/measure_scan_modes.py
     echo "== serving $(date -u +%FT%TZ)"
-    python scripts/measure_serving_tpu.py
+    python -u scripts/measure_serving_tpu.py
     echo "== image featurizer $(date -u +%FT%TZ)"
-    python scripts/measure_image_featurizer.py
+    python -u scripts/measure_image_featurizer.py
     echo "== bench $(date -u +%FT%TZ)"
-    python bench.py
+    python -u bench.py
     echo "== watcher done $(date -u +%FT%TZ)"
     exit 0
   fi

@@ -55,3 +55,26 @@ def test_image_featurizer():
 
 def test_streaming_replay():
     assert _run("streaming_replay.py") is True
+
+
+def test_vw_contextual_bandit():
+    # learned policy must beat the uniform logging policy's cost clearly
+    assert _run("vw_contextual_bandit.py") > 0.1
+
+
+def test_cognitive_pipeline():
+    assert _run("cognitive_pipeline.py") == ["positive", "negative",
+                                             "neutral"]
+
+
+def test_cyber_access_anomaly():
+    # lateral movement must separate from normal accesses by > 2 sigma
+    assert _run("cyber_access_anomaly.py") > 2.0
+
+
+def test_conditional_knn():
+    assert _run("conditional_knn.py") >= 0.8
+
+
+def test_long_context_attention():
+    assert _run("long_context_attention.py") < 1e-4
