@@ -305,6 +305,38 @@ def test_apply_bins_native_matches_numpy():
         pytest.skip("native toolchain unavailable — numpy fallback verified")
 
 
+def test_apply_bins_native_adversarial_exactness():
+    """The vectorized float-threshold fast path must reproduce the double
+    searchsorted-left bin EXACTLY on its hostile inputs: values precisely at
+    every edge, +/-inf values, NaN, odd row counts (the 2-row unroll tail),
+    feature counts off the 32-lane chunk width, and ALL THREE code paths:
+    the vectorized threshold table (first three shapes), the scalar linear
+    fallback (<=128 edges but a table past the 1 MB gate: 127x4096), and
+    the scalar binary-search fallback (>256 edges wide: 255x2048)."""
+    from mmlspark_tpu.ops.binning import compute_bin_edges
+    from mmlspark_tpu.utils import native
+    if native.get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(1)
+    for n, f, mb in ((4097, 5, 64), (999, 33, 129), (2001, 28, 256),
+                     (63, 3, 16), (500, 4096, 128), (500, 2048, 256)):
+        x = rng.normal(size=(n, f)).astype(np.float32)
+        x[:, f - 1] = rng.integers(0, 4, n)       # low-cardinality feature
+        x[: n // 10, 0] = np.nan
+        x[n // 10: n // 8, 0] = np.inf
+        x[n // 8: n // 6, 0] = -np.inf
+        edges = compute_bin_edges(x, max_bins=mb)
+        ne = min(edges.shape[1], n)
+        x[:ne, 1] = edges[1, :ne].astype(np.float32)   # values AT the edges
+        got = native.bin_matrix(x, edges)
+        ref = np.empty(x.shape, np.int32)
+        x64 = x.astype(np.float64)
+        for j in range(f):
+            ref[:, j] = np.searchsorted(edges[j], x64[:, j], side="left")
+        ref[np.isnan(x64)] = 0
+        np.testing.assert_array_equal(got, ref, err_msg=f"{(n, f, mb)}")
+
+
 class TestShardRobustness:
     """Reference robustness suite analogues: empty partitions
     (VerifyLightGBMClassifier.scala:517) and workers that see only one class
