@@ -1,5 +1,6 @@
 from .base import LightGBMModelBase, LightGBMParamsBase
 from .booster import Booster
+from .dataset import LightGBMDataset
 from .delegate import LightGBMDelegate
 from .classifier import LightGBMClassificationModel, LightGBMClassifier
 from .ranker import LightGBMRanker, LightGBMRankerModel

@@ -286,8 +286,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
     def _num_class(self, y: np.ndarray) -> int:
         return 1
 
-    def _extract_xyw(self, df: DataFrame
-                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    def _extract_features(self, df: DataFrame) -> np.ndarray:
         x = df[self.get("featuresCol")]
         if hasattr(x, "toarray") and hasattr(x, "tocsr"):
             # sparse matrix column (kept sparse by the DataFrame): the GBDT
@@ -304,6 +303,48 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         x = np.asarray(x, np.float32)
         if x.ndim != 2:
             raise ValueError("featuresCol must be a 2-D vector column")
+        return x
+
+    def _bin_config(self) -> tuple:
+        """The parameters that determine binning — frozen by
+        LightGBMDataset at construction (upstream Dataset contract), and
+        the SINGLE source _fit_binning builds the BinMapper from, so the
+        frozen-config equality check can never drift from what binning
+        actually consumes."""
+        mbbf = self.get("maxBinByFeature")
+        if mbbf is None or len(mbbf) == 0:
+            mbbf_t = ()
+        else:
+            mbbf_t = tuple(int(v) for v in mbbf)
+        return (int(self.get("maxBin")), int(self.get("binSampleCount")),
+                int(self.get("seed")), tuple(self._categorical_indexes()),
+                mbbf_t, bool(self.get("useMissing")))
+
+    def _fit_binning(self, x: np.ndarray):
+        """Fit the bin mapper + transform to the binned uint8 matrix —
+        the LGBM_DatasetCreateFromMat equivalent; hoisted so
+        LightGBMDataset can run it once for many fits."""
+        max_bin, sample_count, seed, cat, mbbf, use_missing = \
+            self._bin_config()
+        bm = BinMapper.fit(x, max_bin, sample_count, seed, categorical=cat,
+                           max_bins_by_feature=(
+                               np.asarray(mbbf, np.int64) if mbbf else None),
+                           use_missing=use_missing)
+        binned = bm.transform(x)
+        # features with a reserved missing bin get both-direction split scans
+        missing_idx = tuple(int(j) for j in np.nonzero(bm.missing)[0])
+        return bm, binned, missing_idx
+
+    def _extract_xyw(self, df: DataFrame
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                np.ndarray, Optional[np.ndarray]]:
+        from .dataset import LightGBMDataset
+        self._prebinned = None
+        if isinstance(df, LightGBMDataset):
+            x, self._prebinned = df.pack_for(self)
+            df = df.dataframe
+        else:
+            x = self._extract_features(df)
         y = np.asarray(df[self.get("labelCol")])
         wcol = self.get("weightCol")
         w = (np.asarray(df[wcol], np.float32) if wcol and wcol in df
@@ -509,6 +550,11 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             from .native_format import parse_model_string
             prev = parse_model_string(self.get("modelString"))
 
+        # consume the dataset pack: clear the estimator's reference now so a
+        # long-lived estimator doesn't pin the binned/feature matrices after
+        # the dataset itself is dropped
+        pb = getattr(self, "_prebinned", None)
+        self._prebinned = None
         num_batches = self.get("numBatches")
         if num_batches and num_batches > 1:
             rng = np.random.default_rng(self.get("seed"))
@@ -535,38 +581,35 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     objective,
                     init_score[part] if init_score is not None else None,
                     booster,
-                    groups[part] if groups is not None else None)
+                    groups[part] if groups is not None else None,
+                    # dataset bins are full-data: slice rows, keep edges
+                    prebinned=((pb[0], pb[1][part], pb[2])
+                               if pb is not None else None))
                 if delegate is not None:
                     delegate.after_train_batch(bi, None, booster)
             return booster
         self._batch_index = 0
         return self._train_booster_once(x, y, w, is_valid, num_class,
-                                        objective, init_score, prev, groups)
+                                        objective, init_score, prev, groups,
+                                        prebinned=pb)
 
     def _train_booster_once(self, x: np.ndarray, y: np.ndarray, w: np.ndarray,
                             is_valid: np.ndarray, num_class: int,
                             objective: str,
                             init_score: Optional[np.ndarray],
                             prev: Optional[Booster],
-                            groups: Optional[np.ndarray] = None) -> Booster:
+                            groups: Optional[np.ndarray] = None,
+                            prebinned=None) -> Booster:
         n, f = x.shape
         k = num_class if num_class > 1 else 1
         _dlg = self.get("delegate")
         _bi = getattr(self, "_batch_index", 0)
         if _dlg is not None:
             _dlg.before_generate_train_dataset(_bi, self)
-        mbbf = self.get("maxBinByFeature")
-        bm = BinMapper.fit(x, self.get("maxBin"), self.get("binSampleCount"),
-                           self.get("seed"),
-                           categorical=tuple(self._categorical_indexes()),
-                           max_bins_by_feature=(
-                               np.asarray(mbbf, np.int64) if mbbf is not None
-                               and len(mbbf) else None),
-                           use_missing=bool(self.get("useMissing")))
-        binned = bm.transform(x)
-        # features with a reserved missing bin get both-direction split scans
-        self._missing_idx = tuple(
-            int(j) for j in np.nonzero(bm.missing)[0])
+        if prebinned is not None:  # LightGBMDataset: bins computed once
+            bm, binned, self._missing_idx = prebinned
+        else:
+            bm, binned, self._missing_idx = self._fit_binning(x)
         if _dlg is not None:
             _dlg.after_generate_train_dataset(_bi, self)
 

@@ -1,0 +1,108 @@
+"""LightGBMDataset — the reusable binned dataset (upstream `Dataset` role,
+lightgbm/LightGBMDataset.scala:12-101): bins computed once, reused across
+fits; bin parameters frozen at construction."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import (LightGBMClassifier,
+                                          LightGBMDataset,
+                                          LightGBMRanker,
+                                          LightGBMRegressor)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4000, 10)).astype(np.float32)
+    y = ((x @ rng.normal(size=10)) > 0).astype(np.float64)
+    return DataFrame({"features": x, "label": y}), x, y
+
+
+def _proba(model, df):
+    return np.stack(model.transform(df)["probability"])[:, 1]
+
+
+def test_dataset_fit_equals_plain_fit(data):
+    df, x, y = data
+    est = LightGBMClassifier(numIterations=15, numLeaves=15, numTasks=1)
+    ds = LightGBMDataset(df, est)
+    m_plain = est.fit(df)
+    m_ds = est.fit(ds)
+    np.testing.assert_array_equal(_proba(m_plain, df), _proba(m_ds, df))
+    assert m_plain.booster.model_string() == m_ds.booster.model_string()
+
+
+def test_dataset_reused_across_param_sweep(data):
+    df, x, y = data
+    est = LightGBMClassifier(numIterations=10, numLeaves=15, numTasks=1)
+    ds = LightGBMDataset(df, est)
+    maps = [{"learningRate": lr, "lambdaL2": l2}
+            for lr in (0.05, 0.1) for l2 in (0.0, 1.0)]
+    models_ds = est.fit(ds, maps)
+    models_plain = est.fit(df, maps)
+    for a, b in zip(models_ds, models_plain):
+        np.testing.assert_allclose(_proba(a, df), _proba(b, df), atol=1e-6)
+
+
+def test_dataset_skips_rebinning(data):
+    df, x, y = data
+    est = LightGBMClassifier(numIterations=2, numLeaves=7, numTasks=1)
+    ds = LightGBMDataset(df, est)
+    calls = {"n": 0}
+    orig = LightGBMClassifier._fit_binning
+
+    def counting(self, x_):
+        calls["n"] += 1
+        return orig(self, x_)
+
+    LightGBMClassifier._fit_binning = counting
+    try:
+        est.fit(ds)
+        est.fit(ds)
+    finally:
+        LightGBMClassifier._fit_binning = orig
+    assert calls["n"] == 0  # both fits reused the dataset's pack
+
+
+def test_dataset_freezes_bin_config(data):
+    df, x, y = data
+    est = LightGBMClassifier(numIterations=2, maxBin=32, numTasks=1)
+    ds = LightGBMDataset(df, est)
+    with pytest.raises(ValueError, match="maxBin"):
+        LightGBMClassifier(numIterations=2, maxBin=64, numTasks=1).fit(ds)
+    with pytest.raises(ValueError, match="featuresCol"):
+        LightGBMClassifier(numIterations=2, maxBin=32, numTasks=1,
+                           featuresCol="other").fit(ds)
+    # sweeping a bin param over a fixed dataset is the upstream error too
+    with pytest.raises(ValueError, match="constructed"):
+        est.fit(ds, [{"maxBin": 64}])
+
+
+def test_dataset_num_batches_and_regressor(data):
+    df, x, y = data
+    est = LightGBMClassifier(numIterations=6, numBatches=3, numLeaves=7,
+                             numTasks=1)
+    m = est.fit(LightGBMDataset(df, est))
+    assert np.isfinite(_proba(m, df)).all()
+
+    dfr = DataFrame({"features": x, "label": x[:, 0].astype(np.float64)})
+    r = LightGBMRegressor(numIterations=5, numTasks=1)
+    m_ds = r.fit(LightGBMDataset(dfr, r))
+    m_pl = r.fit(dfr)
+    np.testing.assert_array_equal(
+        np.asarray(m_ds.transform(dfr)["prediction"]),
+        np.asarray(m_pl.transform(dfr)["prediction"]))
+
+
+def test_dataset_ranker_groups(data):
+    _, x, y = data
+    groups = np.repeat(np.arange(400), 10)
+    dfr = DataFrame({"features": x, "label": (y * 3).astype(np.float64),
+                     "group": groups})
+    r = LightGBMRanker(numIterations=5, numLeaves=7, groupCol="group",
+                      numTasks=1)
+    m_ds = r.fit(LightGBMDataset(dfr, r))
+    m_pl = r.fit(dfr)
+    assert (m_ds.booster.model_string() == m_pl.booster.model_string())
