@@ -8,15 +8,23 @@ from .resnet import ModelDownloader, ModelSchema, ResNet, load_params, save_para
 from .transformer import (TransformerClassificationModel,
                           TransformerEncoderClassifier,
                           TransformerEncoderModel, encoder_forward,
-                          init_encoder_params, make_tp_dp_train_step)
+                          init_encoder_params, init_head_params,
+                          make_tp_dp_train_step)
+from .pipeline import make_pp_dp_train_step, pipeline_forward
+from .moe import (init_moe_block_params, make_ep_dp_train_step, moe_ffn,
+                  init_moe_params)
 
 __all__ = [
+    "make_pp_dp_train_step", "pipeline_forward",
+    "make_ep_dp_train_step", "moe_ffn", "init_moe_params",
+    "init_moe_block_params",
     "DNNModel", "GraphModel", "ImageFeaturizer",
     "ImageTransformer", "ResizeImageTransformer", "UnrollImage",
     "UnrollBinaryImage",
     "ImageSetAugmenter",
     "ResNet", "ModelDownloader", "ModelSchema", "load_params", "save_params",
     "TransformerEncoderModel", "encoder_forward", "init_encoder_params",
+    "init_head_params",
     "TransformerEncoderClassifier", "TransformerClassificationModel",
     "make_tp_dp_train_step",
 ]

@@ -1,0 +1,123 @@
+"""Expert-parallel (ep x dp) MoE training step.
+
+Completes the distributed-training taxonomy (tp/pp/dp/sp/ep) the TPU build
+treats as first-class (no reference analogue — SURVEY.md §2.2/§5: the
+reference's parallelism is data-parallel partitions only).
+
+Layout (canonical Switch/TPU): the token batch is sharded over BOTH mesh
+axes (data x model) — every device holds a distinct micro-batch; experts
+are sharded over the MODEL axis and replicated over DATA; router + head are
+replicated everywhere. moe_ffn's two all_to_alls ride the model axis;
+expert grads psum over data only, while replicated-param grads psum over
+both axes. The whole step (loss, backward, Adam update) runs inside one
+shard_map — one compiled SPMD program, matching make_tp_dp_train_step's
+stacked-shard calling convention (transformer.py:261-425).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.moe import init_moe_params, moe_ffn, shard_moe_params
+
+__all__ = ["init_moe_block_params", "make_ep_dp_train_step",
+           "init_moe_params", "moe_ffn", "shard_moe_params"]
+
+
+def init_moe_block_params(key, num_experts: int, d_model: int, d_ff: int,
+                          num_out: int):
+    """One MoE block + mean-pool + linear head — the minimal end-to-end
+    trainable MoE model used by tests and the multichip dryrun."""
+    ks = jax.random.split(key, 2)
+    return {
+        "moe": init_moe_params(ks[0], num_experts, d_model, d_ff),
+        "head": {"w": jax.random.normal(ks[1], (d_model, num_out))
+                 * np.sqrt(1.0 / d_model), "b": jnp.zeros((num_out,))},
+    }
+
+
+def moe_block_loss(params, x, y, num_experts: int, capacity_factor: float,
+                   axis_name=None, aux_weight: float = 1e-2):
+    """MSE head loss + Switch aux load-balance loss on one MoE block."""
+    h, aux = moe_ffn(params["moe"], x, num_experts,
+                     capacity_factor=capacity_factor, axis_name=axis_name)
+    pooled = h.mean(axis=1)                                   # [B, D]
+    pred = pooled @ params["head"]["w"] + params["head"]["b"]
+    return jnp.mean((pred - y) ** 2) + aux_weight * aux
+
+
+def make_ep_dp_train_step(mesh, num_experts: int, learning_rate: float,
+                          capacity_factor: float = 4.0,
+                          data_axis=None, model_axis=None,
+                          optimizer=None):
+    """One expert-parallel MoE training step over a 2-D (data, model) mesh.
+
+    Returns (step, shard_params):
+      params_s, opt_s = shard_params(full_params)
+      params_s, opt_s, loss = step(params_s, opt_s, x, y)
+    x: [B, S, D] with B divisible by data*model (tokens sharded over both
+    axes); y: [B, num_out]. Fitting runs Adam inside the shard_map; the
+    stacked leading axis (= model shards) carries each rank's expert slice,
+    peeled to size 1 per device like make_tp_dp_train_step.
+    """
+    import optax
+    from ...parallel import mesh as meshlib
+    from jax.sharding import PartitionSpec as P
+    data_axis = data_axis or meshlib.DATA_AXIS
+    model_axis = model_axis or meshlib.MODEL_AXIS
+    ep = mesh.shape[model_axis]
+    if num_experts % ep:
+        raise ValueError(f"num_experts {num_experts} must divide over the "
+                         f"model axis ({ep} shards)")
+    tx = optimizer if optimizer is not None else optax.adam(learning_rate)
+
+    def step(params, opt_state, x, y):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        opt_state = jax.tree_util.tree_map(lambda a: a[0], opt_state)
+        loss, grads = jax.value_and_grad(moe_block_loss)(
+            params, x, y, num_experts, capacity_factor, model_axis)
+        # experts are sharded over MODEL (disjoint slices). Every model
+        # rank's local loss back-propagates into the expert slices through
+        # the all_to_all transpose, so the raw expert grad is already the
+        # gradient of the SUM over the model group — divide by ep so
+        # experts train on the same MEAN loss as router/head (caught by
+        # tests/test_moe.py::test_ep_dp_sgd_grad_scale; Adam's scale
+        # invariance hides the mismatch, SGD does not).
+        both = lambda g: jax.lax.pmean(
+            jax.lax.pmean(g, data_axis), model_axis)
+        dp_only = lambda g: jax.lax.pmean(g, data_axis) / ep
+        grads = {
+            "moe": {"router": jax.tree_util.tree_map(
+                        both, grads["moe"]["router"]),
+                    "ff1": jax.tree_util.tree_map(
+                        dp_only, grads["moe"]["ff1"]),
+                    "ff2": jax.tree_util.tree_map(
+                        dp_only, grads["moe"]["ff2"])},
+            "head": jax.tree_util.tree_map(both, grads["head"]),
+        }
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        lift = lambda a: a[None]
+        return (jax.tree_util.tree_map(lift, params),
+                jax.tree_util.tree_map(lift, opt_state), both(loss))
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(model_axis), P(model_axis),
+                  P((data_axis, model_axis)), P((data_axis, model_axis))),
+        out_specs=(P(model_axis), P(model_axis), P()),
+        check_vma=False)
+
+    def shard_params(full_params) -> Tuple[dict, tuple]:
+        shards = [{"moe": shard_moe_params(full_params["moe"], r, ep),
+                   "head": full_params["head"]} for r in range(ep)]
+        stack = lambda *xs: jnp.stack(xs)
+        stacked = jax.tree_util.tree_map(stack, *shards)
+        opt_shards = [tx.init(s) for s in shards]
+        return stacked, jax.tree_util.tree_map(stack, *opt_shards)
+
+    return jax.jit(sharded), shard_params

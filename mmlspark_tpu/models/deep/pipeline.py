@@ -1,0 +1,199 @@
+"""Pipeline parallelism (pp) for the encoder stack — GPipe microbatching
+over a mesh axis.
+
+Completes the tp/pp/dp/sp/ep taxonomy (no reference analogue — SURVEY.md
+§2.2/§5: the reference has no model parallelism at all).
+
+Design: the layer stack is split into P contiguous stages, one per device
+on the pipeline axis. The forward pass is ONE lax.scan over M + P - 1
+ticks; each tick every stage applies its layer block to the activation it
+received last tick and hands the result to the next stage via ppermute
+(stage 0 reads microbatch t; the last stage collects microbatch t-(P-1)).
+Bubble ticks compute on garbage and are masked at collection — the classic
+GPipe bubble, P-1 wasted ticks out of M+P-1.
+
+The backward pass is jax autodiff THROUGH the scan + ppermute: ppermute's
+transpose is the reverse rotation, so the cotangents flow last-stage ->
+first-stage in the mirrored schedule automatically — no hand-written
+backward pipeline, and exactness vs the single-device stack is pinned by
+tests (loss AND per-stage parameter gradients).
+
+Composes with data parallelism on a 2-D (data, pipeline) mesh:
+make_pp_dp_train_step shards the batch over DATA and the stages over
+MODEL, reducing stage-parameter grads over data only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import encoder_layer
+
+__all__ = ["stack_stage_params", "pipeline_forward", "make_pp_dp_train_step"]
+
+
+def stack_stage_params(params, num_stages: int):
+    """Split params["layers"] (list of per-layer dicts) into num_stages
+    contiguous blocks and stack each block's layers along a leading axis:
+    returns a pytree [num_stages, layers_per_stage, ...] whose axis 0 is
+    sharded over the pipeline axis."""
+    layers = params["layers"]
+    if len(layers) % num_stages:
+        raise ValueError(f"num_layers {len(layers)} must divide into "
+                         f"{num_stages} pipeline stages")
+    lps = len(layers) // num_stages
+    stages = []
+    for st in range(num_stages):
+        block = layers[st * lps:(st + 1) * lps]
+        stages.append(jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *block))
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stages)
+
+
+def pipeline_forward(stage_params, x_mb, num_heads: int, axis_name: str,
+                     causal: bool = False, remat: bool = False,
+                     broadcast: bool = True):
+    """Shard-local GPipe forward (call inside shard_map).
+
+    stage_params: this stage's stacked layer block [layers_per_stage, ...].
+    x_mb: [M, mb, S, D] microbatches (replicated across the pipeline axis).
+    broadcast=True returns [M, mb, S, D] final-stack activations replicated
+    on every stage (psum broadcast of the last stage's collection) — the
+    INFERENCE convention. For training, use broadcast=False: the raw
+    collection (zeros everywhere except the last stage), compute a LOCAL
+    loss term from it, and reduce only AFTER value_and_grad —
+    differentiating any in-graph reduction of the device-invariant loss
+    (broadcast output or scalar psum alike) seeds every device's backward
+    with its own copy's cotangent and grads come out x stages (caught by
+    tests/test_pipeline_parallel.py::test_pipeline_gradients_match_dense).
+    """
+    p = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def block(x):
+        def body(h, lp):
+            return encoder_layer(h, lp, num_heads, causal=causal,
+                                 attention_impl="reference"), None
+        h, _ = jax.lax.scan(body, x, stage_params)
+        return h
+
+    if remat:
+        block = jax.checkpoint(block)
+
+    def tick(carry, t):
+        recv, coll = carry
+        inp = jnp.where(idx == 0, x_mb[jnp.clip(t, 0, m - 1)], recv)
+        out = block(inp)
+        j = t - idx                       # microbatch index at this stage
+        valid = (j >= 0) & (j < m) & (idx == p - 1)
+        coll = jnp.where(
+            valid,
+            jax.lax.dynamic_update_index_in_dim(
+                coll, out, jnp.clip(j, 0, m - 1), 0),
+            coll)
+        recv = jax.lax.ppermute(out, axis_name, perm)
+        return (recv, coll), None
+
+    recv0 = jnp.zeros_like(x_mb[0])
+    coll0 = jnp.zeros_like(x_mb)
+    (_, coll), _ = jax.lax.scan(tick, (recv0, coll0),
+                                jnp.arange(m + p - 1))
+    if not broadcast:
+        return coll
+    # broadcast the last stage's collected outputs to every stage
+    return jax.lax.psum(jnp.where(idx == p - 1, coll, 0.0), axis_name)
+
+
+def make_pp_dp_train_step(mesh, num_heads: int, learning_rate: float,
+                          num_classes: int, num_microbatches: int,
+                          causal: bool = False,
+                          data_axis: Optional[str] = None,
+                          model_axis: Optional[str] = None,
+                          remat: bool = False):
+    """One pipeline-parallel (x data-parallel) encoder training step.
+
+    Returns (step, shard_params):
+      params_s, opt_s = shard_params(full_params, head_params)
+      params_s, opt_s, loss = step(params_s, opt_s, x, y)
+    x: [B, S, D] (B divisible by data_shards * num_microbatches);
+    y: [B] int labels. Stages ride the MODEL axis, batch rides DATA; the
+    mean-pool + softmax head is replicated.
+    """
+    import optax
+    from ...parallel import mesh as meshlib
+    from jax.sharding import PartitionSpec as P
+    data_axis = data_axis or meshlib.DATA_AXIS
+    model_axis = model_axis or meshlib.MODEL_AXIS
+    pp = mesh.shape[model_axis]
+    tx = optax.adam(learning_rate)
+    m = num_microbatches
+
+    def local_loss(params, x, y):
+        b_loc = x.shape[0]
+        x_mb = x.reshape(m, b_loc // m, *x.shape[1:])
+        # training convention: raw collection (zeros off the last stage),
+        # loss term on the last stage only, scalar psum — the broadcast
+        # variant double-counts cotangents (see pipeline_forward docstring)
+        coll = pipeline_forward(params["stage"], x_mb, num_heads,
+                                model_axis, causal, remat=remat,
+                                broadcast=False)
+        enc = coll.reshape(b_loc, *x.shape[1:])
+        pooled = enc.mean(axis=1)
+        logits = pooled @ params["head"]["w"] + params["head"]["b"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        term = -jnp.mean(jnp.sum(jax.nn.one_hot(y, num_classes) * logp,
+                                 axis=-1))
+        idx = jax.lax.axis_index(model_axis)
+        pp_count = jax.lax.psum(1, model_axis)
+        # LOCAL masked term — no psum inside the differentiated function:
+        # reducing a device-invariant loss in-graph seeds every device's
+        # backward with its own copy's cotangent and grads come out
+        # x stages (the house convention, make_tp_dp_train_step, reduces
+        # AFTER value_and_grad; pinned by the pipeline gradient test)
+        return jnp.where(idx == pp_count - 1, term, 0.0)
+
+    def step(params, opt_state, x, y):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        opt_state = jax.tree_util.tree_map(lambda a: a[0], opt_state)
+        loss, grads = jax.value_and_grad(local_loss)(params, x, y)
+        loss = jax.lax.psum(loss, model_axis)   # value only, post-grad
+        # stage params are disjoint across the pipeline: reduce over data
+        # only. The replicated head contributes to the loss on the LAST
+        # stage only, so its grads are zero elsewhere — the model-axis
+        # psum restores the identical replicated update everywhere.
+        grads = {"stage": grads["stage"],
+                 "head": jax.tree_util.tree_map(
+                     lambda g: jax.lax.psum(g, model_axis), grads["head"])}
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, data_axis), grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        lift = lambda a: a[None]
+        # the model-axis psum above already made the loss model-invariant
+        return (jax.tree_util.tree_map(lift, params),
+                jax.tree_util.tree_map(lift, opt_state),
+                jax.lax.pmean(loss, data_axis))
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(model_axis), P(model_axis), P(data_axis), P(data_axis)),
+        out_specs=(P(model_axis), P(model_axis), P()),
+        check_vma=False)
+
+    def shard_params(full_params, head):
+        stacked_stages = stack_stage_params(full_params, pp)
+        shards = [{"stage": jax.tree_util.tree_map(lambda a, s=st: a[s],
+                                                   stacked_stages),
+                   "head": head} for st in range(pp)]
+        stack = lambda *xs: jnp.stack(xs)
+        stacked = jax.tree_util.tree_map(stack, *shards)
+        opt_shards = [tx.init(s) for s in shards]
+        return stacked, jax.tree_util.tree_map(stack, *opt_shards)
+
+    return jax.jit(sharded), shard_params

@@ -76,6 +76,31 @@ def sinusoidal_positions(start: jax.Array, s: int, d: int) -> jax.Array:
     return pe
 
 
+def encoder_layer(x, lp, num_heads: int, causal: bool = False,
+                  axis_name: Optional[str] = None,
+                  attention_impl: str = "flash"):
+    """One pre-LN encoder layer — THE single layer definition shared by
+    encoder_forward and the pipeline-parallel stage scan
+    (models/deep/pipeline.py), so their exactness contract cannot drift."""
+    b, s, d = x.shape
+    hd = d // num_heads
+    h = _layer_norm(x, lp["ln1"])
+    qkv = _apply(lp["qkv"], h).reshape(b, s, 3, num_heads, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if axis_name is None:
+        if attention_impl == "flash":
+            att = flash_attention(q, k, v, causal=causal)
+        else:
+            att = attention_reference(q, k, v, causal=causal)
+    elif attention_impl == "ulysses":
+        att = ulysses_attention_sharded(q, k, v, axis_name, causal=causal)
+    else:
+        att = ring_attention_sharded(q, k, v, axis_name, causal=causal)
+    x = x + _apply(lp["proj"], att.reshape(b, s, d))
+    h = _layer_norm(x, lp["ln2"])
+    return x + _apply(lp["ff2"], jax.nn.gelu(_apply(lp["ff1"], h)))
+
+
 def encoder_forward(params, x: jax.Array, num_heads: int,
                     causal: bool = False,
                     axis_name: Optional[str] = None,
@@ -93,7 +118,6 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
     encodings — under sequence parallelism each shard offsets by its
     GLOBAL start position, so sharded and dense runs encode identically."""
     b, s, d = x.shape
-    hd = d // num_heads
     if positional:
         if axis_name is None:
             start = jnp.int32(0)
@@ -101,23 +125,11 @@ def encoder_forward(params, x: jax.Array, num_heads: int,
             start = jax.lax.axis_index(axis_name) * s
         x = x + sinusoidal_positions(start.astype(jnp.float32), s,
                                      d)[None, :, :]
+
     def layer(x, lp):
-        h = _layer_norm(x, lp["ln1"])
-        qkv = _apply(lp["qkv"], h).reshape(b, s, 3, num_heads, hd)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if axis_name is None:
-            if attention_impl == "flash":
-                att = flash_attention(q, k, v, causal=causal)
-            else:
-                att = attention_reference(q, k, v, causal=causal)
-        elif attention_impl == "ulysses":
-            att = ulysses_attention_sharded(q, k, v, axis_name,
-                                            causal=causal)
-        else:
-            att = ring_attention_sharded(q, k, v, axis_name, causal=causal)
-        x = x + _apply(lp["proj"], att.reshape(b, s, d))
-        h = _layer_norm(x, lp["ln2"])
-        return x + _apply(lp["ff2"], jax.nn.gelu(_apply(lp["ff1"], h)))
+        return encoder_layer(x, lp, num_heads, causal=causal,
+                             axis_name=axis_name,
+                             attention_impl=attention_impl)
 
     if remat:
         # rematerialisation: drop per-layer activations on the forward pass

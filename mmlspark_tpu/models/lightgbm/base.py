@@ -407,9 +407,15 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         (Estimator.fit(dataset, paramMaps) — the surface TuneHyperparameters
         sweeps, automl/TuneHyperparameters.scala:37-203). Maps touching only
         continuous hyperparameters train in ONE vmapped XLA program."""
-        if isinstance(params, (list, tuple)):
-            return self.fit_param_maps(df, list(params))
-        return super().fit(df, params)
+        try:
+            if isinstance(params, (list, tuple)):
+                return self.fit_param_maps(df, list(params))
+            return super().fit(df, params)
+        finally:
+            # a failure between _extract_xyw and _train_booster (e.g. a
+            # param-validation ValueError) must not leave the estimator
+            # pinning a LightGBMDataset's feature/binned matrices
+            self._prebinned = None
 
     def fit_param_maps(self, df: DataFrame, maps):
         def sequential():

@@ -1,0 +1,145 @@
+"""Mixture-of-Experts FFN with expert parallelism (ep) over a mesh axis.
+
+No reference analogue — SURVEY.md §5 records that the reference has no
+model-parallel taxonomy at all; this is part of the TPU-native distributed
+story (tp/pp/dp/sp/ep) alongside ring/Ulysses sequence parallelism.
+
+Design (Switch Transformer, arXiv:2101.03961, re-derived for shard_map):
+- top-1 softmax routing; each token's output is its expert's FFN output
+  scaled by the router probability (the prob keeps routing differentiable).
+- fixed expert capacity C = ceil(tokens/E * capacity_factor): position
+  within an expert's buffer comes from a cumsum over the token order;
+  tokens past capacity are DROPPED (output 0 for that token — Switch
+  semantics; ample capacity => no drops, pinned by tests).
+- dispatch/combine are one-hot einsum contractions (MXU-friendly), not
+  gather/scatter.
+- expert parallelism: experts are sharded over `axis_name`; one
+  all_to_all swaps the per-expert buffers [E, C, D] so each device holds
+  ALL tokens routed to ITS local experts, the local expert FFNs run, and a
+  second all_to_all sends results back to the tokens' home devices. With
+  data (tokens) also sharded over the same axis this is the canonical
+  ep x dp layout: routing is token-local, compute is expert-local, and the
+  only cross-device traffic is the two all_to_alls.
+
+Aux load-balancing loss (`aux_loss`): E * sum_e f_e * P_e (Switch eq. 4),
+f_e = fraction of tokens dispatched to expert e, P_e = mean router prob —
+minimized at uniform routing; add it to the task loss scaled by ~1e-2.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_moe_params(key, num_experts: int, d_model: int, d_ff: int):
+    """Router + per-expert FFN stacks ([E, ...] leading expert axis)."""
+    ks = jax.random.split(key, 3)
+    s1 = np.sqrt(2.0 / (d_model + d_ff))
+    return {
+        "router": {"w": jax.random.normal(ks[0], (d_model, num_experts))
+                   * np.sqrt(1.0 / d_model)},
+        "ff1": {"w": jax.random.normal(ks[1], (num_experts, d_model, d_ff))
+                * s1, "b": jnp.zeros((num_experts, d_ff))},
+        "ff2": {"w": jax.random.normal(ks[2], (num_experts, d_ff, d_model))
+                * s1, "b": jnp.zeros((num_experts, d_model))},
+    }
+
+
+def _route(params, x, num_experts: int, capacity: int):
+    """Token routing -> (dispatch [T,E,C], combine [T,E,C], aux_loss).
+
+    x: [T, D] flattened tokens. dispatch is 0/1; combine = dispatch *
+    router prob. Tokens whose position within their expert's buffer
+    exceeds C get an all-zero row (dropped)."""
+    logits = x @ params["router"]["w"]                      # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                        # [T]
+    gate = jnp.take_along_axis(probs, top[:, None], axis=1)[:, 0]  # [T]
+    onehot = jax.nn.one_hot(top, num_experts, dtype=x.dtype)      # [T, E]
+    # position of each token within its expert's capacity buffer
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot          # [T, E]
+    keep = onehot * (pos < capacity)                              # [T, E]
+    pos_oh = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32),
+                            capacity, dtype=x.dtype)              # [T, C]
+    dispatch = keep[:, :, None] * pos_oh[:, None, :]              # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: E * sum_e (fraction dispatched)*(mean prob)
+    f = jnp.mean(onehot, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(ff1, ff2, buf):
+    """buf: [E, C, D] -> per-expert FFN, batched over the expert axis."""
+    h = jnp.einsum("ecd,edf->ecf", buf, ff1["w"]) + ff1["b"][:, None, :]
+    return (jnp.einsum("ecf,efd->ecd", jax.nn.gelu(h), ff2["w"])
+            + ff2["b"][:, None, :])
+
+
+def moe_ffn(params, x: jax.Array, num_experts: int,
+            capacity_factor: float = 2.0,
+            axis_name: Optional[str] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN. x: [B, S, D] (shard-local when `axis_name` is set inside
+    shard_map). Returns (y [B,S,D], aux_loss scalar — psum-averaged over
+    the axis when sharded).
+
+    Sharded contract: experts AND tokens are sharded over `axis_name`
+    (P devices): this device holds experts [idx*E_loc, (idx+1)*E_loc) and
+    num_experts = P * E_loc must divide by P. Capacity is per
+    (device, expert) pair, computed from local tokens.
+    """
+    b, s, d = x.shape
+    t = b * s
+    xt = x.reshape(t, d)
+    if axis_name is None:
+        cap = int(np.ceil(t / num_experts * capacity_factor))
+        dispatch, combine, aux = _route(params, xt, num_experts, cap)
+        buf = jnp.einsum("tec,td->ecd", dispatch, xt)       # [E, C, D]
+        out = _expert_ffn(params["ff1"], params["ff2"], buf)
+        y = jnp.einsum("tec,ecd->td", combine, out)
+        return y.reshape(b, s, d), aux
+
+    p_count = jax.lax.psum(1, axis_name)
+    if num_experts % p_count:
+        raise ValueError(
+            f"expert parallelism needs num_experts ({num_experts}) "
+            f"divisible by the '{axis_name}' axis size ({p_count})")
+    e_loc = num_experts // p_count
+    cap = int(np.ceil(t / num_experts * capacity_factor))
+    dispatch, combine, aux = _route(params, xt, num_experts, cap)
+    buf = jnp.einsum("tec,td->ecd", dispatch, xt)           # [E, C, D]
+    # all_to_all: [E=P*e_loc, C, D] -> [P*e_loc, C, D] where the leading
+    # axis becomes (home peer, local expert): this device now holds every
+    # peer's tokens for its OWN e_loc experts
+    buf = buf.reshape(p_count, e_loc, cap, d)
+    buf = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                             tiled=True)                    # [P*e_loc, C, D]
+    buf = buf.reshape(p_count, e_loc, cap, d).transpose(1, 0, 2, 3)
+    buf = buf.reshape(e_loc, p_count * cap, d)              # [e_loc, P*C, D]
+    # local experts: params sharded — this device's slice is [e_loc, ...]
+    out = _expert_ffn(params["ff1"], params["ff2"], buf)
+    # reverse the shuffle: back to [E, C, D] with tokens on home devices
+    out = out.reshape(e_loc, p_count, cap, d).transpose(1, 0, 2, 3)
+    out = out.reshape(p_count * e_loc, cap, d)
+    out = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                             tiled=True)
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y.reshape(b, s, d), jax.lax.pmean(aux, axis_name)
+
+
+def shard_moe_params(params, rank: int, p_count: int):
+    """Slice the expert stacks to rank's local experts; router replicated."""
+    e = params["ff1"]["w"].shape[0]
+    e_loc = e // p_count
+    sl = slice(rank * e_loc, (rank + 1) * e_loc)
+    return {
+        "router": params["router"],
+        "ff1": {"w": params["ff1"]["w"][sl], "b": params["ff1"]["b"][sl]},
+        "ff2": {"w": params["ff2"]["w"][sl], "b": params["ff2"]["b"][sl]},
+    }

@@ -106,3 +106,16 @@ def test_dataset_ranker_groups(data):
     m_ds = r.fit(LightGBMDataset(dfr, r))
     m_pl = r.fit(dfr)
     assert (m_ds.booster.model_string() == m_pl.booster.model_string())
+
+
+def test_prebinned_cleared_even_when_fit_fails(data):
+    """A param-validation failure after _extract_xyw must not leave the
+    estimator pinning the dataset's feature/binned matrices."""
+    df, x, y = data
+    est = LightGBMClassifier(numIterations=2, numTasks=1,
+                             histScan="compact", histRefresh="lazy")
+    ds = LightGBMDataset(
+        df, LightGBMClassifier(numIterations=2, numTasks=1))
+    with pytest.raises(ValueError, match="compact"):
+        est.fit(ds)
+    assert getattr(est, "_prebinned", None) is None

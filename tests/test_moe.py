@@ -1,0 +1,219 @@
+"""Expert parallelism (MoE) — ops/moe.py + models/deep/moe.py.
+
+Invariants: the dense path reproduces a hand-rolled per-token oracle; the
+expert-parallel all_to_all path is EXACTLY the dense path per token batch
+(ample capacity); capacity overflow drops tokens to zero (Switch
+semantics); the ep x dp training step tracks the single-device trajectory.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mmlspark_tpu.ops.moe import (init_moe_params, moe_ffn,
+                                  shard_moe_params)
+from mmlspark_tpu.models.deep.moe import (init_moe_block_params,
+                                          make_ep_dp_train_step,
+                                          moe_block_loss)
+from mmlspark_tpu.parallel import mesh as meshlib
+
+E, D, F = 8, 16, 32
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_moe_params(jax.random.PRNGKey(0), E, D, F)
+
+
+def _oracle(params, x):
+    """Per-token numpy oracle: top-1 expert FFN scaled by router prob."""
+    xt = np.asarray(x, np.float64).reshape(-1, x.shape[-1])
+    w = np.asarray(params["router"]["w"], np.float64)
+    logits = xt @ w
+    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs /= probs.sum(axis=1, keepdims=True)
+    top = probs.argmax(axis=1)
+    out = np.zeros_like(xt)
+    for i, e in enumerate(top):
+        w1 = np.asarray(params["ff1"]["w"][e], np.float64)
+        b1 = np.asarray(params["ff1"]["b"][e], np.float64)
+        w2 = np.asarray(params["ff2"]["w"][e], np.float64)
+        b2 = np.asarray(params["ff2"]["b"][e], np.float64)
+        h = jax.nn.gelu(jnp.asarray(xt[i] @ w1 + b1))
+        out[i] = (np.asarray(h, np.float64) @ w2 + b2) * probs[i, e]
+    return out.reshape(x.shape)
+
+
+def test_dense_matches_oracle(params):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 6, D)).astype(np.float32))
+    y, aux = moe_ffn(params, x, E, capacity_factor=float(E))
+    np.testing.assert_allclose(np.asarray(y, np.float64), _oracle(params, x),
+                               atol=1e-5)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_capacity_overflow_drops_tokens(params):
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(1, 64, D)).astype(np.float32))
+    y_full, _ = moe_ffn(params, x, E, capacity_factor=float(E))
+    y_tight, _ = moe_ffn(params, x, E, capacity_factor=0.25)
+    full = np.asarray(y_full).reshape(-1, D)
+    tight = np.asarray(y_tight).reshape(-1, D)
+    dropped = np.all(tight == 0.0, axis=1) & ~np.all(full == 0.0, axis=1)
+    kept = np.any(tight != 0.0, axis=1)
+    assert dropped.any()                       # overflow really drops
+    np.testing.assert_allclose(tight[kept], full[kept], atol=1e-6)
+
+
+def test_ep_sharded_matches_dense(params):
+    """all_to_all expert parallelism == dense routing, token for token."""
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("x",))
+    p = len(devs)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(p * 2, 8, D)).astype(np.float32)
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[shard_moe_params(params, r, p) for r in range(p)])
+
+    def local(pp, xl):
+        pp = jax.tree_util.tree_map(lambda a: a[0], pp)
+        y, aux = moe_ffn(pp, xl, E, capacity_factor=float(E), axis_name="x")
+        return y, aux
+
+    y_ep, aux_ep = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P("x"), P("x")),
+        out_specs=(P("x"), P()), check_vma=False))(stacked, jnp.asarray(x))
+
+    # dense reference PER SHARD (same local capacity, same router)
+    for r in range(p):
+        xl = jnp.asarray(x[r * 2:(r + 1) * 2])
+        y_ref, _ = moe_ffn(params, xl, E, capacity_factor=float(E))
+        np.testing.assert_allclose(np.asarray(y_ep[r * 2:(r + 1) * 2]),
+                                   np.asarray(y_ref), atol=2e-5,
+                                   err_msg=f"shard {r}")
+
+
+def test_ep_dp_training_tracks_single_device(params):
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4+ devices")
+    dp, ep = 2, len(devs) // 2
+    assert E % ep == 0
+    mesh = meshlib.get_mesh(dp * ep,
+                              axis_names=(meshlib.DATA_AXIS,
+                                          meshlib.MODEL_AXIS),
+                              shape=(dp, ep))
+    rng = np.random.default_rng(4)
+    nb = dp * ep * 2
+    x = rng.normal(size=(nb, 8, D)).astype(np.float32)
+    y = rng.normal(size=(nb, 3)).astype(np.float32)
+
+    full = init_moe_block_params(jax.random.PRNGKey(7), E, D, F, 3)
+    step, shard_params = make_ep_dp_train_step(mesh, E, 1e-2,
+                                               capacity_factor=float(E))
+    ps, opts = shard_params(full)
+
+    # single-device trajectory: the SAME per-device-mean loss (equal local
+    # batches => mean of local means == global mean), same Adam
+    import optax
+    tx = optax.adam(1e-2)
+    sp = full
+    sopt = tx.init(sp)
+
+    def single_loss(pp, xb, yb):
+        # average of per-(data x model)-device local losses
+        losses = [moe_block_loss(pp, xb[i * 2:(i + 1) * 2],
+                                 yb[i * 2:(i + 1) * 2], E, float(E), None)
+                  for i in range(dp * ep)]
+        return sum(losses) / len(losses)
+
+    single_step = jax.jit(
+        lambda pp, oo, xb, yb: _apply(tx, pp, oo, xb, yb))
+
+    def _apply(tx_, pp, oo, xb, yb):
+        loss, g = jax.value_and_grad(single_loss)(pp, xb, yb)
+        upd, oo = tx_.update(g, oo, pp)
+        return optax.apply_updates(pp, upd), oo, loss
+
+    xs, ys = jnp.asarray(x), jnp.asarray(y)
+    for it in range(4):
+        ps, opts, loss_ep = step(ps, opts, xs, ys)
+        sp, sopt, loss_s = single_step(sp, sopt, xs, ys)
+        assert np.isfinite(float(loss_ep))
+        np.testing.assert_allclose(float(loss_ep), float(loss_s), rtol=2e-4,
+                                   err_msg=f"iter {it}")
+    # final parameters agree (experts reassembled from shards)
+    got_ff1 = np.concatenate(
+        [np.asarray(ps["moe"]["ff1"]["w"][r]) for r in range(ep)])
+    np.testing.assert_allclose(got_ff1, np.asarray(sp["moe"]["ff1"]["w"]),
+                               atol=5e-4)
+
+
+def test_ep_validates_divisibility(params):
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("x",))
+
+    def local(xl):
+        y, _ = moe_ffn(params, xl, 6, capacity_factor=6.0, axis_name="x")
+        return y
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.shard_map(local, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                      check_vma=False)(jnp.zeros((len(devs), 4, D)))
+
+
+def test_ep_dp_sgd_grad_scale(params):
+    """Scale-SENSITIVE trajectory check: with plain SGD (no Adam scale
+    invariance), the ep x dp step only matches the single-device run if
+    expert grads carry the MEAN loss gradient like router/head — the
+    ep-times-sum bug this pins was invisible under Adam."""
+    import optax
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4+ devices")
+    dp, ep = 2, len(devs) // 2
+    mesh = meshlib.get_mesh(dp * ep,
+                            axis_names=(meshlib.DATA_AXIS,
+                                        meshlib.MODEL_AXIS),
+                            shape=(dp, ep))
+    rng = np.random.default_rng(9)
+    nb = dp * ep * 2
+    x = rng.normal(size=(nb, 8, D)).astype(np.float32)
+    y = rng.normal(size=(nb, 3)).astype(np.float32)
+    full = init_moe_block_params(jax.random.PRNGKey(11), E, D, F, 3)
+
+    step, shard_params = make_ep_dp_train_step(
+        mesh, E, 0.0, capacity_factor=float(E), optimizer=optax.sgd(0.1))
+    ps, opts = shard_params(full)
+
+    tx = optax.sgd(0.1)
+    sp, sopt = full, tx.init(full)
+
+    def single_loss(pp, xb, yb):
+        losses = [moe_block_loss(pp, xb[i * 2:(i + 1) * 2],
+                                 yb[i * 2:(i + 1) * 2], E, float(E), None)
+                  for i in range(dp * ep)]
+        return sum(losses) / len(losses)
+
+    @jax.jit
+    def single_step(pp, oo, xb, yb):
+        loss, g = jax.value_and_grad(single_loss)(pp, xb, yb)
+        upd, oo = tx.update(g, oo, pp)
+        return optax.apply_updates(pp, upd), oo, loss
+
+    xs, ys = jnp.asarray(x), jnp.asarray(y)
+    for it in range(3):
+        ps, opts, loss_ep = step(ps, opts, xs, ys)
+        sp, sopt, loss_s = single_step(sp, sopt, xs, ys)
+        np.testing.assert_allclose(float(loss_ep), float(loss_s), rtol=1e-4,
+                                   err_msg=f"iter {it}")
+    got = np.concatenate(
+        [np.asarray(ps["moe"]["ff1"]["w"][r]) for r in range(ep)])
+    np.testing.assert_allclose(got, np.asarray(sp["moe"]["ff1"]["w"]),
+                               rtol=1e-4, atol=1e-6)
