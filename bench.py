@@ -121,40 +121,36 @@ def main():
     else:
         hist_method, hist_chunk = "scatter", 512
 
-    # Primary mode selection (round-2 verdict #1): histScan='compact'
-    # reproduces the full scan's trees EXACTLY at upstream's smaller-child
-    # work model (~N*depth histogram rows/tree vs N*(L-1)) — promote it to
-    # primary when it compiles on the production toolchain; eager/full is
-    # the fallback. Both are exact leaf-wise semantics, so the primary
-    # metric never mixes semantics. 'lazy' (approximate refresh) stays a
-    # secondary extra.
+    # Primary mode selection (round-2 verdict #1, resolved by measurement
+    # 2026-07-31 on a live v5e chip — docs/PERF_scan_modes.log): at 1Mx28x64
+    # eager/full = 92.9 ms/iter, lazy = 20.2 ms/iter, and histScan='compact'
+    # (exact trees at upstream's smaller-child work model) = 237 ms/iter with
+    # a 150 s compile — the per-split dynamic-slice pass XLA compiles from
+    # the compact scan is hostile to the TPU, so compact is DEMOTED: never
+    # primary, not timed here (its measured number lives in the log above).
+    #
+    # The north-star condition (BASELINE.md:32) is wall-clock AT AUC PARITY,
+    # not tree-by-tree parity — upstream lightgbm-gpu's own trees differ
+    # from its CPU trees. So the primary is the faster of {eager/full exact,
+    # lazy approximate-refresh} GATED on AUC parity: lazy wins primary only
+    # if its sampled train AUC is within AUC_GATE of exact's on this very
+    # run; both AUCs and both throughputs are always reported.
+    AUC_GATE = 0.002
+
     def make_clf(**extra_kw):
         return LightGBMClassifier(numIterations=iters, numLeaves=leaves,
                                   maxBin=bins, histMethod=hist_method,
                                   histChunk=hist_chunk, numTasks=1,
                                   **extra_kw)
 
-    scan_mode = "full"
+    scan_mode = "eager/full"
     clf = make_clf()
-    if on_accel:
-        try:
-            c_probe = make_clf(histScan="compact")
-            t0 = time.time()
-            c_probe.fit(df)                  # compile + first run
-            warm_wall = time.time() - t0
-            scan_mode, clf = "compact", c_probe
-        except Exception as e:  # noqa: BLE001 - fall back to eager/full
-            scan_mode = f"full (compact failed: {str(e)[:120]})"
-            t0 = time.time()
-            clf.fit(df)
-            warm_wall = time.time() - t0
-    else:
-        # Warm-up = one full fit of the IDENTICAL program (same shapes, same
-        # static config), so the timed fits below hit the compile cache and
-        # measure execution only.
-        t0 = time.time()
-        clf.fit(df)
-        warm_wall = time.time() - t0
+    # Warm-up = one full fit of the IDENTICAL program (same shapes, same
+    # static config), so the timed fits below hit the compile cache and
+    # measure execution only.
+    t0 = time.time()
+    clf.fit(df)
+    warm_wall = time.time() - t0
 
     # The shared pool throttles unpredictably (measured 1.9x swings between
     # IDENTICAL back-to-back fits), so every metric is the MIN over repeated
@@ -172,7 +168,7 @@ def main():
                 break
         return walls, mdl
 
-    walls, model = timed_fits(clf, 3, t_start + 420)
+    walls, model = timed_fits(clf, 2, t_start + 360)
     wall = min(walls)
 
     from sklearn.metrics import roc_auc_score
@@ -180,48 +176,36 @@ def main():
     proba = model.booster.score(x[idx])
     auc = roc_auc_score(y[idx], proba)
 
-    extra = {"wall_s": round(wall, 2), "warm_wall_s": round(warm_wall, 2),
-             "all_wall_s": [round(w, 2) for w in walls],
+    extra = {"wall_s": round(wall, 2), "full_warm_wall_s": round(warm_wall, 2),
+             "full_wall_s": [round(w, 2) for w in walls],
              "n": n, "iters": iters, "hist_scan": scan_mode,
              "hist_kernel": f"{hist_method}/{hist_chunk}",
-             "train_auc_sample": round(auc, 4), "device": str(devs[0])}
+             "full_auc_sample": round(auc, 4),
+             "full_rows_iter_per_s": round(n * iters / wall, 1),
+             "device": str(devs[0])}
 
-    # secondary: lazy histogram refresh (histRefresh='lazy', ~1 pass per tree
-    # level instead of per split; measured 2x end-to-end). Reported as extras
-    # only — the primary metric stays exact leaf-wise, the reference's
-    # semantics. The PROVEN extra runs before the unproven compact one so a
-    # compact compile hang/failure can't cost the lazy numbers. Each extra
-    # is skipped when earlier work already consumed the time budget: the
-    # driver may bound the bench, and an unprinted JSON line is worse than
-    # a missing extra.
+    # lazy histogram refresh (histRefresh='lazy', one refresh pass per
+    # candidate-pool dry-out instead of per split; measured 4.6x/iter on
+    # chip). Promoted to PRIMARY iff its AUC matches exact within AUC_GATE
+    # on this run; otherwise reported as an extra. Fenced so a failure
+    # can't cost the already-recorded exact numbers.
     if on_accel and time.time() - t_start < 360:
         try:
-            lazy_clf = LightGBMClassifier(
-                numIterations=iters, numLeaves=leaves, maxBin=bins,
-                histMethod=hist_method, histChunk=hist_chunk, numTasks=1,
-                histRefresh="lazy")
+            lazy_clf = make_clf(histRefresh="lazy")
             lazy_clf.fit(df)                      # compile
-            lazy_walls, lazy_model = timed_fits(lazy_clf, 2, t_start + 480)
+            lazy_walls, lazy_model = timed_fits(lazy_clf, 2, t_start + 420)
             lazy_wall = min(lazy_walls)
             lazy_auc = roc_auc_score(y[idx], lazy_model.booster.score(x[idx]))
             extra["lazy_rows_iter_per_s"] = round(n * iters / lazy_wall, 1)
             extra["lazy_wall_s"] = [round(w, 2) for w in lazy_walls]
             extra["lazy_auc_sample"] = round(lazy_auc, 4)
+            if lazy_wall < wall and lazy_auc >= auc - AUC_GATE:
+                scan_mode = "lazy (AUC-parity gated, exact in extras)"
+                wall, model = lazy_wall, lazy_model
+                extra["hist_scan"] = scan_mode
+                extra["wall_s"] = round(wall, 2)
         except Exception as e:  # noqa: BLE001 - secondary must not kill bench
             extra["lazy_error"] = str(e)[:300]
-
-    # secondary: eager/full when compact won primary (quantifies the
-    # compact speedup at identical trees on the same chip/session)
-    if on_accel and scan_mode == "compact" and time.time() - t_start < 420:
-        try:
-            f_clf = make_clf()
-            f_clf.fit(df)                         # compile
-            f_walls, _ = timed_fits(f_clf, 2, t_start + 540)
-            extra["full_rows_iter_per_s"] = round(
-                n * iters / min(f_walls), 1)
-            extra["full_wall_s"] = [round(wv, 2) for wv in f_walls]
-        except Exception as e:  # noqa: BLE001 - secondary must not kill bench
-            extra["full_error"] = str(e)[:300]
 
     # extra: HIGGS-scale run — BASELINE.json defines the north-star metric
     # at 11M x 28 x 100 (int8 bins ~ 310 MB HBM; fits one v5e chip). One
@@ -233,8 +217,14 @@ def main():
             y11 = ((x11 @ coef + 0.5 * x11[:, 0] * x11[:, 1]
                     + rng.normal(scale=1.0, size=n11)) > 0).astype(np.float64)
             df11 = DataFrame({"features": x11, "label": y11})
-            clf11 = (make_clf(histScan="compact") if scan_mode == "compact"
-                     else make_clf())
+            # shared pools evict device programs that hold the chip for
+            # minutes (an 11M x 100-iter eager scan measured ~2 min and was
+            # killed twice, 2026-07-31) — split eager into 4 x 25-iter calls
+            # (exact continuation, tests/test_lightgbm.py); lazy's single
+            # ~60 s program survives as-is
+            clf11 = (make_clf(histRefresh="lazy")
+                     if scan_mode.startswith("lazy")
+                     else make_clf(itersPerCall=25))
             t0 = time.time()
             m11 = clf11.fit(df11)
             first11 = time.time() - t0

@@ -29,7 +29,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .transformer import encoder_layer
 
@@ -56,7 +55,8 @@ def stack_stage_params(params, num_stages: int):
 
 def pipeline_forward(stage_params, x_mb, num_heads: int, axis_name: str,
                      causal: bool = False, remat: bool = False,
-                     broadcast: bool = True):
+                     broadcast: bool = True,
+                     attention_impl: str = "reference"):
     """Shard-local GPipe forward (call inside shard_map).
 
     stage_params: this stage's stacked layer block [layers_per_stage, ...].
@@ -79,7 +79,7 @@ def pipeline_forward(stage_params, x_mb, num_heads: int, axis_name: str,
     def block(x):
         def body(h, lp):
             return encoder_layer(h, lp, num_heads, causal=causal,
-                                 attention_impl="reference"), None
+                                 attention_impl=attention_impl), None
         h, _ = jax.lax.scan(body, x, stage_params)
         return h
 
@@ -115,7 +115,8 @@ def make_pp_dp_train_step(mesh, num_heads: int, learning_rate: float,
                           causal: bool = False,
                           data_axis: Optional[str] = None,
                           model_axis: Optional[str] = None,
-                          remat: bool = False):
+                          remat: bool = False,
+                          attention_impl: str = "reference"):
     """One pipeline-parallel (x data-parallel) encoder training step.
 
     Returns (step, shard_params):
@@ -124,6 +125,10 @@ def make_pp_dp_train_step(mesh, num_heads: int, learning_rate: float,
     x: [B, S, D] (B divisible by data_shards * num_microbatches);
     y: [B] int labels. Stages ride the MODEL axis, batch rides DATA; the
     mean-pool + softmax head is replicated.
+
+    attention_impl defaults to "reference" because the fused flash kernel
+    has no VJP (same reason the tp/sp TRAINING paths use reference —
+    transformer.py); pass "flash" for inference-only forwards.
     """
     import optax
     from ...parallel import mesh as meshlib
@@ -142,7 +147,8 @@ def make_pp_dp_train_step(mesh, num_heads: int, learning_rate: float,
         # variant double-counts cotangents (see pipeline_forward docstring)
         coll = pipeline_forward(params["stage"], x_mb, num_heads,
                                 model_axis, causal, remat=remat,
-                                broadcast=False)
+                                broadcast=False,
+                                attention_impl=attention_impl)
         enc = coll.reshape(b_loc, *x.shape[1:])
         pooled = enc.mean(axis=1)
         logits = pooled @ params["head"]["w"] + params["head"]["b"]

@@ -142,6 +142,34 @@ class TestClassifier:
         assert model.booster.best_iteration is not None
         assert 1 <= model.booster.best_iteration <= 40
 
+    def test_iters_per_call_exact_continuation(self, binary_df):
+        """itersPerCall splits the fit into bounded device programs; without
+        bagging randomness the chunked trees must equal the one-program
+        fit's bit-for-bit (only raw scores carry between calls)."""
+        full = LightGBMClassifier(numIterations=11, numLeaves=7, seed=5,
+                                  numTasks=1).fit(binary_df)
+        chunked = LightGBMClassifier(numIterations=11, numLeaves=7, seed=5,
+                                     numTasks=1, itersPerCall=4).fit(binary_df)
+        x = np.asarray(binary_df["features"])
+        np.testing.assert_array_equal(full.booster.raw_predict(x),
+                                      chunked.booster.raw_predict(x))
+
+    def test_iters_per_call_early_stopping_composes(self, binary_df):
+        n = len(binary_df)
+        rng = np.random.default_rng(9)
+        df = binary_df.with_column("val", rng.random(n) < 0.25)
+        model = LightGBMClassifier(numIterations=40, numLeaves=31,
+                                   validationIndicatorCol="val",
+                                   earlyStoppingRound=5, itersPerCall=16,
+                                   numTasks=1).fit(df)
+        assert model.booster.best_iteration is not None
+        assert 1 <= model.booster.best_iteration <= 40
+
+    def test_iters_per_call_rejects_dart(self, binary_df):
+        with pytest.raises(ValueError, match="dart"):
+            LightGBMClassifier(numIterations=4, boostingType="dart",
+                               itersPerCall=2, numTasks=1).fit(binary_df)
+
     def test_feature_importances(self, binary_df):
         model = LightGBMClassifier(numIterations=10, numTasks=1).fit(binary_df)
         fi = model.get_feature_importances("split")
