@@ -187,13 +187,15 @@ def main():
     # lazy histogram refresh (histRefresh='lazy', one refresh pass per
     # candidate-pool dry-out instead of per split; measured 4.6x/iter on
     # chip). Promoted to PRIMARY iff its AUC matches exact within AUC_GATE
-    # on this run; otherwise reported as an extra. Fenced so a failure
-    # can't cost the already-recorded exact numbers.
-    if on_accel and time.time() - t_start < 360:
+    # on this run; otherwise reported as an extra. The PROVEN extras run
+    # before the unproven batched one so a novel-kernel compile hang can't
+    # cost the proven numbers (the lesson of compact's 150 s compile).
+    # Fenced so a failure can't cost the already-recorded exact numbers.
+    if on_accel and time.time() - t_start < 330:
         try:
             lazy_clf = make_clf(histRefresh="lazy")
             lazy_clf.fit(df)                      # compile
-            lazy_walls, lazy_model = timed_fits(lazy_clf, 2, t_start + 420)
+            lazy_walls, lazy_model = timed_fits(lazy_clf, 2, t_start + 390)
             lazy_wall = min(lazy_walls)
             lazy_auc = roc_auc_score(y[idx], lazy_model.booster.score(x[idx]))
             extra["lazy_rows_iter_per_s"] = round(n * iters / lazy_wall, 1)
@@ -206,6 +208,28 @@ def main():
                 extra["wall_s"] = round(wall, 2)
         except Exception as e:  # noqa: BLE001 - secondary must not kill bench
             extra["lazy_error"] = str(e)[:300]
+
+    # batched leaf-wise growth (splitsPerPass=4): top-4 best splits on
+    # distinct leaves per histogram pass, gains never stale — near-exact
+    # greedy at ~(L-1)/4 passes/tree. Promoted to PRIMARY iff faster AND
+    # AUC within the gate of strict leaf-wise on this very run.
+    if on_accel and time.time() - t_start < 390:
+        try:
+            b_clf = make_clf(splitsPerPass=4)
+            b_clf.fit(df)                         # compile
+            b_walls, b_model = timed_fits(b_clf, 2, t_start + 450)
+            b_wall = min(b_walls)
+            b_auc = roc_auc_score(y[idx], b_model.booster.score(x[idx]))
+            extra["batched4_rows_iter_per_s"] = round(n * iters / b_wall, 1)
+            extra["batched4_wall_s"] = [round(w, 2) for w in b_walls]
+            extra["batched4_auc_sample"] = round(b_auc, 4)
+            if b_wall < wall and b_auc >= auc - AUC_GATE:
+                scan_mode = "batched-k4 (AUC-parity gated, exact in extras)"
+                wall, model = b_wall, b_model
+                extra["hist_scan"] = scan_mode
+                extra["wall_s"] = round(wall, 2)
+        except Exception as e:  # noqa: BLE001 - secondary must not kill bench
+            extra["batched4_error"] = str(e)[:300]
 
     # extra: HIGGS-scale run — BASELINE.json defines the north-star metric
     # at 11M x 28 x 100 (int8 bins ~ 310 MB HBM; fits one v5e chip). One
@@ -222,9 +246,12 @@ def main():
             # killed twice, 2026-07-31) — split eager into 4 x 25-iter calls
             # (exact continuation, tests/test_lightgbm.py); lazy's single
             # ~60 s program survives as-is
-            clf11 = (make_clf(histRefresh="lazy")
-                     if scan_mode.startswith("lazy")
-                     else make_clf(itersPerCall=25))
+            if scan_mode.startswith("lazy"):
+                clf11 = make_clf(histRefresh="lazy")
+            elif scan_mode.startswith("batched"):
+                clf11 = make_clf(splitsPerPass=4, itersPerCall=50)
+            else:
+                clf11 = make_clf(itersPerCall=25)
             t0 = time.time()
             m11 = clf11.fit(df11)
             first11 = time.time() - t0

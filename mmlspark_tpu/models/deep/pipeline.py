@@ -115,8 +115,7 @@ def make_pp_dp_train_step(mesh, num_heads: int, learning_rate: float,
                           causal: bool = False,
                           data_axis: Optional[str] = None,
                           model_axis: Optional[str] = None,
-                          remat: bool = False,
-                          attention_impl: str = "reference"):
+                          remat: bool = False):
     """One pipeline-parallel (x data-parallel) encoder training step.
 
     Returns (step, shard_params):
@@ -126,9 +125,10 @@ def make_pp_dp_train_step(mesh, num_heads: int, learning_rate: float,
     y: [B] int labels. Stages ride the MODEL axis, batch rides DATA; the
     mean-pool + softmax head is replicated.
 
-    attention_impl defaults to "reference" because the fused flash kernel
-    has no VJP (same reason the tp/sp TRAINING paths use reference —
-    transformer.py); pass "flash" for inference-only forwards.
+    The differentiated forward always uses reference attention — the fused
+    flash kernel has no VJP (same reason the tp/sp TRAINING paths use
+    reference, transformer.py); pipeline_forward exposes attention_impl
+    for inference-only forwards.
     """
     import optax
     from ...parallel import mesh as meshlib
@@ -147,8 +147,7 @@ def make_pp_dp_train_step(mesh, num_heads: int, learning_rate: float,
         # variant double-counts cotangents (see pipeline_forward docstring)
         coll = pipeline_forward(params["stage"], x_mb, num_heads,
                                 model_axis, causal, remat=remat,
-                                broadcast=False,
-                                attention_impl=attention_impl)
+                                broadcast=False)
         enc = coll.reshape(b_loc, *x.shape[1:])
         pooled = enc.mean(axis=1)
         logits = pooled @ params["head"]["w"] + params["head"]["b"]

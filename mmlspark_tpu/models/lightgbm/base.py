@@ -245,6 +245,16 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         "segment in a pow2-bucketed masked 2-slot pass — the TPU analogue "
         "of upstream's DataPartition + smaller-child trick, exact leaf-wise "
         "semantics at ~N*depth instead of N*(L-1) histogram work)", "full")
+    splitsPerPass = Param(
+        "splitsPerPass",
+        "batched leaf-wise growth: apply the top-k best splits (necessarily "
+        "on distinct leaves, so their gains are mutually independent) per "
+        "histogram pass, then refresh every new child in ONE all-slots "
+        "pass. 1 = strict leaf-wise (exact LightGBM split order); k>1 cuts "
+        "histogram passes per tree from numLeaves-1 to ~(numLeaves-1)/k at "
+        "the cost that children created within a pass cannot compete until "
+        "the next pass. Gains are never stale (unlike histRefresh='lazy'). "
+        "eager/full only", 1, int)
     itersPerCall = Param(
         "itersPerCall",
         "split training into device programs of at most this many boosting "
@@ -530,6 +540,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             hist_dtype=self.get("histDtype"),
             split_refresh=self.get("histRefresh"),
             split_scan=self.get("histScan"),
+            splits_per_pass=self.get("splitsPerPass"),
             categorical_features=tuple(self._categorical_indexes()),
             missing_features=getattr(self, "_missing_idx", ()),
             cat_smooth=self.get("catSmooth"),
@@ -660,6 +671,18 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     "histScan='compact' does not compose with "
                     "parallelism='voting_parallel' (voting needs full local "
                     "histograms per slot)")
+        if self.get("splitsPerPass") > 1:
+            if (self.get("histRefresh") == "lazy"
+                    or self.get("histScan") == "compact"):
+                raise ValueError(
+                    "splitsPerPass > 1 is the batched variant of the "
+                    "eager/full scan; it does not compose with "
+                    "histRefresh='lazy' or histScan='compact'")
+            if self.get("parallelism") == "voting_parallel":
+                raise ValueError(
+                    "splitsPerPass > 1 does not compose with "
+                    "parallelism='voting_parallel' (votes must be recast "
+                    "per split)")
         if ((self.get("posBaggingFraction") >= 0
              or self.get("negBaggingFraction") >= 0)
                 and (objective or self._objective_name()) != "binary"):

@@ -117,6 +117,15 @@ class GBDTConfig(NamedTuple):
     # segment sizes (~= N * avg depth, the same work model as upstream's
     # smaller-child trick) while split selection stays exact leaf-wise.
     split_scan: str = "full"
+    # batched leaf-wise growth (eager/full only): apply the top
+    # `splits_per_pass` best splits — necessarily on DISTINCT leaves, so
+    # their gains are mutually independent — then refresh all children with
+    # ONE all-slots pass. 1 = strict leaf-wise (exact LightGBM order); k>1
+    # cuts histogram passes per tree from L-1 to ~(L-1)/k + ramp at the cost
+    # that children created in a pass cannot compete for splits until the
+    # next pass (a k-step lookahead restriction — gains used are never
+    # stale, unlike split_refresh='lazy'). TPU-native optimization.
+    splits_per_pass: int = 1
     # evaluation metric (LightGBMParams.scala:310-342 `metric`): "" = the
     # objective's default. Canonical names: l1 l2 rmse mape auc
     # binary_logloss binary_error multi_logloss multi_error ndcg. Metrics
@@ -353,6 +362,18 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
             f"split_scan must be 'full' or 'compact', got "
             f"{cfg.split_scan!r}")
     compact = cfg.split_scan == "compact"
+    k_batch = int(cfg.splits_per_pass)
+    if k_batch < 1:
+        raise ValueError(f"splits_per_pass must be >= 1, got {k_batch}")
+    # more than lcap-1 splits can never apply in one pass (and lax.top_k
+    # requires k <= its operand length)
+    k_batch = min(k_batch, lcap - 1)
+    batched = k_batch > 1
+    if batched and (voting or lazy or compact):
+        raise NotImplementedError(
+            "splits_per_pass > 1 is the batched variant of the eager/full "
+            "scan; it does not compose with voting_parallel, "
+            "split_refresh='lazy' or split_scan='compact'")
     if compact and (voting or lazy):
         raise NotImplementedError(
             "split_scan='compact' replaces the per-split full pass of the "
@@ -462,6 +483,59 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
 
     thresh = hp.min_gain_to_split + _MIN_GAIN_EPS
 
+    def apply_split(do_f, slot_f, rec_f, new_slot_f, gain_f, hists_f,
+                    feats_f, bins_f, dls_f, slot_of_row, depth_of_slot,
+                    s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
+                    s_mask, s_dl):
+        """Apply ONE split decision, masked by do_f, writing record rec_f
+        and sending the right child to slot new_slot_f: row routing
+        (categorical bitset + learned missing direction), depth updates,
+        and the eight split-record writes. Shared by the strict leaf-wise
+        body and body_batched so split semantics cannot diverge. All
+        writes keep the current value when do_f is False (rec_f may alias
+        an existing record in the batched path's clipped tail)."""
+        feat_b = feats_f[slot_f]
+        bin_b = bins_f[slot_f]
+        dl_b = dls_f[slot_f]
+        col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
+        in_leaf = slot_of_row == slot_f
+        if cat:
+            # rebuild the sorted-order prefix as an explicit category mask
+            hrow = hists_f[slot_f, feat_b]                       # [B,3]
+            order_b = jnp.argsort(-_cat_ratio(hrow, cfg))
+            mask = jnp.zeros((b,), bool).at[order_b].set(
+                jnp.arange(b) <= bin_b)                          # left subset
+            feat_cat = is_cat_f[feat_b]
+            go_right = jnp.where(feat_cat, ~mask[col], col > bin_b)
+        else:
+            mask = jnp.zeros((bm,), bool)
+            feat_cat = jnp.array(False)
+            go_right = col > bin_b
+        if miss:
+            # bin 0 of a missing-capable feature = NaN rows: route by the
+            # LEARNED default direction, not the value comparison
+            go_right = jnp.where(is_miss_f[feat_b] & (col == 0),
+                                 ~dl_b, go_right)
+        slot_of_row = jnp.where(in_leaf & go_right & do_f, new_slot_f,
+                                slot_of_row)
+        child_depth = depth_of_slot[slot_f] + 1
+        depth_of_slot = depth_of_slot.at[new_slot_f].set(
+            jnp.where(do_f, child_depth, depth_of_slot[new_slot_f]))
+        depth_of_slot = depth_of_slot.at[slot_f].set(
+            jnp.where(do_f, child_depth, depth_of_slot[slot_f]))
+        s_slot = s_slot.at[rec_f].set(jnp.where(do_f, slot_f, s_slot[rec_f]))
+        s_feat = s_feat.at[rec_f].set(jnp.where(do_f, feat_b, s_feat[rec_f]))
+        s_bin = s_bin.at[rec_f].set(jnp.where(do_f, bin_b, s_bin[rec_f]))
+        s_valid = s_valid.at[rec_f].set(s_valid[rec_f] | do_f)
+        s_gain = s_gain.at[rec_f].set(jnp.where(do_f, gain_f, s_gain[rec_f]))
+        s_is_cat = s_is_cat.at[rec_f].set(
+            jnp.where(do_f, feat_cat, s_is_cat[rec_f]))
+        s_mask = s_mask.at[rec_f].set(
+            jnp.where(do_f, mask[:bm], s_mask[rec_f]))
+        s_dl = s_dl.at[rec_f].set(jnp.where(do_f, dl_b, s_dl[rec_f]))
+        return (go_right, slot_of_row, depth_of_slot, s_slot, s_feat,
+                s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl)
+
     def body(s, carry):
         if voting:
             (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
@@ -516,46 +590,12 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         best_gain = gains[best_slot]
         do = (best_gain > thresh) & (~done)
 
-        feat_b = feats_all[best_slot]
-        bin_b = bins_all[best_slot]
-        dl_b = dls_all[best_slot]
         new_slot = (s + 1).astype(jnp.int32)
-
-        col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
-        in_leaf = slot_of_row == best_slot
-        if cat:
-            # rebuild the sorted-order prefix as an explicit category mask
-            hrow = hists[best_slot, feat_b]                      # [B,3]
-            order_b = jnp.argsort(-_cat_ratio(hrow, cfg))
-            mask = jnp.zeros((b,), bool).at[order_b].set(
-                jnp.arange(b) <= bin_b)                          # left subset
-            feat_cat = is_cat_f[feat_b]
-            go_right = jnp.where(feat_cat, ~mask[col], col > bin_b)
-        else:
-            mask = jnp.zeros((bm,), bool)
-            feat_cat = jnp.array(False)
-            go_right = col > bin_b
-        if miss:
-            # bin 0 of a missing-capable feature = NaN rows: route by the
-            # LEARNED default direction, not the value comparison
-            go_right = jnp.where(is_miss_f[feat_b] & (col == 0),
-                                 ~dl_b, go_right)
-        slot_of_row = jnp.where(in_leaf & go_right & do, new_slot, slot_of_row)
-
-        child_depth = depth_of_slot[best_slot] + 1
-        depth_of_slot = depth_of_slot.at[new_slot].set(
-            jnp.where(do, child_depth, 0))
-        depth_of_slot = depth_of_slot.at[best_slot].set(
-            jnp.where(do, child_depth, depth_of_slot[best_slot]))
-
-        s_slot = s_slot.at[s].set(best_slot)
-        s_feat = s_feat.at[s].set(feat_b)
-        s_bin = s_bin.at[s].set(bin_b)
-        s_valid = s_valid.at[s].set(do)
-        s_gain = s_gain.at[s].set(jnp.where(do, best_gain, 0.0))
-        s_is_cat = s_is_cat.at[s].set(feat_cat & do)
-        s_mask = s_mask.at[s].set(mask[:bm])
-        s_dl = s_dl.at[s].set(jnp.where(do, dl_b, True))
+        (go_right, slot_of_row, depth_of_slot, s_slot, s_feat, s_bin,
+         s_valid, s_gain, s_is_cat, s_mask, s_dl) = apply_split(
+            do, best_slot, s, new_slot, best_gain, hists,
+            feats_all, bins_all, dls_all, slot_of_row, depth_of_slot,
+            s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl)
         done = done | ~do
         if voting:
             return (depth_of_slot, slot_of_row, s_slot, s_feat,
@@ -664,17 +704,99 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
             out = out + (perm, seg_start, seg_len)
         return out
 
-    carry = (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-             s_valid, s_gain, s_is_cat, s_mask, s_dl, done)
-    if not voting:
-        carry = carry + (g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
-    if compact:
-        carry = carry + (perm, seg_start, seg_len)
-    carry = jax.lax.fori_loop(0, lcap - 1, body, carry)
-    (_, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
-     s_is_cat, s_mask, s_dl, _) = carry[:11]
+    def body_batched(carry):
+        """One batched pass: apply the top-k cached best splits (distinct
+        leaves — their gains are mutually independent, so this equals k
+        consecutive strict leaf-wise steps restricted from choosing
+        children created within the pass), then ONE all-slots refresh.
+        Valid splits form a PREFIX of the gain-sorted selection (gains
+        descend and the record-budget check only tightens with j), so the
+        j-th valid split's record index is exactly next_rec + j."""
+        (step, next_rec, done, depth_of_slot, slot_of_row, s_slot, s_feat,
+         s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl,
+         g_hists, g_sums, bg, bf_, bb, bd) = carry
+        slot_exists = jnp.arange(lcap) <= next_rec
+        if cfg.max_depth > 0:
+            slot_exists = slot_exists & (depth_of_slot < cfg.max_depth)
+        gains = jnp.where(slot_exists, bg, _NEG_INF)
+        top_g, sel = jax.lax.top_k(gains, k_batch)
+        do_js, parents, children = [], [], []
+        for j in range(k_batch):
+            rec = next_rec + j
+            slot_j = sel[j]
+            do_j = (top_g[j] > thresh) & (rec < lcap - 1) & (~done)
+            rec_c = jnp.minimum(rec, lcap - 2)
+            new_slot = rec_c + 1
+            (_, slot_of_row, depth_of_slot, s_slot, s_feat, s_bin,
+             s_valid, s_gain, s_is_cat, s_mask, s_dl) = apply_split(
+                do_j, slot_j, rec_c, new_slot, top_g[j], g_hists,
+                bf_, bb, bd, slot_of_row, depth_of_slot,
+                s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
+                s_mask, s_dl)
+            do_js.append(do_j)
+            parents.append(slot_j)
+            children.append(new_slot)
+        applied = sum(d.astype(jnp.int32) for d in do_js)
+        next_rec = next_rec + applied
+        done = done | (applied == 0)
+        # ONE refresh pass covers every child created this pass; only the
+        # k child slices ride the allreduce (same total ICI traffic as k
+        # eager steps, k x fewer latency hops), parents update by sibling
+        # subtraction
+        local = hist_local(slot_of_row)
+        ch_idx = jnp.stack(children)
+        childs = psum_(jnp.take(local, ch_idx, axis=0))          # [k,F,B,3]
+        for j in range(k_batch):
+            cj = jnp.where(do_js[j], childs[j], 0.0)
+            cs = cj[0].sum(axis=0)
+            g_hists = g_hists.at[children[j]].set(
+                jnp.where(do_js[j], cj, g_hists[children[j]]))
+            g_hists = g_hists.at[parents[j]].add(-cj)
+            g_sums = g_sums.at[children[j]].set(
+                jnp.where(do_js[j], cs, g_sums[children[j]]))
+            g_sums = g_sums.at[parents[j]].add(
+                jnp.where(do_js[j], -cs, jnp.zeros_like(cs)))
+        idx2k = jnp.stack(parents + children)                    # [2k]
+        pg, pf, pb, pd = _best_split_per_slot(g_hists[idx2k], g_sums[idx2k],
+                                              cfg, feature_mask, hp)
+        do2 = jnp.stack(do_js + do_js)
+        bg = bg.at[idx2k].set(jnp.where(do2, pg, bg[idx2k]))
+        bf2 = bf_.at[idx2k].set(jnp.where(do2, pf, bf_[idx2k]))
+        bb2 = bb.at[idx2k].set(jnp.where(do2, pb, bb[idx2k]))
+        bd2 = bd.at[idx2k].set(jnp.where(do2, pd, bd[idx2k]))
+        return (step + 1, next_rec, done, depth_of_slot, slot_of_row,
+                s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat, s_mask,
+                s_dl, g_hists, g_sums, bg, bf2, bb2, bd2)
 
-    if voting or lazy:
+    if batched:
+        init = (jnp.int32(0), jnp.int32(0), done, depth_of_slot,
+                slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
+                s_is_cat, s_mask, s_dl, g_hists, g_sums, bg, bf_, bb, bd)
+
+        def cond_batched(carry):
+            step, next_rec, done = carry[0], carry[1], carry[2]
+            # step < lcap-1 is the safety bound (1 split/pass worst case);
+            # the typical trip count is ~(L-1)/k + a short ramp
+            return (~done) & (next_rec < lcap - 1) & (step < lcap - 1)
+
+        fin = jax.lax.while_loop(cond_batched, body_batched, init)
+        (_, _, _, _, slot_of_row, s_slot, s_feat, s_bin, s_valid,
+         s_gain, s_is_cat, s_mask, s_dl, _, g_sums_f, *_rest) = fin
+        sums = g_sums_f
+    else:
+        carry = (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
+                 s_valid, s_gain, s_is_cat, s_mask, s_dl, done)
+        if not voting:
+            carry = carry + (g_hists, g_sums, bg, bf_, bb, bd, hist_valid)
+        if compact:
+            carry = carry + (perm, seg_start, seg_len)
+        carry = jax.lax.fori_loop(0, lcap - 1, body, carry)
+        (_, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
+         s_is_cat, s_mask, s_dl, _) = carry[:11]
+
+    if batched:
+        pass
+    elif voting or lazy:
         # post-split leaf stats via a slot-onehot contraction (O(N*L), no
         # histogram pass needed; in lazy mode the carried g_sums are stale
         # for slots split after the last refresh)

@@ -165,6 +165,38 @@ class TestClassifier:
         assert model.booster.best_iteration is not None
         assert 1 <= model.booster.best_iteration <= 40
 
+    def test_splits_per_pass_quality(self, binary_df):
+        """Batched leaf-wise growth (splitsPerPass=k): top-k best splits on
+        distinct leaves per histogram pass. Gains are never stale, so the
+        quality should track strict leaf-wise closely (ops/boosting.py
+        body_batched)."""
+        strict = LightGBMClassifier(numIterations=20, numLeaves=15, seed=5,
+                                    numTasks=1).fit(binary_df)
+        batched = LightGBMClassifier(numIterations=20, numLeaves=15, seed=5,
+                                     numTasks=1, splitsPerPass=4).fit(binary_df)
+        x = np.asarray(binary_df["features"])
+        a_strict = auc(binary_df["label"], strict.booster.score(x))
+        a_batched = auc(binary_df["label"], batched.booster.score(x))
+        assert a_batched > a_strict - 0.005, (a_batched, a_strict)
+
+    def test_splits_per_pass_distributed_matches_serial(self, binary_df):
+        ser = LightGBMClassifier(numIterations=10, numLeaves=15, seed=5,
+                                 numTasks=1, splitsPerPass=4).fit(binary_df)
+        dist = LightGBMClassifier(numIterations=10, numLeaves=15, seed=5,
+                                  numTasks=8, splitsPerPass=4).fit(binary_df)
+        x = np.asarray(binary_df["features"])
+        np.testing.assert_allclose(ser.booster.raw_predict(x),
+                                   dist.booster.raw_predict(x),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_splits_per_pass_invalid_combos(self, binary_df):
+        with pytest.raises(ValueError, match="lazy"):
+            LightGBMClassifier(numIterations=4, splitsPerPass=2,
+                               histRefresh="lazy", numTasks=1).fit(binary_df)
+        with pytest.raises(ValueError, match="compact"):
+            LightGBMClassifier(numIterations=4, splitsPerPass=2,
+                               histScan="compact", numTasks=1).fit(binary_df)
+
     def test_iters_per_call_rejects_dart(self, binary_df):
         with pytest.raises(ValueError, match="dart"):
             LightGBMClassifier(numIterations=4, boostingType="dart",
