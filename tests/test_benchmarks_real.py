@@ -241,6 +241,27 @@ class TestSklearnHeadToHead:
             skl.predict_proba(np.stack(test["features"]))[:, 1])
         assert our_auc > skl_auc - 0.01, (our_auc, skl_auc)
 
+    def test_binary_auc_head_to_head_batched(self):
+        """The batched leaf-wise mode (splitsPerPass=4, the bench's fast
+        candidate) must ALSO hold against the independent implementation —
+        quality of the throughput mode is gated here, not just claimed."""
+        from sklearn.ensemble import HistGradientBoostingClassifier
+        data = load_breast_cancer()
+        train, test = _split(data.data, data.target)
+        ours = LightGBMClassifier(numIterations=100, numLeaves=31,
+                                  learningRate=0.1,
+                                  splitsPerPass=4).fit(train)
+        proba = np.stack(ours.transform(test)["probability"])[:, 1]
+        our_auc = auc_score(test["label"], proba)
+        skl = HistGradientBoostingClassifier(
+            max_iter=100, max_leaf_nodes=31, learning_rate=0.1,
+            random_state=0, early_stopping=False)
+        skl.fit(np.stack(train["features"]), train["label"])
+        skl_auc = auc_score(
+            test["label"],
+            skl.predict_proba(np.stack(test["features"]))[:, 1])
+        assert our_auc > skl_auc - 0.01, (our_auc, skl_auc)
+
     def test_multiclass_acc_head_to_head(self):
         from sklearn.ensemble import HistGradientBoostingClassifier
         data = load_wine()
