@@ -1,17 +1,24 @@
 """ImageFeaturizer forward throughput on chip (round-2 verdict #5).
 
 Measures the jitted ResNet-50 headless forward (the CNTKModel.scala:30-140
-hot-loop replacement) in images/s at the zoo's native 224x224 input, with
-the docs/KERNELS.md paired-difference methodology so the relay RTT cancels.
-Appends results to stdout for docs/PERF.md.
+hot-loop replacement) in images/s at the zoo's native 224x224 input.
+
+Methodology: async-dispatch pipelining instead of the scan-of-forwards used
+by the kernel sweeps — jax dispatches queue without blocking, so timing N
+sequential calls with ONE host fetch at the end costs N x device-time +
+one relay RTT; the (2N calls) - (N calls) difference cancels the RTT and
+the fetch. This avoids jitting a scan over the whole ResNet (which
+compiled for minutes on the relay toolchain and timed the first attempt
+out); the plain forward compiles once.
 """
 
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -28,38 +35,30 @@ def main():
     gm = ModelDownloader().download_by_name("ResNet50")
     h, w, c = gm.schema.input_dims
     rng = np.random.default_rng(0)
+    fwd = jax.jit(lambda v, x_: gm.module.apply(v, x_, capture="pool"))
 
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
     print("| batch | device ms/batch | images/s | date |")
     print("|---|---|---|---|")
-    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
     for batch in (8, 32, 64):
         xb = jnp.asarray(rng.normal(size=(batch, h, w, c)), jnp.float32)
+        out = fwd(gm.variables, xb)
+        jax.block_until_ready(out)               # compile + settle
 
-        # apply(..., capture="pool") returns the pooled features directly
-        fwd = jax.jit(lambda v, x_: gm.module.apply(v, x_, capture="pool"))
+        def loop(k):
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(k):
+                o = fwd(gm.variables, xb)
+            float(jnp.sum(o))                    # one fetch barrier
+            return time.perf_counter() - t0
 
-        def k_calls(k):
-            def run(x_):
-                def body(acc, j):
-                    xj = x_ * (1.0 + 1e-6 * j.astype(jnp.float32))
-                    return acc + jnp.sum(fwd(gm.variables, xj)), None
-                acc, _ = jax.lax.scan(body, jnp.float32(0.0),
-                                      jnp.arange(k))
-                return acc
-            return jax.jit(run)
-
-        inner = 8
-        fn1, fn3 = k_calls(inner), k_calls(3 * inner)
-        float(fn1(xb))
-        float(fn3(xb))
+        loop(4)
         diffs = []
         for _ in range(3):
-            t0 = time.perf_counter()
-            float(fn1(xb))
-            t1 = time.perf_counter()
-            float(fn3(xb))
-            t2 = time.perf_counter()
-            diffs.append(((t2 - t1) - (t1 - t0)) / (2 * inner))
+            t1 = loop(8)
+            t2 = loop(16)
+            diffs.append((t2 - t1) / 8)
         per_batch = float(np.median(diffs))
         print(f"| {batch} | {per_batch * 1e3:.2f} | "
               f"{batch / per_batch:.0f} | {stamp} |", flush=True)

@@ -8,11 +8,14 @@ to docs/PERF_scan_modes.log.
 """
 
 import os
+import sys
 import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mmlspark_tpu.ops.boosting import GBDTConfig, make_train_fn
 
@@ -36,16 +39,20 @@ def main(n=1_000_000, f=28, b=64, lcap=31):
         fh.write(f"== {time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())}"
                  f" on {dev} n={n} f={f} b={b} L={lcap}\n")
 
-    # proven modes first, the unproven compact compile last, each mode
-    # fenced by its own try — one failure must not lose the others'
-    # measurements (the healthy-pool window this runs in is rare), and the
-    # log is appended after EVERY mode for the same reason
-    for refresh, scan in (("eager", "full"), ("lazy", "full"),
-                          ("eager", "compact")):
+    # proven modes first, the heaviest compiles last, each mode fenced by
+    # its own try — one failure must not lose the others' measurements
+    # (the healthy-pool window this runs in is rare), and the log is
+    # appended after EVERY mode for the same reason. The (refresh, scan,
+    # splits_per_pass) triples cover strict eager, lazy, batched top-k
+    # (k=4, 8) and compact.
+    for refresh, scan, spp in (("eager", "full", 1), ("lazy", "full", 1),
+                               ("eager", "full", 4), ("eager", "full", 8),
+                               ("eager", "compact", 1)):
         try:
             cfg = GBDTConfig(num_iterations=24, num_leaves=lcap, max_bins=b,
                              hist_method="pallas", hist_chunk=4096,
                              split_refresh=refresh, split_scan=scan,
+                             splits_per_pass=spp,
                              objective="binary")
             tr24 = make_train_fn(cfg)
             tr4 = make_train_fn(cfg._replace(num_iterations=4))
@@ -66,11 +73,12 @@ def main(n=1_000_000, f=28, b=64, lcap=31):
                 float(f24(binned, yv, w, it_, margin, key))
                 t24.append(time.perf_counter() - t0)
             per = (min(t24) - min(t4)) / 20 * 1e3
-            line = (f"{refresh}/{scan}: per-iter {per:7.2f} ms "
+            tag = f"{refresh}/{scan}" + (f"/k{spp}" if spp > 1 else "")
+            line = (f"{tag}: per-iter {per:7.2f} ms "
                     f"(compile+first {compile_s:.0f}s, 4it {min(t4):.2f}s, "
                     f"24it {min(t24):.2f}s)")
         except Exception as e:  # noqa: BLE001 - keep the other modes
-            line = (f"{refresh}/{scan}: FAILED "
+            line = (f"{refresh}/{scan}/k{spp}: FAILED "
                     f"{type(e).__name__}: {str(e)[:200]}")
         print(line, flush=True)
         with open(LOG, "a") as fh:

@@ -24,12 +24,10 @@ EOF
       sleep 120
       continue
     fi
-    echo "== scan modes $(date -u +%FT%TZ)"
-    python -u scripts/measure_scan_modes.py
-    echo "== serving $(date -u +%FT%TZ)"
-    python -u scripts/measure_serving_tpu.py
     echo "== image featurizer $(date -u +%FT%TZ)"
     python -u scripts/measure_image_featurizer.py
+    echo "== scan modes (incl. batched k=4/k=8) $(date -u +%FT%TZ)"
+    python -u scripts/measure_scan_modes.py
     echo "== bench $(date -u +%FT%TZ)"
     python -u bench.py
     echo "== watcher done $(date -u +%FT%TZ)"
