@@ -122,6 +122,18 @@ WORKER_2D = textwrap.dedent("""
     if pid == 0:
         open(sys.argv[3], "w").write(ms)
 
+    # batched leaf-wise growth over the same cross-process mesh: the
+    # while_loop's k-slice psum must agree across process boundaries too
+    mb = LightGBMClassifier(numIterations=6, numLeaves=15, maxBin=32,
+                            numTasks=8, splitsPerPass=4).fit(df)
+    msb = mb.booster.model_string()
+    structb = "\\n".join(l for l in msb.splitlines()
+                         if l.split("=")[0] in
+                         ("split_feature", "threshold", "decision_type",
+                          "left_child", "right_child", "num_leaves"))
+    digestb = hashlib.sha256(structb.encode()).hexdigest()
+    print(f"GBDTB {{pid}} {{digestb}}", flush=True)
+
     # ---- tp x dp transformer step over a 2-D (data=4, model=2) mesh
     # spanning both processes
     from mmlspark_tpu.models.deep.transformer import (
@@ -188,8 +200,10 @@ def test_two_process_2d_mesh_gbdt_and_transformer(tmp_path):
             maxsplit=2)[2]
 
     # both processes agree with each other...
-    digest0 = field(outs[0][1], "GBDT")
-    assert digest0 == field(outs[1][1], "GBDT")
+    digest0 = field(outs[0][1], "GBDT ")
+    assert digest0 == field(outs[1][1], "GBDT ")
+    digestb0 = field(outs[0][1], "GBDTB ")
+    assert digestb0 == field(outs[1][1], "GBDTB ")
     losses0 = field(outs[0][1], "TP")
     assert losses0 == field(outs[1][1], "TP")
 
@@ -220,6 +234,11 @@ def test_two_process_2d_mesh_gbdt_and_transformer(tmp_path):
     # histogram psums)...
     assert digest0 == hashlib.sha256(
         struct_of(ref_ms).encode()).hexdigest()
+    # ...including for batched leaf-wise growth
+    mb = LightGBMClassifier(numIterations=6, numLeaves=15, maxBin=32,
+                            numTasks=8, splitsPerPass=4).fit(df)
+    assert digestb0 == hashlib.sha256(
+        struct_of(mb.booster.model_string()).encode()).hexdigest()
     # ...and leaf values / predictions equal to reduction-order fp noise
     from mmlspark_tpu.models.lightgbm.native_format import parse_model_string
     b_mp = parse_model_string(model_file.read_text())
