@@ -28,6 +28,8 @@ EOF
     python -u scripts/measure_image_featurizer.py
     echo "== scan modes (incl. batched k=4/k=8) $(date -u +%FT%TZ)"
     python -u scripts/measure_scan_modes.py
+    echo "== vw throughput $(date -u +%FT%TZ)"
+    python -u scripts/measure_vw_tpu.py
     echo "== bench $(date -u +%FT%TZ)"
     python -u bench.py
     echo "== watcher done $(date -u +%FT%TZ)"
