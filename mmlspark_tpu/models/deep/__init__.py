@@ -13,6 +13,7 @@ from .transformer import (TransformerClassificationModel,
 from .pipeline import make_pp_dp_train_step, pipeline_forward
 from .moe import (init_moe_block_params, make_ep_dp_train_step, moe_ffn,
                   init_moe_params)
+from .checkpoint import (latest_step, restore_train_state, save_train_state)
 
 __all__ = [
     "make_pp_dp_train_step", "pipeline_forward",
@@ -27,4 +28,5 @@ __all__ = [
     "init_head_params",
     "TransformerEncoderClassifier", "TransformerClassificationModel",
     "make_tp_dp_train_step",
+    "save_train_state", "restore_train_state", "latest_step",
 ]
