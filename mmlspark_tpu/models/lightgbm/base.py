@@ -13,6 +13,7 @@ picked an arbitrary worker's copy of an identical model, which replication gives
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -255,6 +256,18 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         "the cost that children created within a pass cannot compete until "
         "the next pass. Gains are never stale (unlike histRefresh='lazy'). "
         "eager/full only", 1, int)
+    checkpointDir = Param(
+        "checkpointDir",
+        "directory for crash-resumable training: the booster-so-far is "
+        "written atomically (native text format) at every compiled-chunk "
+        "boundary, and a later fit() with the same checkpointDir resumes "
+        "from it, training only the REMAINING iterations (total stays "
+        "numIterations). The checkpoint is removed on successful "
+        "completion. Early-stopping counters and bagging keys restart at "
+        "the resume point; with bagging off, resumed trees equal the "
+        "uninterrupted fit's. Combine with itersPerCall to bound the work "
+        "lost to an interruption. Not supported with numBatches>1, dart, "
+        "or fit(df, paramMaps)", None)
     itersPerCall = Param(
         "itersPerCall",
         "split training into device programs of at most this many boosting "
@@ -582,6 +595,28 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         pb = getattr(self, "_prebinned", None)
         self._prebinned = None
         num_batches = self.get("numBatches")
+        ckdir = self.get("checkpointDir")
+        if ckdir:
+            if num_batches and num_batches > 1:
+                raise ValueError(
+                    "checkpointDir is not supported with numBatches > 1 "
+                    "(the checkpoint does not record the batch index)")
+            ck_file = os.path.join(ckdir, "booster.txt")
+            self._ck_resume_trees = 0
+            if os.path.exists(ck_file):
+                from .native_format import parse_model_string
+                # the checkpoint's tree count includes any modelString
+                # warm-start trees save_ck folded in — only the NEW trees
+                # count against this fit's numIterations budget
+                base_trees = (int(jax.tree_util.tree_leaves(
+                    prev.trees)[0].shape[0]) if prev is not None else 0)
+                with open(ck_file) as fh:
+                    ck_prev = parse_model_string(fh.read())
+                # the checkpoint supersedes modelString: it was written by
+                # a fit that had already folded modelString into its margins
+                prev = ck_prev
+                self._ck_resume_trees = int(jax.tree_util.tree_leaves(
+                    ck_prev.trees)[0].shape[0]) - base_trees
         if num_batches and num_batches > 1:
             rng = np.random.default_rng(self.get("seed"))
             if groups is not None:
@@ -790,16 +825,40 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 "(dart dropout needs the full prior-tree delta history inside "
                 "one compiled program, so chunked host callbacks cannot run)")
         ipc = self.get("itersPerCall")
-        if ipc and self.get("boostingType") == "dart":
+        ckdir = self.get("checkpointDir")
+        if (ipc or ckdir) and self.get("boostingType") == "dart":
             raise ValueError(
-                "itersPerCall is not supported with boostingType='dart' "
-                "(dart dropout needs the full prior-tree delta history "
-                "inside one compiled program)")
+                "itersPerCall/checkpointDir are not supported with "
+                "boostingType='dart' (dart dropout needs the full "
+                "prior-tree delta history inside one compiled program)")
+        # _iters_override feeds ONLY _run_chunked's trip count (the resume
+        # path is always chunked); cfg.num_iterations stays the full value
+        # and run_full is never used with a checkpointDir, so no compiled
+        # program depends on the override
+        self._iters_override = None
+        if ckdir:
+            resume_trees = getattr(self, "_ck_resume_trees", 0)
+            remaining = self.get("numIterations") - resume_trees
+            if remaining <= 0:
+                # the crashed fit had already checkpointed every requested
+                # iteration: deliver it, and clear the crash artifact so
+                # the next fit with this dir starts fresh
+                try:
+                    os.remove(os.path.join(ckdir, "booster.txt"))
+                except FileNotFoundError:
+                    pass
+                return prev
+            if resume_trees:
+                self._iters_override = remaining
         use_chunked = ((delegate is not None or (rounds and has_valid)
-                        or bool(ipc))
+                        or bool(ipc) or bool(ckdir))
                        and self.get("boostingType") != "dart")
 
         hp_batch = getattr(self, "_hp_batch", None)
+        if hp_batch is not None and ckdir:
+            raise ValueError(
+                "checkpointDir is not supported with fit(df, paramMaps) "
+                "(candidates would race on one checkpoint file)")
         if hp_batch is not None:
             # vmapped multi-candidate training (fit(df, paramMaps)): one
             # compiled program trains every HParams candidate; per-candidate
@@ -823,14 +882,36 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                                    else None)))
             return self._vmap_boosters[0]
 
+        save_ck = None
+        if ckdir:
+            def save_ck(partial: BoostResult) -> None:
+                """Atomic booster-so-far snapshot at a chunk boundary."""
+                bst = self._assemble_booster(partial, bm, num_class,
+                                             objective, f, None, prev)
+                os.makedirs(ckdir, exist_ok=True)
+                tmp = os.path.join(ckdir, ".booster.txt.tmp")
+                with open(tmp, "w") as fh:
+                    fh.write(bst.model_string())
+                os.replace(tmp, os.path.join(ckdir, "booster.txt"))
+
         if use_chunked:
             result, best_iter = self._run_chunked(
-                run_chunk, key, n_rows_exec, k, rounds, has_valid, delegate)
+                run_chunk, key, n_rows_exec, k, rounds, has_valid, delegate,
+                save_ck=save_ck)
         else:
             result = jax.tree.map(np.asarray, run_full(key))
             best_iter = self._select_best_iteration(result, has_valid)
-        return self._assemble_booster(result, bm, num_class, objective, f,
-                                      best_iter, prev)
+        booster = self._assemble_booster(result, bm, num_class, objective, f,
+                                         best_iter, prev)
+        if ckdir:
+            # the checkpoint is a crash artifact: a completed fit removes it
+            # so the next fit() with this dir starts fresh
+            try:
+                os.remove(os.path.join(ckdir, "booster.txt"))
+            except FileNotFoundError:
+                pass
+            self._iters_override = None
+        return booster
 
     def _assemble_booster(self, result: BoostResult, bm, num_class: int,
                           objective: str, f: int, best_iter, prev,
@@ -862,8 +943,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         return booster
 
     def _run_chunked(self, run_chunk, key, n_rows: int, k: int, rounds: int,
-                     has_valid: bool, delegate) -> Tuple[BoostResult,
-                                                         Optional[int]]:
+                     has_valid: bool, delegate,
+                     save_ck=None) -> Tuple[BoostResult, Optional[int]]:
         """Host-driven chunked boosting: compiled chunks of iterations with a
         stop-check + delegate hooks between chunks.
 
@@ -874,7 +955,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         iterations of compute, not 500. Only raw scores carry between chunks;
         chunk sizes are fixed so at most two programs compile (full + final
         partial chunk)."""
-        T = self.get("numIterations")
+        T = (getattr(self, "_iters_override", None)
+             or self.get("numIterations"))
         ipc = self.get("itersPerCall")
         chunk = max(1, min(int(rounds) if rounds else 10, T))
         if ipc:
@@ -886,9 +968,16 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                    else self.get("learningRate"))
         cur_lr = base_lr
         scores = jnp.zeros((n_rows, k), jnp.float32)
-        all_trees, all_tm, all_vm = [], [], []
+        # running concatenation (not a list of chunks): the checkpoint
+        # snapshot and the final result share ONE accumulated copy, so a
+        # per-chunk snapshot costs one concat of the so-far model instead
+        # of re-concatenating every chunk each time
+        trees_acc, tm_acc, vm_acc = None, None, None
         done, best, best_at, stopped = 0, np.inf, 0, False
         init_out = None
+
+        def _cat(a, b):
+            return np.concatenate([a, b], axis=0)
         while done < T and not stopped:
             c = min(chunk, T - done)
             lrs = []
@@ -902,9 +991,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             trees_c, tm_c, vm_c, scores, init_out = run_chunk(
                 sub, jnp.int32(done), scores, jnp.asarray(lrs, jnp.float32))
             tm_c, vm_c = np.asarray(tm_c), np.asarray(vm_c)
-            all_trees.append(jax.tree.map(np.asarray, trees_c))
-            all_tm.append(tm_c)
-            all_vm.append(vm_c)
+            trees_h = jax.tree.map(np.asarray, trees_c)
+            if trees_acc is None:
+                trees_acc, tm_acc, vm_acc = trees_h, tm_c, vm_c
+            else:
+                trees_acc = jax.tree.map(_cat, trees_acc, trees_h)
+                tm_acc = np.concatenate([tm_acc, tm_c])
+                vm_acc = np.concatenate([vm_acc, vm_c])
             tol = self.get("improvementTolerance")
             for j in range(c):
                 i = done + j
@@ -926,10 +1019,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     # this chunk were computed but are dead (truncated below)
                     break
             done += c
-        trees = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0),
-                             *all_trees)
-        result = BoostResult(trees, np.asarray(init_out),
-                             np.concatenate(all_tm), np.concatenate(all_vm))
+            if save_ck is not None:
+                save_ck(BoostResult(trees_acc, np.asarray(init_out),
+                                    tm_acc, vm_acc))
+        result = BoostResult(trees_acc, np.asarray(init_out), tm_acc, vm_acc)
         best_iter = (best_at + 1) if (rounds and has_valid) else None
         return result, best_iter
 

@@ -197,6 +197,80 @@ class TestClassifier:
             LightGBMClassifier(numIterations=4, splitsPerPass=2,
                                histScan="compact", numTasks=1).fit(binary_df)
 
+    def test_checkpoint_dir_crash_resume(self, binary_df, tmp_path):
+        """checkpointDir: booster-so-far written at chunk boundaries; a
+        crashed fit resumes from it, training only the remaining
+        iterations, and the resumed model matches the uninterrupted one
+        (bagging off => same trees; predictions to margin-roundtrip fp)."""
+        from mmlspark_tpu.models.lightgbm.delegate import LightGBMDelegate
+
+        class Crash(LightGBMDelegate):
+            def after_train_iteration(self, batch, it, has_valid, finished,
+                                      tm, vm):
+                if it == 7:
+                    raise RuntimeError("simulated preemption")
+
+        ck = str(tmp_path / "ck")
+        ref = LightGBMClassifier(numIterations=12, numLeaves=7, seed=5,
+                                 numTasks=1, itersPerCall=3).fit(binary_df)
+        with pytest.raises(RuntimeError, match="preemption"):
+            LightGBMClassifier(numIterations=12, numLeaves=7, seed=5,
+                               numTasks=1, itersPerCall=3, checkpointDir=ck,
+                               delegate=Crash()).fit(binary_df)
+        import os as _os
+        assert _os.path.exists(_os.path.join(ck, "booster.txt"))
+        m = LightGBMClassifier(numIterations=12, numLeaves=7, seed=5,
+                               numTasks=1, itersPerCall=3,
+                               checkpointDir=ck).fit(binary_df)
+        import jax as _jax
+        nt = _jax.tree_util.tree_leaves(m.booster.trees)[0].shape[0]
+        assert nt == 12, nt
+        x = np.asarray(binary_df["features"])[:1000]
+        np.testing.assert_allclose(m.booster.raw_predict(x),
+                                   ref.booster.raw_predict(x),
+                                   rtol=1e-5, atol=1e-5)
+        # crash artifact removed on successful completion
+        assert not _os.path.exists(_os.path.join(ck, "booster.txt"))
+
+    def test_checkpoint_dir_with_warm_start(self, binary_df, tmp_path):
+        """modelString warm start + checkpointDir: the checkpoint embeds the
+        warm-start trees, but only NEW trees count against numIterations —
+        resume must train the remaining new trees, not declare the fit
+        complete early (warm 4 + crash after some of 6 new -> final 10)."""
+        from mmlspark_tpu.models.lightgbm.delegate import LightGBMDelegate
+
+        warm = LightGBMClassifier(numIterations=4, numLeaves=7, seed=5,
+                                  numTasks=1).fit(binary_df)
+        ms = warm.booster.model_string()
+
+        class Crash(LightGBMDelegate):
+            def after_train_iteration(self, batch, it, has_valid, finished,
+                                      tm, vm):
+                if it == 3:
+                    raise RuntimeError("preempted")
+
+        ck = str(tmp_path / "ckw")
+        with pytest.raises(RuntimeError, match="preempted"):
+            LightGBMClassifier(numIterations=6, numLeaves=7, seed=5,
+                               numTasks=1, itersPerCall=2, modelString=ms,
+                               checkpointDir=ck,
+                               delegate=Crash()).fit(binary_df)
+        m = LightGBMClassifier(numIterations=6, numLeaves=7, seed=5,
+                               numTasks=1, itersPerCall=2, modelString=ms,
+                               checkpointDir=ck).fit(binary_df)
+        import jax as _jax
+        nt = _jax.tree_util.tree_leaves(m.booster.trees)[0].shape[0]
+        assert nt == 10, nt  # 4 warm + 6 new
+
+    def test_checkpoint_dir_invalid_combos(self, binary_df, tmp_path):
+        ck = str(tmp_path / "ck2")
+        with pytest.raises(ValueError, match="numBatches"):
+            LightGBMClassifier(numIterations=4, numBatches=2,
+                               checkpointDir=ck, numTasks=1).fit(binary_df)
+        with pytest.raises(ValueError, match="dart"):
+            LightGBMClassifier(numIterations=4, boostingType="dart",
+                               checkpointDir=ck, numTasks=1).fit(binary_df)
+
     def test_iters_per_call_rejects_dart(self, binary_df):
         with pytest.raises(ValueError, match="dart"):
             LightGBMClassifier(numIterations=4, boostingType="dart",
