@@ -613,6 +613,15 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
     modelParallel = _p.Param("modelParallel",
                              "tensor-parallel mesh extent", 1, int)
     seed = _p.Param("seed", "init/shuffle seed", 0, int)
+    checkpointDir = _p.Param(
+        "checkpointDir",
+        "epoch-granular resumable training: after every epoch the sharded "
+        "(params, optimizer) state is written via save_train_state "
+        "(models/deep/checkpoint.py), and a fit() finding checkpoints in "
+        "the directory resumes from the latest epoch — shuffles are "
+        "per-epoch seeded, so resume replays the uninterrupted run "
+        "exactly. Checkpoints are kept on completion (epoch history); "
+        "start a fresh fit with a fresh directory", None)
 
     def __init__(self, **kw):
         super().__init__()
@@ -649,8 +658,15 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
         if bs < dp:
             raise ValueError(
                 f"{n} rows cannot fill a {dp}-way data-parallel batch")
-        rng = np.random.default_rng(self.get("seed"))
         lr = self.get("learningRate")
+        ckdir = self.get("checkpointDir")
+        start_epoch = 0
+
+        def _epoch_order(ep: int) -> np.ndarray:
+            # per-epoch seeded shuffle: resume at epoch E replays the SAME
+            # batch sequence the uninterrupted run would have used
+            return np.random.default_rng(
+                [self.get("seed"), ep]).permutation(n)
 
         if dp * tp > 1:
             if nh % tp:
@@ -662,13 +678,32 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
             step, shard = make_tp_dp_train_step(
                 mesh, nh, lr, nc, self.get("causal"))
             p_sh, o_sh = shard(params, head)
-            for _ in range(self.get("epochs")):
-                order = rng.permutation(n)
+            if ckdir:
+                from .checkpoint import latest_step, restore_train_state
+                ls = latest_step(ckdir)
+                if ls is not None:
+                    # templates must carry the mesh layout (the step's
+                    # in_specs): shard() output is device-0-committed, so
+                    # re-place it on the model axis first
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as _P
+                    spec = NamedSharding(mesh, _P(meshlib.MODEL_AXIS))
+                    put = lambda a: jax.device_put(a, spec)
+                    p_sh, o_sh = restore_train_state(
+                        ckdir,
+                        jax.tree_util.tree_map(put, p_sh),
+                        jax.tree_util.tree_map(put, o_sh), step=ls)
+                    start_epoch = ls
+            for ep in range(start_epoch, self.get("epochs")):
+                order = _epoch_order(ep)
                 for lo in range(0, n - bs + 1, bs):
                     idx = order[lo:lo + bs]
                     p_sh, o_sh, loss = step(p_sh, o_sh,
                                             jnp.asarray(x[idx]),
                                             jnp.asarray(y[idx]))
+                if ckdir:
+                    from .checkpoint import save_train_state
+                    save_train_state(ckdir, p_sh, o_sh, step=ep + 1)
             full = unshard_encoder_params(
                 jax.tree_util.tree_map(np.asarray, p_sh)["encoder"], nh)
             head_f = jax.tree_util.tree_map(
@@ -678,12 +713,21 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                 nh, lr, nc, self.get("causal"))
             p = {"encoder": params, "head": head}
             o = init_opt(p)
-            for _ in range(self.get("epochs")):
-                order = rng.permutation(n)
+            if ckdir:
+                from .checkpoint import latest_step, restore_train_state
+                ls = latest_step(ckdir)
+                if ls is not None:
+                    p, o = restore_train_state(ckdir, p, o, step=ls)
+                    start_epoch = ls
+            for ep in range(start_epoch, self.get("epochs")):
+                order = _epoch_order(ep)
                 for lo in range(0, n - bs + 1, bs):
                     idx = order[lo:lo + bs]
                     p, o, loss = step(p, o, jnp.asarray(x[idx]),
                                       jnp.asarray(y[idx]))
+                if ckdir:
+                    from .checkpoint import save_train_state
+                    save_train_state(ckdir, p, o, step=ep + 1)
             full, head_f = p["encoder"], p["head"]
 
         model = TransformerClassificationModel(

@@ -63,6 +63,36 @@ def test_resume_equals_uninterrupted(tmp_path, zero1):
     np.testing.assert_allclose(resumed, losses[2:], rtol=0, atol=0)
 
 
+def test_estimator_epoch_resume(tmp_path):
+    """TransformerEncoderClassifier(checkpointDir=...): a fit stopped after
+    2 of 4 epochs resumes from the checkpoint and ends with weights equal
+    to the uninterrupted 4-epoch fit (per-epoch-seeded shuffles make the
+    replay exact)."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.models.deep import TransformerEncoderClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6, 16)).astype(np.float32)
+    y = (x.mean(axis=(1, 2)) > 0).astype(np.float64)
+    df = DataFrame({"sequence": list(x), "label": y})
+    kw = dict(numLayers=1, dModel=16, numHeads=2, dFF=32, epochs=4,
+              batchSize=16, seed=3, dataParallel=4, modelParallel=2)
+
+    ref = TransformerEncoderClassifier(**kw).fit(df)
+    ck = str(tmp_path / "tck")
+    # "crash" after epoch 2: a fit asked for only 2 epochs leaves
+    # step_00000002 behind (checkpoints are kept on completion)
+    TransformerEncoderClassifier(**{**kw, "epochs": 2},
+                                 checkpointDir=ck).fit(df)
+    assert latest_step(ck) == 2
+    resumed = TransformerEncoderClassifier(**kw, checkpointDir=ck).fit(df)
+    for a, b in zip(jax.tree_util.tree_leaves(ref.get("weights")),
+                    jax.tree_util.tree_leaves(resumed.get("weights"))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    assert latest_step(ck) == 4
+
+
 def test_restore_without_step_dir(tmp_path):
     step, p, o, x, y = _setup()
     p1, o1, _ = step(p, o, x, y)
