@@ -611,7 +611,20 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                             "data-parallel mesh extent (0/1 = single device)",
                             0, int)
     modelParallel = _p.Param("modelParallel",
-                             "tensor-parallel mesh extent", 1, int)
+                             "model-axis mesh extent: tensor-parallel ranks "
+                             "(strategy='tensor') or pipeline stages "
+                             "(strategy='pipeline')", 1, int)
+    strategy = _p.Param(
+        "strategy",
+        "distributed strategy over the (data x model) mesh: 'tensor' "
+        "(Megatron column/row split per layer, make_tp_dp_train_step) or "
+        "'pipeline' (GPipe microbatch schedule, layers split into "
+        "contiguous stages over the model axis, make_pp_dp_train_step)",
+        "tensor")
+    numMicrobatches = _p.Param(
+        "numMicrobatches",
+        "GPipe microbatches per step (strategy='pipeline'); batch size "
+        "rounds to a multiple of dataParallel * numMicrobatches", 2, int)
     seed = _p.Param("seed", "init/shuffle seed", 0, int)
     checkpointDir = _p.Param(
         "checkpointDir",
@@ -660,7 +673,6 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                 f"{n} rows cannot fill a {dp}-way data-parallel batch")
         lr = self.get("learningRate")
         ckdir = self.get("checkpointDir")
-        start_epoch = 0
 
         def _epoch_order(ep: int) -> np.ndarray:
             # per-epoch seeded shuffle: resume at epoch E replays the SAME
@@ -668,66 +680,98 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
             return np.random.default_rng(
                 [self.get("seed"), ep]).permutation(n)
 
-        if dp * tp > 1:
-            if nh % tp:
-                raise ValueError(f"numHeads {nh} not divisible by "
-                                 f"modelParallel {tp}")
-            mesh = meshlib.get_mesh(
-                dp * tp, axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS),
-                shape=(dp, tp))
-            step, shard = make_tp_dp_train_step(
-                mesh, nh, lr, nc, self.get("causal"))
-            p_sh, o_sh = shard(params, head)
+        def _train_loop(step, p_st, o_st, bs_, to_templates=None):
+            """Shared resume + epoch loop: restore from ckdir when present
+            (to_templates re-places state for the sharded layouts), then
+            run the remaining epochs, checkpointing after each."""
+            start = 0
             if ckdir:
                 from .checkpoint import latest_step, restore_train_state
                 ls = latest_step(ckdir)
                 if ls is not None:
-                    # templates must carry the mesh layout (the step's
-                    # in_specs): shard() output is device-0-committed, so
-                    # re-place it on the model axis first
-                    from jax.sharding import NamedSharding
-                    from jax.sharding import PartitionSpec as _P
-                    spec = NamedSharding(mesh, _P(meshlib.MODEL_AXIS))
-                    put = lambda a: jax.device_put(a, spec)
-                    p_sh, o_sh = restore_train_state(
-                        ckdir,
-                        jax.tree_util.tree_map(put, p_sh),
-                        jax.tree_util.tree_map(put, o_sh), step=ls)
-                    start_epoch = ls
-            for ep in range(start_epoch, self.get("epochs")):
+                    tp_, to_ = ((p_st, o_st) if to_templates is None
+                                else to_templates(p_st, o_st))
+                    p_st, o_st = restore_train_state(ckdir, tp_, to_,
+                                                     step=ls)
+                    start = ls
+            for ep in range(start, self.get("epochs")):
                 order = _epoch_order(ep)
-                for lo in range(0, n - bs + 1, bs):
-                    idx = order[lo:lo + bs]
-                    p_sh, o_sh, loss = step(p_sh, o_sh,
-                                            jnp.asarray(x[idx]),
-                                            jnp.asarray(y[idx]))
+                for lo in range(0, n - bs_ + 1, bs_):
+                    idx = order[lo:lo + bs_]
+                    p_st, o_st, _ = step(p_st, o_st, jnp.asarray(x[idx]),
+                                         jnp.asarray(y[idx]))
                 if ckdir:
                     from .checkpoint import save_train_state
-                    save_train_state(ckdir, p_sh, o_sh, step=ep + 1)
-            full = unshard_encoder_params(
-                jax.tree_util.tree_map(np.asarray, p_sh)["encoder"], nh)
+                    save_train_state(ckdir, p_st, o_st, step=ep + 1)
+            return p_st, o_st
+
+        strategy = self.get("strategy")
+        if strategy not in ("tensor", "pipeline"):
+            raise ValueError(
+                f"strategy must be 'tensor' or 'pipeline', got {strategy!r}")
+        if dp * tp > 1:
+            mesh = meshlib.get_mesh(
+                dp * tp, axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS),
+                shape=(dp, tp))
+            if strategy == "pipeline":
+                from .pipeline import make_pp_dp_train_step
+                mb = self.get("numMicrobatches")
+                if mb < 1:
+                    raise ValueError(
+                        f"numMicrobatches must be >= 1, got {mb}")
+                if self.get("numLayers") % tp:
+                    raise ValueError(
+                        f"numLayers {self.get('numLayers')} must divide "
+                        f"into {tp} pipeline stages")
+                step, shard = make_pp_dp_train_step(
+                    mesh, nh, lr, nc, num_microbatches=mb,
+                    causal=self.get("causal"))
+                gran = dp * mb
+                bs = min(max(self.get("batchSize"), gran), n)
+                bs -= bs % gran
+                if bs < gran:
+                    raise ValueError(
+                        f"{n} rows cannot fill a batch of {dp} data shards "
+                        f"x {mb} microbatches")
+            else:
+                if nh % tp:
+                    raise ValueError(f"numHeads {nh} not divisible by "
+                                     f"modelParallel {tp}")
+                step, shard = make_tp_dp_train_step(
+                    mesh, nh, lr, nc, self.get("causal"))
+            p_sh, o_sh = shard(params, head)
+
+            def _to_mesh_templates(p_st, o_st):
+                # templates must carry the mesh layout (the step's
+                # in_specs): shard() output is device-0-committed, so
+                # re-place it on the model axis first
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as _P
+                spec = NamedSharding(mesh, _P(meshlib.MODEL_AXIS))
+                put = lambda a: jax.device_put(a, spec)
+                return (jax.tree_util.tree_map(put, p_st),
+                        jax.tree_util.tree_map(put, o_st))
+
+            p_sh, o_sh = _train_loop(step, p_sh, o_sh, bs,
+                                     to_templates=_to_mesh_templates)
             head_f = jax.tree_util.tree_map(
                 lambda a: np.asarray(a)[0], p_sh["head"])
+            if strategy == "pipeline":
+                # stage stack [pp, layers_per_stage, ...] -> flat layer list
+                stage = jax.tree_util.tree_map(np.asarray, p_sh)["stage"]
+                lps = self.get("numLayers") // tp
+                full = {"layers": [
+                    jax.tree_util.tree_map(lambda a, s=s, i=i: a[s][i], stage)
+                    for s in range(tp) for i in range(lps)]}
+            else:
+                full = unshard_encoder_params(
+                    jax.tree_util.tree_map(np.asarray, p_sh)["encoder"], nh)
         else:
             step, init_opt = make_single_train_step(
                 nh, lr, nc, self.get("causal"))
             p = {"encoder": params, "head": head}
             o = init_opt(p)
-            if ckdir:
-                from .checkpoint import latest_step, restore_train_state
-                ls = latest_step(ckdir)
-                if ls is not None:
-                    p, o = restore_train_state(ckdir, p, o, step=ls)
-                    start_epoch = ls
-            for ep in range(start_epoch, self.get("epochs")):
-                order = _epoch_order(ep)
-                for lo in range(0, n - bs + 1, bs):
-                    idx = order[lo:lo + bs]
-                    p, o, loss = step(p, o, jnp.asarray(x[idx]),
-                                      jnp.asarray(y[idx]))
-                if ckdir:
-                    from .checkpoint import save_train_state
-                    save_train_state(ckdir, p, o, step=ep + 1)
+            p, o = _train_loop(step, p, o, bs)
             full, head_f = p["encoder"], p["head"]
 
         model = TransformerClassificationModel(

@@ -93,6 +93,32 @@ def test_estimator_epoch_resume(tmp_path):
     assert latest_step(ck) == 4
 
 
+def test_estimator_pipeline_strategy_and_resume(tmp_path):
+    """strategy='pipeline' trains through the GPipe pp x dp step via the
+    SAME estimator surface, and composes with checkpointDir resume."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.models.deep import TransformerEncoderClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6, 16)).astype(np.float32)
+    y = (x.mean(axis=(1, 2)) > 0).astype(np.float64)
+    df = DataFrame({"sequence": list(x), "label": y})
+    kw = dict(numLayers=2, dModel=16, numHeads=2, dFF=32, epochs=6,
+              batchSize=16, seed=3, dataParallel=4, modelParallel=2,
+              strategy="pipeline", numMicrobatches=2)
+    ref = TransformerEncoderClassifier(**kw).fit(df)
+    acc = (ref.transform(df)["prediction"] == y).mean()
+    assert acc >= 0.8, acc
+    ck = str(tmp_path / "pck")
+    TransformerEncoderClassifier(**{**kw, "epochs": 3},
+                                 checkpointDir=ck).fit(df)
+    resumed = TransformerEncoderClassifier(**kw, checkpointDir=ck).fit(df)
+    for a, b in zip(jax.tree_util.tree_leaves(ref.get("weights")),
+                    jax.tree_util.tree_leaves(resumed.get("weights"))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
 def test_restore_without_step_dir(tmp_path):
     step, p, o, x, y = _setup()
     p1, o1, _ = step(p, o, x, y)
