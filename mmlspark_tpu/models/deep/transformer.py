@@ -625,6 +625,12 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
         "numMicrobatches",
         "GPipe microbatches per step (strategy='pipeline'); batch size "
         "rounds to a multiple of dataParallel * numMicrobatches", 2, int)
+    zero1 = _p.Param(
+        "zero1",
+        "ZeRO-1 optimizer-state sharding over the data axis "
+        "(strategy='tensor' only): reduce_scatter grads, Adam on the owned "
+        "1/dataParallel flat chunk, all_gather updates — optimizer memory "
+        "divided by dataParallel at identical losses", False, bool)
     seed = _p.Param("seed", "init/shuffle seed", 0, int)
     checkpointDir = _p.Param(
         "checkpointDir",
@@ -738,19 +744,31 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                     raise ValueError(f"numHeads {nh} not divisible by "
                                      f"modelParallel {tp}")
                 step, shard = make_tp_dp_train_step(
-                    mesh, nh, lr, nc, self.get("causal"))
+                    mesh, nh, lr, nc, self.get("causal"),
+                    zero1=self.get("zero1"))
+            if self.get("zero1") and strategy != "tensor":
+                raise ValueError(
+                    "zero1 requires strategy='tensor' (the pipeline step "
+                    "keeps its optimizer replicated over data)")
             p_sh, o_sh = shard(params, head)
 
             def _to_mesh_templates(p_st, o_st):
                 # templates must carry the mesh layout (the step's
                 # in_specs): shard() output is device-0-committed, so
-                # re-place it on the model axis first
+                # re-place it on the right axes first. Params ride the
+                # model axis; the optimizer state does too, EXCEPT under
+                # ZeRO-1 where its flat chunks are additionally sharded
+                # over the data axis ([tp, dp, chunk]).
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as _P
-                spec = NamedSharding(mesh, _P(meshlib.MODEL_AXIS))
-                put = lambda a: jax.device_put(a, spec)
-                return (jax.tree_util.tree_map(put, p_st),
-                        jax.tree_util.tree_map(put, o_st))
+                spec_p = NamedSharding(mesh, _P(meshlib.MODEL_AXIS))
+                spec_o = (NamedSharding(mesh, _P(meshlib.MODEL_AXIS,
+                                                 meshlib.DATA_AXIS))
+                          if self.get("zero1") else spec_p)
+                return (jax.tree_util.tree_map(
+                            lambda a: jax.device_put(a, spec_p), p_st),
+                        jax.tree_util.tree_map(
+                            lambda a: jax.device_put(a, spec_o), o_st))
 
             p_sh, o_sh = _train_loop(step, p_sh, o_sh, bs,
                                      to_templates=_to_mesh_templates)

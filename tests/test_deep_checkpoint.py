@@ -119,6 +119,30 @@ def test_estimator_pipeline_strategy_and_resume(tmp_path):
                                    rtol=2e-5, atol=2e-6)
 
 
+def test_estimator_zero1_resume(tmp_path):
+    """zero1=True on the estimator: ZeRO-1 dp-sharded optimizer state
+    checkpoints and resumes through the same surface."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.models.deep import TransformerEncoderClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6, 16)).astype(np.float32)
+    y = (x.mean(axis=(1, 2)) > 0).astype(np.float64)
+    df = DataFrame({"sequence": list(x), "label": y})
+    kw = dict(numLayers=1, dModel=16, numHeads=2, dFF=32, epochs=4,
+              batchSize=16, seed=3, dataParallel=4, modelParallel=2,
+              zero1=True)
+    ref = TransformerEncoderClassifier(**kw).fit(df)
+    ck = str(tmp_path / "zck")
+    TransformerEncoderClassifier(**{**kw, "epochs": 2},
+                                 checkpointDir=ck).fit(df)
+    resumed = TransformerEncoderClassifier(**kw, checkpointDir=ck).fit(df)
+    for a, b in zip(jax.tree_util.tree_leaves(ref.get("weights")),
+                    jax.tree_util.tree_leaves(resumed.get("weights"))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
 def test_restore_without_step_dir(tmp_path):
     step, p, o, x, y = _setup()
     p1, o1, _ = step(p, o, x, y)
