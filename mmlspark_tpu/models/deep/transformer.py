@@ -616,10 +616,13 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                              "(strategy='pipeline')", 1, int)
     strategy = _p.Param(
         "strategy",
-        "distributed strategy over the (data x model) mesh: 'tensor' "
-        "(Megatron column/row split per layer, make_tp_dp_train_step) or "
+        "distributed strategy: 'tensor' (Megatron column/row split per "
+        "layer over a data x model mesh, make_tp_dp_train_step), "
         "'pipeline' (GPipe microbatch schedule, layers split into "
-        "contiguous stages over the model axis, make_pp_dp_train_step)",
+        "contiguous stages over the model axis, make_pp_dp_train_step), "
+        "or 'sequence' (long-context regime: the SEQUENCE axis sharded "
+        "over modelParallel devices via ring attention, parameters "
+        "replicated, make_sp_train_step; dataParallel must be 0/1)",
         "tensor")
     numMicrobatches = _p.Param(
         "numMicrobatches",
@@ -712,10 +715,38 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
             return p_st, o_st
 
         strategy = self.get("strategy")
-        if strategy not in ("tensor", "pipeline"):
-            raise ValueError(
-                f"strategy must be 'tensor' or 'pipeline', got {strategy!r}")
-        if dp * tp > 1:
+        if strategy not in ("tensor", "pipeline", "sequence"):
+            raise ValueError(f"strategy must be 'tensor', 'pipeline' or "
+                             f"'sequence', got {strategy!r}")
+        if strategy == "sequence" and tp > 1:
+            if dp > 1:
+                raise ValueError(
+                    "strategy='sequence' shards the sequence over "
+                    "modelParallel devices with replicated parameters; "
+                    "set dataParallel=0/1")
+            if s % tp:
+                raise ValueError(
+                    f"sequence length {s} must divide over {tp} shards")
+            mesh1 = meshlib.get_mesh(tp)
+            step, init_opt = make_sp_train_step(
+                mesh1, nh, lr, nc, self.get("causal"))
+            p = {"encoder": params, "head": head}
+            o = init_opt(p)
+
+            def _to_seq_templates(p_st, o_st):
+                # replicate onto the 1-D mesh (orbax restores committed
+                # arrays; shard_map needs the mesh's device set)
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as _P
+                spec = NamedSharding(mesh1, _P())
+                put = lambda a: jax.device_put(a, spec)
+                return (jax.tree_util.tree_map(put, p_st),
+                        jax.tree_util.tree_map(put, o_st))
+
+            p, o = _train_loop(step, p, o, bs,
+                               to_templates=_to_seq_templates)
+            full, head_f = p["encoder"], p["head"]
+        elif dp * tp > 1:
             mesh = meshlib.get_mesh(
                 dp * tp, axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS),
                 shape=(dp, tp))
