@@ -111,3 +111,17 @@ def test_ranker_save_load(tmp_path):
     np.testing.assert_allclose(
         np.asarray(model.transform(df)["prediction"]),
         np.asarray(loaded.transform(df)["prediction"]), rtol=1e-5)
+
+
+def test_ranker_batched_growth():
+    """splitsPerPass composes with lambdarank: batched leaf-wise growth
+    must hold NDCG against strict leaf-wise."""
+    x, y, groups = _ranking_data()
+    df = DataFrame({"features": x, "label": y, "groupId": groups})
+    kw = dict(numIterations=40, numLeaves=15, maxBin=32, minDataInLeaf=3,
+              numTasks=1)
+    strict = LightGBMRanker(**kw).fit(df)
+    batched = LightGBMRanker(splitsPerPass=4, **kw).fit(df)
+    n_s = _mean_ndcg(strict.transform(df)["prediction"], y, groups)
+    n_b = _mean_ndcg(batched.transform(df)["prediction"], y, groups)
+    assert n_b > n_s - 0.02, (n_b, n_s)
