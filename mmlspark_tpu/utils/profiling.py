@@ -1,0 +1,100 @@
+"""Tracing / profiling utilities.
+
+The reference's tracing story is ad-hoc: `StopWatch` wall-time counters
+surfaced as a diagnostics DataFrame (core/utils/StopWatch.scala:35,
+vw/VowpalWabbitBase.scala:268-303) and the `Timer` wrapper stage
+(stages/Timer.scala:18) — both have direct counterparts here (VW perf
+stats, stages.Timer). This module adds the TPU-native layer the JVM never
+had: XLA device traces via `jax.profiler`, viewable in TensorBoard /
+Perfetto, plus a StopWatch with the device-barrier discipline that makes
+wall times MEAN something under async dispatch (a `block_until_ready`
+before each read — without it, timings measure dispatch, not compute).
+
+    with device_trace("/tmp/trace"):         # XLA trace -> TensorBoard
+        model = clf.fit(df)
+
+    sw = StopWatch()
+    with sw.measure("fit"):
+        model = clf.fit(df)
+    print(sw.summary())                       # {'fit': {'total_s': ...}}
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+__all__ = ["device_trace", "annotate", "StopWatch"]
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA/TPU profiler trace into log_dir for the duration of
+    the block (TensorBoard's profile plugin or Perfetto reads it). Device
+    work is barriered before stop so in-flight programs land in trace."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        try:
+            # flush async dispatch so the trace covers the block's work
+            jax.effects_barrier()
+        except Exception:
+            pass
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside a device_trace (jax.profiler.TraceAnnotation);
+    harmless when no trace is active."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StopWatch:
+    """Barrier-aware wall-time accumulator (StopWatch.scala:35 role).
+
+    Each measure() block ends with a `jax.effects_barrier()` so the
+    recorded time includes the device work the block dispatched — under
+    JAX's async dispatch a bare perf_counter pair measures only Python
+    time. Per-name totals/counts mirror the reference's VW TrainingStats
+    percentage breakdowns."""
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, Dict[str, float]] = {}
+
+    @contextlib.contextmanager
+    def measure(self, name: str,
+                barrier: bool = True) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if barrier:
+                try:
+                    import jax
+                    jax.effects_barrier()
+                except Exception:
+                    pass
+            dt = time.perf_counter() - t0
+            slot = self._acc.setdefault(name,
+                                        {"total_s": 0.0, "count": 0.0})
+            slot["total_s"] += dt
+            slot["count"] += 1
+
+    def summary(self, total_name: Optional[str] = None) -> Dict[str, Any]:
+        """Per-name {total_s, count [, pct]} — pct of total_name's time
+        when given (the VW diagnostics-DataFrame convention)."""
+        out: Dict[str, Any] = {}
+        base = (self._acc.get(total_name, {}).get("total_s")
+                if total_name else None)
+        for name, slot in self._acc.items():
+            rec = dict(slot)
+            if base:
+                rec["pct"] = 100.0 * slot["total_s"] / base
+            out[name] = rec
+        return out
