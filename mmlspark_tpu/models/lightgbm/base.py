@@ -256,6 +256,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         "the cost that children created within a pass cannot compete until "
         "the next pass. Gains are never stale (unlike histRefresh='lazy'). "
         "eager/full only", 1, int)
+    collectFitTimings = Param(
+        "collectFitTimings",
+        "record a wall-time decomposition of fit() — binning, device "
+        "transfer, boosting, model assembly — onto the fitted model as "
+        "`model.fit_timings` (the VW TrainingStats diagnostics analogue, "
+        "VowpalWabbitBase.scala:268-303). Adds device barriers between "
+        "phases, so leave False when benchmarking end-to-end wall",
+        False, bool)
     checkpointDir = Param(
         "checkpointDir",
         "directory for crash-resumable training: the booster-so-far is "
@@ -663,11 +671,22 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                             prebinned=None) -> Booster:
         n, f = x.shape
         k = num_class if num_class > 1 else 1
+        _sw = None
+        if self.get("collectFitTimings"):
+            from ...utils.profiling import StopWatch
+            _sw = StopWatch()
+        _t_fit0 = __import__("time").perf_counter()
         _dlg = self.get("delegate")
         _bi = getattr(self, "_batch_index", 0)
         if _dlg is not None:
             _dlg.before_generate_train_dataset(_bi, self)
-        if prebinned is not None:  # LightGBMDataset: bins computed once
+        if _sw is not None:
+            with _sw.measure("binning", barrier=False):
+                if prebinned is not None:
+                    bm, binned, self._missing_idx = prebinned
+                else:
+                    bm, binned, self._missing_idx = self._fit_binning(x)
+        elif prebinned is not None:  # LightGBMDataset: bins computed once
             bm, binned, self._missing_idx = prebinned
         else:
             bm, binned, self._missing_idx = self._fit_binning(x)
@@ -894,15 +913,38 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     fh.write(bst.model_string())
                 os.replace(tmp, os.path.join(ckdir, "booster.txt"))
 
-        if use_chunked:
-            result, best_iter = self._run_chunked(
-                run_chunk, key, n_rows_exec, k, rounds, has_valid, delegate,
-                save_ck=save_ck)
+        if _sw is not None:
+            import time as _tm
+            _t0 = _tm.perf_counter()
+            jax.block_until_ready(data)
+            _sw._acc["device_transfer"] = {
+                "total_s": _tm.perf_counter() - _t0, "count": 1.0}
+
+        def _boost():
+            if use_chunked:
+                return self._run_chunked(
+                    run_chunk, key, n_rows_exec, k, rounds, has_valid,
+                    delegate, save_ck=save_ck)
+            res = jax.tree.map(np.asarray, run_full(key))
+            return res, self._select_best_iteration(res, has_valid)
+
+        if _sw is not None:
+            # np.asarray fetches are synchronous — no barrier needed
+            with _sw.measure("boosting", barrier=False):
+                result, best_iter = _boost()
+            with _sw.measure("assemble", barrier=False):
+                booster = self._assemble_booster(result, bm, num_class,
+                                                 objective, f, best_iter,
+                                                 prev)
+            timings = _sw.summary()
+            timings["total"] = {
+                "total_s": (__import__("time").perf_counter() - _t_fit0),
+                "count": 1.0}
+            booster.fit_timings = timings
         else:
-            result = jax.tree.map(np.asarray, run_full(key))
-            best_iter = self._select_best_iteration(result, has_valid)
-        booster = self._assemble_booster(result, bm, num_class, objective, f,
-                                         best_iter, prev)
+            result, best_iter = _boost()
+            booster = self._assemble_booster(result, bm, num_class,
+                                             objective, f, best_iter, prev)
         if ckdir:
             # the checkpoint is a crash artifact: a completed fit removes it
             # so the next fit() with this dir starts fresh

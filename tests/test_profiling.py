@@ -40,3 +40,21 @@ def test_device_trace_writes_artifacts(tmp_path):
     for root, _, files in os.walk(d):
         found += files
     assert found, "no trace artifacts written"
+
+
+def test_gbdt_fit_timings():
+    """collectFitTimings: the VW TrainingStats analogue on the GBDT — a
+    wall-time decomposition lands on the fitted model."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4000, 10)).astype(np.float32)
+    y = ((x @ rng.normal(size=10)) > 0).astype(np.float64)
+    m = LightGBMClassifier(numIterations=5, numTasks=1,
+                           collectFitTimings=True).fit(
+        DataFrame({"features": x, "label": y}))
+    t = m.booster.fit_timings
+    assert set(t) >= {"binning", "device_transfer", "boosting",
+                      "assemble", "total"}
+    assert t["total"]["total_s"] >= t["boosting"]["total_s"]
