@@ -254,6 +254,25 @@ def main():
         except Exception as e:  # noqa: BLE001 - secondary must not kill bench
             extra["batched8_error"] = str(e)[:300]
 
+    # extra: wall-time decomposition of one instrumented fit of the primary
+    # mode (binning / device transfer / boosting / assembly — barriers
+    # added between phases, so this fit is NOT one of the timed ones)
+    if on_accel and time.time() - t_start < 450:
+        try:
+            kw_best = ({"histRefresh": "lazy"}
+                       if scan_mode.startswith("lazy") else
+                       {"splitsPerPass": 8}
+                       if scan_mode.startswith("batched-k8") else
+                       {"splitsPerPass": 4}
+                       if scan_mode.startswith("batched") else {})
+            t_clf = make_clf(collectFitTimings=True, **kw_best)
+            tm = getattr(t_clf.fit(df).booster, "fit_timings", None)
+            if tm:
+                extra["fit_decomposition_s"] = {
+                    kk: round(vv["total_s"], 2) for kk, vv in tm.items()}
+        except Exception as e:  # noqa: BLE001
+            extra["fit_decomposition_error"] = str(e)[:200]
+
     # extra: HIGGS-scale run — BASELINE.json defines the north-star metric
     # at 11M x 28 x 100 (int8 bins ~ 310 MB HBM; fits one v5e chip). One
     # warm fit + up to 2 timed fits with the primary mode.
