@@ -359,20 +359,58 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 int(self.get("seed")), tuple(self._categorical_indexes()),
                 mbbf_t, bool(self.get("useMissing")))
 
+    def _fit_bin_mapper(self, x: np.ndarray) -> BinMapper:
+        max_bin, sample_count, seed, cat, mbbf, use_missing = \
+            self._bin_config()
+        return BinMapper.fit(x, max_bin, sample_count, seed, categorical=cat,
+                             max_bins_by_feature=(
+                                 np.asarray(mbbf, np.int64) if mbbf
+                                 else None),
+                             use_missing=use_missing)
+
+    @staticmethod
+    def _missing_idx_of(bm: BinMapper):
+        # features with a reserved missing bin get both-direction split scans
+        return tuple(int(j) for j in np.nonzero(bm.missing)[0])
+
     def _fit_binning(self, x: np.ndarray):
         """Fit the bin mapper + transform to the binned uint8 matrix —
         the LGBM_DatasetCreateFromMat equivalent; hoisted so
         LightGBMDataset can run it once for many fits."""
-        max_bin, sample_count, seed, cat, mbbf, use_missing = \
-            self._bin_config()
-        bm = BinMapper.fit(x, max_bin, sample_count, seed, categorical=cat,
-                           max_bins_by_feature=(
-                               np.asarray(mbbf, np.int64) if mbbf else None),
-                           use_missing=use_missing)
-        binned = bm.transform(x)
-        # features with a reserved missing bin get both-direction split scans
-        missing_idx = tuple(int(j) for j in np.nonzero(bm.missing)[0])
-        return bm, binned, missing_idx
+        bm = self._fit_bin_mapper(x)
+        return bm, bm.transform(x), self._missing_idx_of(bm)
+
+    @staticmethod
+    def _binned_to_device(bm: BinMapper, x: np.ndarray,
+                          blk: Optional[int] = None):
+        """Row-block pipelined dataset construction: bin block k+1 on the
+        host while block k's int8 copy rides to the device (device_put is
+        async) — overlaps the two serial halves of
+        LGBM_DatasetCreateFromMat's role instead of paying
+        binning + transfer back to back. Blocks land in ONE preallocated
+        device buffer through a donated dynamic_update_slice, so peak HBM
+        stays ~1x the binned matrix + one block (a naive concatenate of
+        parts would double it at exactly the scale this path targets)."""
+        n, fdim = x.shape
+        if blk is None:
+            blk = max(1_000_000, -(-n // 8))
+        first = jax.device_put(bm.transform(x[:blk]))
+        if blk >= n:
+            return first
+        buf = jnp.zeros((n, fdim), first.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def write(buf, block, i0):
+            return jax.lax.dynamic_update_slice(buf, block, (i0, 0))
+
+        buf = write(buf, first, jnp.int32(0))
+        for i0 in range(blk, n, blk):
+            # the final window shifts back to stay full-size (ONE compiled
+            # write shape); its overlap rows re-bin to identical values
+            j0 = min(i0, n - blk)
+            buf = write(buf, jax.device_put(bm.transform(x[j0:j0 + blk])),
+                        jnp.int32(j0))
+        return buf
 
     def _extract_xyw(self, df: DataFrame
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
@@ -680,6 +718,18 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         _bi = getattr(self, "_batch_index", 0)
         if _dlg is not None:
             _dlg.before_generate_train_dataset(_bi, self)
+        # serial fits at scale take the pipelined dataset path (binning
+        # overlapped with the device transfer); collectFitTimings keeps the
+        # sequential path so the binning/transfer phases stay separable
+        # the serial/sharded decision, made ONCE here and reused by the
+        # mesh-placement code below (drift between two copies of this
+        # predicate would route a committed device array into place_global)
+        par = self.get("parallelism")
+        ndev = self.get("numTasks") or meshlib.device_count()
+        serial = (par == "serial" or ndev <= 1)
+        _pipelined = (prebinned is None and _sw is None and serial
+                      and isinstance(x, np.ndarray)
+                      and x.dtype == np.float32 and n >= 2_000_000)
         if _sw is not None:
             with _sw.measure("binning", barrier=False):
                 if prebinned is not None:
@@ -688,6 +738,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     bm, binned, self._missing_idx = self._fit_binning(x)
         elif prebinned is not None:  # LightGBMDataset: bins computed once
             bm, binned, self._missing_idx = prebinned
+        elif _pipelined:
+            bm = self._fit_bin_mapper(x)
+            self._missing_idx = self._missing_idx_of(bm)
+            binned = self._binned_to_device(bm, x)
         else:
             bm, binned, self._missing_idx = self._fit_binning(x)
         if _dlg is not None:
@@ -752,7 +806,6 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                                     dtype=self.get("histDtype"))
             self._hist_method_resolved, self._hist_chunk_resolved = m, c
 
-        par = self.get("parallelism")
         if par not in ("serial", "data_parallel", "voting_parallel"):
             raise ValueError(
                 f"parallelism must be serial, data_parallel or "
@@ -763,8 +816,6 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 "Names; use data_parallel")
         if par == "voting_parallel" and self.get("topK") < 1:
             raise ValueError("topK must be >= 1 for voting_parallel")
-        ndev = self.get("numTasks") or meshlib.device_count()
-        serial = (par == "serial" or ndev <= 1)
         if (par == "voting_parallel" and not serial
                 and getattr(self, "_missing_idx", ())):
             raise ValueError(

@@ -561,3 +561,18 @@ def test_is_unbalance_recovers_minority_recall():
     with pytest.raises(ValueError, match="isUnbalance"):
         LightGBMClassifier(isUnbalance=True, **kw).fit(
             df.with_column("label", (y + (x[:, 0] > 1) * 1).astype(np.float64)))
+
+
+class TestPipelinedDataset:
+    def test_binned_to_device_matches_host(self, binary_df):
+        """Row-block pipelined transform equals the one-shot host path —
+        forced through the MULTI-block branch (donated-buffer writes,
+        shifted final window) with a tiny block size, plus an uneven
+        final block and the trivial single-block case."""
+        x = np.asarray(binary_df["features"], np.float32)
+        clf = LightGBMClassifier(numIterations=2, numTasks=1)
+        bm, host_binned, _ = clf._fit_binning(x)
+        n = x.shape[0]
+        for blk in (257, n // 3 + 1, n, n + 5):
+            dev = np.asarray(clf._binned_to_device(bm, x, blk=blk))
+            np.testing.assert_array_equal(dev, host_binned, err_msg=f"blk={blk}")
