@@ -237,6 +237,90 @@ class DataFrame:
         out._meta = {k: dict(v) for k, v in self._meta.items()}
         return out
 
+    def group_by(self, *keys: str) -> "GroupedDataFrame":
+        """Spark-style df.groupBy(keys).agg(...): returns a grouped view
+        whose .agg accepts out_name=(column, fn) pairs with fn in
+        count/sum/mean/min/max/first."""
+        for k in keys:
+            if k not in self._cols:
+                raise KeyError(f"unknown group key {k!r}")
+        return GroupedDataFrame(self, keys)
+
+    def join(self, other: "DataFrame", on, how: str = "inner"
+             ) -> "DataFrame":
+        """Hash join on key column(s). how: inner | left. Right-side name
+        collisions (other than the keys) get a '_right' suffix, the Spark
+        disambiguation users apply manually."""
+        if how not in ("inner", "left"):
+            raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
+        keys = [on] if isinstance(on, str) else list(on)
+        lcols, rcols = [], []
+        for k in keys:
+            if k not in self._cols or k not in other._cols:
+                raise KeyError(f"join key {k!r} missing from a side")
+            lc, rc = _as_column(self[k]), _as_column(other[k])
+            if lc.dtype.kind in "biuf" and rc.dtype.kind in "biuf":
+                # joint numeric promotion: int-vs-float sides must compare
+                # by VALUE, not by per-side string form
+                t = np.result_type(lc.dtype, rc.dtype)
+                lc, rc = lc.astype(t), rc.astype(t)
+            lcols.append(lc)
+            rcols.append(rc)
+        lk = _encode_keys(lcols)
+        rk = _encode_keys(rcols)
+        if len(other) == 0:
+            counts = np.zeros(len(lk), np.int64)
+            order = np.zeros(0, np.int64)
+            starts = np.zeros(len(lk), np.int64)
+        else:
+            order = np.argsort(rk, kind="stable")
+            rk_sorted = rk[order]
+            starts = np.searchsorted(rk_sorted, lk, side="left")
+            counts = np.searchsorted(rk_sorted, lk, side="right") - starts
+        matched = counts > 0
+        cm = counts[matched]
+        # within-block offsets 0..c-1 for every matched left row, fully
+        # vectorized (no per-row arrays)
+        cum = np.cumsum(cm)
+        total_m = int(cum[-1]) if len(cum) else 0
+        offs = np.arange(total_m) - np.repeat(cum - cm, cm)
+        src = np.repeat(starts[matched], cm) + offs
+        if how == "inner":
+            li = np.repeat(np.arange(len(lk))[matched], cm)
+            ri = order[src] if total_m else np.zeros(0, np.int64)
+        else:  # left: unmatched rows keep one output row with fill values
+            counts_l = np.maximum(counts, 1)
+            li = np.repeat(np.arange(len(lk)), counts_l)
+            out_start = np.concatenate([[0], np.cumsum(counts_l)[:-1]]) \
+                if len(counts_l) else np.zeros(0, np.int64)
+            ri = np.full(int(counts_l.sum()), -1, np.int64)
+            if total_m:
+                pos = np.repeat(out_start[matched], cm) + offs
+                ri[pos] = order[src]
+        out = self.take(li)
+        rvalid = ri >= 0
+        ri_safe = np.where(rvalid, ri, 0)
+        for n in other.columns:
+            if n in keys:
+                continue
+            name = n if n not in out._cols else f"{n}_right"
+            rc = _as_column(other[n])
+            if len(rc) == 0:
+                col = np.full(len(li), np.nan if rc.dtype.kind == "f"
+                              else None,
+                              rc.dtype if rc.dtype.kind == "f" else object)
+            else:
+                col = rc[ri_safe]
+                if not rvalid.all():
+                    col = col.astype(np.float64) \
+                        if col.dtype.kind in "if" else col.astype(object)
+                    col[~rvalid] = (np.nan if col.dtype.kind == "f"
+                                    else None)
+            out._cols[name] = col
+            if n in other._meta:
+                out._meta[name] = dict(other._meta[n])
+        return out
+
     def random_split(self, weights: Sequence[float], seed: int = 0
                      ) -> List["DataFrame"]:
         """Reference: Dataset.randomSplit used by LightGBMBase.scala:29-50 batch split."""
@@ -320,3 +404,71 @@ def concat_dataframes(dfs: Sequence[DataFrame]) -> DataFrame:
     for d in dfs[1:]:
         out = out.union(d)
     return out
+
+
+def _encode_keys(cols: Sequence[np.ndarray]) -> np.ndarray:
+    """Composite join/group keys -> one sortable 1-D array: single numeric
+    keys pass through; anything else string-encodes per column and joins
+    with an unlikely separator."""
+    cols = [_as_column(c) for c in cols]
+    if len(cols) == 1 and cols[0].dtype.kind in "biuf":
+        return cols[0]
+    parts = [c.astype(str) for c in cols]
+    key = parts[0]
+    for p in parts[1:]:
+        key = np.char.add(np.char.add(key, "\x1f"), p)
+    return key
+
+
+class GroupedDataFrame:
+    """df.group_by(keys) result; .agg(out=(col, fn)) mirrors Spark's
+    groupBy().agg() for the reductions pipelines actually use."""
+
+    _FNS = {
+        "count": lambda v, idx, nb: np.bincount(idx, minlength=nb),
+        "sum": lambda v, idx, nb: np.bincount(idx, weights=v, minlength=nb),
+        "mean": lambda v, idx, nb: (
+            np.bincount(idx, weights=v, minlength=nb)
+            / np.maximum(np.bincount(idx, minlength=nb), 1)),
+    }
+
+    def __init__(self, df: DataFrame, keys: Sequence[str]):
+        self._df = df
+        self._keys = tuple(keys)
+
+    def agg(self, **aggs) -> DataFrame:
+        df = self._df
+        enc = _encode_keys([df[k] for k in self._keys])
+        uniq, first_pos, idx = np.unique(enc, return_index=True,
+                                         return_inverse=True)
+        out = DataFrame()
+        for k in self._keys:
+            out._cols[k] = _as_column(df[k])[first_pos]
+        for name, spec in aggs.items():
+            col, fn = spec
+            v = _as_column(df[col])
+            if fn in self._FNS:
+                out._cols[name] = self._FNS[fn](
+                    np.asarray(v, np.float64) if fn != "count" else v, idx,
+                    len(uniq))
+            elif fn in ("min", "max", "first"):
+                order = np.argsort(idx, kind="stable")
+                bounds = np.searchsorted(idx[order], np.arange(len(uniq)))
+                if fn == "first":
+                    out._cols[name] = v[order[bounds]]
+                else:
+                    red = np.minimum if fn == "min" else np.maximum
+                    acc = np.empty(len(uniq), v.dtype)
+                    sorted_v = v[order]
+                    ends = np.append(bounds[1:], len(v))
+                    for g in range(len(uniq)):
+                        acc[g] = red.reduce(sorted_v[bounds[g]:ends[g]])
+                    out._cols[name] = acc
+            else:
+                raise ValueError(
+                    f"unknown aggregation {fn!r}; have count/sum/mean/"
+                    f"min/max/first")
+        return out
+
+    def count(self) -> DataFrame:
+        return self.agg(count=(self._keys[0], "count"))

@@ -1,0 +1,113 @@
+"""DataFrame.group_by / join — the Spark groupBy().agg() / join surface
+(SURVEY §0: the unit of composition everywhere is the SparkML DataFrame)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+
+
+def _df():
+    return DataFrame({
+        "user": np.array([1, 2, 1, 3, 2, 1]),
+        "item": np.array(["a", "b", "a", "c", "a", "b"], dtype=object),
+        "rating": np.array([5.0, 3.0, 4.0, 1.0, 2.0, 5.0]),
+    })
+
+
+class TestGroupBy:
+    def test_agg_numeric_key(self):
+        out = _df().group_by("user").agg(
+            n=("rating", "count"), total=("rating", "sum"),
+            avg=("rating", "mean"), lo=("rating", "min"),
+            hi=("rating", "max"), first_item=("item", "first"))
+        by = {int(u): i for i, u in enumerate(out["user"])}
+        assert out["n"][by[1]] == 3 and out["n"][by[3]] == 1
+        assert out["total"][by[1]] == 14.0
+        np.testing.assert_allclose(out["avg"][by[2]], 2.5)
+        assert out["lo"][by[1]] == 4.0 and out["hi"][by[1]] == 5.0
+        assert out["first_item"][by[3]] == "c"
+
+    def test_multi_key_and_count(self):
+        out = _df().group_by("user", "item").count()
+        assert len(out) == 5       # (1,a)x2 (1,b) (2,b) (2,a) (3,c)
+        pairs = {(int(u), it): int(c) for u, it, c in
+                 zip(out["user"], out["item"], out["count"])}
+        assert pairs[(1, "a")] == 2 and pairs[(2, "a")] == 1
+
+    def test_unknown_fn_raises(self):
+        with pytest.raises(ValueError, match="unknown aggregation"):
+            _df().group_by("user").agg(x=("rating", "median"))
+
+
+class TestJoin:
+    def test_inner(self):
+        users = DataFrame({"user": np.array([1, 2, 4]),
+                           "age": np.array([30, 40, 50])})
+        out = _df().join(users, on="user")
+        assert len(out) == 5                       # user 3 drops
+        assert set(np.asarray(out["user"])) == {1, 2}
+        assert (out["age"][out["user"] == 1] == 30).all()
+
+    def test_left_with_fill(self):
+        users = DataFrame({"user": np.array([1, 2]),
+                           "age": np.array([30.0, 40.0])})
+        out = _df().join(users, on="user", how="left")
+        assert len(out) == 6
+        assert np.isnan(out["age"][out["user"] == 3]).all()
+
+    def test_duplicate_right_keys_expand(self):
+        left = DataFrame({"k": np.array([1, 2])})
+        right = DataFrame({"k": np.array([1, 1, 3]),
+                           "v": np.array([10, 11, 12])})
+        out = left.join(right, on="k")
+        assert len(out) == 2
+        assert sorted(np.asarray(out["v"]).tolist()) == [10, 11]
+
+    def test_name_collision_suffix(self):
+        left = DataFrame({"k": np.array([1]), "v": np.array([0])})
+        right = DataFrame({"k": np.array([1]), "v": np.array([9])})
+        out = left.join(right, on="k")
+        assert out["v"][0] == 0 and out["v_right"][0] == 9
+
+    def test_multi_key_join(self):
+        right = DataFrame({
+            "user": np.array([1, 2]),
+            "item": np.array(["a", "b"], dtype=object),
+            "seen": np.array([True, True]),
+        })
+        out = _df().join(right, on=["user", "item"])
+        assert len(out) == 3       # (1,a)x2 + (2,b)
+
+
+class TestEdgeCases:
+    def test_numeric_dtype_promotion_multi_key(self):
+        left = DataFrame({"user": np.array([1, 2], np.int64),
+                          "item": np.array(["a", "b"], dtype=object)})
+        right = DataFrame({"user": np.array([1.0, 2.0]),
+                           "item": np.array(["a", "b"], dtype=object),
+                           "v": np.array([7, 8])})
+        out = left.join(right, on=["user", "item"])
+        assert len(out) == 2 and sorted(out["v"].tolist()) == [7, 8]
+
+    def test_left_join_empty_right(self):
+        left = DataFrame({"k": np.array([1, 2])})
+        right = DataFrame({"k": np.array([], np.int64),
+                           "v": np.array([], np.float64)})
+        out = left.join(right, on="k", how="left")
+        assert len(out) == 2 and np.isnan(out["v"]).all()
+        assert len(left.join(right, on="k")) == 0
+
+    def test_group_by_empty(self):
+        df = DataFrame({"k": np.array([], np.int64),
+                        "v": np.array([], np.float64)})
+        out = df.group_by("k").agg(n=("v", "count"), s=("v", "sum"))
+        assert len(out) == 0
+
+    def test_join_propagates_right_metadata(self):
+        left = DataFrame({"k": np.array([1])})
+        right = DataFrame({"k": np.array([1]),
+                           "cat": np.array([0])}).with_metadata(
+            "cat", {"levels": ["x", "y"]})
+        out = left.join(right, on="k")
+        assert out.metadata("cat") == {"levels": ["x", "y"]}
