@@ -14,6 +14,8 @@ from .pipeline import make_pp_dp_train_step, pipeline_forward
 from .moe import (init_moe_block_params, make_ep_dp_train_step, moe_ffn,
                   init_moe_params)
 from .checkpoint import (latest_step, restore_train_state, save_train_state)
+from .moe_encoder import (init_moe_encoder_params, make_moe_ep_dp_train_step,
+                          moe_encoder_forward, unshard_moe_encoder_params)
 
 __all__ = [
     "make_pp_dp_train_step", "pipeline_forward",
@@ -29,4 +31,6 @@ __all__ = [
     "TransformerEncoderClassifier", "TransformerClassificationModel",
     "make_tp_dp_train_step",
     "save_train_state", "restore_train_state", "latest_step",
+    "init_moe_encoder_params", "moe_encoder_forward",
+    "make_moe_ep_dp_train_step", "unshard_moe_encoder_params",
 ]

@@ -1,0 +1,209 @@
+"""Switch-MoE transformer encoder: every layer's dense FFN replaced by a
+top-1-routed expert mixture, trained expert-parallel over the mesh.
+
+Composes the two proven pieces — the shared attention sub-layer
+(transformer.encoder_layer's pre-LN attention block) and the
+token-dispatching MoE FFN (ops/moe.moe_ffn: capacity buckets + two
+all_to_alls riding the model axis) — into a full encoder + classifier
+head. No reference analogue (SURVEY §2.2: the reference's parallelism is
+data-parallel partitions only); this is the ep leg of the tp/pp/dp/sp/ep
+taxonomy at the ESTIMATOR surface (TransformerEncoderClassifier
+strategy='moe').
+
+Layout (canonical Switch/TPU, same as models/deep/moe.py): tokens sharded
+over BOTH mesh axes, experts sharded over MODEL, attention/LN/router/head
+replicated. Expert grads pmean over data / ep; replicated-param grads
+pmean over both axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.moe import init_moe_params, moe_ffn, shard_moe_params
+from .transformer import _apply, _layer_norm, attention_sublayer
+
+__all__ = ["init_moe_encoder_params", "moe_encoder_forward",
+           "make_moe_ep_dp_train_step", "unshard_moe_encoder_params"]
+
+
+def init_moe_encoder_params(key, num_layers: int, d_model: int,
+                            num_heads: int, d_ff: int, num_experts: int):
+    """Per layer: pre-LN attention (qkv/proj/ln1) + MoE FFN (ln2 + router
+    + expert stacks). Attention init matches the dense encoder's
+    per-matrix Xavier (init_encoder_params) so strategy='moe' starts from
+    the same statistics as every other strategy."""
+    def dense(k, fan_in, fan_out):
+        scale = np.sqrt(2.0 / (fan_in + fan_out))
+        return {"w": jax.random.normal(k, (fan_in, fan_out)) * scale,
+                "b": jnp.zeros((fan_out,))}
+
+    layers = []
+    for i in range(num_layers):
+        ks = jax.random.split(jax.random.fold_in(key, i), 3)
+        layers.append({
+            "qkv": dense(ks[0], d_model, 3 * d_model),
+            "proj": dense(ks[1], d_model, d_model),
+            "ln1": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+            "ln2": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+            "moe": init_moe_params(ks[2], num_experts, d_model, d_ff),
+        })
+    return {"layers": layers}
+
+
+def _moe_layer(x, lp, num_heads: int, num_experts: int,
+               capacity_factor: float, causal: bool,
+               axis_name: Optional[str]) -> Tuple[jax.Array, jax.Array]:
+    """One pre-LN MoE encoder layer; returns (y, aux load-balance loss).
+    The attention block is THE shared sublayer (transformer.
+    attention_sublayer) with the dense reference path — the training
+    convention, the fused flash kernel has no VJP."""
+    x = attention_sublayer(x, lp, num_heads, causal=causal,
+                           attention_impl="reference")
+    h = _layer_norm(x, lp["ln2"])
+    y, aux = moe_ffn(lp["moe"], h, num_experts,
+                     capacity_factor=capacity_factor, axis_name=axis_name)
+    return x + y, aux
+
+
+def moe_encoder_forward(params, x: jax.Array, num_heads: int,
+                        num_experts: int, capacity_factor: float = 2.0,
+                        causal: bool = False,
+                        axis_name: Optional[str] = None
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """[B, S, D] -> ([B, S, D], summed aux loss). axis_name set = expert
+    shards + local tokens inside shard_map; None = full experts on one
+    device (the fitted-model scoring path)."""
+    aux_total = jnp.float32(0.0)
+    for lp in params["layers"]:
+        x, aux = _moe_layer(x, lp, num_heads, num_experts, capacity_factor,
+                            causal, axis_name)
+        aux_total = aux_total + aux
+    return x, aux_total
+
+
+def unshard_moe_encoder_params(stacked, num_experts: int):
+    """Inverse of the per-rank expert slicing: stacked [ep, ...] layer
+    pytrees -> full params (expert stacks concatenated along the expert
+    axis; replicated leaves take rank 0). num_experts validates the
+    reassembled expert count."""
+    layers_out = []
+    n_layers = len(stacked["layers"])
+    for li in range(n_layers):
+        lp = stacked["layers"][li]
+        out = {k: jax.tree_util.tree_map(lambda a: np.asarray(a)[0], lp[k])
+               for k in ("qkv", "proj", "ln1", "ln2")}
+        moe = lp["moe"]
+        out["moe"] = {
+            "router": jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[0], moe["router"]),
+            "ff1": jax.tree_util.tree_map(
+                lambda a: np.concatenate(np.asarray(a), axis=0), moe["ff1"]),
+            "ff2": jax.tree_util.tree_map(
+                lambda a: np.concatenate(np.asarray(a), axis=0), moe["ff2"]),
+        }
+        got = out["moe"]["ff1"]["w"].shape[0]
+        if got != num_experts:
+            raise ValueError(
+                f"layer {li}: reassembled {got} experts, expected "
+                f"{num_experts}")
+        layers_out.append(out)
+    return {"layers": layers_out}
+
+
+def make_moe_ep_dp_train_step(mesh, num_heads: int, learning_rate: float,
+                              num_classes: int, num_experts: int,
+                              capacity_factor: float = 2.0,
+                              causal: bool = False,
+                              aux_weight: float = 1e-2,
+                              data_axis: Optional[str] = None,
+                              model_axis: Optional[str] = None):
+    """One expert-parallel MoE-encoder training step over a 2-D mesh.
+
+    Returns (step, shard_params) with make_tp_dp_train_step's stacked
+    calling convention. x: [B, S, D], B divisible by data*model shards
+    (tokens ride both axes); y: [B] int labels.
+    """
+    import optax
+    from ...parallel import mesh as meshlib
+    from jax.sharding import PartitionSpec as P
+    data_axis = data_axis or meshlib.DATA_AXIS
+    model_axis = model_axis or meshlib.MODEL_AXIS
+    ep = mesh.shape[model_axis]
+    if num_experts % ep:
+        raise ValueError(f"num_experts {num_experts} must divide over the "
+                         f"model axis ({ep} shards)")
+    tx = optax.adam(learning_rate)
+
+    def loss_fn(params, x, y):
+        enc, aux = moe_encoder_forward(
+            params["encoder"], x, num_heads, num_experts, capacity_factor,
+            causal, axis_name=model_axis)
+        pooled = enc.mean(axis=1)
+        logits = pooled @ params["head"]["w"] + params["head"]["b"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.mean(jnp.sum(jax.nn.one_hot(y, num_classes) * logp,
+                               axis=-1))
+        return ce + aux_weight * aux
+
+    def _split(tree_fn_expert, tree_fn_repl, grads):
+        out_layers = []
+        for lp in grads["encoder"]["layers"]:
+            g = {k: jax.tree_util.tree_map(tree_fn_repl, lp[k])
+                 for k in ("qkv", "proj", "ln1", "ln2")}
+            g["moe"] = {
+                "router": jax.tree_util.tree_map(tree_fn_repl,
+                                                 lp["moe"]["router"]),
+                "ff1": jax.tree_util.tree_map(tree_fn_expert,
+                                              lp["moe"]["ff1"]),
+                "ff2": jax.tree_util.tree_map(tree_fn_expert,
+                                              lp["moe"]["ff2"]),
+            }
+            out_layers.append(g)
+        return {"encoder": {"layers": out_layers},
+                "head": jax.tree_util.tree_map(tree_fn_repl, grads["head"])}
+
+    def step(params, opt_state, x, y):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        opt_state = jax.tree_util.tree_map(lambda a: a[0], opt_state)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        # expert slices are disjoint over MODEL: their raw grad is already
+        # the model-group sum — /ep puts them on the same MEAN loss as the
+        # replicated params (models/deep/moe.py's SGD-exposed convention)
+        both = lambda g: jax.lax.pmean(
+            jax.lax.pmean(g, data_axis), model_axis)
+        dp_only = lambda g: jax.lax.pmean(g, data_axis) / ep
+        grads = _split(dp_only, both, grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        lift = lambda a: a[None]
+        return (jax.tree_util.tree_map(lift, params),
+                jax.tree_util.tree_map(lift, opt_state), both(loss))
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(model_axis), P(model_axis),
+                  P((data_axis, model_axis)), P((data_axis, model_axis))),
+        out_specs=(P(model_axis), P(model_axis), P()),
+        check_vma=False)
+
+    def shard_params(full_params, head):
+        shards = []
+        for r in range(ep):
+            layers = []
+            for lp in full_params["layers"]:
+                layers.append({
+                    **{k: lp[k] for k in ("qkv", "proj", "ln1", "ln2")},
+                    "moe": shard_moe_params(lp["moe"], r, ep),
+                })
+            shards.append({"encoder": {"layers": layers}, "head": head})
+        stack = lambda *xs: jnp.stack(xs)
+        stacked = jax.tree_util.tree_map(stack, *shards)
+        opt_shards = [tx.init(s) for s in shards]
+        return stacked, jax.tree_util.tree_map(stack, *opt_shards)
+
+    return jax.jit(sharded), shard_params

@@ -76,12 +76,14 @@ def sinusoidal_positions(start: jax.Array, s: int, d: int) -> jax.Array:
     return pe
 
 
-def encoder_layer(x, lp, num_heads: int, causal: bool = False,
-                  axis_name: Optional[str] = None,
-                  attention_impl: str = "flash"):
-    """One pre-LN encoder layer — THE single layer definition shared by
-    encoder_forward and the pipeline-parallel stage scan
-    (models/deep/pipeline.py), so their exactness contract cannot drift."""
+def attention_sublayer(x, lp, num_heads: int, causal: bool = False,
+                       axis_name: Optional[str] = None,
+                       attention_impl: str = "flash"):
+    """Pre-LN attention + residual — THE single attention definition
+    shared by encoder_layer, the pipeline stage scan
+    (models/deep/pipeline.py) and the MoE encoder
+    (models/deep/moe_encoder.py), so their exactness contract cannot
+    drift."""
     b, s, d = x.shape
     hd = d // num_heads
     h = _layer_norm(x, lp["ln1"])
@@ -96,7 +98,15 @@ def encoder_layer(x, lp, num_heads: int, causal: bool = False,
         att = ulysses_attention_sharded(q, k, v, axis_name, causal=causal)
     else:
         att = ring_attention_sharded(q, k, v, axis_name, causal=causal)
-    x = x + _apply(lp["proj"], att.reshape(b, s, d))
+    return x + _apply(lp["proj"], att.reshape(b, s, d))
+
+
+def encoder_layer(x, lp, num_heads: int, causal: bool = False,
+                  axis_name: Optional[str] = None,
+                  attention_impl: str = "flash"):
+    """One pre-LN encoder layer: shared attention sublayer + dense FFN."""
+    x = attention_sublayer(x, lp, num_heads, causal, axis_name,
+                           attention_impl)
     h = _layer_norm(x, lp["ln2"])
     return x + _apply(lp["ff2"], jax.nn.gelu(_apply(lp["ff1"], h)))
 
@@ -620,10 +630,21 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
         "layer over a data x model mesh, make_tp_dp_train_step), "
         "'pipeline' (GPipe microbatch schedule, layers split into "
         "contiguous stages over the model axis, make_pp_dp_train_step), "
-        "or 'sequence' (long-context regime: the SEQUENCE axis sharded "
+        "'sequence' (long-context regime: the SEQUENCE axis sharded "
         "over modelParallel devices via ring attention, parameters "
-        "replicated, make_sp_train_step; dataParallel must be 0/1)",
+        "replicated, make_sp_train_step; dataParallel must be 0/1), or "
+        "'moe' (Switch-MoE encoder: every layer's FFN replaced by "
+        "numExperts top-1-routed experts sharded over the model axis, "
+        "tokens all_to_all-dispatched, make_moe_ep_dp_train_step)",
         "tensor")
+    numExperts = _p.Param(
+        "numExperts",
+        "expert count for strategy='moe' (must divide over modelParallel)",
+        8, int)
+    capacityFactor = _p.Param(
+        "capacityFactor",
+        "MoE expert capacity factor (tokens per expert bucket = "
+        "capacity_factor * tokens/experts)", 2.0, float)
     numMicrobatches = _p.Param(
         "numMicrobatches",
         "GPipe microbatches per step (strategy='pipeline'); batch size "
@@ -663,12 +684,15 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
         nh = self.get("numHeads")
         key = jax.random.PRNGKey(self.get("seed"))
         k_enc, k_head = jax.random.split(key)
-        params = init_encoder_params(k_enc, self.get("numLayers"),
-                                     self.get("dModel"), nh,
-                                     self.get("dFF"))
         if d != self.get("dModel"):
             raise ValueError(
                 f"input feature width {d} != dModel {self.get('dModel')}")
+        # the moe strategy builds its own parameter tree — don't
+        # materialize a dense stack it would immediately discard
+        params = (None if self.get("strategy") == "moe"
+                  else init_encoder_params(k_enc, self.get("numLayers"),
+                                           self.get("dModel"), nh,
+                                           self.get("dFF")))
         head = init_head_params(k_head, d, nc)
 
         dp = self.get("dataParallel") or 1
@@ -715,9 +739,9 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
             return p_st, o_st
 
         strategy = self.get("strategy")
-        if strategy not in ("tensor", "pipeline", "sequence"):
-            raise ValueError(f"strategy must be 'tensor', 'pipeline' or "
-                             f"'sequence', got {strategy!r}")
+        if strategy not in ("tensor", "pipeline", "sequence", "moe"):
+            raise ValueError(f"strategy must be 'tensor', 'pipeline', "
+                             f"'sequence' or 'moe', got {strategy!r}")
         if strategy == "sequence" and tp > 1:
             if dp > 1:
                 raise ValueError(
@@ -750,7 +774,29 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
             mesh = meshlib.get_mesh(
                 dp * tp, axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS),
                 shape=(dp, tp))
-            if strategy == "pipeline":
+            if strategy == "moe":
+                from .moe_encoder import (init_moe_encoder_params,
+                                          make_moe_ep_dp_train_step)
+                ne = self.get("numExperts")
+                if ne < 1 or ne % tp:
+                    raise ValueError(
+                        f"numExperts {ne} must be >= 1 and divide over "
+                        f"modelParallel {tp}")
+                params = init_moe_encoder_params(
+                    k_enc, self.get("numLayers"), self.get("dModel"), nh,
+                    self.get("dFF"), ne)
+                step, shard = make_moe_ep_dp_train_step(
+                    mesh, nh, lr, nc, ne,
+                    capacity_factor=self.get("capacityFactor"),
+                    causal=self.get("causal"))
+                gran = dp * tp           # tokens ride both mesh axes
+                bs = min(max(self.get("batchSize"), gran), n)
+                bs -= bs % gran
+                if bs < gran:
+                    raise ValueError(
+                        f"{n} rows cannot fill a batch over {dp}x{tp} "
+                        f"token shards")
+            elif strategy == "pipeline":
                 from .pipeline import make_pp_dp_train_step
                 mb = self.get("numMicrobatches")
                 if mb < 1:
@@ -805,7 +851,12 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                                      to_templates=_to_mesh_templates)
             head_f = jax.tree_util.tree_map(
                 lambda a: np.asarray(a)[0], p_sh["head"])
-            if strategy == "pipeline":
+            if strategy == "moe":
+                from .moe_encoder import unshard_moe_encoder_params
+                full = unshard_moe_encoder_params(
+                    jax.tree_util.tree_map(np.asarray, p_sh)["encoder"],
+                    self.get("numExperts"))
+            elif strategy == "pipeline":
                 # stage stack [pp, layers_per_stage, ...] -> flat layer list
                 stage = jax.tree_util.tree_map(np.asarray, p_sh)["stage"]
                 lps = self.get("numLayers") // tp
@@ -816,6 +867,10 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                 full = unshard_encoder_params(
                     jax.tree_util.tree_map(np.asarray, p_sh)["encoder"], nh)
         else:
+            if strategy == "moe":
+                raise ValueError(
+                    "strategy='moe' trains expert-parallel — set "
+                    "dataParallel/modelParallel so the mesh has > 1 device")
             step, init_opt = make_single_train_step(
                 nh, lr, nc, self.get("causal"))
             p = {"encoder": params, "head": head}
@@ -829,6 +884,9 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
         model.set("numHeads", nh)
         model.set("causal", self.get("causal"))
         model.set("inputCol", self.get("inputCol"))
+        if strategy == "moe":
+            model.set("numExperts", self.get("numExperts"))
+            model.set("capacityFactor", self.get("capacityFactor"))
         return model
 
 
@@ -839,6 +897,11 @@ class TransformerClassificationModel(Model, _p.HasInputCol):
 
     numHeads = _p.Param("numHeads", "attention heads", 4, int)
     causal = _p.Param("causal", "causal masking", False)
+    numExperts = _p.Param("numExperts",
+                          "Switch-MoE expert count (0 = dense FFN layers)",
+                          0, int)
+    capacityFactor = _p.Param("capacityFactor",
+                              "MoE expert capacity factor", 2.0, float)
     weights = _p.Param("weights", "encoder parameter pytree", None,
                        complex=True)
     head = _p.Param("head", "classifier head {w, b}", None, complex=True)
@@ -855,17 +918,28 @@ class TransformerClassificationModel(Model, _p.HasInputCol):
         inside transform would retrace + recompile on every call (the same
         cache discipline as TransformerEncoderModel._compiled)."""
         nh, causal = self.get("numHeads"), self.get("causal")
-        key = (nh, causal)
+        ne, cf = self.get("numExperts"), self.get("capacityFactor")
+        key = (nh, causal, ne, cf)
         cached = getattr(self, "_fwd_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
 
-        @jax.jit
-        def fwd(p, h, xb):
-            enc = encoder_forward(p, xb, nh, causal,
-                                  attention_impl="reference")
-            logits = enc.mean(axis=1) @ h["w"] + h["b"]
-            return jax.nn.softmax(logits, axis=-1)
+        if ne > 0:
+            from .moe_encoder import moe_encoder_forward
+
+            @jax.jit
+            def fwd(p, h, xb):
+                enc, _ = moe_encoder_forward(p, xb, nh, ne, cf,
+                                             causal=causal)
+                logits = enc.mean(axis=1) @ h["w"] + h["b"]
+                return jax.nn.softmax(logits, axis=-1)
+        else:
+            @jax.jit
+            def fwd(p, h, xb):
+                enc = encoder_forward(p, xb, nh, causal,
+                                      attention_impl="reference")
+                logits = enc.mean(axis=1) @ h["w"] + h["b"]
+                return jax.nn.softmax(logits, axis=-1)
 
         self._fwd_cache = (key, fwd)
         return fwd
