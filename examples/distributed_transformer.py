@@ -69,6 +69,15 @@ def main(n=96, s=8, d=16, nc=3):
     acc_pp = float((pipe.fit(df).transform(df)["prediction"] == y).mean())
     print(f"pipeline-parallel fit train accuracy: {acc_pp:.3f} "
           f"(checkpoints in {ck})")
+
+    # 5. Switch-MoE encoder: every layer's FFN becomes 4 top-1-routed
+    # experts sharded over the model axis (tokens all_to_all-dispatched)
+    moe = TransformerEncoderClassifier(
+        numLayers=1, dModel=d, numHeads=4, dFF=32, epochs=10, batchSize=32,
+        learningRate=5e-3, dataParallel=4, modelParallel=2,
+        strategy="moe", numExperts=4, seed=1)
+    acc_moe = float((moe.fit(df).transform(df)["prediction"] == y).mean())
+    print(f"expert-parallel MoE fit train accuracy: {acc_moe:.3f}")
     return acc
 
 
