@@ -104,3 +104,19 @@ def multiclass_df():
 def auc(y_true, scores):
     from sklearn.metrics import roc_auc_score
     return roc_auc_score(y_true, scores)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Clear jit/compile caches after every test module.
+
+    Two reasons: (a) bounds compile-cache growth over the ~900-test run;
+    (b) works around a deterministic XLA-CPU compiler segfault observed
+    2026-07-31 — after ~824 tests' worth of accumulated compiler state,
+    compiling test_sp_gradients_match_single_device's program crashed in
+    backend_compile_and_load (the same test passes standalone and in every
+    subset tried). Clearing per module keeps each module's compilation
+    context close to the standalone one."""
+    yield
+    import jax as _jax
+    _jax.clear_caches()
