@@ -38,6 +38,11 @@ class FileStreamSource:
     when nothing new), in (mtime, name) order, at most `max_files_per_batch`
     per call. `formats`: "binary" (path, bytes, length), "image" (path,
     image HWC uint8), "json" (one row per .json file of scalars/lists).
+
+    Ingestion contract (same as Spark's file streaming source): files must
+    be PLACED ATOMICALLY into the directory (write elsewhere, then
+    rename/move in) — a file written in place can be picked up
+    half-written.
     """
 
     def __init__(self, path: str, format: str = "binary",

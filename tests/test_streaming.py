@@ -16,8 +16,18 @@ from mmlspark_tpu.io.streaming import FileStreamSource, StreamingQuery
 
 
 def _write(path, data: bytes):
-    with open(path, "wb") as f:
+    """Atomic placement (write to a temp name, then rename) — the file
+    source's ingestion contract, same as Spark's file streaming source:
+    a poller may otherwise legitimately observe a half-written file
+    (seen as a flaky 0-byte read on a loaded host)."""
+    import os as _os
+    # temp file goes OUTSIDE the watched directory (the poller would
+    # happily ingest a .tmp sibling), then renames in atomically
+    tmp = _os.path.join(_os.path.dirname(_os.path.dirname(str(path))),
+                        _os.path.basename(str(path)) + ".tmp~")
+    with open(tmp, "wb") as f:
         f.write(data)
+    _os.replace(tmp, path)
 
 
 class TestFileStreamSource:
