@@ -1,0 +1,78 @@
+"""The bench's patient TPU bring-up (round-3 verdict #1).
+
+The shared pool's two failure modes (fast UNAVAILABLE, multi-minute init
+hang) are simulated with substitute probe bodies — no pool contact. The
+contract under test: every attempt is logged with offset/duration/outcome,
+failed attempts retry until the wall budget, a hanging probe is only killed
+at budget end, and the fallback error message names the probe count.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+@pytest.fixture()
+def probe_code(monkeypatch):
+    # the fallback path sets JAX_PLATFORMS=cpu in os.environ; restore it so
+    # no later-collected test inherits a silently CPU-pinned environment
+    # (the suite's conftest pins CPU anyway, but keep the leak contained)
+    monkeypatch.setenv("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
+
+    def set_code(code):
+        monkeypatch.setattr(bench, "_PROBE_CODE", code)
+    return set_code
+
+
+def test_failing_probes_retry_until_budget(probe_code):
+    probe_code("import sys; print('boom', file=sys.stderr); sys.exit(1)")
+    _, devs, err, attempts = bench._patient_backend_bringup(
+        budget_s=18, retry_sleep_s=4, min_probe_s=2)
+    assert devs[0].platform == "cpu"
+    assert err is not None and "probe" in err
+    assert len(attempts) >= 2
+    assert all(a["outcome"].startswith("error:") for a in attempts)
+    assert all("boom" in a["outcome"] for a in attempts)
+
+
+def test_no_probe_spawned_without_fair_budget(probe_code):
+    # with min_probe_s at the production 60s, an 18s budget yields exactly
+    # one attempt: no doomed re-probe is spawned just to be killed
+    probe_code("import sys; sys.exit(1)")
+    _, _, err, attempts = bench._patient_backend_bringup(
+        budget_s=18, retry_sleep_s=4, min_probe_s=60)
+    assert len(attempts) == 1
+    assert err is not None
+
+
+def test_hanging_probe_killed_only_at_budget_end(probe_code):
+    probe_code("import time; time.sleep(600)")
+    _, devs, err, attempts = bench._patient_backend_bringup(
+        budget_s=12, retry_sleep_s=6)
+    assert devs[0].platform == "cpu"
+    # ONE attempt: the hang is waited out, not kill-respawned (killing a
+    # grant-holding client is what wedges the pool for later processes)
+    assert len(attempts) == 1
+    assert "killed at budget end" in attempts[0]["outcome"]
+    assert attempts[0]["dur_s"] >= 10
+
+
+def test_healthy_probe_reports_platform(probe_code):
+    # A probe that reports a cpu platform is NOT healthy (the whole point is
+    # reaching an accelerator): bring-up must keep probing, then fall back.
+    probe_code("print('8.0 cpu')")
+    _, devs, err, attempts = bench._patient_backend_bringup(
+        budget_s=10, retry_sleep_s=4)
+    assert devs[0].platform == "cpu"
+    assert err is not None
+    assert all(a["outcome"].startswith("error:") for a in attempts)
+
+
+def test_provenance_block_is_embedded_constant():
+    # the provenance block must carry a date and real-chip source note
+    assert "date_utc" in bench.PERF_PROVENANCE
+    assert "PERF.md" in bench.PERF_PROVENANCE["source"]
