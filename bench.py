@@ -112,11 +112,16 @@ def _patient_backend_bringup(budget_s=None, retry_sleep_s=90, min_probe_s=60):
             p = subprocess.Popen([sys.executable, "-c", _PROBE_CODE],
                                  stdout=fo, stderr=fe, text=True)
         except OSError as e:
+            # transient (EAGAIN under memory pressure, etc.) — retry within
+            # the budget like any other failed attempt
             attempts.append({"t_s": round(a0 - t0, 1), "dur_s": 0.0,
                              "outcome": f"spawn failed: {e}"})
             fo.close()
             fe.close()
-            break
+            if budget_s - (time.time() - t0) <= retry_sleep_s + min_probe_s:
+                break
+            time.sleep(retry_sleep_s)
+            continue
         while p.poll() is None and time.time() - t0 < budget_s:
             time.sleep(0.5)
         hung = p.poll() is None

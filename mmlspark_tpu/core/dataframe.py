@@ -268,15 +268,22 @@ class DataFrame:
             rcols.append(rc)
         lk = _encode_keys(lcols)
         rk = _encode_keys(rcols)
-        if len(other) == 0:
+        # Spark join semantics: null (None) keys never match — a None would
+        # otherwise string-encode as 'None' and both join with each other
+        # and collide with a literal "None" key. NaN keys DO match each
+        # other (Spark's NaN semantics: NaN = NaN is true in joins), which
+        # searchsorted/string-encoding already provide.
+        rvalid = np.flatnonzero(~_null_key_mask(rcols))
+        if len(other) == 0 or len(rvalid) == 0:
             counts = np.zeros(len(lk), np.int64)
             order = np.zeros(0, np.int64)
             starts = np.zeros(len(lk), np.int64)
         else:
-            order = np.argsort(rk, kind="stable")
+            order = rvalid[np.argsort(rk[rvalid], kind="stable")]
             rk_sorted = rk[order]
             starts = np.searchsorted(rk_sorted, lk, side="left")
             counts = np.searchsorted(rk_sorted, lk, side="right") - starts
+        counts[_null_key_mask(lcols)] = 0
         matched = counts > 0
         cm = counts[matched]
         # within-block offsets 0..c-1 for every matched left row, fully
@@ -404,6 +411,16 @@ def concat_dataframes(dfs: Sequence[DataFrame]) -> DataFrame:
     for d in dfs[1:]:
         out = out.union(d)
     return out
+
+
+def _null_key_mask(cols: Sequence[np.ndarray]) -> np.ndarray:
+    """Rows where any key column holds a null (None in an object column).
+    Join never matches these rows — Spark null-key semantics."""
+    mask = np.zeros(len(cols[0]), bool)
+    for c in cols:
+        if c.dtype.kind == "O":
+            mask |= np.fromiter((v is None for v in c), bool, len(c))
+    return mask
 
 
 def _encode_keys(cols: Sequence[np.ndarray]) -> np.ndarray:

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.moe import init_moe_params, moe_ffn, shard_moe_params
-from .transformer import _apply, _layer_norm, attention_sublayer
+from .transformer import _layer_norm, attention_sublayer
 
 __all__ = ["init_moe_encoder_params", "moe_encoder_forward",
            "make_moe_ep_dp_train_step", "unshard_moe_encoder_params"]

@@ -742,6 +742,18 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
         if strategy not in ("tensor", "pipeline", "sequence", "moe"):
             raise ValueError(f"strategy must be 'tensor', 'pipeline', "
                              f"'sequence' or 'moe', got {strategy!r}")
+        # validated before the strategy dispatch so EVERY path — sequence,
+        # single-device included — rejects an unusable zero1 instead of
+        # silently ignoring it
+        if self.get("zero1"):
+            if strategy != "tensor":
+                raise ValueError(
+                    "zero1 requires strategy='tensor' (the pipeline step "
+                    "keeps its optimizer replicated over data)")
+            if dp * tp <= 1:
+                raise ValueError(
+                    "zero1 shards optimizer state over a device mesh; it "
+                    "needs dataParallel*modelParallel > 1")
         if strategy == "sequence" and tp > 1:
             if dp > 1:
                 raise ValueError(
@@ -823,10 +835,6 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                 step, shard = make_tp_dp_train_step(
                     mesh, nh, lr, nc, self.get("causal"),
                     zero1=self.get("zero1"))
-            if self.get("zero1") and strategy != "tensor":
-                raise ValueError(
-                    "zero1 requires strategy='tensor' (the pipeline step "
-                    "keeps its optimizer replicated over data)")
             p_sh, o_sh = shard(params, head)
 
             def _to_mesh_templates(p_st, o_st):

@@ -271,11 +271,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         "boundary, and a later fit() with the same checkpointDir resumes "
         "from it, training only the REMAINING iterations (total stays "
         "numIterations). The checkpoint is removed on successful "
-        "completion. Early-stopping counters and bagging keys restart at "
-        "the resume point; with bagging off, resumed trees equal the "
-        "uninterrupted fit's. Combine with itersPerCall to bound the work "
-        "lost to an interruption. Not supported with numBatches>1, dart, "
-        "or fit(df, paramMaps)", None)
+        "completion. Early-stopping counters and bagging keys (and the "
+        "fit's PRNG stream, which restarts from the seed) restart at the "
+        "resume point; with bagging off, resumed trees equal the "
+        "uninterrupted fit's. Delegate hooks and delegate-driven learning-"
+        "rate schedules see ABSOLUTE iteration indices (a resume continues "
+        "at the checkpointed tree count). Combine with itersPerCall to "
+        "bound the work lost to an interruption. Not supported with "
+        "numBatches>1, dart, or fit(df, paramMaps)", None)
     itersPerCall = Param(
         "itersPerCall",
         "split training into device programs of at most this many boosting "
@@ -1057,6 +1060,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # between chunks (a larger chunk only delays the halt)
             chunk = max(1, min(int(ipc), T))
         batch_index = getattr(self, "_batch_index", 0)
+        # Delegate hooks and lr schedules see ABSOLUTE iteration indices: a
+        # checkpointDir resume trains `remaining` iterations (T, done start
+        # at 0 — the device-side `start` must stay 0-based to select the
+        # margin-init scores), but a delegate-driven schedule must continue
+        # from the resumed tree count, not replay from iteration 0.
+        it0 = (getattr(self, "_ck_resume_trees", 0)
+               if self.get("checkpointDir") else 0)
         base_lr = (1.0 if self.get("boostingType") == "rf"
                    else self.get("learningRate"))
         cur_lr = base_lr
@@ -1076,9 +1086,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             lrs = []
             for i in range(done, done + c):
                 if delegate is not None:
-                    delegate.before_train_iteration(batch_index, i, has_valid)
+                    delegate.before_train_iteration(batch_index, it0 + i,
+                                                    has_valid)
                     cur_lr = float(delegate.get_learning_rate(
-                        batch_index, i, cur_lr))
+                        batch_index, it0 + i, cur_lr))
                 lrs.append(cur_lr / base_lr if base_lr else 1.0)
             key, sub = jax.random.split(key)
             trees_c, tm_c, vm_c, scores, init_out = run_chunk(
@@ -1104,7 +1115,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                         stopped = True
                 if delegate is not None:
                     delegate.after_train_iteration(
-                        batch_index, i, has_valid, stopped or i == T - 1,
+                        batch_index, it0 + i, has_valid,
+                        stopped or i == T - 1,
                         {"train": float(tm_c[j])},
                         {"valid": float(vm_c[j])} if has_valid else None)
                 if stopped:

@@ -759,11 +759,20 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         idx2k = jnp.stack(parents + children)                    # [2k]
         pg, pf, pb, pd = _best_split_per_slot(g_hists[idx2k], g_sums[idx2k],
                                               cfg, feature_mask, hp)
+        # Non-applied entries are masked OUT of the scatter (index lcap is
+        # out of bounds -> dropped), not merged via where(do2, ...): when
+        # the record budget clips (rec_c pinned to lcap-2), idx2k can name
+        # slot lcap-1 twice — an applied child and a clipped non-applied
+        # entry — and a duplicate-index scatter is nondeterministic about
+        # which value lands. Applied indices are provably unique (top_k
+        # parents are distinct, applied children are consecutive fresh
+        # slots above next_rec), so the masked scatter is deterministic.
         do2 = jnp.stack(do_js + do_js)
-        bg = bg.at[idx2k].set(jnp.where(do2, pg, bg[idx2k]))
-        bf2 = bf_.at[idx2k].set(jnp.where(do2, pf, bf_[idx2k]))
-        bb2 = bb.at[idx2k].set(jnp.where(do2, pb, bb[idx2k]))
-        bd2 = bd.at[idx2k].set(jnp.where(do2, pd, bd[idx2k]))
+        safe = jnp.where(do2, idx2k, lcap)
+        bg = bg.at[safe].set(pg, mode="drop")
+        bf2 = bf_.at[safe].set(pf, mode="drop")
+        bb2 = bb.at[safe].set(pb, mode="drop")
+        bd2 = bd.at[safe].set(pd, mode="drop")
         return (step + 1, next_rec, done, depth_of_slot, slot_of_row,
                 s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat, s_mask,
                 s_dl, g_hists, g_sums, bg, bf2, bb2, bd2)

@@ -70,6 +70,27 @@ class TestJoin:
         out = left.join(right, on="k")
         assert out["v"][0] == 0 and out["v_right"][0] == 9
 
+    def test_null_keys_never_match(self):
+        # Spark null-key semantics: None on either side matches nothing
+        # (and never collides with a literal "None" string key)
+        left = DataFrame({"k": np.array(["a", None, "None"], dtype=object),
+                          "lv": np.array([1, 2, 3])})
+        right = DataFrame({"k": np.array(["a", None, "None"], dtype=object),
+                           "rv": np.array([10, 20, 30])})
+        out = left.join(right, on="k")
+        # "a"-"a" and "None"-"None" (real strings) match; None matches none
+        assert sorted(zip(out["lv"].tolist(), out["rv"].tolist())) \
+            == [(1, 10), (3, 30)]
+
+    def test_null_key_left_join_keeps_row_with_fill(self):
+        left = DataFrame({"k": np.array(["a", None], dtype=object),
+                          "lv": np.array([1, 2])})
+        right = DataFrame({"k": np.array(["a"], dtype=object),
+                           "rv": np.array([10.0])})
+        out = left.join(right, on="k", how="left")
+        assert len(out) == 2
+        assert np.isnan(out["rv"][np.asarray(out["lv"]) == 2]).all()
+
     def test_multi_key_join(self):
         right = DataFrame({
             "user": np.array([1, 2]),

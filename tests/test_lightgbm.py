@@ -232,6 +232,41 @@ class TestClassifier:
         # crash artifact removed on successful completion
         assert not _os.path.exists(_os.path.join(ck, "booster.txt"))
 
+    def test_checkpoint_resume_delegate_sees_absolute_iterations(
+            self, binary_df, tmp_path):
+        """A resumed fit's delegate hooks continue at the checkpointed tree
+        count: a delegate lr schedule indexed by iteration must not replay
+        from 0 (ADVICE r3: the resume used to restart hook indices)."""
+        from mmlspark_tpu.models.lightgbm.delegate import LightGBMDelegate
+
+        seen = []
+
+        class Sched(LightGBMDelegate):
+            def __init__(self, crash_at=None):
+                self.crash_at = crash_at
+
+            def before_train_iteration(self, batch, it, has_valid):
+                seen.append(it)
+
+            def after_train_iteration(self, batch, it, has_valid, finished,
+                                      tm, vm):
+                if self.crash_at is not None and it == self.crash_at:
+                    raise RuntimeError("preempted")
+
+        ck = str(tmp_path / "ckd")
+        with pytest.raises(RuntimeError, match="preempted"):
+            LightGBMClassifier(numIterations=9, numLeaves=7, seed=5,
+                               numTasks=1, itersPerCall=3, checkpointDir=ck,
+                               delegate=Sched(crash_at=4)).fit(binary_df)
+        pre = list(seen)
+        assert pre[:6] == [0, 1, 2, 3, 4, 5]  # chunk of 3 pre-announced
+        seen.clear()
+        LightGBMClassifier(numIterations=9, numLeaves=7, seed=5,
+                           numTasks=1, itersPerCall=3, checkpointDir=ck,
+                           delegate=Sched()).fit(binary_df)
+        # 3 trees checkpointed (crash mid-2nd chunk) -> resume covers 3..8
+        assert seen == list(range(3, 9)), seen
+
     def test_checkpoint_dir_with_warm_start(self, binary_df, tmp_path):
         """modelString warm start + checkpointDir: the checkpoint embeds the
         warm-start trees, but only NEW trees count against numIterations —

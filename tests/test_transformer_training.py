@@ -206,6 +206,27 @@ def test_classifier_single_device_path():
     assert (out["prediction"] == y).mean() > 0.75
 
 
+def test_zero1_rejected_on_every_non_tensor_path():
+    # zero1 must raise on every path, not only tensor-parallel dp*tp>1:
+    # sequence strategy and single-device fits used to ignore it silently
+    x, y = _toy(n=16, s=4, d=8, nc=2)
+    df = DataFrame({"sequence": np.asarray(x),
+                    "label": y.astype(np.float64)})
+    with pytest.raises(ValueError, match="zero1"):
+        TransformerEncoderClassifier(
+            numLayers=1, dModel=8, numHeads=2, dFF=16, epochs=1,
+            strategy="sequence", modelParallel=4, zero1=True).fit(df)
+    with pytest.raises(ValueError, match="zero1"):
+        TransformerEncoderClassifier(
+            numLayers=1, dModel=8, numHeads=2, dFF=16, epochs=1,
+            zero1=True).fit(df)
+    with pytest.raises(ValueError, match="zero1"):
+        TransformerEncoderClassifier(
+            numLayers=1, dModel=8, numHeads=2, dFF=16, epochs=1,
+            strategy="pipeline", dataParallel=2, modelParallel=2,
+            zero1=True).fit(df)
+
+
 def test_rejects_indivisible_heads():
     x, y = _toy(n=16, s=4, d=8, nc=2)
     df = DataFrame({"sequence": np.asarray(x),
