@@ -176,6 +176,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                                0, int)
     topRate = Param("topRate", "goss top gradient keep rate", 0.2, float)
     otherRate = Param("otherRate", "goss small-gradient sample rate", 0.1, float)
+    dropRate = Param("dropRate", "dart: fraction of prior iterations dropped "
+                     "per boosting round (LightGBM drop_rate)", 0.1, float)
+    skipDrop = Param("skipDrop", "dart: probability of skipping dropout for "
+                     "an iteration (LightGBM skip_drop)", 0.5, float)
     objective = Param("objective", "training objective", "regression")
     modelString = Param("modelString", "serialized warm-start model", "")
     numBatches = Param("numBatches",
@@ -591,6 +595,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             tweedie_variance_power=self.get("tweedieVariancePower"),
             top_rate=self.get("topRate"),
             other_rate=self.get("otherRate"),
+            drop_rate=self.get("dropRate"),
+            skip_drop=self.get("skipDrop"),
             boosting_type=boosting,
             has_init_score=bool(has_init_score),
             seed=self.get("seed"),
@@ -819,14 +825,6 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 "Names; use data_parallel")
         if par == "voting_parallel" and self.get("topK") < 1:
             raise ValueError("topK must be >= 1 for voting_parallel")
-        if (par == "voting_parallel" and not serial
-                and getattr(self, "_missing_idx", ())):
-            raise ValueError(
-                "voting_parallel does not support learned missing "
-                "directions and this data contains NaN features "
-                f"{list(self._missing_idx)}; use "
-                "parallelism='data_parallel' or set useMissing=False for "
-                "the legacy NaN-to-lowest-bin behavior")
         key = jax.random.PRNGKey(self.get("seed"))
         is_train = (~is_valid).astype(np.float32)
         axis = meshlib.DATA_AXIS

@@ -65,7 +65,8 @@ class GBDTConfig(NamedTuple):
     top_rate: float = 0.2       # goss
     other_rate: float = 0.1     # goss
     boosting_type: str = "gbdt"  # gbdt | goss | rf | dart
-    drop_rate: float = 0.1      # dart
+    drop_rate: float = 0.1      # dart (LightGBM drop_rate)
+    skip_drop: float = 0.5      # dart: P(no dropout this iteration)
     has_init_score: bool = False  # row init margins supplied (disables boost_from_average)
     max_position: int = 20   # lambdarank NDCG truncation (maxPosition)
     eval_at: int = 0         # NDCG@k for the eval metric (evalAt[0]; 0 = use
@@ -205,8 +206,14 @@ def _cat_sort_order(hists, cfg: GBDTConfig):
     return jnp.argsort(-_cat_ratio(hists, cfg), axis=2)           # [L,F,B]
 
 
+def _miss_mask_global(f: int, miss) -> jax.Array:
+    """[F] bool mask of missing-capable features (single construction shared
+    by build_tree's row routing and the gain table's default mask)."""
+    return jnp.zeros((f,), bool).at[jnp.asarray(miss)].set(True)
+
+
 def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
-                      hp: "HParams"):
+                      hp: "HParams", miss_mask=None):
     """Masked split-gain table over [L, F, B, 3] histograms -> [L, F, B, 2].
 
     The last axis is the missing-value default direction: 0 = missing goes
@@ -214,10 +221,13 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
     1 = missing goes RIGHT (evaluated only for cfg.missing_features, whose
     bin 0 holds the missing stats — upstream use_missing both-direction
     scan). feature_mask may be [F] (shared across slots) or [L, F]
-    (per-slot, used by the voting-parallel learner). Invalid cells
-    (min_data / min_hessian / masked features) are _NEG_INF. Reference
-    semantics: LightGBM FeatureHistogram::FindBestThreshold(Categorical),
-    driven from TrainUtils.scala:220-315.
+    (per-slot, used by the voting-parallel learner). miss_mask overrides
+    the cfg-derived missing-feature mask when the feature axis is NOT the
+    global one (the voting learner passes is_miss[sel], [L, k], aligned
+    with its per-slot voted features). Invalid cells (min_data /
+    min_hessian / masked features) are _NEG_INF. Reference semantics:
+    LightGBM FeatureHistogram::FindBestThreshold(Categorical), driven from
+    TrainUtils.scala:220-315.
     """
     l, f, b, _ = hists.shape
     cat = cfg.categorical_features
@@ -261,11 +271,14 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
         ok0 = ok0 & (~is_cat[None, :, None]
                      | (prefix_len <= cfg.max_cat_threshold))
     if miss:
-        is_miss = jnp.zeros((f,), bool).at[jnp.asarray(miss)].set(True)
+        if miss_mask is None:
+            miss_mask = _miss_mask_global(f, miss)
+        im = (miss_mask[None, :, None] if miss_mask.ndim == 1
+              else miss_mask[:, :, None])
         bin_ge1 = (jnp.arange(b) >= 1)[None, None, :]
         # bin 0 is the reserved missing bin: value splits start at b >= 1 (a
         # missing-only left side is not expressible as a value threshold)
-        ok0 = ok0 & (~is_miss[None, :, None] | bin_ge1)
+        ok0 = ok0 & (~im | bin_ge1)
         # direction 1: missing stats (bin 0) move to the right side
         h0 = hists[:, :, 0, :]                           # [L,F,3]
         lg1 = left_g - h0[..., 0][:, :, None]
@@ -273,7 +286,7 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
         ln1 = left_n - h0[..., 2][:, :, None]
         gain1 = gain_of(lg1, lh1)
         ok1 = (ok_of(ln1, lh1, tot_n - ln1, tot_h - lh1)
-               & is_miss[None, :, None] & bin_ge1)
+               & im & bin_ge1)
         g1 = jnp.where(ok1, gain1, _NEG_INF)
     else:
         g1 = jnp.full((l, f, b), _NEG_INF)
@@ -281,7 +294,7 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
 
 
 def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask,
-                         hp: "HParams"):
+                         hp: "HParams", miss_mask=None):
     """Vectorized split-gain scan over [L, F, B, 2] gain tables.
 
     Returns per-slot (best_gain [L], best_feat [L], best_bin [L],
@@ -290,7 +303,7 @@ def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask,
     subset mask.
     """
     l, f, b, _ = hists.shape
-    gain = _split_gain_table(hists, sums, cfg, feature_mask, hp)
+    gain = _split_gain_table(hists, sums, cfg, feature_mask, hp, miss_mask)
     flat = gain.reshape(l, f * b * 2)
     best_idx = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
@@ -350,12 +363,6 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         raise NotImplementedError(
             "lazy histogram refresh does not compose with voting_parallel "
             "(votes must be recast per split); use data_parallel")
-    if voting and cfg.missing_features:
-        raise NotImplementedError(
-            "voting_parallel does not support learned missing directions "
-            "(the voted per-slot feature subsets don't compose with global "
-            "missing-feature indices); use parallelism='data_parallel' or "
-            "set useMissing=False for the legacy NaN-to-lowest-bin behavior")
     lazy = cfg.split_refresh == "lazy"
     if cfg.split_scan not in ("full", "compact"):
         raise ValueError(
@@ -426,8 +433,11 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         _, sel = jax.lax.top_k(votes, k_top)      # [L,k] voted features
         hist_v = psum_(jnp.take_along_axis(
             local, sel[:, :, None, None], axis=1))           # [L,k,B,3]
+        # voted feature axis: per-slot masks must be gathered through sel
+        # (global [F] masks don't align with the [L, k] voted columns)
         gains, f_idx, bins_, dls = _best_split_per_slot(
-            hist_v, sums, cfg, feature_mask[sel], hp)
+            hist_v, sums, cfg, feature_mask[sel], hp,
+            miss_mask=(is_miss_f[sel] if miss else None))
         feats = jnp.take_along_axis(sel, f_idx[:, None], axis=1)[:, 0]
         return hist_v, sums, gains, feats.astype(jnp.int32), bins_, dls
 
@@ -443,8 +453,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     s_dl = jnp.ones((lcap - 1,), bool)   # learned default direction
     done = jnp.array(False)
     miss = cfg.missing_features
-    is_miss_f = (jnp.zeros((f,), bool).at[jnp.asarray(miss)].set(True)
-                 if miss else None)
+    is_miss_f = _miss_mask_global(f, miss) if miss else None
 
     if not voting:
         # data_parallel keeps GLOBAL histograms in the loop carry: the local
@@ -1133,8 +1142,6 @@ def make_train_fn(cfg: GBDTConfig):
 
     rf = cfg.boosting_type == "rf"
     dart = cfg.boosting_type == "dart"
-    if dart and multiclass:
-        raise NotImplementedError("dart mode is single-output only for now")
 
     def _env(binned, y, w_all, is_train, init_margin, group_idx, hp):
         """Shared setup: init score, starting margins, and the per-iteration
@@ -1200,14 +1207,24 @@ def make_train_fn(cfg: GBDTConfig):
 
             if dart:
                 # DART (Rashmi & Gilad-Bachrach): drop a random subset of prior
-                # trees, fit the residual, rescale new tree by 1/(k+1) and the
-                # dropped ones by k/(k+1).
+                # ITERATIONS, fit the residual, rescale new trees by 1/(k+1)
+                # and the dropped ones by k/(k+1). Multiclass drops whole
+                # iterations (all num_class trees together), matching
+                # LightGBM's DART at num_tree_per_iteration granularity;
+                # deltas carries [T, N, K] per-iteration score deltas.
                 drop = (jax.random.bernoulli(k_drop, cfg.drop_rate, (t_cap,))
                         & (jnp.arange(t_cap) < it))
+                # skip_drop: with this probability the iteration runs as a
+                # plain gbdt step (no trees dropped) — LightGBM skip_drop,
+                # default 0.5. fold_in keeps the 4-way key split (and thus
+                # every non-dart PRNG stream) unchanged.
+                skip = (jax.random.uniform(jax.random.fold_in(k_drop, 7), ())
+                        < cfg.skip_drop)
+                drop = drop & ~skip
                 kdrop = drop.sum().astype(jnp.float32)
-                drop_sum = jnp.einsum("t,tn->n", drop.astype(jnp.float32),
-                                      deltas)
-                grad_scores = scores - drop_sum[:, None]
+                drop_sum = jnp.einsum("t,tnk->nk", drop.astype(jnp.float32),
+                                      deltas)                     # [N, K]
+                grad_scores = scores - drop_sum
             else:
                 grad_scores = scores0 if rf else scores
                 drop = None
@@ -1277,20 +1294,23 @@ def make_train_fn(cfg: GBDTConfig):
             if multiclass:
                 tree, delta = jax.vmap(build_for_class, in_axes=(1, 1),
                                        out_axes=(0, 0))(g, h)
-                scores = scores + delta.T
-            elif dart:
-                tree, delta = build_for_class(g[:, 0], h[:, 0])
-                norm = 1.0 / (kdrop + 1.0)
-                # rescale dropped trees in place and store the new (scaled) delta
-                deltas = deltas * jnp.where(drop, kdrop * norm, 1.0)[:, None]
-                deltas = deltas.at[it].set(delta * norm)
-                tree_scale = tree_scale * jnp.where(drop, kdrop * norm, 1.0)
-                tree_scale = tree_scale.at[it].set(norm)
-                scores = scores + (delta * norm - drop_sum * (1.0 - kdrop * norm)
-                                   )[:, None]
+                delta_nk = delta.T                               # [N, K]
             else:
                 tree, delta = build_for_class(g[:, 0], h[:, 0])
-                scores = scores + delta[:, None]
+                delta_nk = delta[:, None]                        # [N, 1]
+            if dart:
+                norm = 1.0 / (kdrop + 1.0)
+                # rescale dropped iterations in place, store the new
+                # (scaled) per-class delta
+                deltas = deltas * jnp.where(drop, kdrop * norm,
+                                            1.0)[:, None, None]
+                deltas = deltas.at[it].set(delta_nk * norm)
+                tree_scale = tree_scale * jnp.where(drop, kdrop * norm, 1.0)
+                tree_scale = tree_scale.at[it].set(norm)
+                scores = scores + delta_nk * norm \
+                    - drop_sum * (1.0 - kdrop * norm)
+            else:
+                scores = scores + delta_nk
 
             ys = y if multiclass else yf
             if rf:
@@ -1307,8 +1327,8 @@ def make_train_fn(cfg: GBDTConfig):
                 vm = metric_of(sc, ys, w_valid)
             return (scores, deltas, tree_scale, key), (tree, tm, vm)
 
-        deltas0 = (jnp.zeros((t_cap, n), jnp.float32) if dart
-                   else jnp.zeros((1, 1), jnp.float32))
+        deltas0 = (jnp.zeros((t_cap, n, k if multiclass else 1), jnp.float32)
+                   if dart else jnp.zeros((1, 1, 1), jnp.float32))
         tree_scale0 = jnp.ones((t_cap,), jnp.float32)
         return step, scores0, init, deltas0, tree_scale0
 
@@ -1333,9 +1353,12 @@ def make_train_fn(cfg: GBDTConfig):
             step, (scores0, deltas0, tree_scale0, key),
             (jnp.arange(cfg.num_iterations), lr))
         if dart:
-            # bake final DART scales into the leaf values
-            trees = trees._replace(
-                leaf_value=trees.leaf_value * tree_scale[:, None])
+            # bake final DART scales into the leaf values; leaf_value is
+            # [T, L] single-output or [T, K, L] multiclass — the per-
+            # iteration scale broadcasts over every trailing axis
+            scale = tree_scale.reshape(
+                tree_scale.shape + (1,) * (trees.leaf_value.ndim - 1))
+            trees = trees._replace(leaf_value=trees.leaf_value * scale)
         init_out = jnp.full((k,), init) if multiclass else init
         return BoostResult(trees, init_out, train_m, valid_m)
 

@@ -49,10 +49,21 @@ class Benchmarks:
             self._write_golden()
             return
         golden = self._read_golden()
+        # MMLSPARK_BENCH_RECORD=1: append rows for genuinely-new gate names
+        # (several tests share one CSV, so whole-file record mode cannot
+        # cover a name added to just one of them). Off by default — an
+        # unknown name then FAILS, so a renamed/typo'd gate can't silently
+        # re-record itself alongside a regression.
+        record_new = bool(os.environ.get("MMLSPARK_BENCH_RECORD"))
         errors = []
+        new_rows = []
         for name, value, precision in self.entries:
             if name not in golden:
-                errors.append(f"{name}: no golden entry")
+                if record_new:
+                    new_rows.append((name, value, precision))
+                else:
+                    errors.append(f"{name}: no golden entry (run with "
+                                  f"MMLSPARK_BENCH_RECORD=1 to record)")
                 continue
             expected, tol = golden[name]
             if abs(value - expected) > tol:
@@ -61,3 +72,8 @@ class Benchmarks:
         if errors:
             raise AssertionError("benchmark regressions:\n" +
                                  "\n".join(errors))
+        if new_rows:
+            with open(self.csv_path, "a", newline="") as f:
+                w = csv.writer(f)
+                for name, value, precision in new_rows:
+                    w.writerow([name, f"{value:.6f}", precision])

@@ -30,6 +30,10 @@ def _dataset(seed, n=2000, f=12, kind="binary"):
     margin = x @ coef + 0.8 * x[:, 0] * x[:, 1] + np.sin(x[:, 2] * 2)
     if kind == "binary":
         y = (margin + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    elif kind == "multiclass":
+        noisy = margin + rng.normal(scale=0.5, size=n)
+        y = np.digitize(noisy, np.quantile(noisy, [1 / 3, 2 / 3])
+                        ).astype(np.float64)
     else:
         y = (margin + rng.normal(scale=0.3, size=n)).astype(np.float64)
     return DataFrame({"features": x, "label": y})
@@ -54,6 +58,20 @@ def test_lightgbm_classifier_golden():
         proba = model.transform(test)["probability"][:, 1]
         bench.add(f"auc_{name}_{boosting}",
                   auc_score(test["label"], proba), 0.02)
+    # multiclass x boosting-type rows (the reference grid covers multiclass
+    # with every boosting type incl. dart —
+    # benchmarks_VerifyLightGBMClassifier.csv)
+    for name, seed, boosting in (("synthmc", 606, "gbdt"),
+                                 ("synthmc", 606, "dart"),
+                                 ("synthmc", 606, "goss")):
+        df = _dataset(seed, kind="multiclass")
+        train, test = df.random_split([0.75, 0.25], seed=1)
+        clf = LightGBMClassifier(numIterations=50, numLeaves=31,
+                                 boostingType=boosting)
+        model = clf.fit(train)
+        pred = model.transform(test)["prediction"]
+        acc = float(np.mean(pred == test["label"]))
+        bench.add(f"acc_{name}_{boosting}", acc, 0.02)
     bench.verify()
 
 

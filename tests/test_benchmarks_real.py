@@ -100,7 +100,7 @@ class TestMulticlassAccuracy:
         for name, loader in (("wine", load_wine), ("iris", load_iris)):
             data = loader()
             train, test = _split(data.data, data.target, seed=11)
-            for boosting in ("gbdt", "goss"):
+            for boosting in ("gbdt", "goss", "dart"):
                 clf = LightGBMClassifier(numIterations=40, numLeaves=15,
                                          minDataInLeaf=5,
                                          boostingType=boosting)

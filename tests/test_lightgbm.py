@@ -441,6 +441,35 @@ class TestVotingParallel:
         a = auc(binary_df["label"], np.stack(out["probability"])[:, 1])
         assert a > 0.9, f"voting_parallel train AUC {a}"
 
+    def test_voting_with_missing_directions(self, binary_df):
+        """voting_parallel x learned missing directions (round-3 verdict #8:
+        LightGBM's C++ composes voting with use_missing). With topK >= F the
+        voted scan must match data_parallel EXACTLY on NaN data."""
+        x = np.array(np.asarray(binary_df["features"]))
+        rng = np.random.default_rng(9)
+        x[rng.random(x.shape) < 0.15] = np.nan
+        from mmlspark_tpu import DataFrame
+        df = DataFrame({"features": x,
+                        "label": np.asarray(binary_df["label"])})
+        f = x.shape[1]
+        dp = LightGBMClassifier(numIterations=8, numLeaves=7, numTasks=8,
+                                seed=5).fit(df)
+        vp = LightGBMClassifier(numIterations=8, numLeaves=7, numTasks=8,
+                                parallelism="voting_parallel", topK=f,
+                                seed=5).fit(df)
+        assert np.asarray(dp.booster.trees.split_default_left).any(), \
+            "fixture must exercise learned directions"
+        np.testing.assert_allclose(dp.booster.raw_predict(x[:800]),
+                                   vp.booster.raw_predict(x[:800]),
+                                   rtol=1e-4, atol=1e-4)
+        # small topK: quality holds with NaN features present
+        vp3 = LightGBMClassifier(numIterations=20, numLeaves=15, numTasks=8,
+                                 parallelism="voting_parallel", topK=3,
+                                 seed=5).fit(df)
+        out = vp3.transform(df)
+        a = auc(df["label"], np.stack(out["probability"])[:, 1])
+        assert a > 0.85, f"voting+missing AUC {a}"
+
     def test_voting_rejects_categoricals(self, binary_df):
         import pytest
         with pytest.raises(ValueError, match="voting_parallel"):

@@ -52,6 +52,37 @@ def test_dart_mode(binary_df):
     assert a > 0.9, f"dart AUC {a}"
 
 
+def test_dart_multiclass(multiclass_df):
+    """dart x multiclass (reference benchmark grid covers it,
+    benchmarks_VerifyLightGBMClassifier.csv multiclass x dart rows): whole
+    iterations — all K class trees together — are dropped, matching
+    LightGBM's num_tree_per_iteration dropout granularity."""
+    model = LightGBMClassifier(boostingType="dart", numIterations=20,
+                               numLeaves=15, numTasks=1, seed=4,
+                               dropRate=0.2).fit(multiclass_df)
+    out = model.transform(multiclass_df)
+    acc = (out["prediction"] == multiclass_df["label"]).mean()
+    assert acc > 0.85, f"dart multiclass acc {acc}"
+    probs = np.stack(out["probability"])
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_dart_skip_drop_one_equals_gbdt(binary_df, multiclass_df):
+    """skipDrop=1.0 skips dropout every iteration: dart must reproduce
+    plain gbdt EXACTLY (scale bookkeeping must be a no-op, single-output
+    and multiclass both)."""
+    for df in (binary_df, multiclass_df):
+        g = LightGBMClassifier(numIterations=8, numLeaves=7, numTasks=1,
+                               seed=3).fit(df)
+        d = LightGBMClassifier(boostingType="dart", skipDrop=1.0,
+                               numIterations=8, numLeaves=7, numTasks=1,
+                               seed=3).fit(df)
+        x = np.asarray(df["features"])[:500]
+        np.testing.assert_allclose(d.booster.raw_predict(x),
+                                   g.booster.raw_predict(x),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_warm_start_model_string(binary_df):
     base = LightGBMClassifier(numIterations=10, numTasks=1, seed=2)
     m1 = base.fit(binary_df)
