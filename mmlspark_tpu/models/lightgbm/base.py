@@ -902,6 +902,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 "itersPerCall/checkpointDir are not supported with "
                 "boostingType='dart' (dart dropout needs the full "
                 "prior-tree delta history inside one compiled program)")
+        if rounds and has_valid and self.get("boostingType") == "dart":
+            raise ValueError(
+                "earlyStoppingRound is not supported with "
+                "boostingType='dart' (matching upstream LightGBM: dropped-"
+                "tree rescaling makes a truncated-at-best-iteration model "
+                "inconsistent, and the halt needs chunked training)")
         # _iters_override feeds ONLY _run_chunked's trip count (the resume
         # path is always chunked); cfg.num_iterations stays the full value
         # and run_full is never used with a checkpointDir, so no compiled

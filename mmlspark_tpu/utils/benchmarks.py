@@ -54,7 +54,8 @@ class Benchmarks:
         # cover a name added to just one of them). Off by default — an
         # unknown name then FAILS, so a renamed/typo'd gate can't silently
         # re-record itself alongside a regression.
-        record_new = bool(os.environ.get("MMLSPARK_BENCH_RECORD"))
+        record_new = os.environ.get("MMLSPARK_BENCH_RECORD",
+                                    "").lower() in ("1", "true")
         errors = []
         new_rows = []
         for name, value, precision in self.entries:

@@ -52,6 +52,19 @@ def test_dart_mode(binary_df):
     assert a > 0.9, f"dart AUC {a}"
 
 
+def test_dart_rejects_early_stopping(binary_df):
+    """Matching upstream LightGBM: early stopping is unavailable in dart
+    (truncating at best_iteration is inconsistent with dropped-tree
+    rescaling). Must raise, not silently train every iteration."""
+    import pytest as _pt
+    df = binary_df.with_column(
+        "val", (np.arange(len(binary_df)) % 5 == 0).astype(np.float64))
+    with _pt.raises(ValueError, match="earlyStoppingRound"):
+        LightGBMClassifier(boostingType="dart", numIterations=8,
+                           earlyStoppingRound=3, numTasks=1,
+                           validationIndicatorCol="val").fit(df)
+
+
 def test_dart_multiclass(multiclass_df):
     """dart x multiclass (reference benchmark grid covers it,
     benchmarks_VerifyLightGBMClassifier.csv multiclass x dart rows): whole
