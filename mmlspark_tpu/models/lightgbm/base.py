@@ -927,9 +927,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 return prev
             if resume_trees:
                 self._iters_override = remaining
-        use_chunked = ((delegate is not None or (rounds and has_valid)
-                        or bool(ipc) or bool(ckdir))
-                       and self.get("boostingType") != "dart")
+        # every chunk trigger raises above when boostingType='dart', so no
+        # dart fit can reach the chunked path
+        use_chunked = (delegate is not None or (rounds and has_valid)
+                       or bool(ipc) or bool(ckdir))
 
         hp_batch = getattr(self, "_hp_batch", None)
         if hp_batch is not None and ckdir:

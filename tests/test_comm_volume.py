@@ -13,8 +13,6 @@ walk every nested jaxpr (scan/while/cond bodies), and collect the
 shard-local operand shape of every psum-family primitive.
 """
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
