@@ -17,6 +17,7 @@ Sub-ms p50 needs the compiled program resident: warm it with `warmup()`.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import queue
 import threading
@@ -32,15 +33,38 @@ from ..core.pipeline import Transformer
 
 
 class _PendingRequest:
-    __slots__ = ("rid", "body", "headers", "path", "event", "response")
+    __slots__ = ("rid", "body", "headers", "path", "event", "response",
+                 "_loop", "_fut")
 
-    def __init__(self, rid, body, headers, path):
+    def __init__(self, rid, body, headers, path, loop=None, fut=None):
         self.rid = rid
         self.body = body
         self.headers = headers
         self.path = path
         self.event = threading.Event()
         self.response: Optional[Dict[str, Any]] = None
+        # asyncio completion route: the dispatcher thread resolves the
+        # connection coroutine's future via its event loop instead of an
+        # Event the socket thread would block on
+        self._loop = loop
+        self._fut = fut
+
+    def complete(self, response: Dict[str, Any]) -> None:
+        """Deliver the reply to whichever listener produced this request
+        (threaded: Event; asyncio: future on the listener's loop)."""
+        self.response = response
+        if self._loop is not None:
+            def _set():
+                if not self._fut.done():
+                    self._fut.set_result(response)
+            try:
+                self._loop.call_soon_threadsafe(_set)
+            except RuntimeError:
+                # listener shut down mid-batch: the client is gone, and the
+                # dispatcher must not die delivering to a closed loop
+                pass
+        else:
+            self.event.set()
 
 
 def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
@@ -83,6 +107,152 @@ def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
         daemon_threads = True
 
     return Server((host, port), Handler)
+
+
+class _AsyncListener:
+    """Persistent-connection asyncio HTTP front door (round-3 verdict #6).
+
+    The threaded listener pays a thread handoff + Event wakeup + a fresh
+    TCP connection per request (~1.8 ms p50 through http.server). This one
+    keeps HTTP/1.1 connections open, parses requests with two buffered
+    reads (header block, then exact body), and parks each request on an
+    asyncio future the dispatcher resolves via call_soon_threadsafe — the
+    per-executor long-lived server role of the reference's continuous mode
+    (DistributedHTTPSource.scala:89-202, continuous/HTTPSourceV2.scala),
+    with sub-ms localhost round-trips (tests/test_serving_latency.py).
+    """
+
+    def __init__(self, enqueue: Callable[["_PendingRequest"], None],
+                 request_timeout: float, host: str, port: int):
+        self._enqueue = enqueue
+        self._timeout = request_timeout
+        self.host, self.port = host, port
+        self._loop = None
+        self._server = None
+        self._thread = None
+        self._started = threading.Event()
+
+    async def _handle_conn(self, reader, writer):
+        import socket as _socket
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            # no Nagle delay on tiny JSON replies
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        loop = self._loop
+        reasons = {200: b"OK", 400: b"Bad Request",
+                   500: b"Internal Server Error", 501: b"Not Implemented",
+                   504: b"Gateway Timeout"}
+
+        def status_line(code):
+            return b"HTTP/1.1 %d %s\r\n" % (code, reasons.get(code, b"OK"))
+
+        try:
+            while True:
+                # malformed/truncated/oversized requests close the
+                # connection (or reply 4xx) instead of leaking a task
+                # exception into the asyncio log
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError, ConnectionResetError,
+                        asyncio.LimitOverrunError):
+                    return
+                lines = head.decode("latin1").split("\r\n")
+                parts = lines[0].split(" ")
+                method = parts[0].upper() if parts else ""
+                path = parts[1] if len(parts) > 1 else "/"
+                length = 0
+                keep_alive = True
+                headers = {}
+                try:
+                    for ln in lines[1:]:
+                        if not ln:
+                            continue
+                        k, _, v = ln.partition(":")
+                        headers[k.strip()] = v.strip()
+                        kl = k.strip().lower()
+                        if kl == "content-length":
+                            length = int(v)
+                        elif kl == "connection" and "close" in v.lower():
+                            keep_alive = False
+                except ValueError:
+                    writer.write(status_line(400)
+                                 + b"Content-Length: 0\r\n\r\n")
+                    await writer.drain()
+                    return
+                try:
+                    body = (await reader.readexactly(length)
+                            if length else b"")
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                if method != "POST":
+                    # health probes etc. must not reach the inference
+                    # batcher (matches the threaded listener's POST-only
+                    # handler)
+                    writer.write(status_line(501)
+                                 + b"Content-Length: 0\r\n\r\n")
+                    await writer.drain()
+                    if not keep_alive:
+                        return
+                    continue
+                fut = loop.create_future()
+                pend = _PendingRequest(str(_uuid.uuid4()), body, headers,
+                                       path, loop=loop, fut=fut)
+                self._enqueue(pend)
+                try:
+                    resp = await asyncio.wait_for(fut, self._timeout)
+                except asyncio.TimeoutError:
+                    writer.write(status_line(504)
+                                 + b"Content-Length: 0\r\n\r\n")
+                    await writer.drain()
+                    continue
+                rb = resp["body"]
+                writer.write(
+                    status_line(resp["status"])
+                    + b"Content-Type: application/json\r\n"
+                    b"Content-Length: %d\r\n\r\n%s" % (len(rb), rb))
+                await writer.drain()
+                if not keep_alive:
+                    return
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _serve():
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+            async with self._server:
+                await self._server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(_serve())
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._loop.close()
+
+    def start(self) -> "_AsyncListener":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("asyncio listener failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None and self._loop.is_running():
+            def _shutdown():
+                if self._server is not None:
+                    self._server.close()
+                for task in asyncio.all_tasks(self._loop):
+                    task.cancel()
+            self._loop.call_soon_threadsafe(_shutdown)
 
 
 def parse_request(requests: List[_PendingRequest],
@@ -136,7 +306,7 @@ class ServingServer:
                  reply_col: str = "prediction", host: str = "127.0.0.1",
                  port: int = 8899, max_batch_size: int = 64,
                  max_latency_ms: float = 5.0, request_timeout: float = 30.0,
-                 vector_cols=()):
+                 vector_cols=(), listener: str = "asyncio"):
         self.handler = handler
         self.reply_col = reply_col
         self.host, self.port = host, port
@@ -144,24 +314,37 @@ class ServingServer:
         self.max_latency_ms = max_latency_ms
         self.request_timeout = request_timeout
         self.vector_cols = tuple(vector_cols)
+        if listener not in ("asyncio", "thread"):
+            raise ValueError(f"listener must be 'asyncio' or 'thread', "
+                             f"got {listener!r}")
+        self.listener = listener
         self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._alistener: Optional[_AsyncListener] = None
         self._threads: List[threading.Thread] = []
         self.stats = {"requests": 0, "batches": 0, "errors": 0}
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingServer":
-        self._httpd = _make_http_listener(self._queue.put,
-                                          self.request_timeout,
-                                          self.host, self.port)
-        self.port = self._httpd.server_address[1]  # resolve port 0
-        t_http = threading.Thread(target=self._httpd.serve_forever,
-                                  daemon=True)
+        if self.listener == "asyncio":
+            # persistent-connection listener: the sub-ms HTTP path
+            self._alistener = _AsyncListener(self._queue.put,
+                                             self.request_timeout,
+                                             self.host, self.port).start()
+            self.port = self._alistener.port
+        else:
+            self._httpd = _make_http_listener(self._queue.put,
+                                              self.request_timeout,
+                                              self.host, self.port)
+            self.port = self._httpd.server_address[1]  # resolve port 0
+            t_http = threading.Thread(target=self._httpd.serve_forever,
+                                      daemon=True)
+            t_http.start()
+            self._threads.append(t_http)
         t_disp = threading.Thread(target=self._dispatch_loop, daemon=True)
-        t_http.start()
         t_disp.start()
-        self._threads = [t_http, t_disp]
+        self._threads.append(t_disp)
         return self
 
     def stop(self) -> None:
@@ -169,6 +352,8 @@ class ServingServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self._alistener:
+            self._alistener.stop()
 
     @property
     def url(self) -> str:
@@ -231,14 +416,12 @@ class ServingServer:
             scored = self.handler(df.drop("id"))
             replies = make_reply(scored, self.reply_col)[:n]
             for pend, body in zip(batch, replies):
-                pend.response = {"status": 200, "body": body}
-                pend.event.set()
+                pend.complete({"status": 200, "body": body})
         except Exception as e:  # reply 500 to the whole batch
             self.stats["errors"] += len(batch)
             body = json.dumps({"error": str(e)}).encode()
             for pend in batch:
-                pend.response = {"status": 500, "body": body}
-                pend.event.set()
+                pend.complete({"status": 500, "body": body})
 
 
 class HTTPStreamSource:
@@ -341,9 +524,8 @@ class HTTPStreamSource:
         responding must not leave clients hanging until timeout."""
         err = json.dumps({"error": "no reply produced"}).encode()
         for pend in self._staged:
-            pend.response = self._replies.get(
-                pend.rid, {"status": 500, "body": err})
-            pend.event.set()
+            pend.complete(self._replies.get(
+                pend.rid, {"status": 500, "body": err}))
         self._staged = []
         self._replies = {}
 

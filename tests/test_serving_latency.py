@@ -1,0 +1,229 @@
+"""Sub-millisecond HTTP serving (round-3 verdict #6).
+
+The reference's continuous-mode claim is sub-millisecond request handling
+through per-executor JVM HTTP servers (README.md:23, docs/mmlspark-
+serving.md:93, DistributedHTTPSource.scala:89-202). The asyncio
+persistent-connection listener must deliver that over REAL localhost HTTP
+round-trips — not just the in-process serve_direct path.
+
+Timing note: this asserts wall-clock behavior on a shared 1-vCPU host, so
+the gate takes the best of 3 measurement rounds (scheduler noise damping,
+same discipline as bench.py's min-of-fits) and a numpy-only handler (model
+cost is measured separately in docs/SERVING.md; this test isolates the
+HTTP framing + batcher overhead the verdict called out).
+"""
+
+import json
+import socket
+import time
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.io.serving import ServingServer
+
+
+def _handler(df: DataFrame) -> DataFrame:
+    x = np.asarray(df["x"], np.float64)
+    return df.with_column("prediction", x * 2.0 + 1.0)
+
+
+class _KeepAliveClient:
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.host = host
+        self.buf = b""
+
+    def request(self, body: bytes) -> bytes:
+        req = (b"POST / HTTP/1.1\r\nHost: %s\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Content-Length: %d\r\n\r\n%s"
+               % (self.host.encode(), len(body), body))
+        self.sock.sendall(req)
+        while b"\r\n\r\n" not in self.buf:
+            self.buf += self.sock.recv(65536)
+        head, _, rest = self.buf.partition(b"\r\n\r\n")
+        length = 0
+        for ln in head.split(b"\r\n"):
+            if ln.lower().startswith(b"content-length:"):
+                length = int(ln.split(b":", 1)[1])
+        while len(rest) < length:
+            rest += self.sock.recv(65536)
+        self.buf = rest[length:]
+        return rest[:length]
+
+    def close(self):
+        self.sock.close()
+
+
+def test_http_round_trip_sub_ms():
+    srv = ServingServer(_handler, reply_col="prediction",
+                        max_batch_size=8, max_latency_ms=0.0,
+                        port=0).start()
+    try:
+        cli = _KeepAliveClient("127.0.0.1", srv.port)
+        body = json.dumps({"x": 3.0}).encode()
+        out = json.loads(cli.request(body))
+        assert out["prediction"] == 7.0
+        best_p50 = best_p99 = float("inf")
+        for _ in range(3):                      # best-of-3: scheduler noise
+            for _ in range(50):                 # warm
+                cli.request(body)
+            lat = []
+            for _ in range(300):
+                t0 = time.perf_counter()
+                cli.request(body)
+                lat.append(time.perf_counter() - t0)
+            lat = np.sort(lat)
+            best_p50 = min(best_p50, float(lat[len(lat) // 2]))
+            best_p99 = min(best_p99, float(lat[int(len(lat) * 0.99)]))
+        print(f"HTTP keep-alive p50 {best_p50*1e3:.3f} ms "
+              f"p99 {best_p99*1e3:.3f} ms")
+        assert best_p99 < 1e-3, (
+            f"p99 {best_p99*1e3:.3f} ms >= 1 ms (p50 {best_p50*1e3:.3f})")
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_async_listener_concurrent_clients_and_batching():
+    srv = ServingServer(_handler, reply_col="prediction",
+                        max_batch_size=16, max_latency_ms=2.0,
+                        port=0).start()
+    try:
+        import concurrent.futures as cf
+
+        def one_client(i):
+            cli = _KeepAliveClient("127.0.0.1", srv.port)
+            outs = []
+            for j in range(20):
+                v = float(i * 100 + j)
+                r = json.loads(cli.request(
+                    json.dumps({"x": v}).encode()))
+                outs.append((v, r["prediction"]))
+            cli.close()
+            return outs
+
+        with cf.ThreadPoolExecutor(8) as ex:
+            for outs in ex.map(one_client, range(8)):
+                for v, p in outs:
+                    assert p == v * 2.0 + 1.0, (v, p)
+        assert srv.stats["errors"] == 0
+        # concurrent keep-alive clients must actually coalesce into batches
+        assert srv.stats["batches"] < srv.stats["requests"]
+    finally:
+        srv.stop()
+
+
+def test_async_listener_connection_close_and_errors():
+    def bad_handler(df):
+        raise RuntimeError("boom")
+
+    srv = ServingServer(bad_handler, reply_col="prediction",
+                        max_latency_ms=0.0, port=0).start()
+    try:
+        cli = _KeepAliveClient("127.0.0.1", srv.port)
+        # errors reply 500 with a JSON body, connection stays usable
+        body = cli.request(json.dumps({"x": 1.0}).encode())
+        assert b"boom" in body
+        body2 = cli.request(json.dumps({"x": 2.0}).encode())
+        assert b"boom" in body2
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_async_listener_rejects_non_post_and_bad_requests():
+    srv = ServingServer(_handler, reply_col="prediction",
+                        max_latency_ms=0.0, port=0).start()
+    try:
+        # GET never reaches the batcher: 501, connection stays usable
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert b"501 Not Implemented" in s.recv(65536)
+        s.sendall(b"POST / HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: 10\r\n\r\n" + json.dumps({"x": 1.0})[:10]
+                  .encode())
+        assert b"200 OK" in s.recv(65536)
+        s.close()
+        # malformed Content-Length: 400, then server closes
+        s2 = socket.create_connection(("127.0.0.1", srv.port))
+        s2.sendall(b"POST / HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Length: abc\r\n\r\n")
+        assert b"400 Bad Request" in s2.recv(65536)
+        s2.close()
+        # truncated body then disconnect: server must survive
+        s3 = socket.create_connection(("127.0.0.1", srv.port))
+        s3.sendall(b"POST / HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Length: 100\r\n\r\nshort")
+        s3.close()
+        cli = _KeepAliveClient("127.0.0.1", srv.port)
+        assert json.loads(cli.request(
+            json.dumps({"x": 4.0}).encode()))["prediction"] == 9.0
+        cli.close()
+        assert srv.stats["requests"] >= 2
+    finally:
+        srv.stop()
+
+
+def test_error_status_line_has_correct_reason():
+    def bad_handler(df):
+        raise RuntimeError("kaput")
+
+    srv = ServingServer(bad_handler, max_latency_ms=0.0, port=0).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        body = json.dumps({"x": 1.0}).encode()
+        s.sendall(b"POST / HTTP/1.1\r\nHost: x\r\nContent-Length: %d\r\n\r\n"
+                  % len(body) + body)
+        raw = s.recv(65536)
+        assert raw.startswith(b"HTTP/1.1 500 Internal Server Error"), raw[:60]
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_stop_during_inflight_batch_does_not_kill_dispatcher():
+    import threading
+    release = threading.Event()
+    thread_errors = []
+    orig_hook = threading.excepthook
+    threading.excepthook = lambda args: thread_errors.append(args)
+    try:
+        def slow_handler(df):
+            release.wait(5)
+            return _handler(df)
+
+        srv = ServingServer(slow_handler, reply_col="prediction",
+                            max_latency_ms=0.0, request_timeout=2.0,
+                            port=0).start()
+        cli = _KeepAliveClient("127.0.0.1", srv.port)
+        cli.sock.sendall(
+            b"POST / HTTP/1.1\r\nHost: x\r\nContent-Length: 10\r\n\r\n"
+            + json.dumps({"x": 1.0})[:10].encode())
+        time.sleep(0.2)      # dispatcher is now inside slow_handler
+        srv.stop()           # closes the listener loop mid-batch
+        release.set()        # batch completes against a closed loop
+        time.sleep(0.3)
+        # delivering to the closed loop must not raise out of any thread
+        assert not thread_errors, [str(e.exc_value) for e in thread_errors]
+        cli.close()
+    finally:
+        threading.excepthook = orig_hook
+
+
+def test_thread_listener_still_works():
+    srv = ServingServer(_handler, reply_col="prediction",
+                        listener="thread", max_latency_ms=0.0,
+                        port=0).start()
+    try:
+        import urllib.request
+        req = urllib.request.Request(
+            srv.url, data=json.dumps({"x": 5.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["prediction"] == 11.0
+    finally:
+        srv.stop()
