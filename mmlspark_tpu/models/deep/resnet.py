@@ -95,36 +95,81 @@ _ZOO: Dict[str, Callable[[], ModelSchema]] = {
         "ResNet18-ish", ResNet(stage_sizes=(1, 1, 1, 1)), (64, 64, 3),
         ["stage1", "stage2", "stage3", "stage4", "pool", "logits"],
         mean=(0.485, 0.456, 0.406), std=(0.229, 0.224, 0.225)),
+    "ResNet-Digits": lambda: ModelSchema(
+        # the BUNDLED pretrained anchor (scripts/train_zoo_checkpoint.py):
+        # two-stage bottleneck trained on sklearn digits 16x16x3 to the
+        # accuracy recorded in zoo/MANIFEST.json — the quality anchor the
+        # reference gets from its CNTK zoo (ModelDownloader.scala:27-250)
+        "ResNet-Digits", ResNet(stage_sizes=(1, 1), num_classes=10),
+        (16, 16, 3), ["stage1", "stage2", "pool", "logits"],
+        mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
 }
+
+_BUNDLED_ZOO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "zoo")
+
+
+def bundled_zoo_url() -> str:
+    """file:// URL of the in-repo pretrained-checkpoint zoo — served through
+    RemoteRepository so manifest + sha256 + caching run on the same code
+    path a remote zoo would use."""
+    return "file://" + _BUNDLED_ZOO_DIR
 
 
 class ModelDownloader:
-    """Zoo resolver (ModelDownloader.scala:27-250): weights come from a
-    remote repository (repo_url -> RemoteRepository with retry/timeout,
-    cache, sha256 — downloader.py), a local checkpoint (local_path), or a
-    deterministic init (neither set)."""
+    """Zoo resolver (ModelDownloader.scala:27-250). Weight sources, in
+    precedence order: a remote repository (repo_url -> RemoteRepository
+    with retry/timeout, cache, sha256 — downloader.py), a local checkpoint
+    (local_path), the BUNDLED in-repo zoo (models listed in
+    zoo/MANIFEST.json, served through the same RemoteRepository mechanism
+    via file://; `seed` is ignored for bundled weights), or the
+    deterministic seed init (pretrained=False, or no source has the
+    model)."""
 
     def __init__(self, local_path: Optional[str] = None,
                  repo_url: Optional[str] = None,
                  cache_dir: Optional[str] = None,
                  timeout_s: float = 60.0, retries: int = 3):
+        import tempfile
         self.local_path = local_path
+        self.cache_dir = cache_dir or os.path.join(
+            tempfile.gettempdir(), "mmlspark_tpu_models")
+        self.timeout_s = timeout_s
+        self.retries = retries
         self.repo = None
         if repo_url:
-            from .downloader import RemoteRepository
-            import tempfile
-            self.repo = RemoteRepository(
-                repo_url,
-                cache_dir or os.path.join(tempfile.gettempdir(),
-                                          "mmlspark_tpu_models"),
-                timeout_s=timeout_s, retries=retries)
+            self.repo = self._make_repo(repo_url)
+
+    def _make_repo(self, url: str):
+        from .downloader import RemoteRepository
+        return RemoteRepository(url, self.cache_dir,
+                                timeout_s=self.timeout_s,
+                                retries=self.retries)
+
+    def _bundled_checkpoint(self, name: str) -> Optional[str]:
+        """Path to a bundled pretrained checkpoint, or None. Membership is
+        checked against the local manifest first (plain json read) so
+        non-bundled models never pay a repository round-trip."""
+        import json
+        manifest = os.path.join(_BUNDLED_ZOO_DIR, "MANIFEST.json")
+        if not os.path.exists(manifest):
+            return None
+        with open(manifest) as f:
+            names = {m["name"] for m in json.load(f)}
+        if name not in names:
+            return None
+        return self._make_repo(bundled_zoo_url()).download_model(name)
 
     def list_models(self) -> Sequence[str]:
         if self.repo is not None:
             return sorted(m.name for m in self.repo.models())
         return sorted(_ZOO)
 
-    def download_by_name(self, name: str, seed: int = 0):
+    def download_by_name(self, name: str, seed: int = 0,
+                         pretrained: bool = True):
+        """pretrained=False skips every weight source (remote repo, local
+        checkpoint, bundled zoo) and returns the deterministic seed init —
+        the from-scratch baseline for transfer-learning comparisons."""
         from .dnn import GraphModel
         if name not in _ZOO:
             raise KeyError(f"unknown model {name!r}; have {sorted(_ZOO)}")
@@ -132,11 +177,16 @@ class ModelDownloader:
         h, w, c = schema.input_dims
         variables = schema.module.init(
             jax.random.PRNGKey(seed), jnp.zeros((1, h, w, c), jnp.float32))
-        if self.repo is not None:
-            ckpt = self.repo.download_model(name)
-            variables = load_params(ckpt, variables)
-        elif self.local_path:
-            variables = load_params(self.local_path, variables)
+        if pretrained:
+            if self.repo is not None:
+                variables = load_params(self.repo.download_model(name),
+                                        variables)
+            elif self.local_path:
+                variables = load_params(self.local_path, variables)
+            else:
+                ckpt = self._bundled_checkpoint(name)
+                if ckpt:
+                    variables = load_params(ckpt, variables)
         return GraphModel(module=schema.module, variables=variables,
                           schema=schema)
 

@@ -192,3 +192,78 @@ class TestEndToEndModelDownloader:
                 np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
         finally:
             srv.stop()
+
+
+class TestBundledZooAnchor:
+    """The in-repo pretrained checkpoint (round-3 verdict #5): the anchor the
+    reference gets from its CNTK zoo. scripts/train_zoo_checkpoint.py trained
+    ResNet-Digits to the accuracy recorded in zoo/MANIFEST.json; these gates
+    fail if the checkpoint regresses, fails to load, or stops beating
+    random-init features."""
+
+    def _digits(self):
+        from sklearn.datasets import load_digits
+        d = load_digits()
+        x8 = d.images.astype(np.float32) / 16.0
+        x = np.repeat(np.repeat(x8, 2, axis=1), 2, axis=2)
+        x = np.stack([x] * 3, axis=-1)
+        rng = np.random.default_rng(7)              # the TRAINING split seed
+        order = rng.permutation(len(d.target))
+        n_tr = int(0.8 * len(d.target))
+        return (x, d.target.astype(np.float64), order[:n_tr], order[n_tr:])
+
+    def test_bundled_checkpoint_classifies_digits(self):
+        """Loaded through the default (bundled file:// repo) path, the
+        model's own logits must reach the manifest's documented accuracy on
+        the held-out split."""
+        from mmlspark_tpu.models.deep.resnet import (ModelDownloader,
+                                                     _BUNDLED_ZOO_DIR)
+        manifest = json.load(open(os.path.join(_BUNDLED_ZOO_DIR,
+                                               "MANIFEST.json")))
+        doc_acc = [m for m in manifest
+                   if m["name"] == "ResNet-Digits"][0]["testAccuracy"]
+        gm = ModelDownloader().download_by_name("ResNet-Digits")
+        x, y, _, te = self._digits()
+        import jax.numpy as jnp
+        logits = np.asarray(gm.module.apply(
+            gm.variables, jnp.asarray((x[te] - 0.5) / 0.5)))
+        acc = float((logits.argmax(1) == y[te]).mean())
+        assert acc >= doc_acc - 0.01, (acc, doc_acc)
+
+    def test_featurize_then_train_classifier_beats_random_init(self):
+        """ImageFeaturizer(pretrained) -> TrainClassifier transfer gate
+        (ref image/ImageFeaturizer.scala:40-191 + BASELINE configs[3]):
+        pooled pretrained features must train a markedly better classifier
+        than random-init features on a small budget."""
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.models.deep import ImageFeaturizer
+        from mmlspark_tpu.models.deep.resnet import ModelDownloader
+        from mmlspark_tpu.train import TrainClassifier
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+        x, y, tr, te = self._digits()
+        tr, te = tr[:240], te[:120]          # small transfer budget
+        accs = {}
+        for tag, seed_model in (
+                ("pretrained",
+                 ModelDownloader().download_by_name("ResNet-Digits")),
+                ("random",
+                 # SAME architecture, seed init: isolates pretraining from
+                 # architecture in the comparison
+                 ModelDownloader().download_by_name("ResNet-Digits", seed=1,
+                                                    pretrained=False))):
+            feat = ImageFeaturizer(model=seed_model, cutOutputLayers=1,
+                                   inputCol="image", outputCol="features",
+                                   batchSize=120)
+            df_tr = feat.transform(DataFrame({
+                "image": x[tr], "label": y[tr]})).drop("image")
+            df_te = feat.transform(DataFrame({"image": x[te]})).drop("image")
+            clf = TrainClassifier(
+                model=LightGBMClassifier(numIterations=30, numLeaves=15,
+                                         numTasks=1),
+                labelCol="label").fit(df_tr)
+            pred = clf.transform(df_te)["scored_labels"]
+            accs[tag] = float((np.asarray(pred, np.float64)
+                               == y[te]).mean())
+        assert accs["pretrained"] >= 0.93, accs
+        assert accs["pretrained"] >= accs["random"] + 0.05, accs
