@@ -29,15 +29,36 @@ from ..core.pipeline import Estimator, Model
 
 @partial(jax.jit, static_argnames=("rank", "n_out"))
 def _als_step(other_factors, rows, cols, vals, n_out, reg, rank: int):
-    """One ALS half-step: solve factors for every `row` id given the other
-    side's factors. Normal equations accumulated by segment-sum, solved by a
-    vmapped linear solve."""
+    """One explicit-feedback ALS half-step: solve factors for every `row` id
+    given the other side's factors. Normal equations accumulated by
+    segment-sum, solved by a vmapped linear solve."""
     f = other_factors[cols]                              # [nnz, r]
     ata = jnp.einsum("ni,nj->nij", f, f)                 # [nnz, r, r]
     atb = f * vals[:, None]                              # [nnz, r]
     gram = jax.ops.segment_sum(ata, rows, n_out)         # [n, r, r]
     rhs = jax.ops.segment_sum(atb, rows, n_out)          # [n, r]
     gram = gram + reg * jnp.eye(rank)[None]
+    return jax.vmap(jnp.linalg.solve)(gram, rhs)
+
+
+@partial(jax.jit, static_argnames=("rank", "n_out"))
+def _als_step_implicit(other_factors, rows, cols, conf, n_out, reg,
+                       rank: int):
+    """One implicit-feedback ALS half-step (Hu/Koren/Volinsky, the
+    reference's applyImplicitCf=True default — Spark ALS implicitPrefs):
+    minimize sum_ui c_ui (p_ui - x_u . y_i)^2 + reg ||x||^2 with preference
+    p=1 for observed pairs (0 elsewhere) and confidence c = 1 + alpha * r
+    for observed (1 elsewhere). Normal equations per user:
+    (Y^T Y + Y_obs^T diag(c-1) Y_obs + reg I) x = Y_obs^T c — the dense
+    all-items Y^T Y background term is one [r, r] matmul, the observed
+    correction a segment-sum over nnz."""
+    f = other_factors[cols]                              # [nnz, r]
+    gram_bg = other_factors.T @ other_factors            # [r, r]
+    cm1 = conf - 1.0
+    ata = jnp.einsum("n,ni,nj->nij", cm1, f, f)          # [nnz, r, r]
+    gram = (jax.ops.segment_sum(ata, rows, n_out)
+            + gram_bg[None] + reg * jnp.eye(rank)[None])
+    rhs = jax.ops.segment_sum(f * conf[:, None], rows, n_out)
     return jax.vmap(jnp.linalg.solve)(gram, rhs)
 
 
@@ -58,9 +79,39 @@ class AccessAnomaly(Estimator):
     outputCol = _p.Param("outputCol", "anomaly score column",
                          "anomaly_score")
     rankParam = _p.Param("rankParam", "latent dimension", 10, int)
-    maxIter = _p.Param("maxIter", "ALS sweeps", 10, int)
-    regParam = _p.Param("regParam", "ridge regularization", 0.1, float)
+    maxIter = _p.Param("maxIter", "ALS sweeps", 25, int)
+    regParam = _p.Param("regParam", "ridge regularization", 1.0, float)
     seed = _p.Param("seed", "init seed", 0, int)
+    lowValue = _p.Param(
+        "lowValue", "per-tenant linear rescale of likelihoodCol to "
+        "[lowValue, highValue] (reference LinearScalarScaler; None with "
+        "highValue=None disables scaling)", 5.0, float)
+    highValue = _p.Param("highValue", "upper end of the likelihood rescale",
+                         10.0, float)
+    applyImplicitCf = _p.Param(
+        "applyImplicitCf", "True (default) = implicit-feedback ALS "
+        "(Hu/Koren/Volinsky confidence weights, Spark ALS implicitPrefs); "
+        "False = explicit ridge ALS over the accesses plus sampled "
+        "complement negatives at negScore", True, bool)
+    alphaParam = _p.Param("alphaParam", "implicit-CF confidence slope "
+                          "(c = 1 + alpha * likelihood)", 1.0, float)
+    complementsetFactor = _p.Param(
+        "complementsetFactor", "explicit mode: complement negatives per "
+        "positive (ComplementAccessTransformer)", 2, int)
+    negScore = _p.Param("negScore", "explicit mode: target value for "
+                        "complement negatives", 1.0, float)
+    historyAccessDf = _p.Param(
+        "historyAccessDf", "optional DataFrame of known (tenant, user, res) "
+        "pairs to score 0.0 at transform; None = the training accesses",
+        None, complex=True)
+    separateTenants = _p.Param(
+        "separateTenants", "API-parity flag (reference trains one ALS over "
+        "offset id spaces when False): tenants here ALWAYS train in "
+        "isolation — the variant the reference documents as more accurate; "
+        "ids are per-tenant index spaces either way", False, bool)
+    numBlocks = _p.Param(
+        "numBlocks", "API-parity flag: Spark ALS partition count; the "
+        "batched einsum/Cholesky solves have no block concept", None)
 
     def _fit(self, df: DataFrame) -> "AccessAnomalyModel":
         tenants = df[self.get("tenantCol")]
@@ -69,39 +120,137 @@ class AccessAnomaly(Estimator):
         lik_col = self.get("likelihoodCol")
         vals = (np.asarray(df[lik_col], np.float64) if lik_col and
                 lik_col in df else np.ones(len(df)))
-        vals = np.log1p(vals)  # dampen heavy hitters (reference scales counts)
         rank = self.get("rankParam")
         reg = self.get("regParam")
+        implicit = self.get("applyImplicitCf")
+        alpha = self.get("alphaParam")
+        lo, hi = self.get("lowValue"), self.get("highValue")
+        hist = self.get("historyAccessDf")
         rng = np.random.default_rng(self.get("seed"))
 
         factors: Dict[object, Tuple[np.ndarray, np.ndarray]] = {}
         norm: Dict[object, Tuple[float, float]] = {}
+        seen: Dict[object, set] = {}
+        comps: Dict[object, Tuple[np.ndarray, np.ndarray]] = {}
         for t in sorted(set(tenants.tolist()), key=str):
             mask = np.array([x == t for x in tenants])
             u, r, v = users[mask], resources[mask], vals[mask]
+            if lo is not None and hi is not None:
+                # per-tenant linear rescale to [lo, hi] (LinearScalarScaler)
+                vmin, vmax = float(v.min()), float(v.max())
+                v = (lo + (v - vmin) * (hi - lo) / (vmax - vmin)
+                     if vmax > vmin else np.full_like(v, (lo + hi) / 2.0))
             nu, nr = int(u.max()) + 1, int(r.max()) + 1
+            if not implicit:
+                # explicit feedback trains on accesses UNION complement
+                # negatives at negScore (reference _enrich_and_normalize)
+                neg = ComplementAccessTransformer(
+                    tenantCol=self.get("tenantCol"),
+                    indexedColNames=[self.get("userCol"),
+                                     self.get("resCol")],
+                    complementsetFactor=self.get("complementsetFactor"),
+                    seed=self.get("seed")).transform(
+                        DataFrame({self.get("tenantCol"):
+                                   np.array([t] * len(u), dtype=object),
+                                   self.get("userCol"): u,
+                                   self.get("resCol"): r}))
+                nu_ = np.asarray(neg[self.get("userCol")], np.int64)
+                nr_ = np.asarray(neg[self.get("resCol")], np.int64)
+                u_t = np.concatenate([u, nu_])
+                r_t = np.concatenate([r, nr_])
+                v_t = np.concatenate(
+                    [v, np.full(len(nu_), self.get("negScore"))])
+            else:
+                u_t, r_t, v_t = u, r, v
             uf = rng.normal(scale=0.1, size=(nu, rank)).astype(np.float32)
             rf = rng.normal(scale=0.1, size=(nr, rank)).astype(np.float32)
-            uj, rj = jnp.asarray(u), jnp.asarray(r)
-            vj = jnp.asarray(v, jnp.float32)
+            uj, rj = jnp.asarray(u_t), jnp.asarray(r_t)
+            vj = jnp.asarray(v_t, jnp.float32)
             uf, rf = jnp.asarray(uf), jnp.asarray(rf)
+            step = _als_step_implicit if implicit else _als_step
+            kw = {"reg": reg, "rank": rank}
+            if implicit:
+                vj = 1.0 + alpha * vj                 # confidence weights
             for _ in range(self.get("maxIter")):
-                uf = _als_step(rf, uj, rj, vj, reg=reg, rank=rank, n_out=nu)
-                rf = _als_step(uf, rj, uj, vj, reg=reg, rank=rank, n_out=nr)
+                uf = step(rf, uj, rj, vj, n_out=nu, **kw)
+                rf = step(uf, rj, uj, vj, n_out=nr, **kw)
             uf, rf = np.asarray(uf), np.asarray(rf)
-            # per-tenant standardization of the TRAINING scores
-            # (AccessAnomaly scales scores so tenants are comparable)
-            fit_scores = -(uf[u] * rf[r]).sum(axis=1)
+            # per-tenant standardization of the TRAINING scores over the
+            # enriched pairs (ModelNormalizeTransformer: mean 0 / std 1 on
+            # the fit data, so scores are comparable across tenants)
+            fit_scores = -(uf[np.asarray(u_t)]
+                           * rf[np.asarray(r_t)]).sum(axis=1)
             norm[t] = (float(fit_scores.mean()),
                        float(fit_scores.std()) or 1.0)
             factors[t] = (uf, rf)
-        model = AccessAnomalyModel(factors=factors, norm=norm)
+            # access structure for transform-time semantics: seen pairs
+            # score 0.0; user/resource in different connected components
+            # score +inf (never co-accessed structures — reference
+            # value_calc)
+            ucomp, rcomp = _component_maps(u, r, nu, nr)
+            comps[t] = (ucomp, rcomp)
+            if hist is None:
+                seen[t] = set(zip(u.tolist(), r.tolist()))
+
+        if hist is not None:
+            h_t = hist[self.get("tenantCol")]
+            h_u = np.asarray(hist[self.get("userCol")], np.int64)
+            h_r = np.asarray(hist[self.get("resCol")], np.int64)
+            for t in set(h_t.tolist()):
+                m = np.array([x == t for x in h_t])
+                seen[t] = set(zip(h_u[m].tolist(), h_r[m].tolist()))
+        model = AccessAnomalyModel(factors=factors, norm=norm, seen=seen,
+                                   comps=comps)
         for p in ("tenantCol", "userCol", "resCol", "outputCol"):
             model.set(p, self.get(p))
         return model
 
 
+def _component_maps(u: np.ndarray, r: np.ndarray, nu: int, nr: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-entity connected-component ids over the bipartite access graph
+    (reference ConnectedComponents :415-470, which label-propagates to the
+    min user index; ids here are canonical component labels — equality is
+    the only contract). Unobserved ids get -1 (distinct from every real
+    component)."""
+    parent = np.arange(nu + nr)
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    for a, b in zip(u, r):
+        ra, rb = find(int(a)), find(int(b) + nu)
+        if ra != rb:
+            parent[rb] = ra
+    ucomp = np.full(nu, -1, np.int64)
+    rcomp = np.full(nr, -1, np.int64)
+    for a in set(u.tolist()):
+        ucomp[a] = find(int(a))
+    for b in set(r.tolist()):
+        rcomp[b] = find(int(b) + nu)
+    return ucomp, rcomp
+
+
 class AccessAnomalyModel(Model):
+    """Fitted per-tenant access model. Transform semantics, in PRECEDENCE
+    order (reference AccessAnomalyModel._transform value_calc :366-413 —
+    the seen-pair test is its outermost `when`, so a known access scores
+    0.0 even when ids have no factor vectors):
+
+    - (user, res) in the history/training access set -> 0.0 (known access,
+      `preserveHistory`);
+    - unknown user or resource (no factor vector) -> NaN (null);
+    - user and resource in DIFFERENT connected components of the access
+      graph -> +inf (no path of shared accesses links them);
+    - otherwise the per-tenant standardized negative affinity
+      (mean - u.v)/std — mean 0 / std 1 on the fit data.
+    """
+
     tenantCol = _p.Param("tenantCol", "tenant column", "tenant")
     userCol = _p.Param("userCol", "user index column", "user")
     resCol = _p.Param("resCol", "resource index column", "res")
@@ -109,11 +258,19 @@ class AccessAnomalyModel(Model):
     factors = _p.Param("factors", "tenant -> (user_f, res_f)", None,
                        complex=True)
     norm = _p.Param("norm", "tenant -> (mean, std)", None, complex=True)
+    seenPairs = _p.Param("seenPairs", "tenant -> {(user, res)} known "
+                         "accesses (score 0)", None, complex=True)
+    comps = _p.Param("comps", "tenant -> (user_comp, res_comp) component "
+                     "ids", None, complex=True)
+    preserveHistory = _p.Param(
+        "preserveHistory", "score known accesses 0.0 instead of their "
+        "affinity score (reference preserve_history)", True, bool)
 
-    def __init__(self, factors=None, norm=None, **kw):
+    def __init__(self, factors=None, norm=None, seen=None, comps=None, **kw):
         super().__init__(**kw)
         if factors is not None:
-            self._set(factors=factors, norm=norm)
+            self._set(factors=factors, norm=norm, seenPairs=seen or {},
+                      comps=comps or {})
 
     def transform(self, df: DataFrame) -> DataFrame:
         tenants = df[self.get("tenantCol")]
@@ -121,6 +278,9 @@ class AccessAnomalyModel(Model):
         resources = np.asarray(df[self.get("resCol")], np.int64)
         factors = self.get("factors")
         norm = self.get("norm")
+        seen = self.get("seenPairs") or {}
+        comps = self.get("comps") or {}
+        preserve = self.get("preserveHistory")
         out = np.full(len(df), np.nan)
         for t in set(tenants.tolist()):
             if t not in factors:
@@ -136,6 +296,18 @@ class AccessAnomalyModel(Model):
                     jnp.asarray(uf), jnp.asarray(rf),
                     jnp.asarray(u[ok]), jnp.asarray(r[ok])))
                 scores[ok] = (raw - mean) / std
+            if t in comps:
+                ucomp, rcomp = comps[t]
+                uc = np.where(ok, ucomp[np.clip(u, 0, len(ucomp) - 1)], -2)
+                rc = np.where(ok, rcomp[np.clip(r, 0, len(rcomp) - 1)], -2)
+                cross = ok & ((uc != rc) | (uc == -1) | (rc == -1))
+                scores[cross] = np.inf
+            if preserve and t in seen:
+                st = seen[t]
+                known = np.fromiter(
+                    ((int(a), int(b)) in st for a, b in zip(u, r)),
+                    bool, len(u))
+                scores[known] = 0.0
             out[mask] = scores
         return df.with_column(self.get("outputCol"), out)
 
@@ -194,27 +366,19 @@ class ComplementAccessTransformer(_p.Params):
 
 def connected_components(edges_u: np.ndarray, edges_v: np.ndarray
                          ) -> np.ndarray:
-    """Union-find over a bipartite edge list; returns the component id of each
-    edge (reference: collaborative_filtering.py ConnectedComponents :415).
-    Vertex spaces are disjoint (u and v are separate id spaces)."""
-    nu = int(edges_u.max()) + 1 if len(edges_u) else 0
-    parent = np.arange(nu + (int(edges_v.max()) + 1 if len(edges_v) else 0))
-
-    def find(a):
-        root = a
-        while parent[root] != root:
-            root = parent[root]
-        while parent[a] != root:
-            parent[a], a = root, parent[a]
-        return root
-
-    for u, v in zip(edges_u, edges_v):
-        ra, rb = find(int(u)), find(int(v) + nu)
-        if ra != rb:
-            parent[rb] = ra
-    comp = {}
+    """Component id of each bipartite edge, ids densely renumbered in
+    first-seen order (reference: collaborative_filtering.py
+    ConnectedComponents :415). Vertex spaces are disjoint (u and v are
+    separate id spaces). Built on the same union-find as the model's
+    per-entity maps (_component_maps)."""
+    if not len(edges_u):
+        return np.empty(0, np.int64)
+    nu = int(edges_u.max()) + 1
+    nv = int(edges_v.max()) + 1
+    ucomp, _ = _component_maps(np.asarray(edges_u, np.int64),
+                               np.asarray(edges_v, np.int64), nu, nv)
+    comp: Dict[int, int] = {}
     out = np.empty(len(edges_u), np.int64)
     for i, u in enumerate(edges_u):
-        root = find(int(u))
-        out[i] = comp.setdefault(root, len(comp))
+        out[i] = comp.setdefault(int(ucomp[int(u)]), len(comp))
     return out
