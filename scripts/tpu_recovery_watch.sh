@@ -30,6 +30,8 @@ EOF
     python -u scripts/measure_scan_modes.py
     echo "== vw throughput $(date -u +%FT%TZ)"
     python -u scripts/measure_vw_tpu.py
+    echo "== split bookkeeping microprofile $(date -u +%FT%TZ)"
+    python -u scripts/profile_split.py
     echo "== bench $(date -u +%FT%TZ)"
     python -u bench.py
     echo "== watcher done $(date -u +%FT%TZ)"
