@@ -819,10 +819,6 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             raise ValueError(
                 f"parallelism must be serial, data_parallel or "
                 f"voting_parallel, got {par!r}")
-        if par == "voting_parallel" and self._categorical_indexes():
-            raise ValueError(
-                "voting_parallel does not support categoricalSlotIndexes/"
-                "Names; use data_parallel")
         if par == "voting_parallel" and self.get("topK") < 1:
             raise ValueError("topK must be >= 1 for voting_parallel")
         key = jax.random.PRNGKey(self.get("seed"))

@@ -212,8 +212,14 @@ def _miss_mask_global(f: int, miss) -> jax.Array:
     return jnp.zeros((f,), bool).at[jnp.asarray(miss)].set(True)
 
 
+def _cat_mask_global(f: int, cat) -> jax.Array:
+    """[F] bool mask of categorical features (same sharing contract as
+    _miss_mask_global)."""
+    return jnp.zeros((f,), bool).at[jnp.asarray(cat)].set(True)
+
+
 def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
-                      hp: "HParams", miss_mask=None):
+                      hp: "HParams", miss_mask=None, cat_mask=None):
     """Masked split-gain table over [L, F, B, 3] histograms -> [L, F, B, 2].
 
     The last axis is the missing-value default direction: 0 = missing goes
@@ -233,12 +239,18 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
     cat = cfg.categorical_features
     miss = cfg.missing_features
     if cat:
-        is_cat = jnp.zeros((f,), bool).at[jnp.asarray(cat)].set(True)
+        # cat_mask overrides the cfg-derived global mask when the feature
+        # axis is voted ([L, k] per-slot columns — same contract as
+        # miss_mask)
+        if cat_mask is None:
+            cat_mask = _cat_mask_global(f, cat)
+        ic = (cat_mask[None, :, None] if cat_mask.ndim == 1
+              else cat_mask[:, :, None])
         order = _cat_sort_order(hists, cfg)
         sorted_h = jnp.take_along_axis(hists, order[..., None], axis=2)
-        scan_h = jnp.where(is_cat[None, :, None, None], sorted_h, hists)
+        scan_h = jnp.where(ic[..., None], sorted_h, hists)
     else:
-        is_cat = None
+        ic = None
         scan_h = hists
 
     cum = jnp.cumsum(scan_h, axis=2)             # [L,F,B,3] left stats for bin<=b
@@ -268,8 +280,7 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
     if cat:
         # categorical prefixes are capped at max_cat_threshold categories
         prefix_len = jnp.arange(b)[None, None, :] + 1
-        ok0 = ok0 & (~is_cat[None, :, None]
-                     | (prefix_len <= cfg.max_cat_threshold))
+        ok0 = ok0 & (~ic | (prefix_len <= cfg.max_cat_threshold))
     if miss:
         if miss_mask is None:
             miss_mask = _miss_mask_global(f, miss)
@@ -294,7 +305,7 @@ def _split_gain_table(hists, sums, cfg: GBDTConfig, feature_mask,
 
 
 def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask,
-                         hp: "HParams", miss_mask=None):
+                         hp: "HParams", miss_mask=None, cat_mask=None):
     """Vectorized split-gain scan over [L, F, B, 2] gain tables.
 
     Returns per-slot (best_gain [L], best_feat [L], best_bin [L],
@@ -303,7 +314,8 @@ def _best_split_per_slot(hists, sums, cfg: GBDTConfig, feature_mask,
     subset mask.
     """
     l, f, b, _ = hists.shape
-    gain = _split_gain_table(hists, sums, cfg, feature_mask, hp, miss_mask)
+    gain = _split_gain_table(hists, sums, cfg, feature_mask, hp, miss_mask,
+                             cat_mask)
     flat = gain.reshape(l, f * b * 2)
     best_idx = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
@@ -345,15 +357,9 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     b = cfg.max_bins
     cat = cfg.categorical_features
     bm = b if cat else 1  # split-mask width (1 keeps numeric-only models tiny)
-    is_cat_f = (jnp.zeros((f,), bool).at[jnp.asarray(cat)].set(True)
-                if cat else None)
+    is_cat_f = _cat_mask_global(f, cat) if cat else None
     voting = (cfg.tree_learner == "voting_parallel"
               and cfg.axis_name is not None)
-    if voting and cat:
-        raise NotImplementedError(
-            "voting_parallel does not support categorical features (the "
-            "voted per-slot feature subsets don't compose with static "
-            "categorical indices); use data_parallel")
     k_top = min(cfg.top_k, f) if voting else 0
     if cfg.split_refresh not in ("eager", "lazy"):
         raise ValueError(
@@ -414,7 +420,10 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         chosen among those (LightGBM voting-parallel semantics,
         LightGBMParams.scala:13-27). Allreduce traffic per step is
         [L, top_k, B, 3] instead of data_parallel's [F, B, 3] sibling slice.
-        Returns (hists [L,k,B,3], sums [L,3], gains [L], feats [L], bins [L]).
+        Returns (hists [L,k,B,3], sums [L,3], gains [L], feats [L] global
+        ids, bins [L], default_left [L], hrow [L,B,3] — the chosen
+        feature's allreduced histogram row per slot, for apply_split's
+        categorical-mask reconstruction).
         """
         local = hist_local(slot_of_row)
         local_sums = local[:, 0].sum(axis=1)
@@ -437,9 +446,16 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         # (global [F] masks don't align with the [L, k] voted columns)
         gains, f_idx, bins_, dls = _best_split_per_slot(
             hist_v, sums, cfg, feature_mask[sel], hp,
-            miss_mask=(is_miss_f[sel] if miss else None))
+            miss_mask=(is_miss_f[sel] if miss else None),
+            cat_mask=(is_cat_f[sel] if cat else None))
         feats = jnp.take_along_axis(sel, f_idx[:, None], axis=1)[:, 0]
-        return hist_v, sums, gains, feats.astype(jnp.int32), bins_, dls
+        # chosen-feature histogram row per slot [L, B, 3]: apply_split's
+        # categorical-mask reconstruction needs the allreduced row of the
+        # feature actually chosen, and hist_v's voted axis can't be
+        # indexed by global feature id
+        hrow = jnp.take_along_axis(
+            hist_v, f_idx[:, None, None, None], axis=1)[:, 0]
+        return hist_v, sums, gains, feats.astype(jnp.int32), bins_, dls, hrow
 
     depth_of_slot = jnp.zeros((lcap,), jnp.int32)
     slot_of_row = jnp.zeros((n,), jnp.int32)
@@ -495,14 +511,16 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     def apply_split(do_f, slot_f, rec_f, new_slot_f, gain_f, hists_f,
                     feats_f, bins_f, dls_f, slot_of_row, depth_of_slot,
                     s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
-                    s_mask, s_dl):
+                    s_mask, s_dl, hrow_f=None):
         """Apply ONE split decision, masked by do_f, writing record rec_f
         and sending the right child to slot new_slot_f: row routing
         (categorical bitset + learned missing direction), depth updates,
         and the eight split-record writes. Shared by the strict leaf-wise
         body and body_batched so split semantics cannot diverge. All
         writes keep the current value when do_f is False (rec_f may alias
-        an existing record in the batched path's clipped tail)."""
+        an existing record in the batched path's clipped tail). hrow_f
+        ([L, B, 3], voting path): pre-gathered chosen-feature histogram
+        rows when hists_f's feature axis is voted rather than global."""
         feat_b = feats_f[slot_f]
         bin_b = bins_f[slot_f]
         dl_b = dls_f[slot_f]
@@ -510,7 +528,8 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         in_leaf = slot_of_row == slot_f
         if cat:
             # rebuild the sorted-order prefix as an explicit category mask
-            hrow = hists_f[slot_f, feat_b]                       # [B,3]
+            hrow = (hists_f[slot_f, feat_b] if hrow_f is None
+                    else hrow_f[slot_f])                         # [B,3]
             order_b = jnp.argsort(-_cat_ratio(hrow, cfg))
             mask = jnp.zeros((b,), bool).at[order_b].set(
                 jnp.arange(b) <= bin_b)                          # left subset
@@ -550,7 +569,8 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
             (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
              s_valid, s_gain, s_is_cat, s_mask, s_dl, done) = carry
             (hists, sums, gains_all, feats_all, bins_all,
-             dls_all) = scan_splits_voting(slot_of_row, feature_mask)
+             dls_all, hrow_all) = scan_splits_voting(slot_of_row,
+                                                     feature_mask)
         elif compact:
             (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
              s_valid, s_gain, s_is_cat, s_mask, s_dl, done,
@@ -604,7 +624,8 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
          s_valid, s_gain, s_is_cat, s_mask, s_dl) = apply_split(
             do, best_slot, s, new_slot, best_gain, hists,
             feats_all, bins_all, dls_all, slot_of_row, depth_of_slot,
-            s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl)
+            s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl,
+            hrow_f=hrow_all if voting else None)
         done = done | ~do
         if voting:
             return (depth_of_slot, slot_of_row, s_slot, s_feat,

@@ -470,11 +470,43 @@ class TestVotingParallel:
         a = auc(df["label"], np.stack(out["probability"])[:, 1])
         assert a > 0.85, f"voting+missing AUC {a}"
 
-    def test_voting_rejects_categoricals(self, binary_df):
-        import pytest
-        with pytest.raises(ValueError, match="voting_parallel"):
-            LightGBMClassifier(parallelism="voting_parallel",
-                               categoricalSlotIndexes=[0]).fit(binary_df)
+    def test_voting_with_categoricals_matches_data_parallel(self):
+        """voting_parallel x categorical bitset splits (round-4: the last
+        voting-composition hole): with topK >= F the voted scan — including
+        the category-mask reconstruction from the voted histogram rows —
+        must match data_parallel exactly."""
+        from mmlspark_tpu import DataFrame
+        rng = np.random.default_rng(11)
+        n = 4000
+        xc = rng.integers(0, 8, (n, 2)).astype(np.float32)
+        xn = rng.normal(size=(n, 3)).astype(np.float32)
+        x = np.concatenate([xc, xn], axis=1)
+        y = ((xc[:, 0] >= 4).astype(np.float64)
+             + (xn[:, 0] > 0) >= 1).astype(np.float64)
+        df = DataFrame({"features": x, "label": y})
+        kw = dict(numIterations=8, numLeaves=7, numTasks=8, seed=5,
+                  categoricalSlotIndexes=[0, 1])
+        dp = LightGBMClassifier(**kw).fit(df)
+        vp = LightGBMClassifier(parallelism="voting_parallel", topK=5,
+                                **kw).fit(df)
+        assert np.asarray(dp.booster.trees.split_is_cat).any(), \
+            "fixture must exercise categorical splits"
+        np.testing.assert_allclose(dp.booster.raw_predict(x[:800]),
+                                   vp.booster.raw_predict(x[:800]),
+                                   rtol=1e-4, atol=1e-4)
+        # small topK with categoricals + NaN numerics: finite quality
+        xm = np.array(x)
+        nanmask = rng.random(xm.shape) < 0.1
+        nanmask[:, :2] = False          # keep the categorical columns clean
+        xm[nanmask] = np.nan
+        dfm = DataFrame({"features": xm, "label": y})
+        vp2 = LightGBMClassifier(parallelism="voting_parallel", topK=2,
+                                 numIterations=15, numLeaves=7, numTasks=8,
+                                 categoricalSlotIndexes=[0, 1]).fit(dfm)
+        p = np.stack(vp2.transform(dfm)["probability"])[:, 1]
+        assert np.isfinite(p).all()
+        a = auc(dfm["label"], p)
+        assert a > 0.85, f"voting+cat+missing AUC {a}"
 
     def test_bad_parallelism_value(self, binary_df):
         import pytest
