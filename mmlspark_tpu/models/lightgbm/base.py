@@ -795,11 +795,6 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     "splitsPerPass > 1 is the batched variant of the "
                     "eager/full scan; it does not compose with "
                     "histRefresh='lazy' or histScan='compact'")
-            if self.get("parallelism") == "voting_parallel":
-                raise ValueError(
-                    "splitsPerPass > 1 does not compose with "
-                    "parallelism='voting_parallel' (votes must be recast "
-                    "per split)")
         if ((self.get("posBaggingFraction") >= 0
              or self.get("negBaggingFraction") >= 0)
                 and (objective or self._objective_name()) != "binary"):

@@ -382,11 +382,13 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
     # requires k <= its operand length)
     k_batch = min(k_batch, lcap - 1)
     batched = k_batch > 1
-    if batched and (voting or lazy or compact):
+    if batched and (lazy or compact):
         raise NotImplementedError(
-            "splits_per_pass > 1 is the batched variant of the eager/full "
-            "scan; it does not compose with voting_parallel, "
-            "split_refresh='lazy' or split_scan='compact'")
+            "splits_per_pass > 1 batches the eager scan's split "
+            "applications; it does not compose with split_refresh='lazy' "
+            "(no per-split pass to batch — lazy already amortizes passes) "
+            "or split_scan='compact' (its segment walk is inherently "
+            "one-split-at-a-time)")
     if compact and (voting or lazy):
         raise NotImplementedError(
             "split_scan='compact' replaces the per-split full pass of the "
@@ -807,21 +809,66 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
                 s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat, s_mask,
                 s_dl, g_hists, g_sums, bg, bf2, bb2, bd2)
 
-    if batched:
-        init = (jnp.int32(0), jnp.int32(0), done, depth_of_slot,
-                slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
-                s_is_cat, s_mask, s_dl, g_hists, g_sums, bg, bf_, bb, bd)
+    def body_batched_voting(carry):
+        """Batched voting-parallel pass: one local all-slots pass + vote +
+        top-k-feature allreduce (scan_splits_voting), then apply the top
+        `k_batch` best voted splits on distinct leaves. Voting recomputes
+        every slot's histogram from scratch each pass (no sibling-
+        subtraction carry), so batching k splits per pass divides BOTH the
+        local histogram passes and the [L, top_k, B, 3] allreduce rounds
+        by ~k — the production multi-pod config (traffic mode x perf
+        mode, which the reference's C++ also composes,
+        LightGBMParams.scala:20-27)."""
+        (step, next_rec, done, depth_of_slot, slot_of_row, s_slot, s_feat,
+         s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl) = carry
+        (hists_v, _sums_v, gains_all, feats_all, bins_all,
+         dls_all, hrow_all) = scan_splits_voting(slot_of_row, feature_mask)
+        slot_exists = jnp.arange(lcap) <= next_rec
+        if cfg.max_depth > 0:
+            slot_exists = slot_exists & (depth_of_slot < cfg.max_depth)
+        gains = jnp.where(slot_exists, gains_all, _NEG_INF)
+        top_g, sel = jax.lax.top_k(gains, k_batch)
+        do_js = []
+        for j in range(k_batch):
+            rec = next_rec + j
+            do_j = (top_g[j] > thresh) & (rec < lcap - 1) & (~done)
+            rec_c = jnp.minimum(rec, lcap - 2)
+            (_, slot_of_row, depth_of_slot, s_slot, s_feat, s_bin,
+             s_valid, s_gain, s_is_cat, s_mask, s_dl) = apply_split(
+                do_j, sel[j], rec_c, rec_c + 1, top_g[j], hists_v,
+                feats_all, bins_all, dls_all, slot_of_row, depth_of_slot,
+                s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
+                s_mask, s_dl, hrow_f=hrow_all)
+            do_js.append(do_j)
+        applied = sum(d.astype(jnp.int32) for d in do_js)
+        return (step + 1, next_rec + applied, done | (applied == 0),
+                depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
+                s_valid, s_gain, s_is_cat, s_mask, s_dl)
 
+    if batched:
         def cond_batched(carry):
             step, next_rec, done = carry[0], carry[1], carry[2]
             # step < lcap-1 is the safety bound (1 split/pass worst case);
             # the typical trip count is ~(L-1)/k + a short ramp
             return (~done) & (next_rec < lcap - 1) & (step < lcap - 1)
 
-        fin = jax.lax.while_loop(cond_batched, body_batched, init)
-        (_, _, _, _, slot_of_row, s_slot, s_feat, s_bin, s_valid,
-         s_gain, s_is_cat, s_mask, s_dl, _, g_sums_f, *_rest) = fin
-        sums = g_sums_f
+        if voting:
+            init = (jnp.int32(0), jnp.int32(0), done, depth_of_slot,
+                    slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
+                    s_is_cat, s_mask, s_dl)
+            fin = jax.lax.while_loop(cond_batched, body_batched_voting,
+                                     init)
+            (_, _, _, _, slot_of_row, s_slot, s_feat, s_bin, s_valid,
+             s_gain, s_is_cat, s_mask, s_dl) = fin
+        else:
+            init = (jnp.int32(0), jnp.int32(0), done, depth_of_slot,
+                    slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
+                    s_is_cat, s_mask, s_dl, g_hists, g_sums, bg, bf_, bb,
+                    bd)
+            fin = jax.lax.while_loop(cond_batched, body_batched, init)
+            (_, _, _, _, slot_of_row, s_slot, s_feat, s_bin, s_valid,
+             s_gain, s_is_cat, s_mask, s_dl, _, g_sums_f, *_rest) = fin
+            sums = g_sums_f
     else:
         carry = (depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
                  s_valid, s_gain, s_is_cat, s_mask, s_dl, done)
@@ -833,7 +880,7 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         (_, slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
          s_is_cat, s_mask, s_dl, _) = carry[:11]
 
-    if batched:
+    if batched and not voting:
         pass
     elif voting or lazy:
         # post-split leaf stats via a slot-onehot contraction (O(N*L), no

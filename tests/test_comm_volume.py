@@ -123,6 +123,19 @@ class TestVotingTraffic:
         assert (L, F) in shapes, sorted(shapes)          # votes
         assert (L, F, B, 3) not in shapes, sorted(shapes)
 
+    def test_batched_voting_keeps_topk_shapes(self):
+        """splitsPerPass=k x voting_parallel: the per-pass psum operands
+        stay the voted [L, top_k, B, 3] + [L, F] vote table (never the
+        full histogram table) — batching divides the number of allreduce
+        ROUNDS by ~k, it must not widen what rides each round."""
+        cfg = _cfg(tree_learner="voting_parallel", top_k=4,
+                   splits_per_pass=3)
+        L, F, B = cfg.num_leaves, 16, cfg.max_bins
+        shapes = {s for s, _ in _traced_train_psums(cfg, f=F)}
+        assert (L, cfg.top_k, B, 3) in shapes, sorted(shapes)
+        assert (L, F) in shapes, sorted(shapes)
+        assert (L, F, B, 3) not in shapes, sorted(shapes)
+
     def test_voting_beats_data_parallel_at_wide_f(self):
         """The traffic ratio voting exists for (LightGBMParams.scala:20-27):
         per-pass voted bytes L*top_k*B*3 + votes L*F undercut the
