@@ -108,22 +108,31 @@ def _compiled_sharded(cfg: GBDTConfig, ndev: int, grouped: bool):
     m = meshlib.get_mesh(ndev)
     axis = meshlib.DATA_AXIS
     train = make_train_fn(cfg)
-    if grouped:
-        full = jax.shard_map(
-            train, mesh=m, in_specs=(P(axis),) * 5 + (P(), P(axis)),
-            out_specs=P(), check_vma=False)
-        chunk = jax.shard_map(
-            train.chunk, mesh=m,
-            in_specs=(P(axis),) * 5 + (P(), P(), P(axis), P(), P(axis)),
-            out_specs=(P(), P(), P(), P(axis), P()), check_vma=False)
-    else:
-        full = jax.shard_map(
-            train, mesh=m, in_specs=(P(axis),) * 5 + (P(),),
-            out_specs=P(), check_vma=False)
-        chunk = jax.shard_map(
-            train.chunk, mesh=m,
-            in_specs=(P(axis),) * 5 + (P(), P(), P(axis), P()),
-            out_specs=(P(), P(), P(), P(axis), P()), check_vma=False)
+    dart = cfg.boosting_type == "dart"
+    gspec = (P(axis),) if grouped else ()
+    full = jax.shard_map(
+        train, mesh=m, in_specs=(P(axis),) * 5 + (P(),) + gspec,
+        out_specs=P(), check_vma=False)
+
+    def chunk_fn(b, y, w, t, mg, k_, s_, sc, lr, *rest):
+        # positional tail: [deltas, tree_scale] (dart) then [group_idx]
+        rest = list(rest)
+        dl = ts = None
+        if dart:
+            dl, ts = rest[0], rest[1]
+            rest = rest[2:]
+        return train.chunk(b, y, w, t, mg, k_, s_, sc, lr,
+                           group_idx=rest[0] if rest else None,
+                           deltas_in=dl, tree_scale_in=ts)
+
+    # dart's deltas [T, N, K] shard with the rows on axis 1; tree_scale
+    # and the carried PRNG key are replicated
+    dspec = (P(None, axis), P()) if dart else ()
+    chunk = jax.shard_map(
+        chunk_fn, mesh=m,
+        in_specs=(P(axis),) * 5 + (P(), P(), P(axis), P()) + dspec + gspec,
+        out_specs=(P(), P(), P(), P(axis), P()) + dspec + (P(),),
+        check_vma=False)
     return jax.jit(full), jax.jit(chunk)
 
 
@@ -286,8 +295,9 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
     itersPerCall = Param(
         "itersPerCall",
         "split training into device programs of at most this many boosting "
-        "iterations, carrying raw scores between calls (exact continuation, "
-        "same trees up to per-chunk bagging keys). 0 = one program for the "
+        "iterations, carrying raw scores, the PRNG key, and (dart) the "
+        "dropout delta/rescale state between calls — BIT-IDENTICAL to the "
+        "one-program fit for every boosting mode. 0 = one program for the "
         "whole fit. Bounds single-device-call duration: shared TPU pools "
         "kill programs that hold the chip for minutes (measured: an 11M-row "
         "x 100-iter eager program is evicted; 4 x 25 survives)", 0, int)
@@ -829,13 +839,20 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             data = (jnp.asarray(binned), jnp.asarray(y), jnp.asarray(w),
                     jnp.asarray(is_train), jnp.asarray(margin))
             jfull, jchunk = _compiled_serial(cfg)
+
+            def _st_kw(st):
+                # optional dart carry (deltas, tree_scale) -> chunk kwargs
+                return ({} if st is None
+                        else {"deltas_in": st[0], "tree_scale_in": st[1]})
             if gidx is None:
                 run_full = lambda k: jfull(*data, k)
-                run_chunk = lambda k, s, sc, lr: jchunk(*data, k, s, sc, lr)
+                run_chunk = (lambda k, s, sc, lr, st=None:
+                             jchunk(*data, k, s, sc, lr, **_st_kw(st)))
             else:
                 run_full = lambda k: jfull(*data, k, gidx)
-                run_chunk = (lambda k, s, sc, lr:
-                             jchunk(*data, k, s, sc, lr, gidx))
+                run_chunk = (lambda k, s, sc, lr, st=None:
+                             jchunk(*data, k, s, sc, lr, gidx,
+                                    **_st_kw(st)))
             n_rows_exec = binned.shape[0]
         else:
             cfg = self._make_config(num_class, axis, objective, has_init)
@@ -863,7 +880,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     place(take_pad(margin)))
             jfull, jchunk = _compiled_sharded(cfg, ndev, True)
             run_full = lambda k: jfull(*data, k, gidx)
-            run_chunk = lambda k, s, sc, lr: jchunk(*data, k, s, sc, lr, gidx)
+            run_chunk = (lambda k, s, sc, lr, st=None:
+                         jchunk(*data, k, s, sc, lr, *(st or ()), gidx))
             n_rows_exec = lay.order.shape[0]
         elif not serial:
             binned_p, _ = meshlib.pad_to_multiple(binned, nd)
@@ -875,24 +893,22 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     place(t_p), place(m_p))
             jfull, jchunk = _compiled_sharded(cfg, ndev, False)
             run_full = lambda k: jfull(*data, k)
-            run_chunk = lambda k, s, sc, lr: jchunk(*data, k, s, sc, lr)
+            run_chunk = (lambda k, s, sc, lr, st=None:
+                         jchunk(*data, k, s, sc, lr, *(st or ())))
             n_rows_exec = binned_p.shape[0]
 
         rounds = self.get("earlyStoppingRound")
         delegate = self.get("delegate")
         has_valid = bool(is_valid.any())
-        if delegate is not None and self.get("boostingType") == "dart":
-            raise ValueError(
-                "delegate hooks are not supported with boostingType='dart' "
-                "(dart dropout needs the full prior-tree delta history inside "
-                "one compiled program, so chunked host callbacks cannot run)")
         ipc = self.get("itersPerCall")
         ckdir = self.get("checkpointDir")
-        if (ipc or ckdir) and self.get("boostingType") == "dart":
+        if ckdir and self.get("boostingType") == "dart":
             raise ValueError(
-                "itersPerCall/checkpointDir are not supported with "
-                "boostingType='dart' (dart dropout needs the full "
-                "prior-tree delta history inside one compiled program)")
+                "checkpointDir is not supported with boostingType='dart': "
+                "resuming dropout needs the per-iteration delta history "
+                "([T,N,K] device state), which is training state, not a "
+                "booster checkpoint. itersPerCall DOES compose with dart "
+                "(the delta history is carried on-device across chunks)")
         if rounds and has_valid and self.get("boostingType") == "dart":
             raise ValueError(
                 "earlyStoppingRound is not supported with "
@@ -918,8 +934,6 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 return prev
             if resume_trees:
                 self._iters_override = remaining
-        # every chunk trigger raises above when boostingType='dart', so no
-        # dart fit can reach the chunked path
         use_chunked = (delegate is not None or (rounds and has_valid)
                        or bool(ipc) or bool(ckdir))
 
@@ -1067,6 +1081,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                    else self.get("learningRate"))
         cur_lr = base_lr
         scores = jnp.zeros((n_rows, k), jnp.float32)
+        dart = self.get("boostingType") == "dart"
+        # dart's dropout state rides ON DEVICE between chunks: per-iteration
+        # score deltas [T, N, K] + cumulative rescales [T], returned by one
+        # chunk and fed to the next (never fetched to host)
+        dart_state = ((jnp.zeros((T, n_rows, k), jnp.float32),
+                       jnp.ones((T,), jnp.float32)) if dart else None)
         # running concatenation (not a list of chunks): the checkpoint
         # snapshot and the final result share ONE accumulated copy, so a
         # per-chunk snapshot costs one concat of the so-far model instead
@@ -1087,9 +1107,18 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     cur_lr = float(delegate.get_learning_rate(
                         batch_index, it0 + i, cur_lr))
                 lrs.append(cur_lr / base_lr if base_lr else 1.0)
-            key, sub = jax.random.split(key)
-            trees_c, tm_c, vm_c, scores, init_out = run_chunk(
-                sub, jnp.int32(done), scores, jnp.asarray(lrs, jnp.float32))
+            # the PRNG key carries ACROSS chunks (chunk 1 gets the fit key,
+            # chunk i+1 gets chunk i's carried key) — chunked training is
+            # bit-identical to the one-program scan for every stochastic
+            # mode, dart dropout included
+            out = run_chunk(key, jnp.int32(done), scores,
+                            jnp.asarray(lrs, jnp.float32), dart_state)
+            if dart:
+                (trees_c, tm_c, vm_c, scores, key, d_deltas, d_scale,
+                 init_out) = out
+                dart_state = (d_deltas, d_scale)
+            else:
+                trees_c, tm_c, vm_c, scores, key, init_out = out
             tm_c, vm_c = np.asarray(tm_c), np.asarray(vm_c)
             trees_h = jax.tree.map(np.asarray, trees_c)
             if trees_acc is None:
@@ -1123,6 +1152,16 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             if save_ck is not None:
                 save_ck(BoostResult(trees_acc, np.asarray(init_out),
                                     tm_acc, vm_acc))
+        if dart:
+            # bake the FINAL cumulative rescales into the accumulated trees
+            # (the full scan does this after its lax.scan; chunked trees
+            # came back raw because later chunks retroactively rescale
+            # earlier iterations)
+            ts = np.asarray(dart_state[1])[:tm_acc.shape[0]]
+            scale = ts.reshape(ts.shape + (1,)
+                               * (trees_acc.leaf_value.ndim - 1))
+            trees_acc = trees_acc._replace(
+                leaf_value=trees_acc.leaf_value * scale)
         result = BoostResult(trees_acc, np.asarray(init_out), tm_acc, vm_acc)
         best_iter = (best_at + 1) if (rounds and has_valid) else None
         return result, best_iter

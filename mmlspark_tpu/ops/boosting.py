@@ -736,41 +736,56 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
             out = out + (perm, seg_start, seg_len)
         return out
 
-    def body_batched(carry):
-        """One batched pass: apply the top-k cached best splits (distinct
-        leaves — their gains are mutually independent, so this equals k
-        consecutive strict leaf-wise steps restricted from choosing
-        children created within the pass), then ONE all-slots refresh.
-        Valid splits form a PREFIX of the gain-sorted selection (gains
-        descend and the record-budget check only tightens with j), so the
-        j-th valid split's record index is exactly next_rec + j."""
-        (step, next_rec, done, depth_of_slot, slot_of_row, s_slot, s_feat,
-         s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl,
-         g_hists, g_sums, bg, bf_, bb, bd) = carry
+    def apply_topk_splits(next_rec, done, depth_of_slot, slot_of_row,
+                          s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
+                          s_mask, s_dl, gains_all, hists_f, feats_f,
+                          bins_f, dls_f, hrow_f=None):
+        """Apply the top `k_batch` best splits of one batched pass
+        (distinct leaves — their gains are mutually independent, so this
+        equals k consecutive strict leaf-wise steps restricted from
+        choosing children created within the pass). Valid splits form a
+        PREFIX of the gain-sorted selection (gains descend and the
+        record-budget check only tightens with j), so the j-th valid
+        split's record index is exactly next_rec + j. Shared by
+        body_batched and body_batched_voting so the selection semantics
+        (slot-exists guard, record-budget clip) cannot diverge."""
         slot_exists = jnp.arange(lcap) <= next_rec
         if cfg.max_depth > 0:
             slot_exists = slot_exists & (depth_of_slot < cfg.max_depth)
-        gains = jnp.where(slot_exists, bg, _NEG_INF)
+        gains = jnp.where(slot_exists, gains_all, _NEG_INF)
         top_g, sel = jax.lax.top_k(gains, k_batch)
         do_js, parents, children = [], [], []
         for j in range(k_batch):
             rec = next_rec + j
-            slot_j = sel[j]
             do_j = (top_g[j] > thresh) & (rec < lcap - 1) & (~done)
             rec_c = jnp.minimum(rec, lcap - 2)
             new_slot = rec_c + 1
             (_, slot_of_row, depth_of_slot, s_slot, s_feat, s_bin,
              s_valid, s_gain, s_is_cat, s_mask, s_dl) = apply_split(
-                do_j, slot_j, rec_c, new_slot, top_g[j], g_hists,
-                bf_, bb, bd, slot_of_row, depth_of_slot,
+                do_j, sel[j], rec_c, new_slot, top_g[j], hists_f,
+                feats_f, bins_f, dls_f, slot_of_row, depth_of_slot,
                 s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
-                s_mask, s_dl)
+                s_mask, s_dl, hrow_f=hrow_f)
             do_js.append(do_j)
-            parents.append(slot_j)
+            parents.append(sel[j])
             children.append(new_slot)
         applied = sum(d.astype(jnp.int32) for d in do_js)
-        next_rec = next_rec + applied
-        done = done | (applied == 0)
+        return (next_rec + applied, done | (applied == 0), depth_of_slot,
+                slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
+                s_is_cat, s_mask, s_dl, do_js, parents, children)
+
+    def body_batched(carry):
+        """One batched pass: apply the top-k cached best splits, then ONE
+        all-slots refresh covering every child created this pass."""
+        (step, next_rec, done, depth_of_slot, slot_of_row, s_slot, s_feat,
+         s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl,
+         g_hists, g_sums, bg, bf_, bb, bd) = carry
+        (next_rec, done, depth_of_slot, slot_of_row, s_slot, s_feat,
+         s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl, do_js, parents,
+         children) = apply_topk_splits(
+            next_rec, done, depth_of_slot, slot_of_row, s_slot, s_feat,
+            s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl,
+            bg, g_hists, bf_, bb, bd)
         # ONE refresh pass covers every child created this pass; only the
         # k child slices ride the allreduce (same total ICI traffic as k
         # eager steps, k x fewer latency hops), parents update by sibling
@@ -823,27 +838,16 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
          s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl) = carry
         (hists_v, _sums_v, gains_all, feats_all, bins_all,
          dls_all, hrow_all) = scan_splits_voting(slot_of_row, feature_mask)
-        slot_exists = jnp.arange(lcap) <= next_rec
-        if cfg.max_depth > 0:
-            slot_exists = slot_exists & (depth_of_slot < cfg.max_depth)
-        gains = jnp.where(slot_exists, gains_all, _NEG_INF)
-        top_g, sel = jax.lax.top_k(gains, k_batch)
-        do_js = []
-        for j in range(k_batch):
-            rec = next_rec + j
-            do_j = (top_g[j] > thresh) & (rec < lcap - 1) & (~done)
-            rec_c = jnp.minimum(rec, lcap - 2)
-            (_, slot_of_row, depth_of_slot, s_slot, s_feat, s_bin,
-             s_valid, s_gain, s_is_cat, s_mask, s_dl) = apply_split(
-                do_j, sel[j], rec_c, rec_c + 1, top_g[j], hists_v,
-                feats_all, bins_all, dls_all, slot_of_row, depth_of_slot,
-                s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
-                s_mask, s_dl, hrow_f=hrow_all)
-            do_js.append(do_j)
-        applied = sum(d.astype(jnp.int32) for d in do_js)
-        return (step + 1, next_rec + applied, done | (applied == 0),
-                depth_of_slot, slot_of_row, s_slot, s_feat, s_bin,
-                s_valid, s_gain, s_is_cat, s_mask, s_dl)
+        (next_rec, done, depth_of_slot, slot_of_row, s_slot, s_feat,
+         s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl, _, _, _
+         ) = apply_topk_splits(
+            next_rec, done, depth_of_slot, slot_of_row, s_slot, s_feat,
+            s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl,
+            gains_all, hists_v, feats_all, bins_all, dls_all,
+            hrow_f=hrow_all)
+        return (step + 1, next_rec, done, depth_of_slot, slot_of_row,
+                s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat, s_mask,
+                s_dl)
 
     if batched:
         def cond_batched(carry):
@@ -1431,9 +1435,14 @@ def make_train_fn(cfg: GBDTConfig):
         return BoostResult(trees, init_out, train_m, valid_m)
 
     def train_chunk(binned, y, w_all, is_train, init_margin, key, start,
-                    scores_in, lr_mult, group_idx=None, hp=None):
+                    scores_in, lr_mult, group_idx=None, hp=None,
+                    deltas_in=None, tree_scale_in=None):
         """Run ONE chunk of iterations [start, start+C) where C =
-        len(lr_mult), carrying raw scores across chunks.
+        len(lr_mult), carrying raw scores AND the PRNG key across chunks —
+        chunk boundaries are invisible: any partition of [0, T) into chunks
+        reproduces the one-program fit bit-for-bit, for every stochastic
+        mode (feature_fraction, goss, dart dropout all draw from the
+        carried key exactly as the full scan does).
 
         This is the jit-friendly analogue of the reference's `trainCore` loop
         actually HALTING on early stopping (TrainUtils.scala:220-315): the
@@ -1441,24 +1450,40 @@ def make_train_fn(cfg: GBDTConfig):
         stops launching further chunks. At start == 0 the carried scores are
         ignored and the init-score margins are used.
 
+        dart additionally carries (deltas_in [T,N,K], tree_scale_in [T]) —
+        the per-iteration score deltas and cumulative rescales that dropout
+        reads and retroactively updates. Chunked dart trees come back with
+        leaf values NOT yet scaled by the final tree_scale (later chunks
+        may still rescale earlier iterations); the caller bakes the LAST
+        chunk's tree_scale into the accumulated trees once training halts
+        (LightGBMClassifier._run_chunked), matching the full scan's
+        end-of-fit baking.
+
         Returns (trees [C,...], train_metric [C], valid_metric [C],
-        scores [N,K], init_score)."""
-        if dart:
-            raise NotImplementedError(
-                "chunked early stopping is not supported for dart (dropout "
-                "needs the full prior-tree delta history)")
+        scores [N,K], key_out, init_score) — dart inserts
+        (deltas [T,N,K], tree_scale [T]) before init_score."""
         if hp is None:
             hp = HParams.from_config(cfg)
         step, scores0, init, deltas0, tree_scale0 = _env(
             binned, y, w_all, is_train, init_margin, group_idx, hp)
         scores_start = jnp.where(start == 0, scores0, scores_in)
+        if dart:
+            assert deltas_in is not None and tree_scale_in is not None, (
+                "chunked dart requires the carried deltas/tree_scale state")
+            deltas_start, scale_start = deltas_in, tree_scale_in
+        else:
+            deltas_start, scale_start = deltas0, tree_scale0
         c = lr_mult.shape[0]
         its = start + jnp.arange(c)
-        (scores, _, _, _), (trees, train_m, valid_m) = jax.lax.scan(
-            step, (scores_start, deltas0, tree_scale0, key),
+        ((scores, deltas, tree_scale, key_out),
+         (trees, train_m, valid_m)) = jax.lax.scan(
+            step, (scores_start, deltas_start, scale_start, key),
             (its, jnp.asarray(lr_mult, jnp.float32)))
         init_out = jnp.full((k,), init) if multiclass else init
-        return trees, train_m, valid_m, scores, init_out
+        if dart:
+            return (trees, train_m, valid_m, scores, key_out, deltas,
+                    tree_scale, init_out)
+        return trees, train_m, valid_m, scores, key_out, init_out
 
     train.chunk = train_chunk
     return train

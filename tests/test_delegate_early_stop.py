@@ -120,6 +120,22 @@ class RecordingDelegate(LightGBMDelegate):
 
 
 class TestDelegate:
+    def test_delegate_composes_with_dart(self, binary_df):
+        """Delegates run with dart now that the dropout state carries
+        across chunks (round-5: the old guard's 'chunked host callbacks
+        cannot run' rationale no longer holds). A dynamic lr schedule
+        must see every iteration and the fit must keep dart quality."""
+        d = RecordingDelegate(decay=0.98)
+        clf = LightGBMClassifier(numIterations=12, numTasks=1,
+                                 boostingType="dart", dropRate=0.3, seed=3)
+        clf.set("delegate", d)
+        model = clf.fit(binary_df)
+        assert d.before_iters == list(range(12))
+        assert d.after_iters == list(range(12))
+        assert len(np.asarray(model.booster.train_metric)) == 12
+        x = np.asarray(binary_df["features"])
+        assert np.isfinite(model.booster.raw_predict(x)).all()
+
     def test_iteration_hooks_and_metrics(self, binary_df):
         d = RecordingDelegate()
         clf = LightGBMClassifier(numIterations=20, numTasks=1)
