@@ -510,44 +510,38 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
 
     thresh = hp.min_gain_to_split + _MIN_GAIN_EPS
 
-    def apply_split(do_f, slot_f, rec_f, new_slot_f, gain_f, hists_f,
-                    feats_f, bins_f, dls_f, slot_of_row, depth_of_slot,
-                    s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
-                    s_mask, s_dl, hrow_f=None):
-        """Apply ONE split decision, masked by do_f, writing record rec_f
-        and sending the right child to slot new_slot_f: row routing
-        (categorical bitset + learned missing direction), depth updates,
-        and the eight split-record writes. Shared by the strict leaf-wise
-        body and body_batched so split semantics cannot diverge. All
-        writes keep the current value when do_f is False (rec_f may alias
-        an existing record in the batched path's clipped tail). hrow_f
-        ([L, B, 3], voting path): pre-gathered chosen-feature histogram
-        rows when hists_f's feature axis is voted rather than global."""
+    def split_decision(slot_f, hists_f, feats_f, bins_f, dls_f,
+                       hrow_f=None):
+        """Resolve one slot's chosen split into its routing ingredients:
+        (feat_b, bin_b, dl_b, mask [B or bm], feat_cat). The categorical
+        mask is rebuilt from the sorted-order prefix exactly as the gain
+        scan ordered bins (_cat_sort_order is the shared source of truth).
+        hrow_f ([L, B, 3], voting path): pre-gathered chosen-feature
+        histogram rows when hists_f's feature axis is voted rather than
+        global."""
         feat_b = feats_f[slot_f]
         bin_b = bins_f[slot_f]
         dl_b = dls_f[slot_f]
-        col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
-        in_leaf = slot_of_row == slot_f
         if cat:
-            # rebuild the sorted-order prefix as an explicit category mask
             hrow = (hists_f[slot_f, feat_b] if hrow_f is None
                     else hrow_f[slot_f])                         # [B,3]
             order_b = jnp.argsort(-_cat_ratio(hrow, cfg))
             mask = jnp.zeros((b,), bool).at[order_b].set(
                 jnp.arange(b) <= bin_b)                          # left subset
             feat_cat = is_cat_f[feat_b]
-            go_right = jnp.where(feat_cat, ~mask[col], col > bin_b)
         else:
             mask = jnp.zeros((bm,), bool)
             feat_cat = jnp.array(False)
-            go_right = col > bin_b
-        if miss:
-            # bin 0 of a missing-capable feature = NaN rows: route by the
-            # LEARNED default direction, not the value comparison
-            go_right = jnp.where(is_miss_f[feat_b] & (col == 0),
-                                 ~dl_b, go_right)
-        slot_of_row = jnp.where(in_leaf & go_right & do_f, new_slot_f,
-                                slot_of_row)
+        return feat_b, bin_b, dl_b, mask, feat_cat
+
+    def record_split(do_f, slot_f, rec_f, gain_f, feat_b, bin_b, dl_b,
+                     mask, feat_cat, depth_of_slot, new_slot_f,
+                     s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
+                     s_mask, s_dl):
+        """Depth updates + the eight split-record writes for one split,
+        masked by do_f (rec_f may alias an existing record in the batched
+        path's clipped tail — every write keeps the current value when
+        do_f is False)."""
         child_depth = depth_of_slot[slot_f] + 1
         depth_of_slot = depth_of_slot.at[new_slot_f].set(
             jnp.where(do_f, child_depth, depth_of_slot[new_slot_f]))
@@ -563,6 +557,40 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         s_mask = s_mask.at[rec_f].set(
             jnp.where(do_f, mask[:bm], s_mask[rec_f]))
         s_dl = s_dl.at[rec_f].set(jnp.where(do_f, dl_b, s_dl[rec_f]))
+        return (depth_of_slot, s_slot, s_feat, s_bin, s_valid, s_gain,
+                s_is_cat, s_mask, s_dl)
+
+    def apply_split(do_f, slot_f, rec_f, new_slot_f, gain_f, hists_f,
+                    feats_f, bins_f, dls_f, slot_of_row, depth_of_slot,
+                    s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
+                    s_mask, s_dl, hrow_f=None):
+        """Apply ONE split decision, masked by do_f, writing record rec_f
+        and sending the right child to slot new_slot_f: row routing
+        (categorical bitset + learned missing direction), depth updates,
+        and the split-record writes. Shared by the strict leaf-wise body
+        and the compact scan so split semantics cannot diverge (the
+        batched bodies share split_decision/record_split and vectorize
+        the row routing — apply_topk_splits)."""
+        feat_b, bin_b, dl_b, mask, feat_cat = split_decision(
+            slot_f, hists_f, feats_f, bins_f, dls_f, hrow_f)
+        col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
+        in_leaf = slot_of_row == slot_f
+        if cat:
+            go_right = jnp.where(feat_cat, ~mask[col], col > bin_b)
+        else:
+            go_right = col > bin_b
+        if miss:
+            # bin 0 of a missing-capable feature = NaN rows: route by the
+            # LEARNED default direction, not the value comparison
+            go_right = jnp.where(is_miss_f[feat_b] & (col == 0),
+                                 ~dl_b, go_right)
+        slot_of_row = jnp.where(in_leaf & go_right & do_f, new_slot_f,
+                                slot_of_row)
+        (depth_of_slot, s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
+         s_mask, s_dl) = record_split(
+            do_f, slot_f, rec_f, gain_f, feat_b, bin_b, dl_b, mask,
+            feat_cat, depth_of_slot, new_slot_f, s_slot, s_feat, s_bin,
+            s_valid, s_gain, s_is_cat, s_mask, s_dl)
         return (go_right, slot_of_row, depth_of_slot, s_slot, s_feat,
                 s_bin, s_valid, s_gain, s_is_cat, s_mask, s_dl)
 
@@ -755,20 +783,64 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         gains = jnp.where(slot_exists, gains_all, _NEG_INF)
         top_g, sel = jax.lax.top_k(gains, k_batch)
         do_js, parents, children = [], [], []
+        # per-slot routing tables, filled per split below, consumed by ONE
+        # fused routing pass — replacing k sequential O(N) row updates
+        # (each a column gather + where over every row) with a single
+        # gather-driven pass: the dominant non-histogram cost of a batched
+        # pass (PERF.md: ~0.9 ms/split bookkeeping at 1M rows)
+        feat_of = jnp.zeros((lcap,), jnp.int32)
+        bin_of = jnp.zeros((lcap,), jnp.int32)
+        dl_of = jnp.ones((lcap,), bool)
+        cat_of = jnp.zeros((lcap,), bool)
+        child_of = jnp.zeros((lcap,), jnp.int32)
+        active = jnp.zeros((lcap,), bool)
+        mask_of = jnp.zeros((lcap, b), bool) if cat else None
         for j in range(k_batch):
             rec = next_rec + j
             do_j = (top_g[j] > thresh) & (rec < lcap - 1) & (~done)
             rec_c = jnp.minimum(rec, lcap - 2)
             new_slot = rec_c + 1
-            (_, slot_of_row, depth_of_slot, s_slot, s_feat, s_bin,
-             s_valid, s_gain, s_is_cat, s_mask, s_dl) = apply_split(
-                do_j, sel[j], rec_c, new_slot, top_g[j], hists_f,
-                feats_f, bins_f, dls_f, slot_of_row, depth_of_slot,
-                s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat,
-                s_mask, s_dl, hrow_f=hrow_f)
+            feat_b, bin_b, dl_b, mask, feat_cat = split_decision(
+                sel[j], hists_f, feats_f, bins_f, dls_f, hrow_f)
+            (depth_of_slot, s_slot, s_feat, s_bin, s_valid, s_gain,
+             s_is_cat, s_mask, s_dl) = record_split(
+                do_j, sel[j], rec_c, top_g[j], feat_b, bin_b, dl_b, mask,
+                feat_cat, depth_of_slot, new_slot, s_slot, s_feat, s_bin,
+                s_valid, s_gain, s_is_cat, s_mask, s_dl)
+            # non-applied splits scatter to index lcap -> dropped; applied
+            # parents are distinct (top_k), so no duplicate indices land
+            safe = jnp.where(do_j, sel[j], lcap)
+            feat_of = feat_of.at[safe].set(feat_b, mode="drop")
+            bin_of = bin_of.at[safe].set(bin_b, mode="drop")
+            dl_of = dl_of.at[safe].set(dl_b, mode="drop")
+            cat_of = cat_of.at[safe].set(feat_cat, mode="drop")
+            child_of = child_of.at[safe].set(new_slot, mode="drop")
+            active = active.at[safe].set(True, mode="drop")
+            if cat:
+                mask_of = mask_of.at[safe].set(mask, mode="drop")
             do_js.append(do_j)
             parents.append(sel[j])
             children.append(new_slot)
+        # ONE fused routing pass. Correctness: each row is touched by at
+        # most one split per pass — parents are distinct pre-pass leaves
+        # and children (slots > next_rec) can never be parents (slots <=
+        # next_rec) within the pass — so the k sequential updates commute
+        # and collapse into a table lookup keyed on the row's pass-start
+        # slot. Boolean-identical to the sequential application.
+        slot = slot_of_row
+        f_row = feat_of[slot]                                       # [N]
+        col = jnp.take_along_axis(
+            binned, f_row[:, None], axis=1)[:, 0].astype(jnp.int32)
+        if cat:
+            go_right = jnp.where(cat_of[slot], ~mask_of[slot, col],
+                                 col > bin_of[slot])
+        else:
+            go_right = col > bin_of[slot]
+        if miss:
+            go_right = jnp.where(is_miss_f[f_row] & (col == 0),
+                                 ~dl_of[slot], go_right)
+        slot_of_row = jnp.where(active[slot] & go_right, child_of[slot],
+                                slot)
         applied = sum(d.astype(jnp.int32) for d in do_js)
         return (next_rec + applied, done | (applied == 0), depth_of_slot,
                 slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
