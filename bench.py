@@ -374,6 +374,26 @@ def main():
         except Exception as e:  # noqa: BLE001 - secondary must not kill bench
             extra["batched8_error"] = str(e)[:300]
 
+    # Uniform candidate scoreboard (round-4 verdict #8): one row per mode
+    # tried on THIS run — {mode, rows_iter_per_s, auc} — so an AUC-gate
+    # rejection is visible in the driver-captured json itself, not only in
+    # PERF.md. The primary's name lands in "promoted".
+    cands = [{"mode": "eager/full",
+              "rows_iter_per_s": extra["full_rows_iter_per_s"],
+              "auc": extra["full_auc_sample"]}]
+    for nm, tag in (("lazy", "lazy"), ("batched-k4", "batched4"),
+                    ("batched-k8", "batched8")):
+        if f"{tag}_rows_iter_per_s" in extra:
+            cands.append({"mode": nm,
+                          "rows_iter_per_s": extra[f"{tag}_rows_iter_per_s"],
+                          "auc": extra[f"{tag}_auc_sample"]})
+        elif f"{tag}_error" in extra:
+            cands.append({"mode": nm, "error": extra[f"{tag}_error"]})
+    extra["candidates"] = cands
+    # bare mode name, joinable against candidates[].mode (hist_scan keeps
+    # the verbose provenance string)
+    extra["promoted"] = scan_mode.split(" ")[0]
+
     # extra: wall-time decomposition of one instrumented fit of the primary
     # mode (binning / device transfer / boosting / assembly — barriers
     # added between phases, so this fit is NOT one of the timed ones)
