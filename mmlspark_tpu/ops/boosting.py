@@ -567,10 +567,10 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         """Apply ONE split decision, masked by do_f, writing record rec_f
         and sending the right child to slot new_slot_f: row routing
         (categorical bitset + learned missing direction), depth updates,
-        and the split-record writes. Shared by the strict leaf-wise body
-        and the compact scan so split semantics cannot diverge (the
-        batched bodies share split_decision/record_split and vectorize
-        the row routing — apply_topk_splits)."""
+        and the split-record writes. Shared by the strict leaf-wise body,
+        the compact scan, and the batched bodies (apply_topk_splits calls
+        this once per selected split) so split semantics cannot
+        diverge."""
         feat_b, bin_b, dl_b, mask, feat_cat = split_decision(
             slot_f, hists_f, feats_f, bins_f, dls_f, hrow_f)
         col = jnp.take(binned, feat_b, axis=1).astype(jnp.int32)
@@ -783,64 +783,32 @@ def build_tree(binned: jax.Array, gh3: jax.Array, cfg: GBDTConfig,
         gains = jnp.where(slot_exists, gains_all, _NEG_INF)
         top_g, sel = jax.lax.top_k(gains, k_batch)
         do_js, parents, children = [], [], []
-        # per-slot routing tables, filled per split below, consumed by ONE
-        # fused routing pass — replacing k sequential O(N) row updates
-        # (each a column gather + where over every row) with a single
-        # gather-driven pass: the dominant non-histogram cost of a batched
-        # pass (PERF.md: ~0.9 ms/split bookkeeping at 1M rows)
-        feat_of = jnp.zeros((lcap,), jnp.int32)
-        bin_of = jnp.zeros((lcap,), jnp.int32)
-        dl_of = jnp.ones((lcap,), bool)
-        cat_of = jnp.zeros((lcap,), bool)
-        child_of = jnp.zeros((lcap,), jnp.int32)
-        active = jnp.zeros((lcap,), bool)
-        mask_of = jnp.zeros((lcap, b), bool) if cat else None
+        # k sequential apply_split updates, each routing with a scalar
+        # column dynamic-slice — the same per-split routing the strict
+        # body uses. A fused single-pass alternative (per-slot routing
+        # tables + one take_along_axis(binned, feat_of[slot]) gather)
+        # measured ~11 ms/pass SLOWER on chip at 1M x 28 (k4 123.4 vs
+        # eager 92.4 ms/iter, docs/PERF_scan_modes.log 2026-08-01): the
+        # per-row gather over [N, F] plus the [N]-gathers from the [L]
+        # tables are exactly the access pattern the TPU punishes, while
+        # k column slices + vector wheres cost ~0.2 ms each. The updates
+        # commute (parents are distinct pre-pass leaves; children —
+        # slots > next_rec — can never be parents within the pass), so
+        # application order is irrelevant.
         for j in range(k_batch):
             rec = next_rec + j
             do_j = (top_g[j] > thresh) & (rec < lcap - 1) & (~done)
             rec_c = jnp.minimum(rec, lcap - 2)
             new_slot = rec_c + 1
-            feat_b, bin_b, dl_b, mask, feat_cat = split_decision(
-                sel[j], hists_f, feats_f, bins_f, dls_f, hrow_f)
-            (depth_of_slot, s_slot, s_feat, s_bin, s_valid, s_gain,
-             s_is_cat, s_mask, s_dl) = record_split(
-                do_j, sel[j], rec_c, top_g[j], feat_b, bin_b, dl_b, mask,
-                feat_cat, depth_of_slot, new_slot, s_slot, s_feat, s_bin,
-                s_valid, s_gain, s_is_cat, s_mask, s_dl)
-            # non-applied splits scatter to index lcap -> dropped; applied
-            # parents are distinct (top_k), so no duplicate indices land
-            safe = jnp.where(do_j, sel[j], lcap)
-            feat_of = feat_of.at[safe].set(feat_b, mode="drop")
-            bin_of = bin_of.at[safe].set(bin_b, mode="drop")
-            dl_of = dl_of.at[safe].set(dl_b, mode="drop")
-            cat_of = cat_of.at[safe].set(feat_cat, mode="drop")
-            child_of = child_of.at[safe].set(new_slot, mode="drop")
-            active = active.at[safe].set(True, mode="drop")
-            if cat:
-                mask_of = mask_of.at[safe].set(mask, mode="drop")
+            (_, slot_of_row, depth_of_slot, s_slot, s_feat, s_bin,
+             s_valid, s_gain, s_is_cat, s_mask, s_dl) = apply_split(
+                do_j, sel[j], rec_c, new_slot, top_g[j], hists_f,
+                feats_f, bins_f, dls_f, slot_of_row, depth_of_slot,
+                s_slot, s_feat, s_bin, s_valid, s_gain, s_is_cat, s_mask,
+                s_dl, hrow_f=hrow_f)
             do_js.append(do_j)
             parents.append(sel[j])
             children.append(new_slot)
-        # ONE fused routing pass. Correctness: each row is touched by at
-        # most one split per pass — parents are distinct pre-pass leaves
-        # and children (slots > next_rec) can never be parents (slots <=
-        # next_rec) within the pass — so the k sequential updates commute
-        # and collapse into a table lookup keyed on the row's pass-start
-        # slot. Boolean-identical to the sequential application.
-        slot = slot_of_row
-        f_row = feat_of[slot]                                       # [N]
-        col = jnp.take_along_axis(
-            binned, f_row[:, None], axis=1)[:, 0].astype(jnp.int32)
-        if cat:
-            go_right = jnp.where(cat_of[slot], ~mask_of[slot, col],
-                                 col > bin_of[slot])
-        else:
-            go_right = col > bin_of[slot]
-        if miss:
-            go_right = jnp.where(is_miss_f[f_row] & (col == 0),
-                                 ~dl_of[slot], go_right)
-        slot_of_row = jnp.where(active[slot] & go_right, child_of[slot],
-                                slot)
         applied = sum(d.astype(jnp.int32) for d in do_js)
         return (next_rec + applied, done | (applied == 0), depth_of_slot,
                 slot_of_row, s_slot, s_feat, s_bin, s_valid, s_gain,
