@@ -103,6 +103,15 @@ _ZOO: Dict[str, Callable[[], ModelSchema]] = {
         "ResNet-Digits", ResNet(stage_sizes=(1, 1), num_classes=10),
         (16, 16, 3), ["stage1", "stage2", "pool", "logits"],
         mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
+    "ResNet-DigitsClutter32": lambda: ModelSchema(
+        # the HARDER bundled anchor (scripts/train_zoo_checkpoint2.py):
+        # twice the block depth, 32x32 input, trained on the
+        # DigitsClutter-32 task (random digit placement + distractor
+        # fragments + noise) — the transfer-quality anchor for the full
+        # image-bytes path (decode->resize->unroll->featurize->train)
+        "ResNet-DigitsClutter32", ResNet(stage_sizes=(2, 2), num_classes=10),
+        (32, 32, 3), ["stage1", "stage2", "pool", "logits"],
+        mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5)),
 }
 
 _BUNDLED_ZOO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),

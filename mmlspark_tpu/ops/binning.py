@@ -21,6 +21,21 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 
+def _has_any_nan(X: np.ndarray) -> bool:
+    """Cheap whole-matrix NaN probe: NaN propagates through summation, so a
+    non-NaN total PROVES the matrix NaN-free with one vectorized reduce —
+    ~25x cheaper than `np.isnan(X).any()` at bench shapes, and the fit/
+    transform NaN bookkeeping (nanmin/nanmax, per-column isnan scans, the
+    no-missing-feature NaN coercion pass) was half the host binning cost of
+    a 4M-row fit (docs/PERF.md round-5 decomposition). ±inf pairs can
+    false-POSITIVE (inf - inf = NaN) — the caller then takes the exact
+    detailed path, which is merely slower, never wrong."""
+    if X.dtype.kind != "f" or X.size == 0:
+        return False
+    with np.errstate(all="ignore"):
+        return bool(np.isnan(np.sum(X, dtype=np.float64)))
+
+
 def compute_bin_edges(X: np.ndarray, max_bins: int = 255,
                       sample_count: int = 200_000, seed: int = 0,
                       max_bins_by_feature: Optional[np.ndarray] = None
@@ -159,14 +174,23 @@ class BinMapper:
                         f"maxBin={max_bins}; codes >= {max_bins} are clipped "
                         f"into one bin (raise maxBin to keep them distinct)")
         X = np.asarray(X)
+        # one cheap reduce decides whether ANY NaN bookkeeping is needed:
+        # when the matrix is provably clean (the common case), plain
+        # min/max replace the masked nanmin/nanmax and the per-column
+        # isnan scan is skipped outright
+        any_nan = _has_any_nan(X) if len(X) else False
         with np.errstate(all="ignore"):
-            fmin = (np.nanmin(X, axis=0).astype(np.float64)
-                    if len(X) else None)
-            fmax = (np.nanmax(X, axis=0).astype(np.float64)
-                    if len(X) else None)
+            if not len(X):
+                fmin = fmax = None
+            elif any_nan:
+                fmin = np.nanmin(X, axis=0).astype(np.float64)
+                fmax = np.nanmax(X, axis=0).astype(np.float64)
+            else:
+                fmin = X.min(axis=0).astype(np.float64)
+                fmax = X.max(axis=0).astype(np.float64)
         f = X.shape[1] if X.ndim == 2 else 0
         missing = np.zeros(f, bool)
-        if use_missing and len(X) and X.dtype.kind == "f":
+        if use_missing and len(X) and X.dtype.kind == "f" and any_nan:
             # full-data NaN scan (a sample could miss rare NaNs, and the
             # missing bin changes routing semantics for the whole feature)
             missing = np.isnan(X).any(axis=0)
@@ -190,27 +214,28 @@ class BinMapper:
         out = apply_bins(X, self.edges)
         X = np.asarray(X)
         is_float = X.dtype.kind == "f"
-        if self.missing.any() and is_float:
+        # the one-reduce probe makes the clean path (no NaN anywhere) skip
+        # every per-column isnan scan below — at 4M x 28 those scans plus
+        # the X[:, njs] fancy-index copy cost more than apply_bins itself
+        any_nan = _has_any_nan(X) if is_float else False
+        # ONE full-matrix isnan serves both branches on the (rare)
+        # NaN-present path; the clean path skips every scan
+        nanmask = np.isnan(X) if any_nan else None
+        if self.missing.any() and is_float and any_nan:
             # shift value bins up by one on missing-capable features; NaN
             # takes the reserved bin 0
             mjs = np.nonzero(self.missing)[0]
-            sub = X[:, mjs]
-            out[:, mjs] = np.where(np.isnan(sub), 0, out[:, mjs] + 1)
+            out[:, mjs] = np.where(nanmask[:, mjs], 0, out[:, mjs] + 1)
         elif self.missing.any():
-            out[:, self.missing] += 1   # no NaN possible in int input
+            out[:, self.missing] += 1   # NaN-free: pure shift
         no_miss = ~self.missing
-        if no_miss.any() and is_float:
+        if no_miss.any() and is_float and any_nan:
             # NaN on a feature with no training missing = upstream
-            # MissingType::None: treated as the value 0.0. One vectorized
-            # isnan over the non-missing block, early-out when clean (the
-            # overwhelmingly common case).
-            njs = np.nonzero(no_miss)[0]
-            nanmask = np.isnan(X[:, njs])
-            if nanmask.any():
-                for i in np.nonzero(nanmask.any(axis=0))[0]:
-                    j = int(njs[i])
-                    out[nanmask[:, i], j] = int(np.searchsorted(
-                        self.edges[j], 0.0, side="left"))
+            # MissingType::None: treated as the value 0.0
+            for j in np.nonzero(no_miss & nanmask.any(axis=0))[0]:
+                j = int(j)
+                out[nanmask[:, j], j] = int(np.searchsorted(
+                    self.edges[j], 0.0, side="left"))
         if self.categorical:
             for j in self.categorical:
                 col = np.nan_to_num(X[:, j], nan=0.0)

@@ -109,6 +109,50 @@ def main():
     for batch, per_call in rows:
         print(f"| {batch} | {per_call * 1e3:.3f} | "
               f"{batch / per_call:.0f} | {stamp} |")
+
+    # --- end-to-end HTTP -> TPU inference -> reply (round-4 verdict #4) ---
+    # Real localhost HTTP through the production asyncio listener + batcher
+    # with a handler that scores ON THE CHIP (jit scoring program + device
+    # fetch per batch). On this environment every device fetch crosses the
+    # ~relay RTT measured above — a physics floor no framework code can
+    # remove — so the p50/p99 decompose as (listener+batcher, measured
+    # sub-ms vs a numpy handler in tests/test_serving_latency.py) +
+    # (device dispatch, the per-call rows above) + relay. On a TPU host
+    # with the chip on PCIe the relay term vanishes and the composition is
+    # sub-ms end-to-end; both rows land in docs/SERVING.md.
+    import json
+    import urllib.request
+
+    from mmlspark_tpu.io.serving import ServingServer
+
+    score_jit = jax.jit(score_once)
+
+    def tpu_handler(df):
+        xb = jnp.asarray(np.stack(df["features"]).astype(np.float32))
+        proba = np.asarray(score_jit(xb))       # device fetch (relay RTT)
+        return df.with_column("scored", proba.astype(np.float64))
+
+    srv = ServingServer(tpu_handler, reply_col="scored", port=0,
+                        vector_cols=("features",),
+                        max_batch_size=64).start()
+    try:
+        body = json.dumps({"features": [float(v) for v in x[0]]}).encode()
+        lat = []
+        for i in range(120):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(
+                    urllib.request.Request(srv.url, data=body), timeout=30):
+                pass
+            lat.append(time.perf_counter() - t0)
+        lat = np.asarray(lat[20:]) * 1e3        # drop warmup
+        print()
+        print(f"HTTP->TPU->reply (batch-1, localhost, relay in path): "
+              f"p50 {np.percentile(lat, 50):.2f} ms  "
+              f"p99 {np.percentile(lat, 99):.2f} ms  "
+              f"(relay RTT ~{rtt * 1e3:.0f} ms of that; "
+              f"listener+batcher sub-ms per test_serving_latency)")
+    finally:
+        srv.stop()
     return 0
 
 

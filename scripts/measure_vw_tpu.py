@@ -34,9 +34,19 @@ def main():
     y = ((x @ rng.normal(size=f)) > 0).astype(np.float64)
     df = DataFrame({"features": x, "label": y})
 
-    for passes in (1, 3):
-        clf = VowpalWabbitClassifier(numPasses=passes, numBits=18,
-                                     adaptive=True, numTasks=1)
+    # grid: the default engine (adaptive+normalized+invariant), plain SGD
+    # (1 table instead of 3 -> fewer scatters per step), and the minibatch
+    # ladder (the documented TPU fidelity/speed knob: larger minibatches
+    # cut lax.scan steps/pass; fidelity-vs-upstream is pinned at 256)
+    cases = [("default mb=256", dict(numPasses=1)),
+             ("default mb=256 x3", dict(numPasses=3)),
+             ("plain_sgd mb=256", dict(numPasses=1, adaptive=False,
+                                       normalized=False, invariant=False)),
+             ("default mb=2048", dict(numPasses=1, minibatchSize=2048)),
+             ("default mb=8192", dict(numPasses=1, minibatchSize=8192))]
+    for tag, kw in cases:
+        passes = kw.get("numPasses", 1)
+        clf = VowpalWabbitClassifier(numBits=18, numTasks=1, **kw)
         t0 = time.time()
         clf.fit(df)
         warm = time.time() - t0
@@ -45,7 +55,7 @@ def main():
         wall = time.time() - t0
         rate = n * passes / wall
         stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
-        print(f"passes={passes}: warm {warm:.1f}s timed {wall:.1f}s = "
+        print(f"{tag}: warm {warm:.1f}s timed {wall:.1f}s = "
               f"{rate / 1e6:.2f}M examples/s ({stamp})", flush=True)
         del m
     return 0

@@ -267,3 +267,102 @@ class TestBundledZooAnchor:
                                == y[te]).mean())
         assert accs["pretrained"] >= 0.93, accs
         assert accs["pretrained"] >= accs["random"] + 0.05, accs
+
+
+class TestClutterZooAnchor:
+    """The second, harder bundled checkpoint (round-4 verdict #6):
+    ResNet-DigitsClutter32 — twice the block depth at 32x32 on the
+    DigitsClutter-32 task (random digit placement + distractor fragments +
+    noise; mmlspark_tpu/models/deep/zoo_tasks.py). Gates assert ABSOLUTE
+    accuracy through the FULL image-bytes path, not just >= random-init."""
+
+    def _clutter_test_split(self):
+        from mmlspark_tpu.models.deep.zoo_tasks import make_clutter_dataset
+        _, _, xte, yte = make_clutter_dataset()
+        return xte, yte.astype(np.float64)
+
+    def test_checkpoint_reaches_documented_accuracy(self):
+        from mmlspark_tpu.models.deep.resnet import (ModelDownloader,
+                                                     _BUNDLED_ZOO_DIR)
+        import jax.numpy as jnp
+        manifest = json.load(open(os.path.join(_BUNDLED_ZOO_DIR,
+                                               "MANIFEST.json")))
+        doc = [m for m in manifest
+               if m["name"] == "ResNet-DigitsClutter32"][0]
+        gm = ModelDownloader().download_by_name("ResNet-DigitsClutter32")
+        xte, yte = self._clutter_test_split()
+        preds = []
+        for lo in range(0, len(yte), 256):
+            logits = np.asarray(gm.module.apply(
+                gm.variables, jnp.asarray((xte[lo:lo + 256] - 0.5) / 0.5)))
+            preds.append(logits.argmax(1))
+        acc = float((np.concatenate(preds) == yte).mean())
+        assert acc >= doc["testAccuracy"] - 0.01, (acc, doc["testAccuracy"])
+
+    def test_full_bytes_path_transfer_absolute_accuracy(self):
+        """decode -> resize -> featurize -> TrainClassifier, starting from
+        ENCODED PNG BYTES (the reference's production route:
+        BinaryFileReader -> ImageTransformer -> ImageFeaturizer ->
+        TrainClassifier, ImageFeaturizer.scala:40-191). The gate asserts
+        an ABSOLUTE accuracy floor, not just a margin over random init —
+        and serves the images at a different size (48x48) so the resize
+        stage does real work."""
+        import io as _io
+
+        from PIL import Image
+
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.io.files import decode_image
+        from mmlspark_tpu.models.deep import ImageFeaturizer
+        from mmlspark_tpu.models.deep.image import ImageTransformer
+        from mmlspark_tpu.models.deep.resnet import ModelDownloader
+        from mmlspark_tpu.models.deep.zoo_tasks import make_clutter_dataset
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+        from mmlspark_tpu.train import TrainClassifier
+
+        xtr, ytr, xte, yte = make_clutter_dataset()
+        tr_n, te_n = 360, 180               # small transfer budget
+        rng = np.random.default_rng(5)
+        tr = rng.choice(len(ytr), tr_n, replace=False)
+        te = rng.choice(len(yte), te_n, replace=False)
+
+        def to_png_bytes(img01):
+            # serve at 48x48 so the pipeline's resize is not a no-op
+            u8 = (np.clip(img01, 0, 1) * 255).astype(np.uint8)
+            pil = Image.fromarray(u8).resize((48, 48), Image.BILINEAR)
+            buf = _io.BytesIO()
+            pil.save(buf, format="PNG")
+            return buf.getvalue()
+
+        def featurize(xs, extra):
+            blobs = np.empty(len(xs), dtype=object)
+            for i in range(len(xs)):
+                blobs[i] = to_png_bytes(xs[i])
+            df = DataFrame(dict(bytes=blobs, **extra))
+            # decode stage (BinaryFileReader/read_image role)
+            imgs = np.empty(len(xs), dtype=object)
+            for i, blob in enumerate(df["bytes"]):
+                imgs[i] = decode_image(blob).astype(np.float32) / 255.0
+            df = df.with_column("image", imgs).drop("bytes")
+            # resize 48 -> 32 (the model's input dims)
+            df = (ImageTransformer(inputCol="image", outputCol="image")
+                  .resize(32, 32).transform(df))
+            feat = ImageFeaturizer(
+                model=ModelDownloader().download_by_name(
+                    "ResNet-DigitsClutter32"),
+                cutOutputLayers=1, inputCol="image", outputCol="features",
+                batchSize=128)
+            return feat.transform(df).drop("image")
+
+        df_tr = featurize(xtr[tr], {"label": ytr[tr].astype(np.float64)})
+        df_te = featurize(xte[te], {})
+        clf = TrainClassifier(
+            model=LightGBMClassifier(numIterations=30, numLeaves=15,
+                                     numTasks=1),
+            labelCol="label").fit(df_tr)
+        pred = np.asarray(clf.transform(df_te)["scored_labels"], np.float64)
+        acc = float((pred == yte[te].astype(np.float64)).mean())
+        # absolute floor: pretrained features through the full bytes path
+        # must classify held-out clutter digits at >= 0.85 on a 360-image
+        # training budget (random-init features reach ~0.5 here)
+        assert acc >= 0.85, acc
