@@ -318,6 +318,13 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
         nf = 1 << int(eff["numBits"])
         ntasks = self.get("numTasks") or jax.local_device_count()
         mb = self.get("minibatchSize")
+        # row-invariant index detection (dense feature columns and their
+        # interactions hash to the same index vector on every row): checked
+        # on the REAL rows, before padding — pad rows carry value 0 and are
+        # inert on both scatter paths, so they cannot break the
+        # equivalence (sgd.VWConfig.shared_indices)
+        fi = feats.indices
+        shared = bool(fi.size) and bool((fi == fi[:1]).all())
         cfg = VWConfig(
             num_features=nf, loss=eff["loss"] or self._loss,
             learning_rate=float(eff["learningRate"]),
@@ -327,6 +334,7 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
             invariant=bool(eff["invariant"]),
             num_passes=int(eff["numPasses"]), minibatch=mb,
             use_constant=bool(eff["useConstant"]),
+            shared_indices=shared,
             axis_name=meshlib.DATA_AXIS if ntasks > 1 else None)
         train = make_train_fn(cfg)
         t_ingest = time.perf_counter_ns()

@@ -198,3 +198,49 @@ def test_contextual_bandit_parallel_fit():
     # per-map copies must not mutate the source estimator
     assert cb.get("learningRate") not in (0.11, 0.77)
     assert cb.parallel_fit(df, []) == []
+
+
+def test_shared_indices_path_equals_general():
+    """The row-invariant (dense-column) scatter fast path must reproduce
+    the general [B, k] path's state exactly up to f32 summation order —
+    across every engine-mode combination (adaptive/normalized/invariant
+    on and off), both losses, importance weights, and padding rows."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.vw.sgd import (VWConfig, init_state,
+                                            make_train_fn, pad_examples)
+
+    rng = np.random.default_rng(3)
+    n, f = 1000, 12
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y_sq = (x @ rng.normal(size=f)).astype(np.float32)
+    y_lg = np.where(y_sq > 0, 1.0, -1.0).astype(np.float32)
+    wts = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    indices = np.broadcast_to(np.arange(f, dtype=np.int32), (n, f)).copy()
+
+    for loss, yv in (("squared", y_sq), ("logistic", y_lg)):
+        for adaptive, normalized, invariant in (
+                (True, True, True), (False, False, False),
+                (True, False, False), (False, True, True)):
+            base = dict(num_features=64, loss=loss, num_passes=2,
+                        minibatch=128, adaptive=adaptive,
+                        normalized=normalized, invariant=invariant,
+                        l1=1e-6, l2=1e-6)
+            idx_p, val_p, y_p, w_p = pad_examples(indices, x, yv, wts, 128)
+            outs = {}
+            for shared in (False, True):
+                cfg = VWConfig(shared_indices=shared, **base)
+                st, losses = make_train_fn(cfg)(
+                    jnp.asarray(idx_p), jnp.asarray(val_p),
+                    jnp.asarray(y_p), jnp.asarray(w_p), init_state(64))
+                outs[shared] = (st, losses)
+            s0, l0 = outs[False]
+            s1, l1 = outs[True]
+            tag = (loss, adaptive, normalized, invariant)
+            np.testing.assert_allclose(s0.w, s1.w, rtol=2e-5, atol=2e-6,
+                                       err_msg=str(tag))
+            np.testing.assert_allclose(s0.g2, s1.g2, rtol=2e-5, atol=2e-6)
+            np.testing.assert_allclose(s0.scale, s1.scale, rtol=1e-6)
+            np.testing.assert_allclose(s0.bias, s1.bias, rtol=2e-5,
+                                       atol=2e-6)
+            np.testing.assert_allclose(l0, l1, rtol=2e-5)
