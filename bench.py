@@ -53,12 +53,18 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
 # measurement alongside whatever this run produces (round-3 verdict #1).
 PERF_PROVENANCE = {
     "source": "docs/PERF.md — measured on live TPU v5e (1 chip, via relay)",
-    "date_utc": "2026-07-31",
-    "eager_4Mx28x100_rows_iter_per_s": 8.01e6,
-    "eager_4Mx28x100_vs_baseline": 0.291,
-    "lazy_4Mx28x100_rows_iter_per_s": 19.72e6,
-    "higgs11M_lazy_rows_iter_per_s": 18.13e6,
-    "higgs11M_lazy_vs_baseline": 0.659,
+    "date_utc": "2026-08-01",
+    # round-5 headline: batched-k8 promoted under the on-run ±0.002
+    # AUC-parity gate (strict-order split quality; AUC 0.9677 vs exact
+    # 0.9686 on the same run) — full json in docs/bench_r5_run1.log
+    "batchedk8_4Mx28x100_rows_iter_per_s": 25.40e6,
+    "batchedk8_4Mx28x100_vs_baseline": 0.9235,
+    "batchedk8_higgs11M_rows_iter_per_s": 23.88e6,
+    "batchedk8_higgs11M_vs_baseline": 0.8682,
+    "eager_4Mx28x100_rows_iter_per_s": 9.28e6,
+    "per_iter_1M_ms": {"eager": 92.41, "lazy": 20.16, "batched_k8": 24.57},
+    "binning_4M_host_s_after_nan_fastpath": 1.84,  # was 7.89 in that run
+    "vw_1Mx30_examples_per_s": 0.18e6,
     "hist_pass_pallas_bf16_ms": 2.90,
     "serving_device_dispatch_ms": 0.062,
 }

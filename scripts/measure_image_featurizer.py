@@ -1,7 +1,10 @@
 """ImageFeaturizer forward throughput on chip (round-2 verdict #5).
 
-Measures the jitted ResNet-50 headless forward (the CNTKModel.scala:30-140
-hot-loop replacement) in images/s at the zoo's native 224x224 input.
+Measures the jitted headless forward (the CNTKModel.scala:30-140 hot-loop
+replacement) in images/s across the zoo ladder — ResNet-DigitsClutter32
+(32x32), ResNet18-ish (64x64), ResNet50 (224x224) — smallest compile
+first and each model fenced, so one model's hang/failure cannot cost the
+others' rows (the ResNet-50 compile hung >35 min on 2026-08-01).
 
 Methodology: async-dispatch pipelining instead of the scan-of-forwards used
 by the kernel sweeps — jax dispatches queue without blocking, so timing N
@@ -32,36 +35,45 @@ def main():
 
     from mmlspark_tpu.models.deep import ModelDownloader
 
-    gm = ModelDownloader().download_by_name("ResNet50")
-    h, w, c = gm.schema.input_dims
     rng = np.random.default_rng(0)
-    fwd = jax.jit(lambda v, x_: gm.module.apply(v, x_, capture="pool"))
-
     stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
-    print("| batch | device ms/batch | images/s | date |")
-    print("|---|---|---|---|")
-    for batch in (8, 32, 64):
-        xb = jnp.asarray(rng.normal(size=(batch, h, w, c)), jnp.float32)
-        out = fwd(gm.variables, xb)
-        jax.block_until_ready(out)               # compile + settle
+    print("| model | batch | device ms/batch | images/s | date |",
+          flush=True)
+    print("|---|---|---|---|---|", flush=True)
+    # smallest compile first: a hang on the big ResNet-50 224x224 compile
+    # (observed >35 min on 2026-08-01, suspected pool hang) must not cost
+    # the rows the smaller models can land in the same window
+    for name in ("ResNet-DigitsClutter32", "ResNet18-ish", "ResNet50"):
+      try:
+        gm = ModelDownloader().download_by_name(name)
+        h, w, c = gm.schema.input_dims
+        fwd = jax.jit(lambda v, x_, _gm=gm: _gm.module.apply(
+            v, x_, capture="pool"))
+        for batch in (8, 64):
+            xb = jnp.asarray(rng.normal(size=(batch, h, w, c)), jnp.float32)
+            out = fwd(gm.variables, xb)
+            jax.block_until_ready(out)               # compile + settle
 
-        def loop(k):
-            t0 = time.perf_counter()
-            o = None
-            for _ in range(k):
-                o = fwd(gm.variables, xb)
-            float(jnp.sum(o))                    # one fetch barrier
-            return time.perf_counter() - t0
+            def loop(k):
+                t0 = time.perf_counter()
+                o = None
+                for _ in range(k):
+                    o = fwd(gm.variables, xb)
+                float(jnp.sum(o))                    # one fetch barrier
+                return time.perf_counter() - t0
 
-        loop(4)
-        diffs = []
-        for _ in range(3):
-            t1 = loop(8)
-            t2 = loop(16)
-            diffs.append((t2 - t1) / 8)
-        per_batch = float(np.median(diffs))
-        print(f"| {batch} | {per_batch * 1e3:.2f} | "
-              f"{batch / per_batch:.0f} | {stamp} |", flush=True)
+            loop(4)
+            diffs = []
+            for _ in range(3):
+                t1 = loop(8)
+                t2 = loop(16)
+                diffs.append((t2 - t1) / 8)
+            per_batch = float(np.median(diffs))
+            print(f"| {name} | {batch} | {per_batch * 1e3:.2f} | "
+                  f"{batch / per_batch:.0f} | {stamp} |", flush=True)
+      except Exception as e:  # noqa: BLE001 - one model must not cost the rest
+        print(f"| {name} | - | FAILED {type(e).__name__}: {str(e)[:120]} | "
+              f"- | {stamp} |", flush=True)
     return 0
 
 
