@@ -1,0 +1,54 @@
+"""Production-scale GBDT configuration — the round-5 composition.
+
+The config a multi-pod v5e fit would actually run, with every TPU-native
+knob engaged at once (reference analogue: LightGBM's voting-parallel
+tree_learner + max_bin + early stopping driven from
+lightgbm/LightGBMParams.scala, all of which the C++ composes freely):
+
+- `splitsPerPass=8`  — batched leaf-wise growth: top-8 never-stale splits
+  per histogram pass (3.8x eager on a real v5e at strict-order split
+  quality; docs/PERF.md);
+- `parallelism="voting_parallel"` + `topK` — only the globally-voted
+  features' histogram slices ride the interconnect (the cross-pod/DCN
+  traffic mode; measured 2x+ bytes/split reduction in the dryrun);
+- `numTasks=8`       — shard_map data parallelism over the device mesh;
+- `itersPerCall=20`  — bounded device programs with exact chunked
+  continuation (survives shared pools that evict long programs);
+- `earlyStoppingRound` on a validation split.
+"""
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+from mmlspark_tpu.train.metrics import auc_score
+
+
+def main(n=40000, f=24, iters=60):
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((x @ rng.normal(size=f) + 0.4 * x[:, 2] * x[:, 3]
+          + rng.normal(scale=0.5, size=n)) > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    train, test = df.random_split([0.8, 0.2], seed=3)
+
+    clf = LightGBMClassifier(
+        numIterations=iters, numLeaves=31, maxBin=64,
+        splitsPerPass=8,                    # batched growth (perf mode)
+        parallelism="voting_parallel", topK=12,  # traffic mode
+        numTasks=8,                         # data-parallel mesh shards
+        itersPerCall=20,                    # eviction-safe chunking
+        earlyStoppingRound=10, validationIndicatorCol="isVal")
+    tr = train.with_column(
+        "isVal", (np.arange(len(train)) % 5 == 0).astype(np.float64))
+    model = clf.fit(tr)
+    proba = np.stack(model.transform(test)["probability"])[:, 1]
+    auc = auc_score(test["label"], proba)
+    stop = model.booster.best_iteration
+    print("held-out AUC", round(float(auc), 4),
+          "| iterations:", model.booster.num_iterations,
+          "| early-stopped at:", stop if stop is not None else "no stop")
+    return float(auc)
+
+
+if __name__ == "__main__":
+    print("AUC", main())

@@ -78,3 +78,7 @@ def test_conditional_knn():
 
 def test_long_context_attention():
     assert _run("long_context_attention.py") < 1e-4
+
+
+def test_production_scale_fit():
+    assert _run("production_scale_fit.py") > 0.85
