@@ -1,10 +1,10 @@
 #!/bin/bash
 # Patient TPU recovery watcher. The shared-pool backend wedges after a client
-# is killed mid-dispatch (observed twice in round 2: init then hangs ~26 min
-# per attempt before erroring UNAVAILABLE). This watcher probes WITHOUT
-# killing anything — each probe is allowed to hang until the backend itself
-# answers or errors — and on the first healthy probe runs the pending
-# measurements + bench, logging into the repo.
+# is killed mid-dispatch (observed rounds 2/4/5: init then hangs ~25 min per
+# attempt before erroring UNAVAILABLE). This watcher probes WITHOUT killing
+# anything — each probe is allowed to hang until the backend itself answers
+# or errors — and on the first healthy probe runs the round-5 pending queue
+# in priority order, each step fenced so one failure cannot cost the rest.
 #
 # Usage: nohup bash scripts/tpu_recovery_watch.sh >> docs/tpu_watch.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
@@ -18,22 +18,20 @@ assert jax.devices()[0].platform != "cpu"
 float((x @ x).sum())
 EOF
   then
-    echo "== chip healthy $(date -u +%FT%TZ) — running measurements"
+    echo "== chip healthy $(date -u +%FT%TZ) — running round-5 queue"
     if ! python -u scripts/quick_fit_probe.py; then
       echo "== quick fit probe FAILED $(date -u +%FT%TZ); back to probing"
       sleep 120
       continue
     fi
-    echo "== image featurizer $(date -u +%FT%TZ)"
-    python -u scripts/measure_image_featurizer.py
-    echo "== scan modes (incl. batched k=4/k=8) $(date -u +%FT%TZ)"
-    python -u scripts/measure_scan_modes.py
-    echo "== vw throughput $(date -u +%FT%TZ)"
-    python -u scripts/measure_vw_tpu.py
-    echo "== split bookkeeping microprofile $(date -u +%FT%TZ)"
-    python -u scripts/profile_split.py
-    echo "== bench $(date -u +%FT%TZ)"
+    echo "== serving (incl. HTTP->TPU->reply E2E) $(date -u +%FT%TZ)"
+    python -u scripts/measure_serving_tpu.py
+    echo "== bench (validates binning fast path on chip) $(date -u +%FT%TZ)"
     python -u bench.py
+    echo "== vw throughput (validates shared-index fast path) $(date -u +%FT%TZ)"
+    python -u scripts/measure_vw_tpu.py
+    echo "== image featurizer ladder $(date -u +%FT%TZ)"
+    python -u scripts/measure_image_featurizer.py
     echo "== watcher done $(date -u +%FT%TZ)"
     exit 0
   fi
