@@ -132,9 +132,13 @@ def main():
         proba = np.asarray(score_jit(xb))       # device fetch (relay RTT)
         return df.with_column("scored", proba.astype(np.float64))
 
+    # max_latency_ms=0.0: a lone request must not sit in the dynamic
+    # batcher waiting for companions — this row measures the
+    # latency-optimal single-request config (the reference's continuous
+    # mode is per-request; throughput configs raise the window instead)
     srv = ServingServer(tpu_handler, reply_col="scored", port=0,
                         vector_cols=("features",),
-                        max_batch_size=64).start()
+                        max_batch_size=64, max_latency_ms=0.0).start()
     try:
         body = json.dumps({"features": [float(v) for v in x[0]]}).encode()
         lat = []
