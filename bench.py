@@ -78,134 +78,33 @@ _PROBE_CODE = ("import jax; d = jax.devices(); "
 def _patient_backend_bringup(budget_s=None, retry_sleep_s=90, min_probe_s=60):
     """Patient bounded TPU bring-up (round-3 verdict, next-round #1).
 
-    The shared axon pool has two measured failure modes (docs/tpu_watch.log,
-    rounds 2-3): fast UNAVAILABLE errors, and init hangs that clear in
-    ~25 min after a killed client wedged the pool's grant. Round 3's
-    2 x 150 s killable probes therefore declared CPU fallback while the pool
-    was merely wedged. Two changes:
-
-    - probe for up to ~22 min wall (override: BENCH_BRINGUP_BUDGET_S),
-      sleeping ~90 s between failed attempts — matching observed
-      wedge-clear times;
-    - let each probe RUN TO COMPLETION instead of killing it on a timer:
-      killing a client that holds the grant is precisely what wedges the
-      pool for every later process. The only kill is at the very end of the
-      budget, when this bench is the round's last consumer of the chip.
+    The probe loop itself now lives behind the shared resilience layer
+    (mmlspark_tpu/resilience/bringup.py, scheduling via RetryPolicy with
+    jittered backoff + a Deadline wall budget; see parallel/mesh.py). This
+    wrapper keeps the bench-specific pieces: the BENCH_BRINGUP_BUDGET_S
+    override, the module-level probe log `_emit` reads on every exit path,
+    and the watchdog that still emits the mandatory JSON line if the
+    parent's own backend init hangs after a healthy probe.
 
     Every attempt (offset, duration, outcome) is recorded and returned so
     the BENCH json itself shows whether the pool was down the whole window.
     Returns (jax, devices, error_or_None, attempts).
     """
-    import subprocess
-    import sys
+    from mmlspark_tpu.resilience.bringup import backend_bringup
     if budget_s is None:
         budget_s = int(os.environ.get("BENCH_BRINGUP_BUDGET_S", "1320"))
-    t0 = time.time()
     _BRINGUP_LOG.clear()
-    attempts = _BRINGUP_LOG
-    # min_probe_s: don't spawn a probe that can't get a fair shot — a probe
-    # killed seconds into init is both useless and (if the pool is in hang
-    # mode) a fresh grant-holding kill
-    import tempfile
-    while time.time() - t0 < budget_s:
-        a0 = time.time()
-        # temp files, not PIPEs: a verbose plugin init can overflow a 64 KB
-        # pipe buffer and block the child — indistinguishable from an init
-        # hang from out here
-        fo = tempfile.TemporaryFile(mode="w+")
-        fe = tempfile.TemporaryFile(mode="w+")
-        try:
-            p = subprocess.Popen([sys.executable, "-c", _PROBE_CODE],
-                                 stdout=fo, stderr=fe, text=True)
-        except OSError as e:
-            # transient (EAGAIN under memory pressure, etc.) — retry within
-            # the budget like any other failed attempt
-            attempts.append({"t_s": round(a0 - t0, 1), "dur_s": 0.0,
-                             "outcome": f"spawn failed: {e}"})
-            fo.close()
-            fe.close()
-            if budget_s - (time.time() - t0) <= retry_sleep_s + min_probe_s:
-                break
-            time.sleep(retry_sleep_s)
-            continue
-        while p.poll() is None and time.time() - t0 < budget_s:
-            time.sleep(0.5)
-        hung = p.poll() is None
-        if hung:
-            p.kill()
-            p.wait()
-        fo.seek(0)
-        out = fo.read()
-        fe.seek(0)
-        err = fe.read()
-        fo.close()
-        fe.close()
-        if hung:
-            attempts.append({"t_s": round(a0 - t0, 1),
-                             "dur_s": round(time.time() - a0, 1),
-                             "outcome": "init hang — killed at budget end"})
-            break
-        dur = time.time() - a0
-        platform = out.strip().rsplit(" ", 1)[-1] if out.strip() else "?"
-        if p.returncode == 0 and platform not in ("cpu", "?"):
-            attempts.append({"t_s": round(a0 - t0, 1), "dur_s": round(dur, 1),
-                             "outcome": f"healthy: {out.strip()}"})
-            # The parent's OWN backend init can still hang (the probe's exit
-            # released its grant; another client may grab or wedge the pool in
-            # the gap). A watchdog guarantees the mandatory JSON line lands
-            # even then — emit the fallback record and hard-exit. The timer
-            # absorbs all remaining bring-up budget (+ grace) first, so the
-            # hard-exit — itself a grant-holding kill — fires only once
-            # waiting longer could no longer produce a bench run anyway.
-            import threading
-            wd_s = max(240.0, budget_s - (time.time() - t0) + 120.0)
-            watchdog = threading.Timer(wd_s, lambda: (
-                _emit(0.0, error="parent backend init hung after a healthy "
-                                 "probe — pool lost between probe exit and "
-                                 "parent grant"),
-                os._exit(0)))
-            watchdog.daemon = True
-            watchdog.start()
-            try:
-                import jax
-                jdevs = jax.devices()
-            except Exception as e:  # noqa: BLE001 - treat as failed attempt
-                watchdog.cancel()
-                attempts.append({"t_s": round(time.time() - t0, 1),
-                                 "dur_s": 0.0,
-                                 "outcome": f"parent init error: {e}"[:240]})
-                break  # jax is imported now; can't retry backend selection
-            watchdog.cancel()
-            return jax, jdevs, None, list(attempts)
-        detail = (err or out).strip().replace("\n", " ")[-220:]
-        attempts.append({"t_s": round(a0 - t0, 1), "dur_s": round(dur, 1),
-                         "outcome": f"error: {detail}"})
-        remaining = budget_s - (time.time() - t0)
-        if remaining <= retry_sleep_s + min_probe_s:
-            break
-        time.sleep(retry_sleep_s)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    try:
-        # works even when jax was already imported by a failed parent-init
-        # attempt above (the documented post-import CPU-forcing path)
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
-    n_probes = sum(1 for a in attempts
-                   if not a["outcome"].startswith(("parent", "healthy")))
-    err_msg = (f"no healthy TPU across {n_probes} probe(s) in a "
-               f"{round(time.time() - t0)} s bring-up window"
-               + (" (a probe succeeded but the parent's own init failed)"
-                  if n_probes != len(attempts) else ""))
-    try:
-        devs = jax.devices()
-    except Exception as e:  # noqa: BLE001 - even CPU fallback can fail when
-        # a poisoned backend cache survives the config update; surface it
-        # with the probe history rather than crashing before any JSON lands
-        raise RuntimeError(f"CPU fallback init failed after bring-up "
-                           f"({err_msg}): {e}") from e
-    return jax, devs, err_msg, list(attempts)
+
+    def on_parent_hang():
+        _emit(0.0, error="parent backend init hung after a healthy "
+                         "probe — pool lost between probe exit and "
+                         "parent grant")
+        os._exit(0)
+
+    return backend_bringup(_PROBE_CODE, budget_s=budget_s,
+                           retry_sleep_s=retry_sleep_s,
+                           min_probe_s=min_probe_s, log=_BRINGUP_LOG,
+                           on_parent_hang=on_parent_hang)
 
 
 def main():

@@ -69,6 +69,10 @@ class CognitiveServicesBase(Transformer, _p.HasOutputCol):
     errorCol = _p.Param("errorCol", "error info column", "error")
     concurrency = _p.Param("concurrency", "parallel requests", 4, int)
     timeout = _p.Param("timeout", "per-request timeout s", 60.0, float)
+    retryPolicy = _p.Param("retryPolicy",
+                           "resilience.RetryPolicy for request retries "
+                           "(None = the shared default backoff array)",
+                           None, complex=True)
 
     service_name: str = ""   # e.g. "text/analytics/v3.0/sentiment"
     method: str = "POST"
@@ -121,7 +125,8 @@ class CognitiveServicesBase(Transformer, _p.HasOutputCol):
             reqs.append(HTTPRequestData(url=url, method=self.method,
                                         headers=self.headers(df, i),
                                         entity=body))
-        client = AsyncClient(self.get("concurrency"), self.get("timeout"))
+        client = AsyncClient(self.get("concurrency"), self.get("timeout"),
+                             policy=self.get("retryPolicy"))
         resps = client.send_all(reqs)
         out = np.empty(len(df), dtype=object)
         errors = np.empty(len(df), dtype=object)

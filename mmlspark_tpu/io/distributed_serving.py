@@ -16,69 +16,134 @@ forwards to executor servers) or POST through the coordinator's forwarding
 gateway, which round-robins across workers (MultiChannelMap.addToNextList
 semantics). Replies always come back on the connection that owns the request —
 there is no cross-host respond hop to re-create because each worker owns its
-own sockets. The micro-batch tick does not exist at all: worker dispatchers
-are continuous (the HTTPSourceV2-continuous analogue), so "continuous mode"
-is the only mode.
+own sockets.
+
+Failure handling (resilience layer):
+- workers HEARTBEAT to the coordinator (`POST /heartbeat`); a monitor thread
+  evicts heartbeat-capable workers silent for `heartbeat_timeout_s` — a
+  dead worker cannot stay in the routing table forever (manual
+  registrations without a heartbeat loop keep the old contract: evicted
+  only by gateway failure detection);
+- the gateway retries a failed forward on the next healthy worker under a
+  shared `RetryPolicy`, deregistering unreachable workers immediately;
+- an evicted-but-alive worker's next heartbeat gets 410 Gone and the worker
+  RE-REGISTERS itself — transient eviction (a chaos-injected forward
+  failure, a network blip) heals without operator action;
+- request budgets ride the `X-Deadline-Ms` header: the gateway answers 504
+  when the budget is spent and re-encodes only the REMAINING budget on each
+  forward hop, so a retry can never exceed the client's patience.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
+from ..resilience import Deadline, RetryError, RetryPolicy
 from .serving import ServingServer
 
 
 class ServiceInfo:
-    """Worker registration record (HTTPSourceV2.scala ServiceInfo :126-152)."""
+    """Worker registration record (HTTPSourceV2.scala ServiceInfo :126-152).
 
-    __slots__ = ("name", "host", "port", "machine", "partition")
+    `heartbeating=True` declares at REGISTRATION time that this worker runs
+    a heartbeat loop, making it subject to silence-based eviction from the
+    moment it registers — inferring capability from the first received beat
+    would leave a worker that dies (or is GIL-starved by a jit compile)
+    before ever beating in the routing table forever."""
+
+    __slots__ = ("name", "host", "port", "machine", "partition",
+                 "heartbeating")
 
     def __init__(self, name: str, host: str, port: int,
-                 machine: str = "localhost", partition: int = 0):
+                 machine: str = "localhost", partition: int = 0,
+                 heartbeating: bool = False):
         self.name = name
         self.host = host
         self.port = port
         self.machine = machine
         self.partition = partition
+        self.heartbeating = heartbeating
 
     def to_dict(self) -> Dict:
         return {"name": self.name, "host": self.host, "port": self.port,
-                "machine": self.machine, "partition": self.partition}
+                "machine": self.machine, "partition": self.partition,
+                "heartbeating": self.heartbeating}
 
     @staticmethod
     def from_dict(d: Dict) -> "ServiceInfo":
         return ServiceInfo(d["name"], d["host"], int(d["port"]),
                            d.get("machine", "localhost"),
-                           int(d.get("partition", 0)))
+                           int(d.get("partition", 0)),
+                           bool(d.get("heartbeating", False)))
 
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/"
 
 
+def _default_transport(url: str, body: bytes, headers: Dict[str, str],
+                       timeout: float) -> Tuple[int, bytes]:
+    """One forward hop. Raises urllib.error.HTTPError for alive-but-erroring
+    workers and other exceptions for unreachable ones — the gateway treats
+    the two differently. Injectable for chaos testing (FaultInjector.wrap)."""
+    req = urllib.request.Request(url, data=body, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
 class ServingCoordinator:
-    """Driver-role registration + routing service.
+    """Driver-role registration + routing service with worker health.
 
     Endpoints:
       POST /register   body = ServiceInfo JSON           (worker -> driver)
+      POST /heartbeat  body = ServiceInfo JSON; 410 Gone => re-register
       GET  /routes/<service>                             routing table JSON
-      POST /gateway/<service>  forward round-robin to a registered worker
+      GET  /health     worker counts + eviction stats
+      POST /gateway/<service>  forward to a healthy worker (retry + evict)
+
+    Workers silent for `heartbeat_timeout_s` are evicted by a monitor
+    thread (the driver-side failure detector the reference lacks — its
+    routing table only ever grows, HTTPSourceV2.scala:113-173).
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 forward_timeout: float = 30.0):
+                 forward_timeout: float = 30.0,
+                 heartbeat_timeout_s: float = 10.0,
+                 forward_transport=None,
+                 forward_retry: Optional[RetryPolicy] = None):
         self.host, self.port = host, port
         self.forward_timeout = forward_timeout
+        self.heartbeat_timeout_s = heartbeat_timeout_s
         self._routes: Dict[str, List[ServiceInfo]] = {}
         self._rr: Dict[str, int] = {}
+        self._last_seen: Dict[Tuple[str, str, int], float] = {}
+        self._known: set = set()  # services that have EVER had a worker
+        # workers subject to silence-based eviction: declared heartbeating
+        # at registration, or actually heartbeat at least once — a plain
+        # register()/register_with_retries worker with no heartbeat loop
+        # keeps the pre-resilience contract (evicted only by gateway
+        # failure detection)
+        self._hb_seen: set = set()
         self._lock = threading.Lock()
+        self._stopev = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._transport = forward_transport or _default_transport
+        # bounded fail-fast: ~8 attempts spanning ~1.5 s rides out a
+        # transient all-evicted dip (heartbeat re-registration is sub-second)
+        # without hanging a doomed request for the full forward_timeout
+        self.forward_retry = forward_retry or RetryPolicy(
+            attempts=8, backoff_s=0.05, multiplier=1.5, max_backoff_s=0.4,
+            jitter=0.1)
+        self.stats = {"forwards": 0, "forward_retries": 0, "evictions": 0,
+                      "heartbeats": 0}
 
     # -------------------------------------------------------------- registry
     def register(self, info: ServiceInfo) -> None:
@@ -90,11 +155,24 @@ class ServingCoordinator:
             # DistributedServingServer defaults derive them from hostname +
             # bound port so unconfigured workers on any topology never
             # collide. Same-endpoint re-posts are also collapsed.
+            for s in lst:
+                if (s.machine, s.partition) == (info.machine,
+                                                info.partition) \
+                        or (s.host, s.port) == (info.host, info.port):
+                    self._last_seen.pop((info.name, s.host, s.port), None)
+                    self._hb_seen.discard((info.name, s.host, s.port))
             lst[:] = [s for s in lst
                       if (s.machine, s.partition) != (info.machine,
                                                       info.partition)
                       and (s.host, s.port) != (info.host, info.port)]
             lst.append(info)
+            self._known.add(info.name)
+            key = (info.name, info.host, info.port)
+            self._last_seen[key] = time.monotonic()
+            if info.heartbeating:
+                # eviction-eligible from registration: a worker that dies
+                # before its first beat must not stay routable forever
+                self._hb_seen.add(key)
 
     def routes(self, name: str) -> List[ServiceInfo]:
         with self._lock:
@@ -102,12 +180,43 @@ class ServingCoordinator:
 
     def deregister(self, name: str, info: ServiceInfo) -> None:
         """Drop a worker from the routing table (gateway failure detection:
-        a worker whose forward errored is evicted until it re-registers)."""
+        a worker whose forward errored is evicted until it re-registers —
+        an alive worker's next heartbeat gets 410 and re-registers it)."""
         with self._lock:
             lst = self._routes.get(name)
             if lst:
+                before = len(lst)
                 lst[:] = [s for s in lst
                           if (s.host, s.port) != (info.host, info.port)]
+                if len(lst) < before:
+                    self.stats["evictions"] += 1
+            self._last_seen.pop((name, info.host, info.port), None)
+            self._hb_seen.discard((name, info.host, info.port))
+
+    def heartbeat(self, info: ServiceInfo) -> str:
+        """Record a worker heartbeat. Returns:
+        "ok"         — worker is routable, beat recorded;
+        "gone"       — worker is not in the table and its (machine,
+                       partition) slot is free: re-register (HTTP 410);
+        "superseded" — a DIFFERENT endpoint now owns this worker's
+                       (machine, partition) identity (HTTP 409): do NOT
+                       re-register — doing so would collapse the successor
+                       out of the table and the two incarnations would evict
+                       each other in a permanent flap. Stand down; if the
+                       successor dies the slot frees up and the next beat
+                       gets "gone" again."""
+        with self._lock:
+            lst = self._routes.get(info.name, [])
+            if any((s.host, s.port) == (info.host, info.port) for s in lst):
+                key = (info.name, info.host, info.port)
+                self._last_seen[key] = time.monotonic()
+                self._hb_seen.add(key)
+                self.stats["heartbeats"] += 1
+                return "ok"
+            if any((s.machine, s.partition) == (info.machine, info.partition)
+                   for s in lst):
+                return "superseded"
+            return "gone"
 
     def _next_worker(self, name: str) -> Optional[ServiceInfo]:
         """Round-robin channel selection (MultiChannelMap.addToNextList,
@@ -119,6 +228,130 @@ class ServingCoordinator:
             i = self._rr.get(name, 0) % len(lst)
             self._rr[name] = i + 1
             return lst[i]
+
+    # --------------------------------------------------------------- health
+    def _monitor_loop(self) -> None:
+        """Evict HEARTBEATING workers whose last beat is older than
+        heartbeat_timeout_s. Workers that never heartbeat (plain
+        register()/register_with_retries, no DistributedServingServer loop)
+        are exempt — for them only gateway failure detection evicts, the
+        pre-resilience contract."""
+        interval = max(0.02, self.heartbeat_timeout_s / 4.0)
+        while not self._stopev.wait(interval):
+            cutoff = time.monotonic() - self.heartbeat_timeout_s
+            with self._lock:
+                for name, lst in self._routes.items():
+                    stale = [s for s in lst
+                             if (name, s.host, s.port) in self._hb_seen
+                             and self._last_seen.get(
+                                 (name, s.host, s.port), 0.0) < cutoff]
+                    if stale:
+                        lst[:] = [s for s in lst if s not in stale]
+                        for s in stale:
+                            self._last_seen.pop((name, s.host, s.port),
+                                                None)
+                            self._hb_seen.discard((name, s.host, s.port))
+                            self.stats["evictions"] += 1
+
+    def health(self) -> Dict:
+        with self._lock:
+            services = {name: len(lst) for name, lst in self._routes.items()}
+        return {"services": services,
+                "heartbeat_timeout_s": self.heartbeat_timeout_s,
+                "stats": dict(self.stats)}
+
+    # -------------------------------------------------------------- gateway
+    def _handle_gateway(self, reply, name: str, body: bytes,
+                        headers: Dict[str, str]) -> None:
+        """Forward with bounded retry + eviction + deadline propagation.
+        `reply(status, body)` writes the client response."""
+        if name not in self._known:
+            reply(503, json.dumps(
+                {"error": f"no workers for {name!r}: never registered"}
+            ).encode())
+            return
+        client_deadline = Deadline.from_headers(headers)
+        deadline = (client_deadline
+                    or Deadline.after(self.forward_timeout))
+        if deadline.expired:
+            reply(504, b'{"error": "deadline exceeded"}')
+            return
+        policy = self.forward_retry
+        if client_deadline is not None:
+            # an explicit client budget makes the DEADLINE the retry
+            # contract: keep failing over for as long as the client is
+            # still waiting (rides out transient all-evicted churn), not
+            # just for the fail-fast attempt count
+            policy = dataclasses.replace(policy, attempts=None)
+        elif policy.attempts is not None:
+            # bounded fail-fast must still be able to try EVERY registered
+            # worker once (the pre-resilience per-worker bound): a
+            # correlated failure of N-1 workers out of many should reach
+            # the survivor, not give up at a fixed count
+            policy = dataclasses.replace(
+                policy, attempts=max(policy.attempts,
+                                     len(self.routes(name)) + 1))
+        with self._lock:
+            self.stats["forwards"] += 1
+        last_err = "routing table empty (all workers evicted)"
+        last_shed = None  # most recent worker 503 (queue-full) response
+        for attempt in policy.attempts_iter(deadline=deadline):
+            if attempt.index:
+                with self._lock:
+                    self.stats["forward_retries"] += 1
+            worker = self._next_worker(name)
+            if worker is None:
+                # all evicted: the backoff sleep gives heartbeat
+                # re-registration a chance to repopulate the table
+                continue
+            remaining = deadline.remaining()
+            if remaining <= 0:
+                break
+            fwd_headers = {"Content-Type": "application/json",
+                           Deadline.HEADER: deadline.to_header()}
+            try:
+                status, rbody = self._transport(
+                    worker.url, body, fwd_headers,
+                    min(self.forward_timeout, remaining))
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    # worker SHED the request (bounded queue full): it is
+                    # alive — don't evict — but another worker may have
+                    # room, so keep failing over; remember the shed reply
+                    # (incl. Retry-After) in case every worker is full
+                    last_err = f"worker {worker.host}:{worker.port} shed " \
+                               f"(503 queue full)"
+                    last_shed = (e.read(),
+                                 {k: v for k, v in e.headers.items()
+                                  if k.lower() == "retry-after"})
+                    continue
+                # worker is ALIVE and answered with a non-shed error
+                # status — deterministic for this request; surface it
+                # (with its headers), don't evict
+                reply(e.code, e.read(),
+                      {k: v for k, v in e.headers.items()
+                       if k.lower() == "retry-after"})
+                return
+            except Exception as e:  # unreachable: evict + retry next worker
+                last_err = str(e)
+                self.deregister(name, worker)
+            else:
+                # reply OUTSIDE the try: a client that disconnects while the
+                # response is being written must not be misread as a worker
+                # failure (which would evict the healthy worker and re-send
+                # the already-processed request — a duplicate inference)
+                reply(status, rbody)
+                return
+        if last_shed is not None and not deadline.expired:
+            # every attempt landed on a full queue: propagate the shed
+            # (503 + Retry-After) so the client backs off correctly
+            reply(503, last_shed[0], last_shed[1])
+            return
+        # unbounded mode only exits on budget exhaustion -> 504; bounded
+        # mode distinguishes attempts-exhausted (502) from expired (504)
+        reply(504 if (client_deadline is not None or deadline.expired)
+              else 502,
+              json.dumps({"error": f"forward failed: {last_err}"}).encode())
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "ServingCoordinator":
@@ -136,38 +369,26 @@ class ServingCoordinator:
                     except (ValueError, KeyError) as e:
                         self._reply(400, json.dumps(
                             {"error": str(e)}).encode())
+                elif self.path == "/heartbeat":
+                    try:
+                        state = outer.heartbeat(ServiceInfo.from_dict(
+                            json.loads(body.decode())))
+                    except (ValueError, KeyError) as e:
+                        self._reply(400, json.dumps(
+                            {"error": str(e)}).encode())
+                        return
+                    if state == "ok":
+                        self._reply(200, b'{"ok": true}')
+                    elif state == "superseded":
+                        self._reply(409, b'{"error": "identity taken by a '
+                                         b'newer registration; stand down"}')
+                    else:
+                        self._reply(410, b'{"error": "unknown worker; '
+                                         b're-register"}')
                 elif self.path.startswith("/gateway/"):
                     name = self.path[len("/gateway/"):].strip("/")
-                    # failure detection: a worker that refuses/errors is
-                    # deregistered and the request fails over to the next
-                    # one — bounded by the registered worker count
-                    last_err = "no workers registered"
-                    for _ in range(max(len(outer.routes(name)), 1)):
-                        worker = outer._next_worker(name)
-                        if worker is None:
-                            self._reply(503, json.dumps(
-                                {"error":
-                                 f"no workers for {name!r}: {last_err}"}
-                            ).encode())
-                            return
-                        try:
-                            req = urllib.request.Request(
-                                worker.url, data=body,
-                                headers={"Content-Type": "application/json"})
-                            with urllib.request.urlopen(
-                                    req, timeout=outer.forward_timeout) as r:
-                                self._reply(r.status, r.read())
-                                return
-                        except urllib.error.HTTPError as e:
-                            # worker is ALIVE and answered with an error
-                            # status — surface it, don't evict
-                            self._reply(e.code, e.read())
-                            return
-                        except Exception as e:  # unreachable: evict + retry
-                            last_err = str(e)
-                            outer.deregister(name, worker)
-                    self._reply(502, json.dumps(
-                        {"error": f"forward failed: {last_err}"}).encode())
+                    outer._handle_gateway(self._reply, name, body,
+                                          dict(self.headers))
                 else:
                     self._reply(404, b'{"error": "unknown endpoint"}')
 
@@ -177,12 +398,16 @@ class ServingCoordinator:
                     body = json.dumps(
                         [s.to_dict() for s in outer.routes(name)]).encode()
                     self._reply(200, body)
+                elif self.path == "/health":
+                    self._reply(200, json.dumps(outer.health()).encode())
                 else:
                     self._reply(404, b'{"error": "unknown endpoint"}')
 
-            def _reply(self, status: int, body: bytes):
+            def _reply(self, status: int, body: bytes, headers=None):
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -198,9 +423,11 @@ class ServingCoordinator:
         self.port = self._httpd.server_address[1]
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
+        threading.Thread(target=self._monitor_loop, daemon=True).start()
         return self
 
     def stop(self) -> None:
+        self._stopev.set()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -211,40 +438,52 @@ class ServingCoordinator:
 
 
 def register_with_retries(coordinator_url: str, info: ServiceInfo,
-                          retries: int = 10, delay_s: float = 0.2) -> None:
+                          retries: int = 10, delay_s: float = 0.2,
+                          policy: Optional[RetryPolicy] = None) -> None:
     """Worker-side registration with bounded retries (the workers' ServiceInfo
-    POST, HTTPSourceV2.scala:126-152; retry discipline mirrors the reference's
-    port-probe/rendezvous retry loops, TrainUtils.scala:496-512)."""
+    POST, HTTPSourceV2.scala:126-152), routed through the shared
+    RetryPolicy (retry discipline mirrors the reference's port-probe/
+    rendezvous retry loops, TrainUtils.scala:496-512)."""
     body = json.dumps(info.to_dict()).encode()
-    last: Optional[Exception] = None
-    for attempt in range(retries):
-        try:
-            req = urllib.request.Request(
-                coordinator_url.rstrip("/") + "/register", data=body,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=5.0) as r:
-                if r.status == 200:
-                    return
-        except Exception as e:  # noqa: BLE001
-            last = e
-        time.sleep(delay_s * (attempt + 1))
-    raise ConnectionError(
-        f"could not register with coordinator at {coordinator_url}: {last}")
+
+    def post_once() -> None:
+        req = urllib.request.Request(
+            coordinator_url.rstrip("/") + "/register", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            if r.status != 200:
+                raise ConnectionError(f"register returned {r.status}")
+
+    pol = policy or RetryPolicy(attempts=retries, backoff_s=delay_s,
+                                multiplier=1.5, max_backoff_s=2.0,
+                                jitter=0.1)
+    try:
+        pol.call(post_once)
+    except RetryError as e:
+        raise ConnectionError(
+            f"could not register with coordinator at {coordinator_url}: "
+            f"{e.last}") from e
 
 
 class DistributedServingServer(ServingServer):
     """A per-host worker: ServingServer that announces itself to the
     coordinator on start (WorkerServer + ServiceInfo POST,
-    HTTPSourceV2.scala:318-430)."""
+    HTTPSourceV2.scala:318-430) and HEARTBEATS for liveness — a worker the
+    coordinator evicted (crash suspected, chaos-injected forward failure)
+    re-registers itself on the next beat if it is actually alive."""
 
     def __init__(self, handler, coordinator_url: str, service_name: str,
                  partition: Optional[int] = None,
-                 machine: Optional[str] = None, **kw):
+                 machine: Optional[str] = None,
+                 heartbeat_interval_s: float = 1.0, **kw):
         super().__init__(handler, **kw)
         self.coordinator_url = coordinator_url
         self.service_name = service_name
         self.partition = partition
         self.machine = machine
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self._info: Optional[ServiceInfo] = None
+        self._hb_stop = threading.Event()
 
     def start(self) -> "DistributedServingServer":
         super().start()
@@ -254,11 +493,44 @@ class DistributedServingServer(ServingServer):
         machine = (self.machine if self.machine is not None
                    else socket.gethostname())
         partition = self.partition if self.partition is not None else self.port
-        register_with_retries(
-            self.coordinator_url,
-            ServiceInfo(self.service_name, self.host, self.port,
-                        machine, partition))
+        self._info = ServiceInfo(self.service_name, self.host, self.port,
+                                 machine, partition, heartbeating=True)
+        register_with_retries(self.coordinator_url, self._info)
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         return self
+
+    def _heartbeat_loop(self) -> None:
+        url = self.coordinator_url.rstrip("/") + "/heartbeat"
+        body = json.dumps(self._info.to_dict()).encode()
+        while not self._hb_stop.wait(self.heartbeat_interval_s):
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5.0):
+                    pass
+            except urllib.error.HTTPError as e:
+                # 409 (identity superseded by a newer registration) is a
+                # deliberate stand-down: keep beating WITHOUT re-registering,
+                # so two live incarnations of one identity cannot evict each
+                # other in a flap loop; if the successor dies the next beat
+                # gets 410 and heals normally
+                if e.code == 410 and not self._hb_stop.is_set():
+                    # evicted while alive (gateway failure detection tripped
+                    # on a transient fault): heal by re-registering
+                    try:
+                        register_with_retries(
+                            self.coordinator_url, self._info, retries=3,
+                            delay_s=max(0.05,
+                                        self.heartbeat_interval_s / 4.0))
+                    except ConnectionError:
+                        pass  # next beat tries again
+            except Exception:  # noqa: BLE001 - coordinator briefly
+                pass  # unreachable: keep beating; it may come back
+
+    def stop(self) -> None:
+        self._hb_stop.set()
+        super().stop()
 
 
 def fetch_routes(coordinator_url: str, name: str) -> List[ServiceInfo]:

@@ -14,7 +14,6 @@ Reference files replaced here:
 from __future__ import annotations
 
 import json
-import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -24,6 +23,7 @@ import numpy as np
 from ..core import params as _p
 from ..core.dataframe import DataFrame
 from ..core.pipeline import Transformer
+from ..resilience import RetryPolicy, parse_retry_after
 
 
 @dataclass
@@ -63,51 +63,51 @@ RETRY_BACKOFFS_MS = (100, 500, 1000)  # HTTPClients.scala retry array
 def send_with_retries(req: HTTPRequestData,
                       backoffs=RETRY_BACKOFFS_MS,
                       timeout: float = 60.0,
-                      session=None) -> HTTPResponseData:
+                      session=None,
+                      policy: Optional[RetryPolicy] = None
+                      ) -> HTTPResponseData:
     """Reference: HandlingUtils.sendWithRetries (HTTPClients.scala:74-110):
-    retries on 429 (honoring Retry-After) and 5xx with the backoff array."""
+    retries on 429 (honoring Retry-After, both delta-seconds and HTTP-date
+    forms) and 5xx. The retry schedule is the shared `resilience.RetryPolicy`
+    (default: the reference's backoff array); a 429's Retry-After overrides
+    the policy's next sleep."""
     import requests
     sess = session or requests
+    if policy is None:
+        policy = RetryPolicy.from_backoffs_ms(backoffs)
     last = None
-    for attempt, backoff in enumerate(list(backoffs) + [None]):
+    for attempt in policy.attempts_iter():
         try:
             r = sess.request(req.method, req.url, headers=req.headers,
                              data=req.entity, timeout=timeout)
-            resp = HTTPResponseData(r.status_code, r.content,
-                                    dict(r.headers), r.reason or "")
-            if r.status_code == 429:
-                retry_after = r.headers.get("Retry-After")
-                if backoff is None:
-                    return resp
-                try:
-                    # numeric-seconds form only; an HTTP-date Retry-After
-                    # falls back to the backoff schedule instead of raising
-                    # inside the try (which would misclassify the response
-                    # as a connection failure)
-                    wait = float(retry_after) * 1000
-                except (TypeError, ValueError):
-                    wait = backoff
-                time.sleep(wait / 1000.0)
-                last = resp
-                continue
-            if 500 <= r.status_code < 600 and backoff is not None:
-                time.sleep(backoff / 1000.0)
-                last = resp
-                continue
-            return resp
         except Exception as e:  # connection errors retry too
-            if backoff is None:
-                return HTTPResponseData(0, str(e).encode(), {}, "send failed")
-            time.sleep(backoff / 1000.0)
+            last = HTTPResponseData(0, str(e).encode(), {}, "send failed")
+            if attempt.is_last:
+                return last
+            continue
+        resp = HTTPResponseData(r.status_code, r.content,
+                                dict(r.headers), r.reason or "")
+        if r.status_code == 429 and not attempt.is_last:
+            wait = parse_retry_after(r.headers.get("Retry-After"))
+            if wait is not None:
+                attempt.override_sleep_s = wait
+            last = resp
+            continue
+        if 500 <= r.status_code < 600 and not attempt.is_last:
+            last = resp
+            continue
+        return resp
     return last or HTTPResponseData(0, b"", {}, "exhausted retries")
 
 
 class AsyncClient:
     """Bounded-concurrency ordered request pipeline (Clients.scala:12-63)."""
 
-    def __init__(self, concurrency: int = 8, timeout: float = 60.0):
+    def __init__(self, concurrency: int = 8, timeout: float = 60.0,
+                 policy: Optional[RetryPolicy] = None):
         self.concurrency = concurrency
         self.timeout = timeout
+        self.policy = policy
 
     def send_all(self, requests_: List[Optional[HTTPRequestData]]
                  ) -> List[Optional[HTTPResponseData]]:
@@ -117,7 +117,7 @@ class AsyncClient:
                 if req is None:
                     return None
                 return send_with_retries(req, timeout=self.timeout,
-                                         session=sess)
+                                         session=sess, policy=self.policy)
             with ThreadPoolExecutor(max_workers=self.concurrency) as ex:
                 return list(ex.map(one, requests_))  # order preserved
 

@@ -17,7 +17,9 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
+
+from ..resilience import RetryError, RetryPolicy
 
 
 class Forwarder:
@@ -91,18 +93,26 @@ def forward_port_to_remote(bind_address: str, remote_port_start: int,
                            ) -> Tuple[Forwarder, int]:
     """Probe ports [remote_port_start, remote_port_start + max_retries] until
     one binds, exactly the reference's retry loop
-    (PortForwarding.scala:50-66). Returns (forwarder, bound_port)."""
-    last: Optional[OSError] = None
-    for attempt in range(max_retries + 1):
-        try:
-            fwd = Forwarder(bind_address, remote_port_start + attempt,
-                            local_host, local_port)
-            return fwd, fwd.port
-        except OSError as e:
-            last = e
-    raise RuntimeError(
-        f"Could not find open port between {remote_port_start} and "
-        f"{remote_port_start + max_retries}") from last
+    (PortForwarding.scala:50-66), expressed over the shared RetryPolicy
+    (attempt index = port offset; zero backoff — a bound port won't free
+    itself for waiting). Returns (forwarder, bound_port)."""
+    probe = {"port": remote_port_start}
+
+    def bind_next() -> Forwarder:
+        port = probe["port"]
+        probe["port"] += 1
+        return Forwarder(bind_address, port, local_host, local_port)
+
+    policy = RetryPolicy(attempts=max_retries + 1, backoff_s=0.0,
+                         jitter=0.0, timeout_s=None,
+                         retryable=lambda e: isinstance(e, OSError))
+    try:
+        fwd = policy.call(bind_next)
+    except RetryError as e:
+        raise RuntimeError(
+            f"Could not find open port between {remote_port_start} and "
+            f"{remote_port_start + max_retries}") from e.last
+    return fwd, fwd.port
 
 
 def forward_port_to_remote_options(options: Dict[str, str]

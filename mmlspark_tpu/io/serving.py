@@ -30,11 +30,12 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.pipeline import Transformer
+from ..resilience import Deadline
 
 
 class _PendingRequest:
     __slots__ = ("rid", "body", "headers", "path", "event", "response",
-                 "_loop", "_fut")
+                 "deadline", "_loop", "_fut")
 
     def __init__(self, rid, body, headers, path, loop=None, fut=None):
         self.rid = rid
@@ -43,6 +44,9 @@ class _PendingRequest:
         self.path = path
         self.event = threading.Event()
         self.response: Optional[Dict[str, Any]] = None
+        # remaining request budget, propagated hop-to-hop via X-Deadline-Ms:
+        # an expired request is answered 504 instead of occupying batch slots
+        self.deadline: Optional[Deadline] = Deadline.from_headers(headers)
         # asyncio completion route: the dispatcher thread resolves the
         # connection coroutine's future via its event loop instead of an
         # Event the socket thread would block on
@@ -69,13 +73,15 @@ class _PendingRequest:
 
 def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
                         request_timeout: float, host: str,
-                        port: int) -> ThreadingHTTPServer:
+                        port: int, health_fn=None) -> ThreadingHTTPServer:
     """Shared HTTP front door for ServingServer and HTTPStreamSource: POST
     bodies become _PendingRequests handed to `enqueue`; the socket thread
     blocks on the request's event until a dispatcher/commit sets the reply
     (JVMSharedServer's handler role, DistributedHTTPSource.scala:151-168).
-    Returns the bound (but not yet serving) server; callers start
-    `serve_forever` on a daemon thread."""
+    GET /health serves `health_fn()` as JSON when provided (queue depth +
+    dispatcher liveness — the load-balancer probe endpoint). Returns the
+    bound (but not yet serving) server; callers start `serve_forever` on a
+    daemon thread."""
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
@@ -92,9 +98,23 @@ def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
             resp = pend.response
             self.send_response(resp["status"])
             self.send_header("Content-Type", "application/json")
+            for k, v in (resp.get("headers") or {}).items():
+                self.send_header(k, v)
             self.send_header("Content-Length", str(len(resp["body"])))
             self.end_headers()
             self.wfile.write(resp["body"])
+
+        def do_GET(self):
+            if self.path == "/health" and health_fn is not None:
+                body = json.dumps(health_fn()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
 
         def log_message(self, *a):  # quiet
             pass
@@ -123,9 +143,11 @@ class _AsyncListener:
     """
 
     def __init__(self, enqueue: Callable[["_PendingRequest"], None],
-                 request_timeout: float, host: str, port: int):
+                 request_timeout: float, host: str, port: int,
+                 health_fn=None):
         self._enqueue = enqueue
         self._timeout = request_timeout
+        self._health_fn = health_fn
         self.host, self.port = host, port
         self._loop = None
         self._server = None
@@ -139,9 +161,9 @@ class _AsyncListener:
             # no Nagle delay on tiny JSON replies
             sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         loop = self._loop
-        reasons = {200: b"OK", 400: b"Bad Request",
+        reasons = {200: b"OK", 400: b"Bad Request", 404: b"Not Found",
                    500: b"Internal Server Error", 501: b"Not Implemented",
-                   504: b"Gateway Timeout"}
+                   503: b"Service Unavailable", 504: b"Gateway Timeout"}
 
         def status_line(code):
             return b"HTTP/1.1 %d %s\r\n" % (code, reasons.get(code, b"OK"))
@@ -184,8 +206,19 @@ class _AsyncListener:
                             if length else b"")
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
+                if method == "GET" and path == "/health" \
+                        and self._health_fn is not None:
+                    hb = json.dumps(self._health_fn()).encode()
+                    writer.write(
+                        status_line(200)
+                        + b"Content-Type: application/json\r\n"
+                        b"Content-Length: %d\r\n\r\n%s" % (len(hb), hb))
+                    await writer.drain()
+                    if not keep_alive:
+                        return
+                    continue
                 if method != "POST":
-                    # health probes etc. must not reach the inference
+                    # other non-POST traffic must not reach the inference
                     # batcher (matches the threaded listener's POST-only
                     # handler)
                     writer.write(status_line(501)
@@ -206,10 +239,14 @@ class _AsyncListener:
                     await writer.drain()
                     continue
                 rb = resp["body"]
+                extra = b"".join(
+                    b"%s: %s\r\n" % (k.encode("latin1"), str(v).encode(
+                        "latin1"))
+                    for k, v in (resp.get("headers") or {}).items())
                 writer.write(
                     status_line(resp["status"])
-                    + b"Content-Type: application/json\r\n"
-                    b"Content-Length: %d\r\n\r\n%s" % (len(rb), rb))
+                    + b"Content-Type: application/json\r\n" + extra
+                    + b"Content-Length: %d\r\n\r\n%s" % (len(rb), rb))
                 await writer.drain()
                 if not keep_alive:
                     return
@@ -300,13 +337,20 @@ class ServingServer:
     replyCol: which output column to serialize back.
     maxBatchSize / maxLatencyMs control the dynamic batcher: a batch launches
     when it is full OR the oldest request has waited maxLatencyMs.
+    max_queue bounds the request queue (0 = unbounded): when full, new
+    requests are SHED with 503 + Retry-After instead of growing an unbounded
+    backlog that times every client out (load shedding under overload).
+    Requests carrying an X-Deadline-Ms budget that has expired are answered
+    504 without occupying batch slots. GET /health reports queue depth and
+    dispatcher liveness.
     """
 
     def __init__(self, handler: Callable[[DataFrame], DataFrame],
                  reply_col: str = "prediction", host: str = "127.0.0.1",
                  port: int = 8899, max_batch_size: int = 64,
                  max_latency_ms: float = 5.0, request_timeout: float = 30.0,
-                 vector_cols=(), listener: str = "asyncio"):
+                 vector_cols=(), listener: str = "asyncio",
+                 max_queue: int = 0):
         self.handler = handler
         self.reply_col = reply_col
         self.host, self.port = host, port
@@ -318,25 +362,59 @@ class ServingServer:
             raise ValueError(f"listener must be 'asyncio' or 'thread', "
                              f"got {listener!r}")
         self.listener = listener
-        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
+        self.max_queue = max_queue
+        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue(
+            maxsize=max_queue)
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._alistener: Optional[_AsyncListener] = None
         self._threads: List[threading.Thread] = []
-        self.stats = {"requests": 0, "batches": 0, "errors": 0}
+        self._disp_thread: Optional[threading.Thread] = None
+        self.stats = {"requests": 0, "batches": 0, "errors": 0,
+                      "shed": 0, "expired": 0}
+
+    # ------------------------------------------------------------ admission
+    def _submit(self, pend: _PendingRequest) -> None:
+        """Admission control between the listener and the batcher: expired
+        budgets answer 504 immediately, a full queue sheds with 503 +
+        Retry-After (the client's signal to back off and retry elsewhere)."""
+        if pend.deadline is not None and pend.deadline.expired:
+            self.stats["expired"] += 1
+            pend.complete({"status": 504,
+                           "body": b'{"error": "deadline exceeded"}'})
+            return
+        try:
+            self._queue.put_nowait(pend)
+        except queue.Full:
+            self.stats["shed"] += 1
+            pend.complete({"status": 503,
+                           "headers": {"Retry-After": "1"},
+                           "body": b'{"error": "overloaded: '
+                                   b'request queue full"}'})
+
+    def health(self) -> Dict[str, Any]:
+        """GET /health payload: queue depth + dispatcher liveness."""
+        return {"queue_depth": self._queue.qsize(),
+                "max_queue": self.max_queue,
+                "dispatcher_alive": bool(self._disp_thread
+                                         and self._disp_thread.is_alive()),
+                "listener": self.listener,
+                "stats": dict(self.stats)}
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingServer":
         if self.listener == "asyncio":
             # persistent-connection listener: the sub-ms HTTP path
-            self._alistener = _AsyncListener(self._queue.put,
+            self._alistener = _AsyncListener(self._submit,
                                              self.request_timeout,
-                                             self.host, self.port).start()
+                                             self.host, self.port,
+                                             health_fn=self.health).start()
             self.port = self._alistener.port
         else:
-            self._httpd = _make_http_listener(self._queue.put,
+            self._httpd = _make_http_listener(self._submit,
                                               self.request_timeout,
-                                              self.host, self.port)
+                                              self.host, self.port,
+                                              health_fn=self.health)
             self.port = self._httpd.server_address[1]  # resolve port 0
             t_http = threading.Thread(target=self._httpd.serve_forever,
                                       daemon=True)
@@ -344,6 +422,7 @@ class ServingServer:
             self._threads.append(t_http)
         t_disp = threading.Thread(target=self._dispatch_loop, daemon=True)
         t_disp.start()
+        self._disp_thread = t_disp
         self._threads.append(t_disp)
         return self
 
@@ -394,7 +473,20 @@ class ServingServer:
                         timeout=max(deadline - time.perf_counter(), 0.0)))
                 except queue.Empty:
                     break
-            self._run_batch(batch)
+            # a request whose cross-hop budget expired while queued gets its
+            # 504 now — it must not occupy a batch slot a live request could
+            # use (the Deadline threading the gateway forwards shrinks)
+            live: List[_PendingRequest] = []
+            for pend in batch:
+                if pend.deadline is not None and pend.deadline.expired:
+                    self.stats["expired"] += 1
+                    pend.complete({"status": 504,
+                                   "body": b'{"error": "deadline '
+                                           b'exceeded"}'})
+                else:
+                    live.append(pend)
+            if live:
+                self._run_batch(live)
 
     def _run_batch(self, batch: List[_PendingRequest]) -> None:
         self.stats["requests"] += len(batch)

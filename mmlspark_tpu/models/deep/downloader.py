@@ -13,13 +13,13 @@ sha256 (the reference records a hash per model in its schema).
 
 from __future__ import annotations
 
-import concurrent.futures
 import hashlib
 import json
 import os
-import time
 import urllib.request
-from typing import Callable, Dict, List, Optional, TypeVar
+from typing import Callable, Dict, List, TypeVar
+
+from ...resilience import RetryPolicy
 
 T = TypeVar("T")
 
@@ -27,31 +27,14 @@ T = TypeVar("T")
 def retry_with_timeout(fn: Callable[[], T], timeout_s: float = 60.0,
                        retries: int = 3, backoff_s: float = 0.5) -> T:
     """FaultToleranceUtils.retryWithTimeout (:37-52): run fn with a hard
-    per-attempt timeout, retrying with backoff on failure OR timeout."""
-    last: Optional[BaseException] = None
-    for attempt in range(retries):
-        # one throwaway executor per attempt, abandoned without joining: a
-        # `with` block (shutdown(wait=True)) would block on a hung fn and
-        # defeat the hard timeout this function exists to provide. The
-        # leaked worker thread dies with the hung call; cancel() is a no-op
-        # on a running future by design.
-        ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-        fut = ex.submit(fn)
-        try:
-            result = fut.result(timeout=timeout_s)
-            ex.shutdown(wait=False)
-            return result
-        except concurrent.futures.TimeoutError:
-            last = TimeoutError(f"attempt {attempt + 1} exceeded "
-                                f"{timeout_s}s")
-            fut.cancel()
-            ex.shutdown(wait=False)
-        except Exception as e:  # noqa: BLE001 - retry any failure
-            last = e
-            ex.shutdown(wait=False)
-        if attempt < retries - 1:
-            time.sleep(backoff_s * (attempt + 1))
-    raise RuntimeError(f"all {retries} attempts failed: {last}") from last
+    per-attempt timeout, retrying with backoff on failure OR timeout.
+
+    Thin shim over the shared `resilience.RetryPolicy` (which owns the
+    abandoned-executor hard-timeout mechanics) kept so existing imports
+    keep working; new code should construct a RetryPolicy directly."""
+    return RetryPolicy(attempts=retries, timeout_s=timeout_s,
+                       backoff_s=backoff_s, multiplier=2.0,
+                       jitter=0.1).call(fn)
 
 
 class RemoteModelInfo:

@@ -22,6 +22,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Patient bounded device bring-up (probe subprocesses + jittered RetryPolicy
+# backoff + Deadline wall budget, structured probe records): the resilient
+# path to a healthy mesh on a flaky shared pool. Convenience re-export for
+# code already working at the mesh layer; launchers that must control the
+# backend BEFORE jax is imported (env-var CPU forcing) import it from
+# mmlspark_tpu.resilience.bringup instead — this module imports jax at top.
+from ..resilience.bringup import backend_bringup  # noqa: F401 (re-export)
+
 DATA_AXIS = "data"    # row/batch sharding (the universal strategy — SURVEY.md §2.2)
 MODEL_AXIS = "model"  # tensor/feature sharding for deep models
 

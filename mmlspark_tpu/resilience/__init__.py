@@ -1,0 +1,21 @@
+"""Unified resilience layer: retry policy, deadlines, fault injection.
+
+The single home for retry/backoff/deadline logic (reference:
+FaultToleranceUtils, HandlingUtils.sendWithRetries, the rendezvous retry
+loops). `io/http.py`, `models/deep/downloader.py`, `io/port_forwarding.py`,
+the distributed-serving registration/heartbeat/gateway paths, and the bench
+bring-up probe loop all route through here; tests/test_resilience.py lints
+that no other module defines its own backoff loop.
+"""
+
+from .policy import (Attempt, Deadline, DeadlineExceeded, RetryError,
+                     RetryPolicy, parse_retry_after)
+from .chaos import FaultInjector, InjectedDrop, InjectedFault
+from .bringup import backend_bringup
+
+__all__ = [
+    "Attempt", "Deadline", "DeadlineExceeded", "RetryError", "RetryPolicy",
+    "parse_retry_after",
+    "FaultInjector", "InjectedDrop", "InjectedFault",
+    "backend_bringup",
+]

@@ -1,0 +1,143 @@
+"""Patient, bounded accelerator bring-up behind the shared RetryPolicy.
+
+Factored out of bench.py (round-3 verdict #1; the probe history appears as
+`bringup_probes` in every BENCH_r*.json). The shared device pool has two
+measured failure modes (docs/tpu_watch.log, rounds 2-3): fast UNAVAILABLE
+errors, and init hangs that clear in ~25 min after a killed client wedged
+the pool's grant. Discipline:
+
+- probe for up to the wall budget, sleeping a jittered `retry_sleep_s`
+  between failed attempts (RetryPolicy owns the sleeping and the
+  don't-spawn-a-doomed-probe cutoff via `min_attempt_s`);
+- let each probe RUN TO COMPLETION instead of killing it on a timer:
+  killing a client that holds the grant is precisely what wedges the pool
+  for every later process. The only kill is at the very end of the budget.
+
+Every attempt (offset, duration, outcome) is recorded via
+`Attempt.record()` — the structured `bringup_probes` shape — and returned
+so the emitted JSON itself shows whether the pool was down the whole
+window. jax is imported lazily: importing this module must not touch the
+backend.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+from .policy import Deadline, RetryPolicy
+
+
+def backend_bringup(probe_code: str, budget_s: float = 1320.0,
+                    retry_sleep_s: float = 90.0, min_probe_s: float = 60.0,
+                    log: Optional[List] = None,
+                    on_parent_hang: Optional[Callable[[], None]] = None
+                    ) -> Tuple[object, list, Optional[str], List[dict]]:
+    """Probe the backend in subprocesses until healthy or the budget ends.
+
+    probe_code: python -c body that prints "... <platform>" on success.
+    log: optional list that receives attempt records as they happen (so a
+    crash handler can still report the history).
+    on_parent_hang: invoked if the parent's own backend init hangs after a
+    healthy probe (default: hard-exit — the process is unrecoverable).
+    Returns (jax, devices, error_or_None, attempts).
+    """
+    import subprocess
+    import sys
+    import tempfile
+    attempts: List[dict] = log if log is not None else []
+    deadline = Deadline.after(budget_s)
+    t0 = time.time()
+    policy = RetryPolicy(attempts=None, backoff_s=retry_sleep_s,
+                         multiplier=1.0, jitter=0.1,
+                         max_backoff_s=retry_sleep_s * 1.2)
+    # min_attempt_s: don't spawn a probe that can't get a fair shot — a
+    # probe killed seconds into init is both useless and (if the pool is in
+    # hang mode) a fresh grant-holding kill
+    for a in policy.attempts_iter(deadline=deadline,
+                                  min_attempt_s=min_probe_s):
+        a0 = time.time()
+        # temp files, not PIPEs: a verbose plugin init can overflow a 64 KB
+        # pipe buffer and block the child — indistinguishable from an init
+        # hang from out here
+        fo = tempfile.TemporaryFile(mode="w+")
+        fe = tempfile.TemporaryFile(mode="w+")
+        try:
+            p = subprocess.Popen([sys.executable, "-c", probe_code],
+                                 stdout=fo, stderr=fe, text=True)
+        except OSError as e:
+            # transient (EAGAIN under memory pressure, etc.) — retry within
+            # the budget like any other failed attempt
+            attempts.append(a.record(f"spawn failed: {e}"))
+            fo.close()
+            fe.close()
+            continue
+        while p.poll() is None and not deadline.expired:
+            time.sleep(0.5)
+        hung = p.poll() is None
+        if hung:
+            p.kill()
+            p.wait()
+        fo.seek(0)
+        out = fo.read()
+        fe.seek(0)
+        err = fe.read()
+        fo.close()
+        fe.close()
+        dur = time.time() - a0
+        if hung:
+            attempts.append(a.record("init hang — killed at budget end",
+                                     dur))
+            break
+        platform = out.strip().rsplit(" ", 1)[-1] if out.strip() else "?"
+        if p.returncode == 0 and platform not in ("cpu", "?"):
+            attempts.append(a.record(f"healthy: {out.strip()}", dur))
+            # The parent's OWN backend init can still hang (the probe's exit
+            # released its grant; another client may grab or wedge the pool
+            # in the gap). A watchdog guarantees the caller's mandatory
+            # reporting still lands — the timer absorbs all remaining
+            # bring-up budget (+ grace) first, so the hard-exit — itself a
+            # grant-holding kill — fires only once waiting longer could no
+            # longer produce a run anyway.
+            import threading
+            wd_s = max(240.0, deadline.remaining() + 120.0)
+            hang_cb = on_parent_hang or (lambda: os._exit(1))
+            watchdog = threading.Timer(wd_s, hang_cb)
+            watchdog.daemon = True
+            watchdog.start()
+            try:
+                import jax
+                jdevs = jax.devices()
+            except Exception as e:  # noqa: BLE001 - treat as failed attempt
+                watchdog.cancel()
+                attempts.append({"t_s": round(time.time() - t0, 1),
+                                 "dur_s": 0.0,
+                                 "outcome": f"parent init error: {e}"[:240]})
+                break  # jax is imported now; can't retry backend selection
+            watchdog.cancel()
+            return jax, jdevs, None, list(attempts)
+        detail = (err or out).strip().replace("\n", " ")[-220:]
+        attempts.append(a.record(f"error: {detail}", dur))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        # works even when jax was already imported by a failed parent-init
+        # attempt above (the documented post-import CPU-forcing path)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    n_probes = sum(1 for a in attempts
+                   if not a["outcome"].startswith(("parent", "healthy")))
+    err_msg = (f"no healthy TPU across {n_probes} probe(s) in a "
+               f"{round(time.time() - t0)} s bring-up window"
+               + (" (a probe succeeded but the parent's own init failed)"
+                  if n_probes != len(attempts) else ""))
+    try:
+        devs = jax.devices()
+    except Exception as e:  # noqa: BLE001 - even CPU fallback can fail when
+        # a poisoned backend cache survives the config update; surface it
+        # with the probe history rather than crashing before any JSON lands
+        raise RuntimeError(f"CPU fallback init failed after bring-up "
+                           f"({err_msg}): {e}") from e
+    return jax, devs, err_msg, list(attempts)

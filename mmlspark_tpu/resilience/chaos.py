@@ -1,0 +1,97 @@
+"""Deterministic, seed-driven fault injection for chaos testing.
+
+Wraps any callable/transport to inject error/delay/drop faults by
+probability, with the whole fault schedule derived from one seed — same
+seed => same fault sequence, so a chaos run that loses a request replays
+exactly. Used by tests/test_resilience.py to prove the serving stack
+completes N requests with zero losses while workers are killed and a
+configured fraction of gateway forwards fail.
+
+Fault kinds:
+- error: raise `InjectedFault` (a ConnectionError) BEFORE invoking the
+  wrapped callable — models an unreachable peer; the call never happens,
+  so retries cannot duplicate work.
+- delay: sleep `delay_s`, then invoke normally — models a straggler hop
+  ("Understanding and Optimizing Distributed ML on Spark", arxiv
+  1612.01437: straggler behavior dominates tail latency).
+- drop: raise `InjectedDrop` (a TimeoutError) before invoking — models a
+  request lost in flight with no response ever coming back.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List
+
+
+class InjectedFault(ConnectionError):
+    """A chaos-injected transport error (peer unreachable)."""
+
+
+class InjectedDrop(TimeoutError):
+    """A chaos-injected silent drop (no reply ever arrives)."""
+
+
+class FaultInjector:
+    """Seeded fault source; `wrap(fn)` returns fn with faults injected.
+
+    Rates are independent probabilities evaluated in order
+    error -> drop -> delay from ONE uniform draw per call, so the decision
+    sequence is a pure function of (seed, rates) — `schedule(n)` previews
+    it without consuming state.
+    """
+
+    def __init__(self, seed: int = 0, error_rate: float = 0.0,
+                 drop_rate: float = 0.0, delay_rate: float = 0.0,
+                 delay_s: float = 0.05):
+        if min(error_rate, drop_rate, delay_rate) < 0 or \
+                error_rate + drop_rate + delay_rate > 1.0:
+            raise ValueError("fault rates must be >= 0 and sum to <= 1")
+        self.seed = seed
+        self.error_rate = error_rate
+        self.drop_rate = drop_rate
+        self.delay_rate = delay_rate
+        self.delay_s = delay_s
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {"calls": 0, "error": 0, "drop": 0,
+                                       "delay": 0, "ok": 0}
+
+    def _classify(self, u: float) -> str:
+        if u < self.error_rate:
+            return "error"
+        if u < self.error_rate + self.drop_rate:
+            return "drop"
+        if u < self.error_rate + self.drop_rate + self.delay_rate:
+            return "delay"
+        return "ok"
+
+    def next_fault(self) -> str:
+        """Draw the next fault decision (thread-safe)."""
+        with self._lock:
+            u = self._rng.random()
+            kind = self._classify(u)
+            self.counts["calls"] += 1
+            self.counts[kind] += 1
+            return kind
+
+    def schedule(self, n: int) -> List[str]:
+        """The first n decisions a fresh injector with this seed makes —
+        the determinism contract (same seed => same fault schedule). Does
+        not consume this injector's state."""
+        rng = random.Random(self.seed)
+        return [self._classify(rng.random()) for _ in range(n)]
+
+    def wrap(self, fn: Callable) -> Callable:
+        def chaotic(*args, **kw):
+            kind = self.next_fault()
+            if kind == "error":
+                raise InjectedFault("injected fault: peer unreachable")
+            if kind == "drop":
+                raise InjectedDrop("injected drop: no reply")
+            if kind == "delay":
+                time.sleep(self.delay_s)
+            return fn(*args, **kw)
+        return chaotic

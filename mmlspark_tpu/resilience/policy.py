@@ -1,0 +1,293 @@
+"""The ONE retry/backoff/deadline implementation in the codebase.
+
+Reference: FaultToleranceUtils.retryWithTimeout (ModelDownloader.scala:37-52),
+HandlingUtils.sendWithRetries (HTTPClients.scala:74-110, backoff array + 429
+Retry-After), and the port-probe / rendezvous retry loops
+(PortForwarding.scala:50-66, TrainUtils.scala:496-512). The port scattered
+those into three incompatible ad-hoc loops (io/http.py, models/deep/
+downloader.py, io/port_forwarding.py) plus bench.py's bring-up loop; all of
+them now route through `RetryPolicy`, and `tests/test_resilience.py` lints
+that no other module grows its own backoff loop again.
+
+Two consumption styles:
+
+- `policy.call(fn)` — exception-driven: run `fn` under a per-attempt hard
+  timeout, retry retryable failures with jittered exponential backoff,
+  bounded by an overall `Deadline`. Raises `RetryError` on exhaustion.
+- `for attempt in policy.attempts_iter():` — loop-driven, for callers whose
+  "failure" is a value (an HTTP 429/5xx response, a port already bound):
+  the generator owns ALL sleeping between iterations; the caller breaks on
+  success. `attempt.override_sleep_s` lets one iteration replace the
+  policy's backoff (e.g. honoring a server's Retry-After).
+
+`Deadline` is the request-budget object threaded through serving dispatch
+and gateway forwarding: each hop re-encodes the REMAINING budget into the
+`X-Deadline-Ms` header, so a request's budget shrinks across hops and an
+expired request is answered 504 instead of occupying batch slots.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+
+class RetryError(RuntimeError):
+    """All attempts failed. `last` carries the final failure."""
+
+    def __init__(self, attempts: int, last: Optional[BaseException]):
+        super().__init__(f"all {attempts} attempts failed: {last}")
+        self.attempts = attempts
+        self.last = last
+
+
+class DeadlineExceeded(RetryError):
+    """The overall deadline expired before the attempts were exhausted."""
+
+    def __init__(self, attempts_made: int, last: Optional[BaseException]):
+        RuntimeError.__init__(
+            self, f"deadline exceeded after {attempts_made} attempt(s): "
+                  f"{last}")
+        self.attempts = attempts_made
+        self.last = last
+
+
+class Deadline:
+    """Monotonic-clock request budget, propagated across hops via header.
+
+    `Deadline.after(1.5)` gives a hop 1.5 s; `to_header()` encodes whatever
+    REMAINS at encode time, so forwarding a request re-budgets the next hop
+    with only the unspent portion.
+    """
+
+    HEADER = "X-Deadline-Ms"
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, expires_at: float):
+        self.expires_at = expires_at
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + seconds)
+
+    @classmethod
+    def never(cls) -> "Deadline":
+        return cls(math.inf)
+
+    def remaining(self) -> float:
+        return max(0.0, self.expires_at - time.monotonic())
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+    def to_header(self) -> str:
+        return str(int(self.remaining() * 1000))
+
+    @classmethod
+    def from_headers(cls, headers: Optional[Dict[str, str]]
+                     ) -> Optional["Deadline"]:
+        """Case-insensitive `X-Deadline-Ms` lookup; None when absent or
+        malformed (an unparseable budget must not kill the request)."""
+        if not headers:
+            return None
+        for k, v in headers.items():
+            if k.lower() == cls.HEADER.lower():
+                try:
+                    return cls.after(float(v) / 1000.0)
+                except (TypeError, ValueError):
+                    return None
+        return None
+
+    def __repr__(self):
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Seconds to wait from a Retry-After header value — both RFC 7231
+    forms: delta-seconds ("120") and HTTP-date ("Wed, 21 Oct 2015 07:28:00
+    GMT"). None when absent or unparseable (callers fall back to their
+    backoff schedule)."""
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        pass
+    from email.utils import parsedate_to_datetime
+    from datetime import datetime, timezone
+    try:
+        dt = parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return max(0.0, (dt - datetime.now(timezone.utc)).total_seconds())
+
+
+class Attempt:
+    """One iteration of `RetryPolicy.attempts()`.
+
+    `index` doubles as the probe offset for callers that map attempts onto
+    a search space (port probing). `record()` emits the structured probe
+    dict used by bench bring-up logs (`bringup_probes` shape)."""
+
+    __slots__ = ("index", "t_s", "is_last", "override_sleep_s")
+
+    def __init__(self, index: int, t_s: float, is_last: bool):
+        self.index = index
+        self.t_s = t_s
+        self.is_last = is_last
+        self.override_sleep_s: Optional[float] = None
+
+    def record(self, outcome: str, dur_s: float = 0.0) -> Dict:
+        return {"t_s": round(self.t_s, 1), "dur_s": round(dur_s, 1),
+                "outcome": outcome}
+
+
+def _always_retry(e: BaseException) -> bool:
+    return True
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """attempts + backoff + jitter + per-attempt timeout + overall deadline
+    + retryable predicate, in one immutable, reusable value.
+
+    attempts=None means unbounded — only meaningful with a deadline (the
+    bring-up probe loop's "retry until the wall budget" mode).
+    schedule_s pins an explicit per-gap schedule (the reference's
+    HTTPClients backoff array) instead of exponential growth.
+    seed makes jitter deterministic (chaos tests; reproducible schedules).
+    """
+
+    attempts: Optional[int] = 3
+    backoff_s: float = 0.5
+    multiplier: float = 2.0
+    max_backoff_s: float = 30.0
+    jitter: float = 0.1
+    timeout_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    retryable: Callable[[BaseException], bool] = field(default=_always_retry)
+    schedule_s: Optional[Tuple[float, ...]] = None
+    seed: Optional[int] = None
+
+    @classmethod
+    def from_backoffs_ms(cls, backoffs_ms: Sequence[float],
+                         **kw) -> "RetryPolicy":
+        """The reference's retry-array form (HTTPClients.scala:74-110):
+        len(backoffs)+1 attempts with exactly those gaps, no jitter."""
+        sched = tuple(b / 1000.0 for b in backoffs_ms)
+        return cls(attempts=len(sched) + 1, schedule_s=sched, jitter=0.0,
+                   **kw)
+
+    # ------------------------------------------------------------- schedule
+    def sleep_for(self, gap_index: int,
+                  rng: Optional[random.Random] = None) -> float:
+        """Jittered sleep after attempt `gap_index` (0-based gap)."""
+        if self.schedule_s is not None:
+            base = self.schedule_s[min(gap_index, len(self.schedule_s) - 1)]
+        else:
+            base = min(self.backoff_s * (self.multiplier ** gap_index),
+                       self.max_backoff_s)
+        if self.jitter and base > 0:
+            r = rng if rng is not None else random
+            base *= 1.0 + self.jitter * (2.0 * r.random() - 1.0)
+        return max(0.0, base)
+
+    def backoff_schedule(self, n: int) -> List[float]:
+        """The first n sleeps this policy would take — deterministic when
+        seeded (same seed => same schedule)."""
+        rng = random.Random(self.seed) if self.seed is not None else None
+        return [self.sleep_for(i, rng) for i in range(n)]
+
+    # -------------------------------------------------------------- looping
+    def attempts_iter(self, deadline: Optional[Deadline] = None,
+                      min_attempt_s: float = 0.0) -> Iterator[Attempt]:
+        """Yield attempts, sleeping the backoff between them. Stops when
+        attempts are exhausted or the deadline cannot fit another sleep plus
+        `min_attempt_s` of useful work (a probe spawned only to be killed is
+        worse than no probe — it can wedge a shared device pool)."""
+        if deadline is None and self.deadline_s is not None:
+            deadline = Deadline.after(self.deadline_s)
+        if self.attempts is None and deadline is None:
+            raise ValueError(
+                "RetryPolicy with attempts=None (unbounded) requires a "
+                "deadline — otherwise a persistently failing callee retries "
+                "forever")
+        rng = random.Random(self.seed) if self.seed is not None else None
+        t0 = time.monotonic()
+        k = 0
+        while True:
+            is_last = self.attempts is not None and k == self.attempts - 1
+            a = Attempt(k, time.monotonic() - t0, is_last)
+            yield a
+            k += 1
+            if self.attempts is not None and k >= self.attempts:
+                return
+            sleep = (a.override_sleep_s if a.override_sleep_s is not None
+                     else self.sleep_for(k - 1, rng))
+            if deadline is not None and \
+                    deadline.remaining() <= sleep + min_attempt_s:
+                return
+            if sleep > 0:
+                time.sleep(sleep)
+
+    # -------------------------------------------------------------- calling
+    def call(self, fn: Callable, *args,
+             deadline: Optional[Deadline] = None, **kw):
+        """Run fn with per-attempt hard timeout + bounded retries.
+
+        The hard timeout uses one throwaway single-worker executor per
+        attempt, abandoned without joining: a `with` block
+        (shutdown(wait=True)) would block on a hung fn and defeat the hard
+        timeout this exists to provide (FaultToleranceUtils.retryWithTimeout,
+        ModelDownloader.scala:37-52). The leaked worker thread dies with the
+        hung call; cancel() is a no-op on a running future by design.
+        """
+        if deadline is None and self.deadline_s is not None:
+            deadline = Deadline.after(self.deadline_s)
+        last: Optional[BaseException] = None
+        made = 0
+        for a in self.attempts_iter(deadline=deadline):
+            made += 1
+            timeout = self.timeout_s
+            if deadline is not None:
+                rem = deadline.remaining()
+                if rem <= 0:
+                    raise DeadlineExceeded(made - 1, last)
+                timeout = rem if timeout is None else min(timeout, rem)
+            if timeout is None:
+                try:
+                    return fn(*args, **kw)
+                except Exception as e:  # noqa: BLE001 - classified below
+                    last = e
+            else:
+                ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+                fut = ex.submit(fn, *args, **kw)
+                try:
+                    result = fut.result(timeout=timeout)
+                    ex.shutdown(wait=False)
+                    return result
+                except concurrent.futures.TimeoutError:
+                    last = TimeoutError(f"attempt {a.index + 1} exceeded "
+                                        f"{timeout}s")
+                    fut.cancel()
+                    ex.shutdown(wait=False)
+                except Exception as e:  # noqa: BLE001 - classified below
+                    last = e
+                    ex.shutdown(wait=False)
+            if not self.retryable(last):
+                raise last
+            if deadline is not None and deadline.expired:
+                raise DeadlineExceeded(made, last)
+        if self.attempts is not None and made >= self.attempts:
+            raise RetryError(self.attempts, last)
+        raise DeadlineExceeded(made, last)

@@ -1,0 +1,764 @@
+"""Resilience layer: RetryPolicy/Deadline units, chaos suite, backoff lint.
+
+The chaos suite (seeded FaultInjector over the distributed-serving gateway)
+proves the ISSUE-4 acceptance behavior: with 30% injected forward failures
+and one worker killed mid-stream, 200 requests through the gateway all
+complete with zero lost or duplicated replies, and the killed worker is
+evicted from the routing table and then successfully re-registers.
+
+Also hosts the single-backoff-implementation lint: no module outside
+mmlspark_tpu/resilience/ may define its own retry/backoff loop.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.resilience import (Deadline, DeadlineExceeded,
+                                     FaultInjector, InjectedFault,
+                                     RetryError, RetryPolicy,
+                                     parse_retry_after)
+
+
+# --------------------------------------------------------------- RetryPolicy
+
+class TestRetryPolicy:
+    def test_succeeds_after_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise IOError("boom")
+            return "ok"
+
+        policy = RetryPolicy(attempts=3, backoff_s=0.01, timeout_s=5)
+        assert policy.call(flaky) == "ok"
+        assert calls["n"] == 3
+
+    def test_exhaustion_raises_retry_error(self):
+        def always():
+            raise IOError("down")
+
+        with pytest.raises(RetryError, match="all 2 attempts failed"):
+            RetryPolicy(attempts=2, backoff_s=0.01).call(always)
+
+    def test_per_attempt_hard_timeout(self):
+        with pytest.raises(RuntimeError, match="exceeded"):
+            RetryPolicy(attempts=1, timeout_s=0.2).call(
+                lambda: time.sleep(30))
+
+    def test_non_retryable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def fails():
+            calls["n"] += 1
+            raise ValueError("fatal")
+
+        policy = RetryPolicy(attempts=5, backoff_s=0.01,
+                             retryable=lambda e: not isinstance(e,
+                                                                ValueError))
+        with pytest.raises(ValueError):
+            policy.call(fails)
+        assert calls["n"] == 1
+
+    def test_deadline_bounds_attempts(self):
+        calls = {"n": 0}
+
+        def fails():
+            calls["n"] += 1
+            raise IOError("down")
+
+        policy = RetryPolicy(attempts=100, backoff_s=0.1, multiplier=1.0,
+                             jitter=0.0)
+        with pytest.raises(DeadlineExceeded):
+            policy.call(fails, deadline=Deadline.after(0.35))
+        assert calls["n"] < 100
+
+    def test_seeded_jitter_deterministic(self):
+        p = RetryPolicy(backoff_s=1.0, multiplier=2.0, jitter=0.3, seed=42)
+        s1 = p.backoff_schedule(6)
+        s2 = p.backoff_schedule(6)
+        assert s1 == s2
+        # different seed -> different schedule (overwhelmingly likely)
+        assert s1 != RetryPolicy(backoff_s=1.0, multiplier=2.0, jitter=0.3,
+                                 seed=43).backoff_schedule(6)
+
+    def test_backoff_array_form(self):
+        policy = RetryPolicy.from_backoffs_ms([100, 500, 1000])
+        assert policy.attempts == 4
+        assert policy.backoff_schedule(3) == [0.1, 0.5, 1.0]
+        seen = [(a.index, a.is_last) for a in RetryPolicy.from_backoffs_ms(
+            [0, 0]).attempts_iter()]
+        assert seen == [(0, False), (1, False), (2, True)]
+
+    def test_unbounded_attempts_require_deadline(self):
+        """attempts=None with no deadline would retry a persistently
+        failing callee forever — rejected up front."""
+        policy = RetryPolicy(attempts=None, backoff_s=0.01)
+        with pytest.raises(ValueError, match="requires a deadline"):
+            policy.call(lambda: 1)
+        with pytest.raises(ValueError, match="requires a deadline"):
+            next(policy.attempts_iter())
+        # a deadline (either form) makes unbounded mode legal
+        assert RetryPolicy(attempts=None, backoff_s=0.01,
+                           deadline_s=5.0).call(lambda: "ok") == "ok"
+        assert policy.call(lambda: "ok",
+                           deadline=Deadline.after(5.0)) == "ok"
+
+    def test_attempt_override_sleep(self):
+        t0 = time.monotonic()
+        waits = []
+        for a in RetryPolicy(attempts=3, backoff_s=0.5,
+                             jitter=0.0).attempts_iter():
+            waits.append(a.t_s)
+            a.override_sleep_s = 0.0  # server said "now is fine"
+        assert time.monotonic() - t0 < 0.3  # policy sleep was overridden
+
+
+# ------------------------------------------------------------------ Deadline
+
+class TestDeadline:
+    def test_remaining_and_expired(self):
+        d = Deadline.after(0.2)
+        assert 0.0 < d.remaining() <= 0.2
+        assert not d.expired
+        assert Deadline.after(-1).expired
+        assert not Deadline.never().expired
+
+    def test_header_roundtrip_shrinks_across_hops(self):
+        d = Deadline.after(2.0)
+        time.sleep(0.05)
+        hop2 = Deadline.from_headers({Deadline.HEADER: d.to_header()})
+        assert hop2 is not None
+        assert hop2.remaining() <= d.remaining() + 1e-3
+        assert hop2.remaining() < 2.0
+
+    def test_header_case_insensitive(self):
+        assert Deadline.from_headers({"x-deadline-ms": "1000"}) is not None
+
+    def test_absent_or_malformed_header(self):
+        assert Deadline.from_headers(None) is None
+        assert Deadline.from_headers({}) is None
+        assert Deadline.from_headers({"X-Deadline-Ms": "soon"}) is None
+
+
+# ------------------------------------------------------- Retry-After parsing
+
+class TestParseRetryAfter:
+    def test_delta_seconds(self):
+        assert parse_retry_after("2") == 2.0
+        assert parse_retry_after("0.5") == 0.5
+
+    def test_http_date(self):
+        from email.utils import formatdate
+        v = parse_retry_after(formatdate(time.time() + 3, usegmt=True))
+        assert v is not None and 1.0 < v <= 3.0
+        # dates in the past clamp to zero (retry immediately)
+        assert parse_retry_after(
+            formatdate(time.time() - 60, usegmt=True)) == 0.0
+
+    def test_garbage(self):
+        assert parse_retry_after(None) is None
+        assert parse_retry_after("next tuesday") is None
+
+    def test_send_with_retries_honors_http_date(self):
+        """io/http.py satellite: the HTTP-date form of Retry-After is now
+        parsed (it used to silently fall back to the backoff array)."""
+        from email.utils import formatdate
+
+        from mmlspark_tpu.io.http import HTTPRequestData, send_with_retries
+
+        state = {"n": 0}
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                state["n"] += 1
+                if state["n"] == 1:
+                    self.send_response(429)
+                    # HTTP-date pointing at "now": retry immediately instead
+                    # of sleeping the 100ms backoff-array slot
+                    self.send_header("Retry-After",
+                                     formatdate(time.time(), usegmt=True))
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/"
+            r = send_with_retries(HTTPRequestData(url, "POST", entity=b"{}"))
+            assert r.statusCode == 200
+            assert state["n"] == 2  # retried exactly once, honoring the date
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ------------------------------------------------------------- FaultInjector
+
+class TestFaultInjector:
+    def test_same_seed_same_schedule(self):
+        a = FaultInjector(seed=7, error_rate=0.3, drop_rate=0.1,
+                          delay_rate=0.2)
+        b = FaultInjector(seed=7, error_rate=0.3, drop_rate=0.1,
+                          delay_rate=0.2)
+        assert a.schedule(200) == b.schedule(200)
+        assert a.schedule(200) != FaultInjector(
+            seed=8, error_rate=0.3, drop_rate=0.1,
+            delay_rate=0.2).schedule(200)
+
+    def test_live_draws_match_schedule(self):
+        fi = FaultInjector(seed=3, error_rate=0.25, drop_rate=0.25)
+        expect = fi.schedule(100)
+        assert [fi.next_fault() for _ in range(100)] == expect
+
+    def test_rates_roughly_honored(self):
+        sched = FaultInjector(seed=0, error_rate=0.3).schedule(2000)
+        frac = sched.count("error") / len(sched)
+        assert 0.25 < frac < 0.35
+
+    def test_wrap_injects_and_counts(self):
+        fi = FaultInjector(seed=1, error_rate=1.0)
+        wrapped = fi.wrap(lambda: "never")
+        with pytest.raises(InjectedFault):
+            wrapped()
+        assert fi.counts == {"calls": 1, "error": 1, "drop": 0, "delay": 0,
+                             "ok": 0}
+        ok = FaultInjector(seed=1).wrap(lambda x: x + 1)
+        assert ok(1) == 2
+
+    def test_invalid_rates_rejected(self):
+        with pytest.raises(ValueError):
+            FaultInjector(error_rate=0.7, drop_rate=0.7)
+
+
+# --------------------------------------------------- serving: shed + health
+
+def _post(url, payload, timeout=30.0, headers=None):
+    body = json.dumps(payload).encode()
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=body, headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get_json(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestLoadShedding:
+    def test_queue_full_sheds_503_with_retry_after(self):
+        from mmlspark_tpu.io.serving import ServingServer
+
+        release = threading.Event()
+
+        def slow_handler(df):
+            release.wait(5.0)
+            return df.with_column("prediction", np.ones(len(df)))
+
+        srv = ServingServer(slow_handler, port=0, max_batch_size=1,
+                            max_latency_ms=0.0, max_queue=2,
+                            request_timeout=10.0).start()
+        try:
+            results = {"ok": 0, "shed": 0}
+            shed_headers = []
+
+            def call(i):
+                try:
+                    status, _ = _post(srv.url, {"x": float(i)})
+                    results["ok"] += 1
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    shed_headers.append(e.headers.get("Retry-After"))
+                    results["shed"] += 1
+
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                futs = [ex.submit(call, i) for i in range(8)]
+                time.sleep(0.3)   # let the queue fill against the held batch
+                release.set()
+                for f in futs:
+                    f.result()
+            # the dispatcher holds 1, the queue holds 2 -> >= 5 shed of 8
+            assert results["shed"] >= 1
+            assert results["ok"] == 8 - results["shed"]
+            assert all(h == "1" for h in shed_headers)
+            assert srv.stats["shed"] == results["shed"]
+        finally:
+            release.set()
+            srv.stop()
+
+    @pytest.mark.parametrize("listener", ["asyncio", "thread"])
+    def test_health_endpoint(self, listener):
+        from mmlspark_tpu.io.serving import ServingServer
+
+        srv = ServingServer(
+            lambda df: df.with_column("prediction", np.ones(len(df))),
+            port=0, listener=listener, max_queue=16).start()
+        try:
+            status, h = _get_json(srv.url.rstrip("/") + "/health")
+            assert status == 200
+            assert h["dispatcher_alive"] is True
+            assert h["queue_depth"] == 0
+            assert h["max_queue"] == 16
+            assert h["stats"]["shed"] == 0
+        finally:
+            srv.stop()
+
+
+class TestDeadlineExpiry:
+    def test_expired_budget_is_504_not_a_batch_slot(self):
+        from mmlspark_tpu.io.serving import ServingServer
+
+        handled = {"n": 0}
+
+        def handler(df):
+            handled["n"] += len(df)
+            return df.with_column("prediction", np.ones(len(df)))
+
+        srv = ServingServer(handler, port=0, max_latency_ms=1.0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(srv.url, {"x": 1.0},
+                      headers={Deadline.HEADER: "0"})
+            assert ei.value.code == 504
+            assert handled["n"] == 0  # never occupied a batch slot
+            assert srv.stats["expired"] == 1
+            # a live budget still flows through
+            status, body = _post(srv.url, {"x": 1.0},
+                                 headers={Deadline.HEADER: "5000"})
+            assert status == 200 and body["prediction"] == 1.0
+        finally:
+            srv.stop()
+
+    def test_gateway_answers_504_without_forwarding(self):
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+
+        forwarded = {"n": 0}
+
+        def transport(url, body, headers, timeout):
+            forwarded["n"] += 1
+            return 200, b"{}"
+
+        coord = ServingCoordinator(forward_transport=transport).start()
+        try:
+            coord.register(ServiceInfo("svc", "127.0.0.1", 1, "m", 0))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(coord.url + "/gateway/svc", {"x": 1.0},
+                      headers={Deadline.HEADER: "0"})
+            assert ei.value.code == 504
+            assert forwarded["n"] == 0
+        finally:
+            coord.stop()
+
+    def test_gateway_forwards_shrunken_budget(self):
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+
+        seen = {}
+
+        def transport(url, body, headers, timeout):
+            seen["deadline_ms"] = float(headers[Deadline.HEADER])
+            seen["timeout"] = timeout
+            return 200, b"{}"
+
+        coord = ServingCoordinator(forward_transport=transport).start()
+        try:
+            coord.register(ServiceInfo("svc", "127.0.0.1", 1, "m", 0))
+            status, _ = _post(coord.url + "/gateway/svc", {"x": 1.0},
+                              headers={Deadline.HEADER: "2000"})
+            assert status == 200
+            # the next hop sees only the REMAINING budget, and the forward
+            # socket timeout is capped by it too
+            assert 0 < seen["deadline_ms"] <= 2000
+            assert seen["timeout"] <= 2.0 + 1e-3
+        finally:
+            coord.stop()
+
+
+# ------------------------------------------- worker health: evict/re-register
+
+class _EchoWorkers:
+    """N in-process DistributedServingServer workers whose handlers echo x
+    and record every processed id (duplicate-processing audit)."""
+
+    def __init__(self, coord_url, name, n, heartbeat_interval_s=0.1):
+        self.processed = [[] for _ in range(n)]
+        self.locks = [threading.Lock() for _ in range(n)]
+        self.workers = []
+        from mmlspark_tpu.io.distributed_serving import \
+            DistributedServingServer
+        for p in range(n):
+            self.workers.append(DistributedServingServer(
+                self._handler(p), coord_url, name, partition=p,
+                machine=f"m{p}", port=0, max_latency_ms=1.0,
+                heartbeat_interval_s=heartbeat_interval_s).start())
+
+    def _handler(self, p):
+        def handler(df):
+            xs = np.asarray(df["x"], np.float64)
+            with self.locks[p]:
+                self.processed[p].extend(xs.tolist())
+            return df.with_column("prediction", xs)
+        return handler
+
+    def stop(self):
+        for w in self.workers:
+            w.stop()
+
+
+def _wait_until(fn, timeout=5.0, interval=0.05):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+class TestWorkerHealth:
+    def test_silent_worker_evicted_alive_worker_reregisters(self):
+        from mmlspark_tpu.io.distributed_serving import (ServingCoordinator,
+                                                         fetch_routes)
+
+        coord = ServingCoordinator(heartbeat_timeout_s=0.5).start()
+        fleet = _EchoWorkers(coord.url, "hb", 2, heartbeat_interval_s=0.1)
+        try:
+            assert len(fetch_routes(coord.url, "hb")) == 2
+            # evict a LIVE worker by hand (what a chaos-injected forward
+            # failure does): its next heartbeat gets 410 and re-registers
+            live = fleet.workers[1]
+            coord.deregister("hb", live._info)
+            assert _wait_until(lambda: len(coord.routes("hb")) == 2, 3.0), \
+                "evicted-but-alive worker did not re-register via heartbeat"
+            # kill a worker: heartbeats stop -> the monitor evicts it
+            fleet.workers[0].stop()
+            assert _wait_until(
+                lambda: {s.partition for s in coord.routes("hb")} == {1},
+                4.0), "dead worker was never evicted from the routing table"
+            # the coordinator's health endpoint reflects the eviction
+            _, h = _get_json(coord.url + "/health")
+            assert h["services"]["hb"] == 1
+            assert h["stats"]["evictions"] >= 1
+        finally:
+            fleet.stop()
+            coord.stop()
+
+
+class TestHeartbeatSupersede:
+    def test_superseded_incarnation_stands_down_no_flap(self):
+        """When a replacement takes over a worker's (machine, partition)
+        identity, the old incarnation's heartbeat gets "superseded" (409) —
+        NOT "gone" — so it must not re-register and collapse the successor
+        out of the table (which would flap forever)."""
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+
+        coord = ServingCoordinator(heartbeat_timeout_s=30.0).start()
+        try:
+            w1 = ServiceInfo("svc", "127.0.0.1", 1111, "m", 0,
+                             heartbeating=True)
+            w2 = ServiceInfo("svc", "127.0.0.1", 2222, "m", 0,
+                             heartbeating=True)
+            coord.register(w1)
+            coord.register(w2)  # same identity, different endpoint: wins
+            assert [s.port for s in coord.routes("svc")] == [2222]
+            assert coord.heartbeat(w1) == "superseded"
+            assert [s.port for s in coord.routes("svc")] == [2222]
+            assert coord.heartbeat(w2) == "ok"
+            # the successor dying frees the slot: w1 may then re-register
+            coord.deregister("svc", w2)
+            assert coord.heartbeat(w1) == "gone"
+            coord.register(w1)
+            assert coord.heartbeat(w1) == "ok"
+        finally:
+            coord.stop()
+
+
+class TestGatewayFailoverSemantics:
+    def test_worker_503_shed_fails_over_to_idle_worker(self):
+        """A worker shedding (queue full) must not be terminal: the gateway
+        retries the next worker without evicting the shedding one."""
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+
+        calls = []
+
+        def transport(url, body, headers, timeout):
+            calls.append(url)
+            if len(calls) == 1:
+                raise urllib.error.HTTPError(
+                    url, 503, "Service Unavailable",
+                    {"Retry-After": "1"}, None)
+            return 200, b'{"ok": true}'
+
+        coord = ServingCoordinator(forward_transport=transport).start()
+        try:
+            coord.register(ServiceInfo("svc", "127.0.0.1", 1, "m", 0))
+            coord.register(ServiceInfo("svc", "127.0.0.1", 2, "m", 1))
+            status, body = _post(coord.url + "/gateway/svc", {"x": 1.0})
+            assert status == 200 and body["ok"] is True
+            assert len(calls) == 2           # failed over after the shed
+            assert len(coord.routes("svc")) == 2  # nobody evicted
+        finally:
+            coord.stop()
+
+    def test_all_workers_shedding_propagates_503_retry_after(self):
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+
+        def transport(url, body, headers, timeout):
+            raise urllib.error.HTTPError(url, 503, "Service Unavailable",
+                                         {"Retry-After": "2"}, None)
+
+        coord = ServingCoordinator(
+            forward_transport=transport,
+            forward_retry=RetryPolicy(attempts=3, backoff_s=0.01,
+                                      jitter=0.0)).start()
+        try:
+            coord.register(ServiceInfo("svc", "127.0.0.1", 1, "m", 0))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(coord.url + "/gateway/svc", {"x": 1.0})
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") == "2"
+        finally:
+            coord.stop()
+
+    def test_manual_registration_not_evicted_by_monitor(self):
+        """Workers that never heartbeat (plain register(), no
+        DistributedServingServer loop) keep the pre-resilience contract:
+        only gateway failure detection evicts them."""
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+
+        coord = ServingCoordinator(heartbeat_timeout_s=0.2).start()
+        try:
+            coord.register(ServiceInfo("svc", "127.0.0.1", 1234, "m", 0))
+            time.sleep(0.8)  # several monitor sweeps past the timeout
+            assert len(coord.routes("svc")) == 1
+        finally:
+            coord.stop()
+
+    def test_bounded_failover_reaches_survivor_among_many_dead(self):
+        """The bounded (no client deadline) attempt count grows with the
+        registered worker count: 9 dead workers + 1 live one must still
+        serve the request."""
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+        from mmlspark_tpu.io.serving import ServingServer
+
+        coord = ServingCoordinator(forward_timeout=5.0).start()
+        live = ServingServer(
+            lambda df: df.with_column("prediction", np.ones(len(df))),
+            port=0, max_latency_ms=1.0).start()
+        try:
+            for p in range(9):  # closed ports: instant connection refusal
+                s = __import__("socket").socket()
+                s.bind(("127.0.0.1", 0))
+                dead_port = s.getsockname()[1]
+                s.close()
+                coord.register(ServiceInfo("svc", "127.0.0.1", dead_port,
+                                           f"dead{p}", p))
+            coord.register(ServiceInfo("svc", "127.0.0.1", live.port,
+                                       "live", 9))
+            status, body = _post(coord.url + "/gateway/svc", {"x": 1.0})
+            assert status == 200 and body["prediction"] == 1.0
+            # the survivor stayed; every dead worker the rotation actually
+            # touched was evicted (the gateway stops at first success, so
+            # untried dead workers legitimately remain until traffic or the
+            # heartbeat monitor reaches them)
+            ports = [s.port for s in coord.routes("svc")]
+            assert live.port in ports
+            assert coord.stats["evictions"] >= 1
+            assert len(ports) < 10
+        finally:
+            live.stop()
+            coord.stop()
+
+    def test_budget_exhaustion_is_504_not_502(self):
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+
+        coord = ServingCoordinator().start()
+        try:
+            info = ServiceInfo("svc", "127.0.0.1", 1234, "m", 0)
+            coord.register(info)
+            coord.deregister("svc", info)  # known service, empty table
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(coord.url + "/gateway/svc", {"x": 1.0},
+                      headers={Deadline.HEADER: "300"})
+            assert ei.value.code == 504  # the BUDGET ran out, not the infra
+        finally:
+            coord.stop()
+
+
+# --------------------------------------------------------- the chaos run
+
+class TestGatewayChaos:
+    # ~5-6 s of wall clock (200 gateway round-trips + eviction waits):
+    # slow-marked per the tier-1 budget rule (chaos tests sleeping/waiting
+    # > 2 s stay out of the fast tier)
+    @pytest.mark.slow
+    def test_200_requests_30pct_forward_faults_worker_killed(self):
+        """ISSUE-4 acceptance: 30% injected forward failures + one worker
+        killed mid-stream; 200 gateway requests all complete (0 lost, 0
+        duplicated replies); the killed worker is evicted then successfully
+        re-registers."""
+        from mmlspark_tpu.io.distributed_serving import (
+            DistributedServingServer, ServingCoordinator,
+            _default_transport)
+
+        injector = FaultInjector(seed=11, error_rate=0.3)
+        coord = ServingCoordinator(
+            heartbeat_timeout_s=0.8,
+            forward_transport=injector.wrap(_default_transport)).start()
+        fleet = _EchoWorkers(coord.url, "chaos", 3,
+                             heartbeat_interval_s=0.1)
+        replies = {}
+        rep_lock = threading.Lock()
+
+        def call(i):
+            status, body = _post(coord.url + "/gateway/chaos",
+                                 {"x": float(i)}, timeout=30.0,
+                                 headers={Deadline.HEADER: "20000"})
+            assert status == 200
+            with rep_lock:
+                assert i not in replies, f"duplicated reply for {i}"
+                replies[i] = body["prediction"]
+
+        try:
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                first = [ex.submit(call, i) for i in range(100)]
+                for f in first:
+                    f.result()
+                fleet.workers[0].stop()   # kill one worker mid-stream
+                second = [ex.submit(call, i) for i in range(100, 200)]
+                for f in second:
+                    f.result()
+
+            # zero lost, zero duplicated, correct payloads
+            assert len(replies) == 200
+            assert all(replies[i] == float(i) for i in range(200))
+            assert injector.counts["error"] > 0, \
+                "chaos run injected no faults — the test proved nothing"
+
+            # the killed worker is evicted (gateway failure detection or
+            # heartbeat monitor, whichever saw it first)...
+            assert _wait_until(
+                lambda: 0 not in {s.partition
+                                  for s in coord.routes("chaos")}, 4.0), \
+                "killed worker still in the routing table"
+            # ...and a replacement with the SAME identity re-registers and
+            # serves (register replaces the (machine, partition) slot)
+            w0b = DistributedServingServer(
+                fleet._handler(0), coord.url, "chaos", partition=0,
+                machine="m0", port=0, max_latency_ms=1.0,
+                heartbeat_interval_s=0.1).start()
+            fleet.workers[0] = w0b
+            assert {s.partition for s in coord.routes("chaos")} == {0, 1, 2}
+            # round-robin reaches the re-registered worker (bounded poll:
+            # with 30% forward faults a fixed small burst could miss it)
+            before = len(fleet.processed[0])
+            total = 200
+            while len(fleet.processed[0]) == before and total < 260:
+                call(total)
+                total += 1
+            assert len(fleet.processed[0]) > before, \
+                "re-registered worker never received traffic"
+
+            # duplicate-PROCESSING audit: every id was processed at least
+            # once; with error-before-send injection the only duplication
+            # window is a worker dying after processing but before replying
+            all_processed = sorted(
+                x for lst in fleet.processed for x in lst)
+            assert set(all_processed) == {float(i) for i in range(total)}
+        finally:
+            fleet.stop()
+            coord.stop()
+
+
+# ------------------------------------------------------------ backoff lint
+
+class TestSingleBackoffImplementation:
+    """Exactly one retry/backoff implementation may exist: resilience/.
+
+    Grep-based lint (ISSUE 4 satellite): a sleep whose argument speaks of
+    backoff/retry/delay, or a `for <var> in range(...retries...)` loop,
+    outside mmlspark_tpu/resilience/ means someone grew a fourth ad-hoc
+    retry loop again."""
+
+    SLEEP_RE = re.compile(r"time\.sleep\([^)]*(backoff|retry|delay)")
+    LOOP_RE = re.compile(r"for\s+\w+\s+in\s+range\([^)]*(retries|attempt)")
+    ATTEMPT_RE = re.compile(r"for\s+attempt\s+in\s+range\(")
+
+    def _source_files(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = os.path.join(root, "mmlspark_tpu")
+        files = [os.path.join(root, "bench.py")]
+        for dirpath, _, names in os.walk(pkg):
+            if os.sep + "resilience" in dirpath:
+                continue
+            files.extend(os.path.join(dirpath, n) for n in names
+                         if n.endswith(".py"))
+        return files
+
+    def test_no_ad_hoc_backoff_loops_outside_resilience(self):
+        offenders = []
+        for path in self._source_files():
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if (self.SLEEP_RE.search(line)
+                            or self.LOOP_RE.search(line)
+                            or self.ATTEMPT_RE.search(line)):
+                        offenders.append(f"{path}:{lineno}: {line.strip()}")
+        assert not offenders, (
+            "ad-hoc retry/backoff loop(s) outside mmlspark_tpu/resilience/ "
+            "— route them through RetryPolicy:\n" + "\n".join(offenders))
+
+    def test_retry_policy_defined_once(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        homes = []
+        for dirpath, _, names in os.walk(os.path.join(root, "mmlspark_tpu")):
+            for n in names:
+                if not n.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, n)
+                with open(path, encoding="utf-8") as f:
+                    if "class RetryPolicy" in f.read():
+                        homes.append(os.path.relpath(path, root))
+        assert homes == [os.path.join("mmlspark_tpu", "resilience",
+                                      "policy.py")], homes
+
+
+# --------------------------------------------------- bring-up probe records
+
+class TestBringupProbes:
+    def test_healthy_probe_returns_structured_records(self):
+        from mmlspark_tpu.resilience.bringup import backend_bringup
+
+        jx, devs, err, attempts = backend_bringup(
+            "print('8.0 fakeaccel')", budget_s=10, retry_sleep_s=1,
+            min_probe_s=0.2)
+        assert err is None and devs
+        assert len(attempts) == 1
+        assert set(attempts[0]) == {"t_s", "dur_s", "outcome"}
+        assert attempts[0]["outcome"].startswith("healthy:")
