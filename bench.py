@@ -75,16 +75,29 @@ _PROBE_CODE = ("import jax; d = jax.devices(); "
                "print(jax.numpy.ones(8).sum().item(), d[0].platform)")
 
 
-def _patient_backend_bringup(budget_s=None, retry_sleep_s=90, min_probe_s=60):
-    """Patient bounded TPU bring-up (round-3 verdict, next-round #1).
+#: sentinel: "use the BENCH_PROBE_CAP_S env default" — distinct from None,
+#: which explicitly selects the grant-preserving wait-out mode
+_PROBE_CAP_FROM_ENV = object()
 
-    The probe loop itself now lives behind the shared resilience layer
+
+def _patient_backend_bringup(budget_s=None, retry_sleep_s=90, min_probe_s=60,
+                             max_probe_s=_PROBE_CAP_FROM_ENV, probe_fn=None):
+    """Patient bounded TPU bring-up (round-3 verdict #1; probe policy
+    revised per round-5 verdict #1).
+
+    The probe loop itself lives behind the shared resilience layer
     (mmlspark_tpu/resilience/bringup.py, scheduling via RetryPolicy with
-    jittered backoff + a Deadline wall budget; see parallel/mesh.py). This
-    wrapper keeps the bench-specific pieces: the BENCH_BRINGUP_BUDGET_S
-    override, the module-level probe log `_emit` reads on every exit path,
-    and the watchdog that still emits the mandatory JSON line if the
-    parent's own backend init hangs after a healthy probe.
+    jittered backoff + a Deadline wall budget; see parallel/mesh.py).
+    Each probe is CAPPED at ~3 min (BENCH_PROBE_CAP_S) and the loop keeps
+    probing for the whole budget — BENCH_r05's single 1320 s hung probe
+    ate the entire window and produced the fifth consecutive CPU-fallback
+    scoreboard; short repeated probes catch mid-window recoveries. The
+    cadence is seeded from tpu_recovery_watch's last-known-healthy marker
+    (scripts/tpu_last_healthy) when fresh. This wrapper keeps the
+    bench-specific pieces: the env overrides, the module-level probe log
+    `_emit` reads on every exit path, and the watchdog that still emits
+    the mandatory JSON line if the parent's own backend init hangs after
+    a healthy probe.
 
     Every attempt (offset, duration, outcome) is recorded and returned so
     the BENCH json itself shows whether the pool was down the whole window.
@@ -93,6 +106,10 @@ def _patient_backend_bringup(budget_s=None, retry_sleep_s=90, min_probe_s=60):
     from mmlspark_tpu.resilience.bringup import backend_bringup
     if budget_s is None:
         budget_s = int(os.environ.get("BENCH_BRINGUP_BUDGET_S", "1320"))
+    if max_probe_s is _PROBE_CAP_FROM_ENV:
+        max_probe_s = float(os.environ.get("BENCH_PROBE_CAP_S", "180"))
+    state_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "tpu_last_healthy")
     _BRINGUP_LOG.clear()
 
     def on_parent_hang():
@@ -103,8 +120,10 @@ def _patient_backend_bringup(budget_s=None, retry_sleep_s=90, min_probe_s=60):
 
     return backend_bringup(_PROBE_CODE, budget_s=budget_s,
                            retry_sleep_s=retry_sleep_s,
-                           min_probe_s=min_probe_s, log=_BRINGUP_LOG,
-                           on_parent_hang=on_parent_hang)
+                           min_probe_s=min_probe_s,
+                           max_probe_s=max_probe_s, log=_BRINGUP_LOG,
+                           on_parent_hang=on_parent_hang,
+                           probe_fn=probe_fn, state_path=state_path)
 
 
 def main():
@@ -132,9 +151,22 @@ def main():
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, f)).astype(np.float32)
     coef = rng.normal(size=f)
-    y = ((x @ coef + 0.5 * x[:, 0] * x[:, 1]
-          + rng.normal(scale=1.0, size=n)) > 0).astype(np.float64)
+
+    def label_of(xs):
+        return ((xs @ coef + 0.5 * xs[:, 0] * xs[:, 1]
+                 + rng.normal(scale=1.0, size=len(xs))) > 0
+                ).astype(np.float64)
+
+    y = label_of(x)
     df = DataFrame({"features": x, "label": y})
+    # HELD-OUT gate slice (round-5 verdict #5): candidates are promoted on
+    # held-out AUC, not train AUC — the lazy episode proved generalization
+    # loss is the failure mode that matters (held-out 0.9650 vs eager
+    # 0.9680 while train also moved). Same generative process, rows never
+    # seen by any fit; both AUCs are always reported per candidate.
+    n_ho = 200_000 if on_accel else 20_000
+    x_ho = rng.normal(size=(n_ho, f)).astype(np.float32)
+    y_ho = label_of(x_ho)
 
     # measured kernel selection at the bench shape (ops/autotune.py): times
     # the onehot-scan and pallas candidates on the live chip, picks the winner
@@ -198,14 +230,22 @@ def main():
 
     from sklearn.metrics import roc_auc_score
     idx = rng.choice(n, min(n, 100_000), replace=False)
-    proba = model.booster.score(x[idx])
-    auc = roc_auc_score(y[idx], proba)
+
+    def aucs_of(mdl):
+        """(train-sample AUC, held-out AUC) for one fitted candidate."""
+        a_tr = roc_auc_score(y[idx], mdl.booster.score(x[idx]))
+        a_ho = roc_auc_score(y_ho, mdl.booster.score(x_ho))
+        return a_tr, a_ho
+
+    auc, auc_ho = aucs_of(model)
 
     extra = {"wall_s": round(wall, 2), "full_warm_wall_s": round(warm_wall, 2),
              "full_wall_s": [round(w, 2) for w in walls],
              "n": n, "iters": iters, "hist_scan": scan_mode,
              "hist_kernel": f"{hist_method}/{hist_chunk}",
              "full_auc_sample": round(auc, 4),
+             "full_auc_holdout": round(auc_ho, 4),
+             "holdout_rows": n_ho,
              "full_rows_iter_per_s": round(n * iters / wall, 1),
              "device": str(devs[0])}
 
@@ -222,12 +262,18 @@ def main():
             c.fit(df)                             # compile
             ws, mdl = timed_fits(c, n_fits, t_start + entry_s + 60)
             wbest = min(ws)
-            a = roc_auc_score(y[idx], mdl.booster.score(x[idx]))
+            a_tr, a_ho = aucs_of(mdl)
             extra[f"{tag}_rows_iter_per_s"] = round(n * iters / wbest, 1)
             extra[f"{tag}_wall_s"] = [round(w_, 2) for w_ in ws]
-            extra[f"{tag}_auc_sample"] = round(a, 4)
-            if wbest < wall and a >= auc - AUC_GATE:
-                scan_mode = f"{mode_label} (AUC-parity gated, exact in extras)"
+            extra[f"{tag}_auc_sample"] = round(a_tr, 4)
+            extra[f"{tag}_auc_holdout"] = round(a_ho, 4)
+            # promotion is gated on HELD-OUT AUC (round-5 verdict #5),
+            # anchored to the EXACT mode's held-out AUC on this same run
+            # (the bar must not drift to a previously promoted candidate);
+            # train AUC is reported alongside but never gates
+            if wbest < wall and a_ho >= auc_ho - AUC_GATE:
+                scan_mode = f"{mode_label} (held-out-AUC gated, " \
+                            f"exact in extras)"
                 wall, model = wbest, mdl
                 extra["hist_scan"] = scan_mode
                 extra["wall_s"] = round(wall, 2)
@@ -263,38 +309,63 @@ def main():
     # aggregation can never mix CPU-fallback and accelerator scales
     cands = [{"mode": "eager/full", "n": n, "iters": iters,
               "rows_iter_per_s": extra["full_rows_iter_per_s"],
-              "auc": extra["full_auc_sample"]}]
+              "auc": extra["full_auc_sample"],
+              "auc_holdout": extra["full_auc_holdout"]}]
     for nm, tag in (("lazy", "lazy"), ("batched-k4", "batched4"),
                     ("batched-k8", "batched8")):
         if f"{tag}_rows_iter_per_s" in extra:
             cands.append({"mode": nm, "n": n, "iters": iters,
                           "rows_iter_per_s": extra[f"{tag}_rows_iter_per_s"],
-                          "auc": extra[f"{tag}_auc_sample"]})
+                          "auc": extra[f"{tag}_auc_sample"],
+                          "auc_holdout": extra[f"{tag}_auc_holdout"]})
         elif f"{tag}_error" in extra:
             cands.append({"mode": nm, "error": extra[f"{tag}_error"]})
     extra["candidates"] = cands
+    # the gate rule itself, machine-readable (promotion = faster AND
+    # auc_holdout within gate of the exact mode's auc_holdout on this run)
+    extra["promotion_gate"] = {"on": "auc_holdout", "tolerance": AUC_GATE,
+                               "anchor": "eager/full"}
     # bare mode name, joinable against candidates[].mode (hist_scan keeps
     # the verbose provenance string)
     extra["promoted"] = scan_mode.split(" ")[0]
 
     # extra: wall-time decomposition of one instrumented fit of the primary
     # mode (binning / device transfer / boosting / assembly — barriers
-    # added between phases, so this fit is NOT one of the timed ones)
-    if on_accel and time.time() - t_start < 450:
+    # added between phases, so this fit is NOT one of the timed ones),
+    # plus one PIPELINED instrumented fit (fitPipeline='on'): its
+    # barrier-free FitTimeline carries the measured overlap ratio, and the
+    # two runs together give the cross-run ratio
+    # 1 - pipelined_construction / (sequential binning + transfer).
+    kw_best = ({"histRefresh": "lazy"}
+               if scan_mode.startswith("lazy") else
+               {"splitsPerPass": 8}
+               if scan_mode.startswith("batched-k8") else
+               {"splitsPerPass": 4}
+               if scan_mode.startswith("batched") else {})
+    if time.time() - t_start < 450:
         try:
-            kw_best = ({"histRefresh": "lazy"}
-                       if scan_mode.startswith("lazy") else
-                       {"splitsPerPass": 8}
-                       if scan_mode.startswith("batched-k8") else
-                       {"splitsPerPass": 4}
-                       if scan_mode.startswith("batched") else {})
-            t_clf = make_clf(collectFitTimings=True, **kw_best)
+            t_clf = make_clf(collectFitTimings=True, fitPipeline="off",
+                             **kw_best)
             tm = getattr(t_clf.fit(df).booster, "fit_timings", None)
             if tm:
                 extra["fit_decomposition_s"] = {
-                    kk: round(vv["total_s"], 2) for kk, vv in tm.items()}
+                    kk: round(vv["total_s"], 2) for kk, vv in tm.items()
+                    if isinstance(vv, dict) and "total_s" in vv}
         except Exception as e:  # noqa: BLE001
             extra["fit_decomposition_error"] = str(e)[:200]
+    if time.time() - t_start < 480:
+        try:
+            from mmlspark_tpu.utils.profiling import \
+                fit_pipeline_overlap_record
+            p_clf = make_clf(collectFitTimings=True, fitPipeline="on",
+                             **kw_best)
+            ptm = getattr(p_clf.fit(df).booster, "fit_timings", None)
+            rec = fit_pipeline_overlap_record(
+                ptm, extra.get("fit_decomposition_s"))
+            if rec:
+                extra["fit_pipeline_overlap"] = rec
+        except Exception as e:  # noqa: BLE001
+            extra["fit_pipeline_overlap_error"] = str(e)[:200]
 
     # extra: HIGGS-scale run — BASELINE.json defines the north-star metric
     # at 11M x 28 x 100 (int8 bins ~ 310 MB HBM; fits one v5e chip). One

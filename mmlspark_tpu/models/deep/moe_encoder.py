@@ -22,6 +22,8 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ...parallel.mesh import shard_map as _shard_map
 import numpy as np
 
 from ...ops.moe import init_moe_params, moe_ffn, shard_moe_params
@@ -184,7 +186,7 @@ def make_moe_ep_dp_train_step(mesh, num_heads: int, learning_rate: float,
         return (jax.tree_util.tree_map(lift, params),
                 jax.tree_util.tree_map(lift, opt_state), both(loss))
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         step, mesh=mesh,
         in_specs=(P(model_axis), P(model_axis),
                   P((data_axis, model_axis)), P((data_axis, model_axis))),

@@ -30,6 +30,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ...parallel.mesh import shard_map as _shard_map
+
 from .transformer import encoder_layer
 
 __all__ = ["stack_stage_params", "pipeline_forward", "make_pp_dp_train_step"]
@@ -185,7 +187,7 @@ def make_pp_dp_train_step(mesh, num_heads: int, learning_rate: float,
                 jax.tree_util.tree_map(lift, opt_state),
                 jax.lax.pmean(loss, data_axis))
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         step, mesh=mesh,
         in_specs=(P(model_axis), P(model_axis), P(data_axis), P(data_axis)),
         out_specs=(P(model_axis), P(model_axis), P()),

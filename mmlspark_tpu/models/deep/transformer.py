@@ -20,6 +20,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ...parallel.mesh import shard_map as _shard_map
 import numpy as np
 from jax.flatten_util import ravel_pytree
 
@@ -409,14 +411,14 @@ def make_tp_dp_train_step(mesh, num_heads: int, learning_rate: float,
 
     if zero1:
         opt_spec = P(model_axis, data_axis)
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             step_zero1, mesh=mesh,
             in_specs=(P(model_axis), opt_spec,
                       P(data_axis), P(data_axis)),
             out_specs=(P(model_axis), opt_spec, P()),
             check_vma=False)
     else:
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             step, mesh=mesh,
             in_specs=(P(model_axis), P(model_axis),
                       P(data_axis), P(data_axis)),
@@ -561,7 +563,7 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
             from jax.sharding import PartitionSpec as P
             mesh = meshlib.get_mesh(ndev)
             axis = meshlib.DATA_AXIS
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(_shard_map(
                 partial(encoder_forward, num_heads=nh, causal=causal,
                         axis_name=axis, positional=pos,
                         attention_impl=seq_attn),
@@ -1019,7 +1021,7 @@ def make_sp_train_step(mesh, num_heads: int, learning_rate: float,
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         step, mesh=mesh,
         in_specs=(P(), P(), P(None, seq_axis, None), P()),
         out_specs=(P(), P(), P()), check_vma=False)

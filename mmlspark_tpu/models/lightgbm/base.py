@@ -28,6 +28,7 @@ from ...ops.binning import BinMapper
 from ...ops.boosting import (BoostResult, GBDTConfig, HParams, Tree,
                              make_train_fn)
 from ...parallel import mesh as meshlib
+from ...utils.profiling import NULL_TIMELINE, FitTimeline
 from .booster import Booster, concat_boosters
 
 Param = _p.Param
@@ -94,7 +95,7 @@ def _compiled_sharded_vmapped(cfg: GBDTConfig, ndev: int,
     axis = meshlib.DATA_AXIS
     train = make_train_fn(cfg)
     specs = (P(axis),) * 5 + (P(), P()) + ((P(axis),) if grouped else ())
-    sharded = jax.shard_map(
+    sharded = meshlib.shard_map(
         lambda b, y, w, t, mg, k_, hp_, *rest: train(
             b, y, w, t, mg, k_,
             group_idx=rest[0] if rest else None, hp=hp_),
@@ -110,7 +111,7 @@ def _compiled_sharded(cfg: GBDTConfig, ndev: int, grouped: bool):
     train = make_train_fn(cfg)
     dart = cfg.boosting_type == "dart"
     gspec = (P(axis),) if grouped else ()
-    full = jax.shard_map(
+    full = meshlib.shard_map(
         train, mesh=m, in_specs=(P(axis),) * 5 + (P(),) + gspec,
         out_specs=P(), check_vma=False)
 
@@ -128,7 +129,7 @@ def _compiled_sharded(cfg: GBDTConfig, ndev: int, grouped: bool):
     # dart's deltas [T, N, K] shard with the rows on axis 1; tree_scale
     # and the carried PRNG key are replicated
     dspec = (P(None, axis), P()) if dart else ()
-    chunk = jax.shard_map(
+    chunk = meshlib.shard_map(
         chunk_fn, mesh=m,
         in_specs=(P(axis),) * 5 + (P(), P(), P(axis), P()) + dspec + gspec,
         out_specs=(P(), P(), P(), P(axis), P()) + dspec + (P(),),
@@ -269,6 +270,21 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         "the cost that children created within a pass cannot compete until "
         "the next pass. Gains are never stale (unlike histRefresh='lazy'). "
         "eager/full only", 1, int)
+    fitPipeline = Param(
+        "fitPipeline",
+        "host/device fit pipeline for serial fits: 'auto' (pipelined "
+        "dataset construction at >= 2M float32 rows — binning of row-block "
+        "k+1 overlaps block k's async device transfer, label/weight/margin "
+        "transfers ride under the first blocks, and the itersPerCall chunk "
+        "loop dispatches chunk i+1 before fetching chunk i's host "
+        "bookkeeping), 'on' (force the pipeline at any size/dtype — with "
+        "collectFitTimings this records a barrier-free FitTimeline with "
+        "per-block bin/put spans and a measured overlap ratio instead of "
+        "the phase-separated decomposition), or 'off' (sequential "
+        "construction; with collectFitTimings this is the separable-phase "
+        "decomposition mode). Boosters are BIT-IDENTICAL across all three "
+        "(regression-pinned incl. NaN and float64-fallback inputs)",
+        "auto")
     collectFitTimings = Param(
         "collectFitTimings",
         "record a wall-time decomposition of fit() — binning, device "
@@ -399,19 +415,32 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 
     @staticmethod
     def _binned_to_device(bm: BinMapper, x: np.ndarray,
-                          blk: Optional[int] = None):
+                          blk: Optional[int] = None, timeline=None):
         """Row-block pipelined dataset construction: bin block k+1 on the
         host while block k's int8 copy rides to the device (device_put is
         async) — overlaps the two serial halves of
         LGBM_DatasetCreateFromMat's role instead of paying
-        binning + transfer back to back. Blocks land in ONE preallocated
-        device buffer through a donated dynamic_update_slice, so peak HBM
-        stays ~1x the binned matrix + one block (a naive concatenate of
-        parts would double it at exactly the scale this path targets)."""
+        binning + transfer back to back. Double-buffered by construction:
+        at most two blocks are in flight (the host-side array being binned
+        plus the previous block's async transfer; JAX pins the source
+        buffer until its copy lands, so no staging reuse and no wait).
+        Blocks land in ONE preallocated device buffer through a donated
+        dynamic_update_slice, so peak HBM stays ~1x the binned matrix +
+        one block (a naive concatenate of parts would double it at exactly
+        the scale this path targets). This stage contains NO host sync —
+        the only commit barrier is at first-dispatch time (sync-point
+        lint, tests/test_fit_pipeline.py); `timeline` (a FitTimeline)
+        records the per-block bin/put spans without adding barriers."""
+        tl = timeline if timeline is not None else NULL_TIMELINE
         n, fdim = x.shape
         if blk is None:
             blk = max(1_000_000, -(-n // 8))
-        first = jax.device_put(bm.transform(x[:blk]))
+        tl.meta["blk"] = int(min(blk, n))
+        tl.meta["n_blocks"] = 1 + len(range(blk, n, blk))
+        with tl.span("bin[0]"):
+            b0 = bm.transform(x[:blk])
+        with tl.span("put[0]"):
+            first = jax.device_put(b0)
         if blk >= n:
             return first
         buf = jnp.zeros((n, fdim), first.dtype)
@@ -425,9 +454,42 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # the final window shifts back to stay full-size (ONE compiled
             # write shape); its overlap rows re-bin to identical values
             j0 = min(i0, n - blk)
-            buf = write(buf, jax.device_put(bm.transform(x[j0:j0 + blk])),
-                        jnp.int32(j0))
+            with tl.span(f"bin[{j0}]"):
+                bk = bm.transform(x[j0:j0 + blk])
+            with tl.span(f"put[{j0}]"):
+                buf = write(buf, jax.device_put(bk), jnp.int32(j0))
         return buf
+
+    def _pipelined_device_data(self, bm: BinMapper, x: np.ndarray, y, w,
+                               is_valid, margin, has_init: bool, k: int,
+                               groups, timeline):
+        """The pipelined construction stage of the host/device fit
+        pipeline: every fixed host cost is dispatched ASYNC before the
+        row-block loop so it rides the interconnect UNDER the first
+        blocks' host binning — label/weight/validity transfers, the margin
+        copy (device-side zeros when there is no init score: a [N, K]
+        zeros transfer is pure waste), and the lambdarank group layout.
+        Returns (binned_device, (y_d, w_d, t_d, mg_d, gidx)). No host
+        sync anywhere in this stage (sync-point lint): the commit barrier
+        is first-dispatch time — in collectFitTimings mode, an explicit
+        measured `commit_wait` in _train_booster_once."""
+        n = x.shape[0]
+        with timeline.span("aux_dispatch"):
+            y_d = jnp.asarray(y)
+            w_d = jnp.asarray(w)
+            t_d = jnp.asarray((~is_valid).astype(np.float32))
+            mg_d = (jnp.asarray(margin) if has_init
+                    else jnp.zeros((n, k), jnp.float32))
+            gidx = None
+            if groups is not None:
+                from ...ops.ranking import make_group_layout
+                gidx = jnp.asarray(make_group_layout(groups).group_idx)
+        # forced-on fits pipeline at any size (>= 2 blocks whenever the
+        # data allows), auto keeps the measured 4M-scale block size
+        blk = (max(1024, -(-n // 8)) if self.get("fitPipeline") == "on"
+               else None)
+        binned = self._binned_to_device(bm, x, blk=blk, timeline=timeline)
+        return binned, (y_d, w_d, t_d, mg_d, gidx)
 
     def _extract_xyw(self, df: DataFrame
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
@@ -738,35 +800,36 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         if _dlg is not None:
             _dlg.before_generate_train_dataset(_bi, self)
         # serial fits at scale take the pipelined dataset path (binning
-        # overlapped with the device transfer); collectFitTimings keeps the
-        # sequential path so the binning/transfer phases stay separable
+        # overlapped with the device transfer); under collectFitTimings the
+        # sequential path keeps the binning/transfer phases separable, while
+        # fitPipeline='on' + collectFitTimings records the barrier-free
+        # FitTimeline instead (overlap measured, not inferred).
         # the serial/sharded decision, made ONCE here and reused by the
         # mesh-placement code below (drift between two copies of this
         # predicate would route a committed device array into place_global)
         par = self.get("parallelism")
         ndev = self.get("numTasks") or meshlib.device_count()
         serial = (par == "serial" or ndev <= 1)
-        _pipelined = (prebinned is None and _sw is None and serial
-                      and isinstance(x, np.ndarray)
-                      and x.dtype == np.float32 and n >= 2_000_000)
-        if _sw is not None:
-            with _sw.measure("binning", barrier=False):
-                if prebinned is not None:
-                    bm, binned, self._missing_idx = prebinned
-                else:
-                    bm, binned, self._missing_idx = self._fit_binning(x)
-        elif prebinned is not None:  # LightGBMDataset: bins computed once
-            bm, binned, self._missing_idx = prebinned
-        elif _pipelined:
-            bm = self._fit_bin_mapper(x)
-            self._missing_idx = self._missing_idx_of(bm)
-            binned = self._binned_to_device(bm, x)
-        else:
-            bm, binned, self._missing_idx = self._fit_binning(x)
-        if _dlg is not None:
-            _dlg.after_generate_train_dataset(_bi, self)
+        fp = self.get("fitPipeline")
+        if fp not in ("auto", "on", "off"):
+            raise ValueError(
+                f"fitPipeline must be auto, on or off, got {fp!r}")
+        if fp == "on" and not serial and prebinned is None:
+            raise ValueError(
+                "fitPipeline='on' requires a serial fit (parallelism="
+                "'serial' or one device/task): the sharded data plane "
+                "places padded global arrays, not a streaming block buffer")
+        _pipelined = (prebinned is None and serial
+                      and isinstance(x, np.ndarray) and x.ndim == 2
+                      and (fp == "on"
+                           or (fp == "auto" and _sw is None
+                               and x.dtype == np.float32
+                               and n >= 2_000_000)))
+        self._last_fit_pipelined = bool(_pipelined)
 
-        # assemble per-row init margins: user initScoreCol + previous booster
+        # margin assembly hoisted ABOVE dataset construction (it only needs
+        # raw features): the pipelined path dispatches its device copy
+        # before the block loop, hiding the transfer under host binning
         margin = np.zeros((n, k), np.float32)
         has_init = False
         if init_score is not None:
@@ -776,6 +839,30 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             pm = prev.raw_predict(x)
             margin += pm.reshape(n, -1).astype(np.float32)
             has_init = True
+
+        _tl = None
+        _aux = None
+        if _sw is not None and not _pipelined:
+            with _sw.measure("binning", barrier=False):
+                if prebinned is not None:
+                    bm, binned, self._missing_idx = prebinned
+                else:
+                    bm, binned, self._missing_idx = self._fit_binning(x)
+        elif prebinned is not None:  # LightGBMDataset: bins computed once
+            bm, binned, self._missing_idx = prebinned
+        elif _pipelined:
+            _tl = FitTimeline() if _sw is not None else NULL_TIMELINE
+            with _tl.span("edges_fit"):
+                bm = self._fit_bin_mapper(x)
+            self._missing_idx = self._missing_idx_of(bm)
+            binned, _aux = self._pipelined_device_data(
+                bm, x, y, w, is_valid, margin, has_init, k, groups, _tl)
+            if _sw is None:
+                _tl = None
+        else:
+            bm, binned, self._missing_idx = self._fit_binning(x)
+        if _dlg is not None:
+            _dlg.after_generate_train_dataset(_bi, self)
 
         if self.get("histDtype") not in ("bf16", "f32"):
             raise ValueError(
@@ -833,11 +920,17 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 
         if serial:
             cfg = self._make_config(num_class, None, objective, has_init)
-            if groups is not None:
-                from ...ops.ranking import make_group_layout
-                gidx = jnp.asarray(make_group_layout(groups).group_idx)
-            data = (jnp.asarray(binned), jnp.asarray(y), jnp.asarray(w),
-                    jnp.asarray(is_train), jnp.asarray(margin))
+            if _aux is not None:
+                # pipelined construction: every array was dispatched async
+                # during/ahead of the block loop — no fresh transfers here
+                y_d, w_d, t_d, mg_d, gidx = _aux
+                data = (binned, y_d, w_d, t_d, mg_d)
+            else:
+                if groups is not None:
+                    from ...ops.ranking import make_group_layout
+                    gidx = jnp.asarray(make_group_layout(groups).group_idx)
+                data = (jnp.asarray(binned), jnp.asarray(y), jnp.asarray(w),
+                        jnp.asarray(is_train), jnp.asarray(margin))
             jfull, jchunk = _compiled_serial(cfg)
 
             def _st_kw(st):
@@ -977,18 +1070,43 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     fh.write(bst.model_string())
                 os.replace(tmp, os.path.join(ckdir, "booster.txt"))
 
+        _chunk_tl = None
         if _sw is not None:
             import time as _tm
-            _t0 = _tm.perf_counter()
-            jax.block_until_ready(data)
-            _sw._acc["device_transfer"] = {
-                "total_s": _tm.perf_counter() - _t0, "count": 1.0}
+            if _tl is not None:
+                # pipelined timeline mode: the DESIGNATED commit barrier —
+                # the one host sync of the construction stage, at
+                # first-dispatch time. Its measured wait is the transfer
+                # backlog NOT hidden under host binning.
+                with _tl.span("commit_wait", kind="wait"):
+                    jax.block_until_ready(data)
+                # calibrate the total transfer backlog (the 'device' stream
+                # of the overlap ratio): one block's d2h round trip
+                # approximates one block's h2d cost over the same link,
+                # scaled by the block count. An estimate, flagged as such
+                # in the timeline — measuring h2d per block exactly would
+                # need the per-block barriers this pipeline removes.
+                nb = int(_tl.meta.get("n_blocks", 1))
+                cb = int(_tl.meta.get("blk", n))
+                _t0 = _tm.perf_counter()
+                np.asarray(binned[:cb])
+                _tl.add_span("transfer_estimate", "device",
+                             (_tm.perf_counter() - _t0) * nb)
+                _sw._acc["construction"] = {"total_s": _tl.wall_s,
+                                            "count": 1.0}
+                if use_chunked:
+                    _chunk_tl = FitTimeline()
+            else:
+                _t0 = _tm.perf_counter()
+                jax.block_until_ready(data)
+                _sw._acc["device_transfer"] = {
+                    "total_s": _tm.perf_counter() - _t0, "count": 1.0}
 
         def _boost():
             if use_chunked:
                 return self._run_chunked(
                     run_chunk, key, n_rows_exec, k, rounds, has_valid,
-                    delegate, save_ck=save_ck)
+                    delegate, save_ck=save_ck, timeline=_chunk_tl)
             res = jax.tree.map(np.asarray, run_full(key))
             return res, self._select_best_iteration(res, has_valid)
 
@@ -1004,6 +1122,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             timings["total"] = {
                 "total_s": (__import__("time").perf_counter() - _t_fit0),
                 "count": 1.0}
+            if _tl is not None:
+                timings["timeline"] = {"construction": _tl.summary()}
+                if _chunk_tl is not None:
+                    timings["timeline"]["chunks"] = _chunk_tl.summary()
             booster.fit_timings = timings
         else:
             result, best_iter = _boost()
@@ -1049,8 +1171,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         return booster
 
     def _run_chunked(self, run_chunk, key, n_rows: int, k: int, rounds: int,
-                     has_valid: bool, delegate,
-                     save_ck=None) -> Tuple[BoostResult, Optional[int]]:
+                     has_valid: bool, delegate, save_ck=None,
+                     timeline=None) -> Tuple[BoostResult, Optional[int]]:
         """Host-driven chunked boosting: compiled chunks of iterations with a
         stop-check + delegate hooks between chunks.
 
@@ -1060,7 +1182,20 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         launch, so earlyStoppingRound=10 hit at iteration 50 of 500 costs ~60
         iterations of compute, not 500. Only raw scores carry between chunks;
         chunk sizes are fixed so at most two programs compile (full + final
-        partial chunk)."""
+        partial chunk).
+
+        AHEAD-DISPATCH (the host/device fit pipeline's chunk stage): when no
+        host decision can depend on a chunk's results — no delegate (hooks
+        and lr schedules read per-iteration metrics) and no active early
+        stopping (the stop decision gates the next launch) — chunk i+1 is
+        dispatched BEFORE chunk i's host work. Raw scores, the PRNG key and
+        dart's dropout state flow device-to-device between calls (they are
+        never fetched), so the chunk boundary costs no sync and no relay
+        RTT, and all host bookkeeping — metric/tree fetches, accumulation,
+        checkpoint serialization — runs in `_fetch_chunk_host` UNDER chunk
+        i+1's device execution. Trip count and inputs are identical either
+        way, so ahead-dispatch is bit-identical to the sequential loop
+        (regression-pinned, tests/test_fit_pipeline.py)."""
         T = (getattr(self, "_iters_override", None)
              or self.get("numIterations"))
         ipc = self.get("itersPerCall")
@@ -1094,9 +1229,76 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         trees_acc, tm_acc, vm_acc = None, None, None
         done, best, best_at, stopped = 0, np.inf, 0, False
         init_out = None
+        tol = self.get("improvementTolerance")
+        tl = timeline if timeline is not None else NULL_TIMELINE
+        ahead = delegate is None and not (rounds and has_valid)
 
         def _cat(a, b):
             return np.concatenate([a, b], axis=0)
+
+        def _fetch_chunk_host(trees_c, tm_c, vm_c, init_ref, c, start):
+            """The DESIGNATED host fetch + bookkeeping point (the only
+            place in the chunk loop allowed to sync on device results —
+            sync-point lint, tests/test_fit_pipeline.py). Blocks until
+            chunk [start, start+c) completes, then accumulates trees and
+            metrics, runs the early-stop comparator and delegate
+            after-hooks, and writes the checkpoint snapshot. Under
+            ahead-dispatch this whole body executes while the NEXT chunk
+            runs on the device."""
+            nonlocal trees_acc, tm_acc, vm_acc, best, best_at, stopped, \
+                init_out
+            with tl.span(f"fetch_wait[{start}]", kind="wait"):
+                tm_h, vm_h = np.asarray(tm_c), np.asarray(vm_c)
+            with tl.span(f"bookkeep[{start}]"):
+                trees_h = jax.tree.map(np.asarray, trees_c)
+                init_out = np.asarray(init_ref)
+                if trees_acc is None:
+                    trees_acc, tm_acc, vm_acc = trees_h, tm_h, vm_h
+                else:
+                    trees_acc = jax.tree.map(_cat, trees_acc, trees_h)
+                    tm_acc = np.concatenate([tm_acc, tm_h])
+                    vm_acc = np.concatenate([vm_acc, vm_h])
+                for j in range(c):
+                    i = start + j
+                    if rounds and has_valid and not stopped:
+                        v = vm_h[j]
+                        # reference comparator (TrainUtils.scala:287-298):
+                        # lower-is-better improves when score - best < tol
+                        if best == np.inf or v - best < tol:
+                            best, best_at = v, i
+                        elif i - best_at >= rounds:
+                            stopped = True
+                    if delegate is not None:
+                        delegate.after_train_iteration(
+                            batch_index, it0 + i, has_valid,
+                            stopped or i == T - 1,
+                            {"train": float(tm_h[j])},
+                            {"valid": float(vm_h[j])} if has_valid else None)
+                    if stopped:
+                        # is_finished fires exactly once: post-stop
+                        # iterations of this chunk were computed but are
+                        # dead (truncated below)
+                        break
+                if save_ck is not None:
+                    save_ck(BoostResult(trees_acc, init_out, tm_acc, vm_acc))
+
+        def _finalize_chunks():
+            """Designated end-of-training sync (dart's carried rescale
+            state is device-resident until every chunk has landed)."""
+            nonlocal trees_acc
+            if dart:
+                # bake the FINAL cumulative rescales into the accumulated
+                # trees (the full scan does this after its lax.scan;
+                # chunked trees came back raw because later chunks
+                # retroactively rescale earlier iterations)
+                ts = np.asarray(dart_state[1])[:tm_acc.shape[0]]
+                scale = ts.reshape(ts.shape + (1,)
+                                   * (trees_acc.leaf_value.ndim - 1))
+                trees_acc = trees_acc._replace(
+                    leaf_value=trees_acc.leaf_value * scale)
+            return BoostResult(trees_acc, init_out, tm_acc, vm_acc)
+
+        pending = None
         while done < T and not stopped:
             c = min(chunk, T - done)
             lrs = []
@@ -1111,58 +1313,32 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # chunk i+1 gets chunk i's carried key) — chunked training is
             # bit-identical to the one-program scan for every stochastic
             # mode, dart dropout included
-            out = run_chunk(key, jnp.int32(done), scores,
-                            jnp.asarray(lrs, jnp.float32), dart_state)
+            with tl.span(f"dispatch[{done}]"):
+                out = run_chunk(key, jnp.int32(done), scores,
+                                jnp.asarray(lrs, jnp.float32), dart_state)
             if dart:
                 (trees_c, tm_c, vm_c, scores, key, d_deltas, d_scale,
-                 init_out) = out
+                 init_ref) = out
                 dart_state = (d_deltas, d_scale)
             else:
-                trees_c, tm_c, vm_c, scores, key, init_out = out
-            tm_c, vm_c = np.asarray(tm_c), np.asarray(vm_c)
-            trees_h = jax.tree.map(np.asarray, trees_c)
-            if trees_acc is None:
-                trees_acc, tm_acc, vm_acc = trees_h, tm_c, vm_c
-            else:
-                trees_acc = jax.tree.map(_cat, trees_acc, trees_h)
-                tm_acc = np.concatenate([tm_acc, tm_c])
-                vm_acc = np.concatenate([vm_acc, vm_c])
-            tol = self.get("improvementTolerance")
-            for j in range(c):
-                i = done + j
-                if rounds and has_valid and not stopped:
-                    v = vm_c[j]
-                    # reference comparator (TrainUtils.scala:287-298):
-                    # lower-is-better improves when score - best < tolerance
-                    if best == np.inf or v - best < tol:
-                        best, best_at = v, i
-                    elif i - best_at >= rounds:
-                        stopped = True
-                if delegate is not None:
-                    delegate.after_train_iteration(
-                        batch_index, it0 + i, has_valid,
-                        stopped or i == T - 1,
-                        {"train": float(tm_c[j])},
-                        {"valid": float(vm_c[j])} if has_valid else None)
-                if stopped:
-                    # is_finished fires exactly once: post-stop iterations of
-                    # this chunk were computed but are dead (truncated below)
-                    break
+                trees_c, tm_c, vm_c, scores, key, init_ref = out
+            this = (trees_c, tm_c, vm_c, init_ref, c, done)
             done += c
-            if save_ck is not None:
-                save_ck(BoostResult(trees_acc, np.asarray(init_out),
-                                    tm_acc, vm_acc))
-        if dart:
-            # bake the FINAL cumulative rescales into the accumulated trees
-            # (the full scan does this after its lax.scan; chunked trees
-            # came back raw because later chunks retroactively rescale
-            # earlier iterations)
-            ts = np.asarray(dart_state[1])[:tm_acc.shape[0]]
-            scale = ts.reshape(ts.shape + (1,)
-                               * (trees_acc.leaf_value.ndim - 1))
-            trees_acc = trees_acc._replace(
-                leaf_value=trees_acc.leaf_value * scale)
-        result = BoostResult(trees_acc, np.asarray(init_out), tm_acc, vm_acc)
+            if ahead and done < T:
+                # chunk i+1's inputs are chunk i's OUTPUT device arrays —
+                # available as async values immediately, so the next
+                # dispatch happens before this chunk's results are read
+                if pending is not None:
+                    _fetch_chunk_host(*pending)
+                pending = this
+            else:
+                if pending is not None:
+                    _fetch_chunk_host(*pending)
+                    pending = None
+                _fetch_chunk_host(*this)
+        if pending is not None:
+            _fetch_chunk_host(*pending)
+        result = _finalize_chunks()
         best_iter = (best_at + 1) if (rounds and has_valid) else None
         return result, best_iter
 

@@ -366,7 +366,7 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
             from jax.sharding import PartitionSpec as P
             mesh = meshlib.get_mesh(ntasks)
             ax = meshlib.DATA_AXIS
-            sharded = jax.shard_map(
+            sharded = meshlib.shard_map(
                 train, mesh=mesh,
                 in_specs=(P(ax), P(ax), P(ax), P(ax), P()),
                 out_specs=(P(), P()), check_vma=False)

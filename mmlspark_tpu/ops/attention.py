@@ -26,6 +26,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..parallel.mesh import shard_map as _shard_map
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -109,7 +111,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "data",
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
@@ -163,7 +165,7 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "data",
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(ulysses_attention_sharded, axis_name=axis_name,
                 causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -107,14 +107,17 @@ def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
     # the C++ kernel takes float32 rows; only exact for float32 inputs
     if X.dtype == np.float32 and native.get_lib() is not None:
         out = native.bin_matrix(X, edges)
-    else:
-        X = np.asarray(X, dtype=np.float64)
-        out = np.empty(X.shape, dtype=np.int32)
-        for j in range(X.shape[1]):
-            out[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
-        out[np.isnan(X)] = 0
-    if max_bins <= 256:
-        return out.astype(np.uint8)
+        return out.astype(np.uint8) if max_bins <= 256 else out
+    X = np.asarray(X, dtype=np.float64)
+    # bin ids are < max_bins, so with <= 256 bins they fit uint8 directly —
+    # writing the searchsorted results straight into the final-dtype buffer
+    # skips an [N, F] int32 materialization + astype copy per call. The
+    # row-block fit pipeline pays this path once per block on float64 /
+    # no-toolchain fallbacks, so the copy was pure overhead there.
+    out = np.empty(X.shape, dtype=np.uint8 if max_bins <= 256 else np.int32)
+    for j in range(X.shape[1]):
+        out[:, j] = np.searchsorted(edges[j], X[:, j], side="left")
+    out[np.isnan(X)] = 0
     return out
 
 

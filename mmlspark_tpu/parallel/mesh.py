@@ -34,6 +34,22 @@ DATA_AXIS = "data"    # row/batch sharding (the universal strategy — SURVEY.md
 MODEL_AXIS = "model"  # tensor/feature sharding for deep models
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable `jax.shard_map`: newer jax exposes it top-level
+    with `check_vma`; older releases (<= 0.4.x) ship
+    `jax.experimental.shard_map.shard_map` with the same knob named
+    `check_rep`. Every shard_map in this codebase routes through here so
+    a jax upgrade/downgrade is a one-line concern. check_vma defaults
+    True to match jax's own default — callers that don't opt out keep
+    the replication check."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def distributed_init(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:

@@ -4,14 +4,24 @@ Factored out of bench.py (round-3 verdict #1; the probe history appears as
 `bringup_probes` in every BENCH_r*.json). The shared device pool has two
 measured failure modes (docs/tpu_watch.log, rounds 2-3): fast UNAVAILABLE
 errors, and init hangs that clear in ~25 min after a killed client wedged
-the pool's grant. Discipline:
+the pool's grant. Discipline (revised per round-5 verdict #1 — a single
+probe left hanging for the whole 1320 s budget was the direct cause of
+five consecutive CPU-fallback scoreboards):
 
 - probe for up to the wall budget, sleeping a jittered `retry_sleep_s`
   between failed attempts (RetryPolicy owns the sleeping and the
   don't-spawn-a-doomed-probe cutoff via `min_attempt_s`);
-- let each probe RUN TO COMPLETION instead of killing it on a timer:
-  killing a client that holds the grant is precisely what wedges the pool
-  for every later process. The only kill is at the very end of the budget.
+- cap EACH probe at `max_probe_s` (~3 min): the builder's own watch data
+  shows hangs are long and recoveries happen between them, so a hung
+  probe is killed at the cap and the loop keeps probing — many short
+  probes catch a mid-window recovery that one budget-long hang never
+  can. Killing a grant-holding client CAN wedge the pool, but a wedged
+  probe guarantees a wasted window; the cap trades a possible wedge for
+  a certain one. `max_probe_s=None` restores the wait-out behavior.
+- optionally seed from `tpu_recovery_watch`'s last-known-healthy state
+  (`state_path`): when the pool was healthy within `state_fresh_s`, the
+  backoff between probes shrinks 3x — recoveries cluster, so probe
+  eagerly right after known health.
 
 Every attempt (offset, duration, outcome) is recorded via
 `Attempt.record()` — the structured `bringup_probes` shape — and returned
@@ -29,18 +39,76 @@ from typing import Callable, List, Optional, Tuple
 from .policy import Deadline, RetryPolicy
 
 
+def _read_state_age_s(state_path: Optional[str]) -> Optional[float]:
+    """Age in seconds of the watch script's last-known-healthy marker:
+    the file body is an epoch timestamp (one float/int line); a
+    non-numeric body falls back to the file's mtime. None when absent."""
+    if not state_path or not os.path.exists(state_path):
+        return None
+    try:
+        with open(state_path) as fh:
+            body = fh.read().strip().split()[0]
+        ts = float(body)
+    except (OSError, ValueError, IndexError):
+        try:
+            ts = os.path.getmtime(state_path)
+        except OSError:
+            return None
+    return max(0.0, time.time() - ts)
+
+
+def _run_probe_thread(probe_fn: Callable[[], str], deadline: Deadline,
+                      max_probe_s: Optional[float]
+                      ) -> Tuple[bool, int, str, str]:
+    """Run an in-process probe callable on a worker thread so a hang can
+    be observed and abandoned (the thread is a daemon; an abandoned probe
+    dies with the process). Returns (hung, returncode, out, err)."""
+    import threading
+    res = {"out": "", "err": None}
+
+    def _runner():
+        try:
+            res["out"] = str(probe_fn())
+        except BaseException as e:  # noqa: BLE001 - surfaced as probe error
+            res["err"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=_runner, daemon=True)
+    a0 = time.time()
+    th.start()
+    while th.is_alive() and not deadline.expired and (
+            max_probe_s is None or time.time() - a0 < max_probe_s):
+        th.join(0.05)
+    if th.is_alive():
+        return True, 1, "", ""
+    if res["err"] is not None:
+        return False, 1, "", res["err"]
+    return False, 0, res["out"], ""
+
+
 def backend_bringup(probe_code: str, budget_s: float = 1320.0,
                     retry_sleep_s: float = 90.0, min_probe_s: float = 60.0,
+                    max_probe_s: Optional[float] = 180.0,
                     log: Optional[List] = None,
-                    on_parent_hang: Optional[Callable[[], None]] = None
+                    on_parent_hang: Optional[Callable[[], None]] = None,
+                    probe_fn: Optional[Callable[[], str]] = None,
+                    state_path: Optional[str] = None,
+                    state_fresh_s: float = 900.0
                     ) -> Tuple[object, list, Optional[str], List[dict]]:
-    """Probe the backend in subprocesses until healthy or the budget ends.
+    """Probe the backend until healthy or the budget ends, capping each
+    probe at `max_probe_s` so one hang cannot eat the window.
 
     probe_code: python -c body that prints "... <platform>" on success.
+    probe_fn: optional in-process probe callable returning that same
+    output string (unit tests route a seeded FaultInjector-wrapped probe
+    here to simulate init hangs without touching a pool); when given,
+    probe_code is unused.
     log: optional list that receives attempt records as they happen (so a
     crash handler can still report the history).
     on_parent_hang: invoked if the parent's own backend init hangs after a
     healthy probe (default: hard-exit — the process is unrecoverable).
+    state_path: optional last-known-healthy marker written by
+    scripts/tpu_recovery_watch.sh; a fresh marker (< state_fresh_s old)
+    shrinks the inter-probe backoff 3x.
     Returns (jax, devices, error_or_None, attempts).
     """
     import subprocess
@@ -49,6 +117,13 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
     attempts: List[dict] = log if log is not None else []
     deadline = Deadline.after(budget_s)
     t0 = time.time()
+    age = _read_state_age_s(state_path)
+    if age is not None and age < state_fresh_s:
+        retry_sleep_s = max(1.0, retry_sleep_s / 3.0)
+        attempts.append({"t_s": 0.0, "dur_s": 0.0,
+                         "outcome": f"seed: pool healthy {round(age)}s ago "
+                                    f"— eager probing "
+                                    f"(sleep {retry_sleep_s:.0f}s)"})
     policy = RetryPolicy(attempts=None, backoff_s=retry_sleep_s,
                          multiplier=1.0, jitter=0.1,
                          max_backoff_s=retry_sleep_s * 1.2)
@@ -58,40 +133,52 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
     for a in policy.attempts_iter(deadline=deadline,
                                   min_attempt_s=min_probe_s):
         a0 = time.time()
-        # temp files, not PIPEs: a verbose plugin init can overflow a 64 KB
-        # pipe buffer and block the child — indistinguishable from an init
-        # hang from out here
-        fo = tempfile.TemporaryFile(mode="w+")
-        fe = tempfile.TemporaryFile(mode="w+")
-        try:
-            p = subprocess.Popen([sys.executable, "-c", probe_code],
-                                 stdout=fo, stderr=fe, text=True)
-        except OSError as e:
-            # transient (EAGAIN under memory pressure, etc.) — retry within
-            # the budget like any other failed attempt
-            attempts.append(a.record(f"spawn failed: {e}"))
+        if probe_fn is not None:
+            hung, rc, out, err = _run_probe_thread(probe_fn, deadline,
+                                                   max_probe_s)
+        else:
+            # temp files, not PIPEs: a verbose plugin init can overflow a
+            # 64 KB pipe buffer and block the child — indistinguishable
+            # from an init hang from out here
+            fo = tempfile.TemporaryFile(mode="w+")
+            fe = tempfile.TemporaryFile(mode="w+")
+            try:
+                p = subprocess.Popen([sys.executable, "-c", probe_code],
+                                     stdout=fo, stderr=fe, text=True)
+            except OSError as e:
+                # transient (EAGAIN under memory pressure, etc.) — retry
+                # within the budget like any other failed attempt
+                attempts.append(a.record(f"spawn failed: {e}"))
+                fo.close()
+                fe.close()
+                continue
+            while p.poll() is None and not deadline.expired and (
+                    max_probe_s is None or time.time() - a0 < max_probe_s):
+                time.sleep(0.5)
+            hung = p.poll() is None
+            if hung:
+                p.kill()
+                p.wait()
+            fo.seek(0)
+            out = fo.read()
+            fe.seek(0)
+            err = fe.read()
             fo.close()
             fe.close()
-            continue
-        while p.poll() is None and not deadline.expired:
-            time.sleep(0.5)
-        hung = p.poll() is None
-        if hung:
-            p.kill()
-            p.wait()
-        fo.seek(0)
-        out = fo.read()
-        fe.seek(0)
-        err = fe.read()
-        fo.close()
-        fe.close()
+            rc = 1 if hung else p.returncode
         dur = time.time() - a0
         if hung:
-            attempts.append(a.record("init hang — killed at budget end",
-                                     dur))
-            break
+            if deadline.expired:
+                attempts.append(a.record("init hang — killed at budget end",
+                                         dur))
+                break
+            # probe cap (round-5 verdict #1): kill the hung probe and KEEP
+            # LOOPING — the next attempt may land in a recovery window
+            attempts.append(a.record(
+                f"init hang — killed at probe cap ({round(dur)}s)", dur))
+            continue
         platform = out.strip().rsplit(" ", 1)[-1] if out.strip() else "?"
-        if p.returncode == 0 and platform not in ("cpu", "?"):
+        if rc == 0 and platform not in ("cpu", "?"):
             attempts.append(a.record(f"healthy: {out.strip()}", dur))
             # The parent's OWN backend init can still hang (the probe's exit
             # released its grant; another client may grab or wedge the pool
@@ -128,11 +215,14 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
     except Exception:
         pass
     n_probes = sum(1 for a in attempts
-                   if not a["outcome"].startswith(("parent", "healthy")))
+                   if not a["outcome"].startswith(("parent", "healthy",
+                                                   "seed")))
     err_msg = (f"no healthy TPU across {n_probes} probe(s) in a "
                f"{round(time.time() - t0)} s bring-up window"
                + (" (a probe succeeded but the parent's own init failed)"
-                  if n_probes != len(attempts) else ""))
+                  if n_probes != sum(1 for a in attempts
+                                     if not a["outcome"].startswith("seed"))
+                  else ""))
     try:
         devs = jax.devices()
     except Exception as e:  # noqa: BLE001 - even CPU fallback can fail when

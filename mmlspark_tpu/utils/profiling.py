@@ -23,9 +23,10 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
-__all__ = ["device_trace", "annotate", "StopWatch"]
+__all__ = ["device_trace", "annotate", "StopWatch", "FitTimeline",
+           "NULL_TIMELINE"]
 
 
 @contextlib.contextmanager
@@ -98,3 +99,178 @@ class StopWatch:
                 rec["pct"] = 100.0 * slot["total_s"] / base
             out[name] = rec
         return out
+
+
+class FitTimeline:
+    """Barrier-FREE span recorder for the host/device fit pipeline.
+
+    Where StopWatch adds a device barrier per block (correct for phase
+    decompositions, fatal for measuring overlap — the barrier serializes
+    exactly the concurrency under measurement), FitTimeline records plain
+    host-clock intervals and never touches the device. Spans carry a kind:
+
+    - ``host``   — real host busy time (binning a block, bookkeeping,
+      dispatching a transfer or a chunk);
+    - ``wait``   — host blocked on the device (the designated commit
+      barrier, a chunk-result fetch): EXPOSED device time;
+    - ``device`` — device-side work whose duration is known only by
+      estimate/calibration (``add_span(..., estimated dur)``): transfer
+      backlog that ran concurrently with host spans.
+
+    ``overlap_ratio`` is the standard two-stream pipelining metric: with
+    host total H, device total D and construction wall W (real spans
+    only), a fully serial stage costs H + D and a perfectly overlapped
+    one max(H, D), so
+
+        overlap_ratio = clip((H + D - W) / min(H, D), 0, 1)
+
+    1.0 = the smaller stream is entirely hidden under the larger one.
+    ``summary()`` additionally proves ahead-dispatch for chunk-loop
+    timelines structurally: every ``dispatch[k+1]`` span must begin
+    before ``fetch_wait[k]`` does (the next device program is in flight
+    before the host blocks on the previous one's results).
+    """
+
+    def __init__(self) -> None:
+        self._t0 = time.perf_counter()
+        self.spans: List[Dict[str, Any]] = []
+        self.meta: Dict[str, Any] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str, kind: str = "host") -> Iterator[None]:
+        t0 = time.perf_counter() - self._t0
+        try:
+            yield
+        finally:
+            self.spans.append({"name": name, "kind": kind, "t0_s": t0,
+                               "t1_s": time.perf_counter() - self._t0})
+
+    def add_span(self, name: str, kind: str, dur_s: float) -> None:
+        """Record an ESTIMATED span (e.g. calibrated transfer backlog):
+        excluded from the wall, included in the per-kind totals. The true
+        duration is stored explicitly (`dur_s`) so an estimate longer
+        than the elapsed timeline is never truncated by the display
+        clamp on t0."""
+        t1 = time.perf_counter() - self._t0
+        self.spans.append({"name": name, "kind": kind,
+                           "t0_s": max(0.0, t1 - dur_s), "t1_s": t1,
+                           "dur_s": dur_s, "estimated": True})
+
+    @property
+    def wall_s(self) -> float:
+        real = [s for s in self.spans if not s.get("estimated")]
+        if not real:
+            return 0.0
+        return (max(s["t1_s"] for s in real)
+                - min(s["t0_s"] for s in real))
+
+    def totals(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for s in self.spans:
+            dur = s.get("dur_s", s["t1_s"] - s["t0_s"])
+            out[s["kind"]] = out.get(s["kind"], 0.0) + dur
+        return out
+
+    def overlap_ratio(self) -> Optional[float]:
+        t = self.totals()
+        host, dev = t.get("host", 0.0), t.get("device", 0.0)
+        lo = min(host, dev)
+        if lo <= 0.0:
+            return None
+        return round(max(0.0, min(1.0, (host + dev - self.wall_s) / lo)), 4)
+
+    def _ahead_dispatch(self) -> Optional[bool]:
+        """True iff every dispatch[k+1] begins before fetch_wait[k] —
+        the structural proof that the chunk loop runs ahead of its own
+        host bookkeeping. None when the timeline has < 2 chunks."""
+        disp: Dict[str, float] = {}
+        fw: Dict[str, float] = {}
+        order: List[str] = []
+        for s in self.spans:
+            n = s["name"]
+            if n.startswith("dispatch[") and n.endswith("]"):
+                disp[n[9:-1]] = s["t0_s"]
+                order.append(n[9:-1])
+            elif n.startswith("fetch_wait[") and n.endswith("]"):
+                fw[n[11:-1]] = s["t0_s"]
+        if len(order) < 2 or not fw:
+            return None
+        ok = True
+        for prev, nxt in zip(order, order[1:]):
+            if prev in fw:
+                ok = ok and disp[nxt] < fw[prev]
+        return ok
+
+    def summary(self) -> Dict[str, Any]:
+        t = self.totals()
+        out: Dict[str, Any] = {
+            "wall_s": round(self.wall_s, 4),
+            "host_busy_s": round(t.get("host", 0.0), 4),
+            "device_busy_s": round(t.get("device", 0.0), 4),
+            "wait_s": round(t.get("wait", 0.0), 4),
+            "spans": [{**s, "t0_s": round(s["t0_s"], 4),
+                       "t1_s": round(s["t1_s"], 4),
+                       **({"dur_s": round(s["dur_s"], 4)}
+                          if "dur_s" in s else {})} for s in self.spans],
+        }
+        orat = self.overlap_ratio()
+        if orat is not None:
+            out["overlap_ratio"] = orat
+        ahead = self._ahead_dispatch()
+        if ahead is not None:
+            out["ahead_dispatch"] = ahead
+        out.update({k: v for k, v in self.meta.items()})
+        return out
+
+
+def fit_pipeline_overlap_record(fit_timings: Dict[str, Any],
+                                seq_phases: Optional[Dict[str, float]] = None
+                                ) -> Optional[Dict[str, Any]]:
+    """The ONE assembly of the pipelined-fit overlap record (consumed by
+    bench.py extras and scripts/measure_fit_pipeline.py rows — a single
+    definition so the like-named metrics in BENCH json and
+    PERF_fit_pipeline.log can never be computed differently).
+
+    fit_timings: a booster's `fit_timings` from a `fitPipeline='on'` +
+    `collectFitTimings=True` fit. seq_phases: optionally, the phase dict
+    of a SEQUENTIAL (`fitPipeline='off'`) decomposition of the same
+    problem ({'binning': s, 'device_transfer': s, ...}) — when present,
+    the cross-run ratio 1 - pipelined_construction / (binning + transfer)
+    is included. Returns None when fit_timings has no timeline."""
+    tl = (fit_timings or {}).get("timeline") or {}
+    cons = tl.get("construction")
+    if cons is None:
+        return None
+    rec: Dict[str, Any] = {
+        "construction_s": round(cons["wall_s"], 3),
+        "host_busy_s": cons["host_busy_s"],
+        "commit_wait_s": cons["wait_s"],
+        "transfer_est_s": cons["device_busy_s"],
+        "overlap_ratio": cons.get("overlap_ratio"),
+    }
+    if seq_phases and "binning" in seq_phases \
+            and "device_transfer" in seq_phases:
+        serial = seq_phases["binning"] + seq_phases["device_transfer"]
+        if serial > 0:
+            rec["cross_run_overlap_ratio"] = round(
+                1.0 - cons["wall_s"] / serial, 4)
+    if "chunks" in tl:
+        rec["chunks_ahead_dispatch"] = tl["chunks"].get("ahead_dispatch")
+    return rec
+
+
+class _NullTimeline:
+    """No-op FitTimeline stand-in so pipeline code needs no `if timeline`
+    branching on the hot path."""
+
+    def __init__(self) -> None:
+        self.meta: Dict[str, Any] = {}
+
+    def span(self, name: str, kind: str = "host"):
+        return contextlib.nullcontext()
+
+    def add_span(self, name: str, kind: str, dur_s: float) -> None:
+        pass
+
+
+NULL_TIMELINE = _NullTimeline()

@@ -1,0 +1,119 @@
+"""Measure the host/device fit pipeline on the live chip (ISSUE 7).
+
+Armed for the next healthy pool window (scripts/tpu_recovery_watch.sh runs
+this first). Three measurements, each fenced so one failure cannot cost
+the rest, every result appended to docs/PERF_fit_pipeline.log and printed
+as one JSON line per row:
+
+1. 4M x 28: sequential instrumented fit (collectFitTimings,
+   fitPipeline='off') -> the binning / device-transfer / boosting
+   decomposition, confirming the binning NaN fastpath on chip
+   (docs/PERF.md predicts 7.89 s -> 1.84 s at 4M);
+2. 4M x 28: pipelined instrumented fit (fitPipeline='on') -> the
+   FitTimeline construction wall + measured overlap ratio, plus the
+   cross-run ratio 1 - pipelined_construction / (seq binning + transfer);
+3. 11M x 28 x 100 (HIGGS scale, the north-star row): warm + timed
+   pipelined fits with the round-5 promoted mode (splitsPerPass=8,
+   itersPerCall=50 — ahead-dispatched chunks) -> rows*iter/s and
+   vs_baseline (>= 27.5M rows*iter/s = 1.0x single-H100).
+
+Run from the repo root. Uses bench.py's patient bring-up so a wedged pool
+degrades to a logged CPU run instead of a hang.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LOG = os.path.join(os.path.dirname(__file__), "..", "docs",
+                   "PERF_fit_pipeline.log")
+BASELINE = 27.5e6
+
+
+def _log(row):
+    line = json.dumps(row)
+    print(line, flush=True)
+    with open(LOG, "a") as fh:
+        fh.write(line + "\n")
+
+
+def main():
+    import bench
+    jx, devs, init_err, _ = bench._patient_backend_bringup()
+    dev = str(devs[0])
+    _log({"start": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
+          "device": dev, "init_err": init_err})
+    on_accel = devs[0].platform not in ("cpu",)
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+    n, f, iters = (4_000_000, 28, 100) if on_accel else (200_000, 28, 10)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    coef = rng.normal(size=f)
+    y = ((x @ coef + 0.5 * x[:, 0] * x[:, 1]
+          + rng.normal(scale=1.0, size=n)) > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+
+    def clf(**kw):
+        return LightGBMClassifier(numIterations=iters, numLeaves=31,
+                                  maxBin=64, numTasks=1, splitsPerPass=8,
+                                  **kw)
+
+    seq = None
+    try:  # 1) sequential decomposition (NaN-fastpath confirmation at 4M)
+        m = clf(collectFitTimings=True, fitPipeline="off").fit(df)
+        seq = {k: round(v["total_s"], 3)
+               for k, v in m.booster.fit_timings.items()
+               if isinstance(v, dict) and "total_s" in v}
+        _log({"row": "sequential_decomposition", "n": n, "phases_s": seq})
+    except Exception as e:  # noqa: BLE001
+        _log({"row": "sequential_decomposition", "error": str(e)[:300]})
+
+    try:  # 2) pipelined construction + overlap ratio
+        from mmlspark_tpu.utils.profiling import fit_pipeline_overlap_record
+        m = clf(collectFitTimings=True, fitPipeline="on",
+                itersPerCall=50).fit(df)
+        rec = fit_pipeline_overlap_record(m.booster.fit_timings, seq)
+        _log({"row": "pipelined_overlap", "n": n, **(rec or {})})
+    except Exception as e:  # noqa: BLE001
+        _log({"row": "pipelined_overlap", "error": str(e)[:300]})
+
+    if not on_accel:
+        _log({"row": "higgs11m", "skipped": "cpu fallback"})
+        return
+    try:  # 3) the north-star row: 11M x 28 x 100 pipelined
+        n11 = 11_000_000
+        x11 = rng.normal(size=(n11, f)).astype(np.float32)
+        y11 = ((x11 @ coef + 0.5 * x11[:, 0] * x11[:, 1]
+                + rng.normal(scale=1.0, size=n11)) > 0).astype(np.float64)
+        df11 = DataFrame({"features": x11, "label": y11})
+        c11 = clf(itersPerCall=50)       # auto-pipelines at 11M serial f32
+        t0 = time.time()
+        m11 = c11.fit(df11)
+        walls = [time.time() - t0]
+        for _ in range(2):
+            t0 = time.time()
+            m11 = c11.fit(df11)
+            walls.append(time.time() - t0)
+        from sklearn.metrics import roc_auc_score
+        ho = rng.choice(n11, 100_000, replace=False)
+        auc = roc_auc_score(y11[ho], m11.booster.score(x11[ho]))
+        rate = n11 * iters / min(walls)
+        _log({"row": "higgs11m", "mode": "batched-k8 ipc=50 pipelined",
+              "walls_s": [round(w, 2) for w in walls],
+              "rows_iter_per_s": round(rate, 1),
+              "vs_baseline": round(rate / BASELINE, 4),
+              "auc_sample": round(auc, 4)})
+    except Exception as e:  # noqa: BLE001
+        _log({"row": "higgs11m", "error": str(e)[:300]})
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,12 @@ assert jax.devices()[0].platform != "cpu"
 float((x @ x).sum())
 EOF
   then
-    echo "== chip healthy $(date -u +%FT%TZ) — running round-5 queue"
+    # last-known-healthy marker: resilience/bringup.py seeds its probe
+    # cadence from this (fresh marker => 3x shorter inter-probe backoff)
+    date +%s > scripts/tpu_last_healthy
+    echo "== chip healthy $(date -u +%FT%TZ) — running the pending queue"
+    echo "== fit pipeline overlap (this round's tentpole) $(date -u +%FT%TZ)"
+    python -u scripts/measure_fit_pipeline.py
     if ! python -u scripts/quick_fit_probe.py; then
       echo "== quick fit probe FAILED $(date -u +%FT%TZ); back to probing"
       sleep 120

@@ -9,6 +9,7 @@ import pytest
 from mmlspark_tpu.ops.attention import (attention_reference, ring_attention,
                                         ring_attention_sharded)
 from mmlspark_tpu.parallel import mesh as meshlib
+from mmlspark_tpu.parallel.mesh import shard_map as _shard_map
 
 
 def _qkv(b=2, s=64, h=4, d=16, seed=0):
@@ -143,7 +144,7 @@ class TestUlyssesAttention:
             return jnp.sum(attention_reference(q_, k_, v_, causal=True) ** 2)
 
         spec = P(None, meshlib.DATA_AXIS, None, None)
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             lambda q_, k_, v_: ulysses_attention_sharded(
                 q_, k_, v_, meshlib.DATA_AXIS, causal=True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from mmlspark_tpu.ops.boosting import GBDTConfig, make_train_fn
 from mmlspark_tpu.parallel import mesh as meshlib
+from mmlspark_tpu.parallel.mesh import shard_map as _shard_map
 
 NDEV = 8
 
@@ -53,7 +54,7 @@ def _traced_train_psums(cfg, n=1024, f=None):
     f = f or 16
     m = meshlib.get_mesh(NDEV)
     train = make_train_fn(cfg)
-    sm = jax.shard_map(train, mesh=m, in_specs=(P(meshlib.DATA_AXIS),) * 5
+    sm = _shard_map(train, mesh=m, in_specs=(P(meshlib.DATA_AXIS),) * 5
                        + (P(),), out_specs=P(), check_vma=False)
     binned = jnp.zeros((n, f), jnp.int32)
     y = jnp.zeros((n,), jnp.float32)
@@ -168,7 +169,7 @@ def test_walker_sees_nested_scan_psums():
         out, _ = jax.lax.scan(body, x, None, length=3)
         return out
 
-    sm = jax.shard_map(f, mesh=m, in_specs=P(meshlib.DATA_AXIS),
+    sm = _shard_map(f, mesh=m, in_specs=P(meshlib.DATA_AXIS),
                        out_specs=P(meshlib.DATA_AXIS), check_vma=False)
     shapes = _collect_psum_operands(
         jax.make_jaxpr(sm)(jnp.ones((16, 5))))

@@ -19,6 +19,7 @@ from mmlspark_tpu.models.deep.moe import (init_moe_block_params,
                                           make_ep_dp_train_step,
                                           moe_block_loss)
 from mmlspark_tpu.parallel import mesh as meshlib
+from mmlspark_tpu.parallel.mesh import shard_map as _shard_map
 
 E, D, F = 8, 16, 32
 
@@ -86,7 +87,7 @@ def test_ep_sharded_matches_dense(params):
         y, aux = moe_ffn(pp, xl, E, capacity_factor=float(E), axis_name="x")
         return y, aux
 
-    y_ep, aux_ep = jax.jit(jax.shard_map(
+    y_ep, aux_ep = jax.jit(_shard_map(
         local, mesh=mesh, in_specs=(P("x"), P("x")),
         out_specs=(P("x"), P()), check_vma=False))(stacked, jnp.asarray(x))
 
@@ -164,7 +165,7 @@ def test_ep_validates_divisibility(params):
         return y
 
     with pytest.raises(ValueError, match="divisible"):
-        jax.shard_map(local, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+        _shard_map(local, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
                       check_vma=False)(jnp.zeros((len(devs), 4, D)))
 
 

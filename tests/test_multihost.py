@@ -41,8 +41,8 @@ WORKER = textwrap.dedent("""
                 jax.lax.pmean(x, meshlib.DATA_AXIS))
 
     x = jnp.ones(4) * (pid + 1)     # host 0 holds 1s, host 1 holds 2s
-    s, m = jax.jit(jax.shard_map(collectives, mesh=mesh,
-                                 in_specs=P(), out_specs=(P(), P())))(x)
+    s, m = jax.jit(meshlib.shard_map(collectives, mesh=mesh,
+                                     in_specs=P(), out_specs=(P(), P())))(x)
     s0, m0 = float(np.asarray(s)[0]), float(np.asarray(m)[0])
     assert s0 == 3.0, s0            # 1 + 2 across processes
     assert m0 == 1.5, m0

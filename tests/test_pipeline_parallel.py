@@ -20,6 +20,7 @@ from mmlspark_tpu.models.deep.transformer import (encoder_forward,
                                                   init_encoder_params,
                                                   init_head_params)
 from mmlspark_tpu.parallel import mesh as meshlib
+from mmlspark_tpu.parallel.mesh import shard_map as _shard_map
 
 H, D, FF = 2, 16, 32
 
@@ -43,7 +44,7 @@ def test_pipeline_forward_matches_dense():
         sp = jax.tree_util.tree_map(lambda a: a[0], sp)
         return pipeline_forward(sp, xmb, H, "pipe")
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map(
         local, mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
         check_vma=False))(stages, x)
 
@@ -76,7 +77,7 @@ def test_pipeline_gradients_match_dense():
         loss, g = jax.value_and_grad(pp_loss)(sp, xmb)
         return jax.lax.psum(loss, "pipe"), g
 
-    loss_pp, g_pp = jax.jit(jax.shard_map(
+    loss_pp, g_pp = jax.jit(_shard_map(
         local, mesh=mesh, in_specs=(P("pipe"), P()),
         out_specs=(P(), P("pipe")), check_vma=False))(stages, x)
 

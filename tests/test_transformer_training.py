@@ -18,6 +18,7 @@ from mmlspark_tpu.models.deep.transformer import (
     make_single_train_step, make_tp_dp_train_step, shard_encoder_params,
     unshard_encoder_params)
 from mmlspark_tpu.parallel import mesh as meshlib
+from mmlspark_tpu.parallel.mesh import shard_map as _shard_map
 
 
 def _toy(n=32, s=6, d=16, nc=3, seed=0):
@@ -137,7 +138,7 @@ def test_tp_gradients_match_single_device_exactly():
     shards = [{"encoder": shard_encoder_params(enc, r, 2, nh),
                "head": head} for r in range(2)]
     p_sh = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *shards)
-    g_tp = jax.jit(jax.shard_map(
+    g_tp = jax.jit(_shard_map(
         grad_step, mesh=mesh,
         in_specs=(P(meshlib.MODEL_AXIS), P(meshlib.DATA_AXIS),
                   P(meshlib.DATA_AXIS)),
@@ -288,7 +289,7 @@ def test_sp_gradients_match_single_device():
         return {"encoder": jax.lax.psum(g["encoder"], meshlib.DATA_AXIS),
                 "head": g["head"]}
 
-    g_sp = jax.jit(jax.shard_map(
+    g_sp = jax.jit(_shard_map(
         sp_grads, mesh=mesh,
         in_specs=(P(), P(None, meshlib.DATA_AXIS, None), P()),
         out_specs=P(), check_vma=False))(p0, jnp.asarray(x),
