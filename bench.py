@@ -41,6 +41,16 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
     extra = dict(extra or {})
     extra.setdefault("bringup_probes", list(_BRINGUP_LOG))
     extra.setdefault("perf_provenance", PERF_PROVENANCE)
+    # the full telemetry snapshot rides in the bench record (fit-loop
+    # gauges, bring-up probe counters, any serving series): the bench JSON
+    # and a /metrics scrape are views of the SAME registry, so they can
+    # never disagree. Guarded: _emit is also the crash handler, and the
+    # mandatory JSON line outranks telemetry completeness.
+    try:
+        from mmlspark_tpu.observability import get_registry
+        extra.setdefault("telemetry", get_registry().snapshot())
+    except Exception as e:  # noqa: BLE001 - the JSON line must still land
+        extra.setdefault("telemetry_error", str(e)[:200])
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]

@@ -46,8 +46,10 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from ..observability import (EventLog, TRACE_HEADER, get_registry,
+                             mint_trace_id, trace_id_from_headers)
 from ..resilience import Deadline, RetryError, RetryPolicy
-from .serving import ServingServer
+from .serving import _INSTANCE_SEQ, ServingServer
 
 
 class ServiceInfo:
@@ -107,6 +109,7 @@ class ServingCoordinator:
       POST /heartbeat  body = ServiceInfo JSON; 410 Gone => re-register
       GET  /routes/<service>                             routing table JSON
       GET  /health     worker counts + eviction stats
+      GET  /metrics    Prometheus text (forward latency + gateway counters)
       POST /gateway/<service>  forward to a healthy worker (retry + evict)
 
     Workers silent for `heartbeat_timeout_s` are evicted by a monitor
@@ -118,7 +121,9 @@ class ServingCoordinator:
                  forward_timeout: float = 30.0,
                  heartbeat_timeout_s: float = 10.0,
                  forward_transport=None,
-                 forward_retry: Optional[RetryPolicy] = None):
+                 forward_retry: Optional[RetryPolicy] = None,
+                 registry=None, event_log=None,
+                 metrics_label: Optional[str] = None):
         self.host, self.port = host, port
         self.forward_timeout = forward_timeout
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -142,8 +147,52 @@ class ServingCoordinator:
         self.forward_retry = forward_retry or RetryPolicy(
             attempts=8, backoff_s=0.05, multiplier=1.5, max_backoff_s=0.4,
             jitter=0.1)
-        self.stats = {"forwards": 0, "forward_retries": 0, "evictions": 0,
-                      "heartbeats": 0}
+        # telemetry: gateway counters + forward-latency histogram in the
+        # (default: process-global) registry, per-hop forward spans in the
+        # coordinator's own event log (the gateway side of a trace)
+        self.registry = registry if registry is not None else get_registry()
+        self.events = event_log if event_log is not None else EventLog()
+        self.metrics_label = (metrics_label if metrics_label is not None
+                              else f"gateway-{next(_INSTANCE_SEQ)}")
+        lbl = {"instance": self.metrics_label}
+        self._m = {
+            "forwards": self.registry.counter(
+                "gateway_forwards_total", "gateway requests forwarded", lbl),
+            "forward_retries": self.registry.counter(
+                "gateway_forward_retries_total",
+                "failover/retry forward attempts past the first", lbl),
+            "evictions": self.registry.counter(
+                "gateway_evictions_total",
+                "workers dropped from the routing table", lbl),
+            "heartbeats": self.registry.counter(
+                "gateway_heartbeats_total", "worker heartbeats recorded",
+                lbl),
+        }
+        self._m_failures = self.registry.counter(
+            "gateway_forward_failures_total",
+            "forward transport failures (worker unreachable/dropped)", lbl)
+        self._m_expired = self.registry.counter(
+            "gateway_expired_total", "gateway replies with 504 (budget "
+            "spent)", lbl)
+        self._m_shed = self.registry.counter(
+            "gateway_shed_total", "gateway replies with 503 (workers "
+            "shedding or none registered)", lbl)
+        self._lat_hist = self.registry.histogram(
+            "gateway_request_latency_seconds",
+            "gateway receive-to-reply latency", lbl)
+        self._workers_gauge = self.registry.gauge(
+            "gateway_registered_workers",
+            "workers currently routable (all services)", lbl)
+        self._workers_gauge.set_function(self._worker_count)
+
+    def _worker_count(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._routes.values())
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Counter view (registry-backed; the pre-observability dict)."""
+        return {k: int(c.value) for k, c in self._m.items()}
 
     # -------------------------------------------------------------- registry
     def register(self, info: ServiceInfo) -> None:
@@ -189,7 +238,7 @@ class ServingCoordinator:
                 lst[:] = [s for s in lst
                           if (s.host, s.port) != (info.host, info.port)]
                 if len(lst) < before:
-                    self.stats["evictions"] += 1
+                    self._m["evictions"].inc()
             self._last_seen.pop((name, info.host, info.port), None)
             self._hb_seen.discard((name, info.host, info.port))
 
@@ -211,7 +260,7 @@ class ServingCoordinator:
                 key = (info.name, info.host, info.port)
                 self._last_seen[key] = time.monotonic()
                 self._hb_seen.add(key)
-                self.stats["heartbeats"] += 1
+                self._m["heartbeats"].inc()
                 return "ok"
             if any((s.machine, s.partition) == (info.machine, info.partition)
                    for s in lst):
@@ -251,7 +300,7 @@ class ServingCoordinator:
                             self._last_seen.pop((name, s.host, s.port),
                                                 None)
                             self._hb_seen.discard((name, s.host, s.port))
-                            self.stats["evictions"] += 1
+                            self._m["evictions"].inc()
 
     def health(self) -> Dict:
         with self._lock:
@@ -264,7 +313,26 @@ class ServingCoordinator:
     def _handle_gateway(self, reply, name: str, body: bytes,
                         headers: Dict[str, str]) -> None:
         """Forward with bounded retry + eviction + deadline propagation.
-        `reply(status, body)` writes the client response."""
+        `reply(status, body)` writes the client response. The trace id
+        (client-sent X-Trace-Id or minted here) rides every forward hop —
+        retries and failovers included — and comes back on the reply, so
+        the gateway's per-attempt spans and the worker's dispatch spans
+        join on one id."""
+        trace_id = trace_id_from_headers(headers) or mint_trace_id()
+        t_recv = time.perf_counter()
+        raw_reply = reply
+
+        def reply(status: int, rbody: bytes, rheaders=None) -> None:
+            dur = time.perf_counter() - t_recv
+            self._lat_hist.observe(dur)
+            if status == 504:
+                self._m_expired.inc()
+            elif status == 503:
+                self._m_shed.inc()
+            self.events.append("reply", trace_id, dur_s=dur, status=status)
+            raw_reply(status, rbody,
+                      {TRACE_HEADER: trace_id, **(rheaders or {})})
+
         if name not in self._known:
             reply(503, json.dumps(
                 {"error": f"no workers for {name!r}: never registered"}
@@ -291,24 +359,28 @@ class ServingCoordinator:
             policy = dataclasses.replace(
                 policy, attempts=max(policy.attempts,
                                      len(self.routes(name)) + 1))
-        with self._lock:
-            self.stats["forwards"] += 1
+        self._m["forwards"].inc()
         last_err = "routing table empty (all workers evicted)"
         last_shed = None  # most recent worker 503 (queue-full) response
         for attempt in policy.attempts_iter(deadline=deadline):
             if attempt.index:
-                with self._lock:
-                    self.stats["forward_retries"] += 1
+                self._m["forward_retries"].inc()
             worker = self._next_worker(name)
             if worker is None:
                 # all evicted: the backoff sleep gives heartbeat
                 # re-registration a chance to repopulate the table
+                self.events.append("forward_attempt", trace_id,
+                                   attempt=attempt.index,
+                                   outcome="no_worker")
                 continue
             remaining = deadline.remaining()
             if remaining <= 0:
                 break
             fwd_headers = {"Content-Type": "application/json",
+                           TRACE_HEADER: trace_id,
                            Deadline.HEADER: deadline.to_header()}
+            w_id = f"{worker.host}:{worker.port}"
+            t_fwd = time.perf_counter()
             try:
                 status, rbody = self._transport(
                     worker.url, body, fwd_headers,
@@ -324,18 +396,35 @@ class ServingCoordinator:
                     last_shed = (e.read(),
                                  {k: v for k, v in e.headers.items()
                                   if k.lower() == "retry-after"})
+                    self.events.append(
+                        "forward_attempt", trace_id, attempt=attempt.index,
+                        dur_s=time.perf_counter() - t_fwd, worker=w_id,
+                        outcome="shed")
                     continue
                 # worker is ALIVE and answered with a non-shed error
                 # status — deterministic for this request; surface it
                 # (with its headers), don't evict
+                self.events.append(
+                    "forward_attempt", trace_id, attempt=attempt.index,
+                    dur_s=time.perf_counter() - t_fwd, worker=w_id,
+                    outcome=f"http_{e.code}")
                 reply(e.code, e.read(),
                       {k: v for k, v in e.headers.items()
                        if k.lower() == "retry-after"})
                 return
             except Exception as e:  # unreachable: evict + retry next worker
                 last_err = str(e)
+                self._m_failures.inc()
+                self.events.append(
+                    "forward_attempt", trace_id, attempt=attempt.index,
+                    dur_s=time.perf_counter() - t_fwd, worker=w_id,
+                    outcome="unreachable")
                 self.deregister(name, worker)
             else:
+                self.events.append(
+                    "forward_attempt", trace_id, attempt=attempt.index,
+                    dur_s=time.perf_counter() - t_fwd, worker=w_id,
+                    outcome="ok")
                 # reply OUTSIDE the try: a client that disconnects while the
                 # response is being written must not be misread as a worker
                 # failure (which would evict the healthy worker and re-send
@@ -400,12 +489,18 @@ class ServingCoordinator:
                     self._reply(200, body)
                 elif self.path == "/health":
                     self._reply(200, json.dumps(outer.health()).encode())
+                elif self.path == "/metrics":
+                    self._reply(200,
+                                outer.registry.render_prometheus().encode(),
+                                ctype="text/plain; version=0.0.4; "
+                                      "charset=utf-8")
                 else:
                     self._reply(404, b'{"error": "unknown endpoint"}')
 
-            def _reply(self, status: int, body: bytes, headers=None):
+            def _reply(self, status: int, body: bytes, headers=None,
+                       ctype: str = "application/json"):
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
@@ -431,6 +526,11 @@ class ServingCoordinator:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # freeze the collect-time gauge so the registry (which outlives
+        # this coordinator) does not pin it in memory via the callback; a
+        # stopped coordinator routes to nobody, so it scrapes as 0
+        self._workers_gauge.set_function(None)
+        self._workers_gauge.set(0.0)
 
     @property
     def url(self) -> str:

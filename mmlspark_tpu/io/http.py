@@ -60,6 +60,33 @@ class HTTPResponseData:
 RETRY_BACKOFFS_MS = (100, 500, 1000)  # HTTPClients.scala retry array
 
 
+#: per-outcome counter handles, built lazily then reused — the registry's
+#: own hot-path contract is "keep the handle, hit only the series lock"
+#: (a set_registry() swap after first use keeps counting on the old
+#: registry; acceptable for the data plane, tests pass explicit registries)
+_HTTP_CLIENT_COUNTERS: Dict[str, Any] = {}
+
+
+def _count_http_client(outcome: str) -> None:
+    """Client-side data-plane telemetry: per-attempt outcomes by class
+    (2xx/4xx/5xx/429/send_failed) — the HTTPTransformer/cognitive request
+    path lands in the same registry as serving and fit
+    (docs/OBSERVABILITY.md). Fully guarded: a telemetry failure (import,
+    metric-kind collision) must never fail the actual HTTP request."""
+    c = _HTTP_CLIENT_COUNTERS.get(outcome)
+    if c is None:
+        try:
+            from ..observability import get_registry
+            c = get_registry().counter(
+                "http_client_attempts_total",
+                "send_with_retries attempts by outcome class",
+                labels={"outcome": outcome})
+        except Exception:  # noqa: BLE001 - telemetry never fails the send
+            return
+        _HTTP_CLIENT_COUNTERS[outcome] = c
+    c.inc()
+
+
 def send_with_retries(req: HTTPRequestData,
                       backoffs=RETRY_BACKOFFS_MS,
                       timeout: float = 60.0,
@@ -81,12 +108,15 @@ def send_with_retries(req: HTTPRequestData,
             r = sess.request(req.method, req.url, headers=req.headers,
                              data=req.entity, timeout=timeout)
         except Exception as e:  # connection errors retry too
+            _count_http_client("send_failed")
             last = HTTPResponseData(0, str(e).encode(), {}, "send failed")
             if attempt.is_last:
                 return last
             continue
         resp = HTTPResponseData(r.status_code, r.content,
                                 dict(r.headers), r.reason or "")
+        _count_http_client("429" if r.status_code == 429
+                           else f"{r.status_code // 100}xx")
         if r.status_code == 429 and not attempt.is_last:
             wait = parse_retry_after(r.headers.get("Retry-After"))
             if wait is not None:

@@ -18,6 +18,7 @@ Sub-ms p50 needs the compiled program resident: warm it with `warmup()`.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import queue
 import threading
@@ -30,12 +31,19 @@ import numpy as np
 
 from ..core.dataframe import DataFrame
 from ..core.pipeline import Transformer
+from ..observability import (EventLog, TRACE_HEADER, get_registry,
+                             mint_trace_id, trace_id_from_headers)
 from ..resilience import Deadline
+
+
+#: deterministic per-process instance labels (construction order) so
+#: concurrent servers sharing the global registry never collide
+_INSTANCE_SEQ = itertools.count()
 
 
 class _PendingRequest:
     __slots__ = ("rid", "body", "headers", "path", "event", "response",
-                 "deadline", "_loop", "_fut")
+                 "deadline", "trace_id", "t_enq", "_loop", "_fut")
 
     def __init__(self, rid, body, headers, path, loop=None, fut=None):
         self.rid = rid
@@ -47,6 +55,13 @@ class _PendingRequest:
         # remaining request budget, propagated hop-to-hop via X-Deadline-Ms:
         # an expired request is answered 504 instead of occupying batch slots
         self.deadline: Optional[Deadline] = Deadline.from_headers(headers)
+        # end-to-end trace identity: accepted from the client/gateway via
+        # X-Trace-Id or minted here; every reply carries it back and every
+        # hop's EventLog spans key on it
+        self.trace_id: str = trace_id_from_headers(headers) or mint_trace_id()
+        # span clock origin: queue_wait and the latency histogram both
+        # measure from this enqueue stamp
+        self.t_enq: float = time.perf_counter()
         # asyncio completion route: the dispatcher thread resolves the
         # connection coroutine's future via its event loop instead of an
         # Event the socket thread would block on
@@ -73,15 +88,17 @@ class _PendingRequest:
 
 def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
                         request_timeout: float, host: str,
-                        port: int, health_fn=None) -> ThreadingHTTPServer:
+                        port: int, health_fn=None,
+                        metrics_fn=None) -> ThreadingHTTPServer:
     """Shared HTTP front door for ServingServer and HTTPStreamSource: POST
     bodies become _PendingRequests handed to `enqueue`; the socket thread
     blocks on the request's event until a dispatcher/commit sets the reply
     (JVMSharedServer's handler role, DistributedHTTPSource.scala:151-168).
     GET /health serves `health_fn()` as JSON when provided (queue depth +
-    dispatcher liveness — the load-balancer probe endpoint). Returns the
-    bound (but not yet serving) server; callers start `serve_forever` on a
-    daemon thread."""
+    dispatcher liveness — the load-balancer probe endpoint); GET /metrics
+    serves `metrics_fn()` as Prometheus text (the scrape endpoint).
+    Returns the bound (but not yet serving) server; callers start
+    `serve_forever` on a daemon thread."""
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
@@ -93,11 +110,13 @@ def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
             ok = pend.event.wait(request_timeout)
             if not ok:
                 self.send_response(504)
+                self.send_header(TRACE_HEADER, pend.trace_id)
                 self.end_headers()
                 return
             resp = pend.response
             self.send_response(resp["status"])
             self.send_header("Content-Type", "application/json")
+            self.send_header(TRACE_HEADER, pend.trace_id)
             for k, v in (resp.get("headers") or {}).items():
                 self.send_header(k, v)
             self.send_header("Content-Length", str(len(resp["body"])))
@@ -107,14 +126,19 @@ def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
         def do_GET(self):
             if self.path == "/health" and health_fn is not None:
                 body = json.dumps(health_fn()).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                ctype = "application/json"
+            elif self.path == "/metrics" and metrics_fn is not None:
+                body = metrics_fn().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
             else:
                 self.send_response(404)
                 self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def log_message(self, *a):  # quiet
             pass
@@ -144,10 +168,11 @@ class _AsyncListener:
 
     def __init__(self, enqueue: Callable[["_PendingRequest"], None],
                  request_timeout: float, host: str, port: int,
-                 health_fn=None):
+                 health_fn=None, metrics_fn=None):
         self._enqueue = enqueue
         self._timeout = request_timeout
         self._health_fn = health_fn
+        self._metrics_fn = metrics_fn
         self.host, self.port = host, port
         self._loop = None
         self._server = None
@@ -206,13 +231,20 @@ class _AsyncListener:
                             if length else b"")
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
-                if method == "GET" and path == "/health" \
-                        and self._health_fn is not None:
-                    hb = json.dumps(self._health_fn()).encode()
+                if method == "GET" and (
+                        (path == "/health" and self._health_fn is not None)
+                        or (path == "/metrics"
+                            and self._metrics_fn is not None)):
+                    if path == "/health":
+                        hb = json.dumps(self._health_fn()).encode()
+                        ct = b"application/json"
+                    else:
+                        hb = self._metrics_fn().encode()
+                        ct = b"text/plain; version=0.0.4; charset=utf-8"
                     writer.write(
                         status_line(200)
-                        + b"Content-Type: application/json\r\n"
-                        b"Content-Length: %d\r\n\r\n%s" % (len(hb), hb))
+                        + b"Content-Type: %s\r\n"
+                        b"Content-Length: %d\r\n\r\n%s" % (ct, len(hb), hb))
                     await writer.drain()
                     if not keep_alive:
                         return
@@ -235,14 +267,19 @@ class _AsyncListener:
                     resp = await asyncio.wait_for(fut, self._timeout)
                 except asyncio.TimeoutError:
                     writer.write(status_line(504)
+                                 + b"%s: %s\r\n" % (
+                                     TRACE_HEADER.encode("latin1"),
+                                     pend.trace_id.encode("latin1"))
                                  + b"Content-Length: 0\r\n\r\n")
                     await writer.drain()
                     continue
                 rb = resp["body"]
+                hdrs = {TRACE_HEADER: pend.trace_id,
+                        **(resp.get("headers") or {})}
                 extra = b"".join(
                     b"%s: %s\r\n" % (k.encode("latin1"), str(v).encode(
                         "latin1"))
-                    for k, v in (resp.get("headers") or {}).items())
+                    for k, v in hdrs.items())
                 writer.write(
                     status_line(resp["status"])
                     + b"Content-Type: application/json\r\n" + extra
@@ -342,7 +379,11 @@ class ServingServer:
     backlog that times every client out (load shedding under overload).
     Requests carrying an X-Deadline-Ms budget that has expired are answered
     504 without occupying batch slots. GET /health reports queue depth and
-    dispatcher liveness.
+    dispatcher liveness; GET /metrics is the Prometheus scrape (request
+    latency histogram, queue depth, shed/expired/error counters, batch-size
+    and rows/s gauges). Each request's X-Trace-Id (accepted or minted) keys
+    per-hop spans (queue_wait -> batch_assembly -> device_dispatch -> reply)
+    in `self.events`, and every reply echoes the id back.
     """
 
     def __init__(self, handler: Callable[[DataFrame], DataFrame],
@@ -350,7 +391,8 @@ class ServingServer:
                  port: int = 8899, max_batch_size: int = 64,
                  max_latency_ms: float = 5.0, request_timeout: float = 30.0,
                  vector_cols=(), listener: str = "asyncio",
-                 max_queue: int = 0):
+                 max_queue: int = 0, registry=None, event_log=None,
+                 metrics_label: Optional[str] = None):
         self.handler = handler
         self.reply_col = reply_col
         self.host, self.port = host, port
@@ -370,8 +412,55 @@ class ServingServer:
         self._alistener: Optional[_AsyncListener] = None
         self._threads: List[threading.Thread] = []
         self._disp_thread: Optional[threading.Thread] = None
-        self.stats = {"requests": 0, "batches": 0, "errors": 0,
-                      "shed": 0, "expired": 0}
+        # telemetry: all counters/gauges/histograms live in the registry
+        # (process-global by default, so one scrape carries every server
+        # plus the fit-side bridge); the instance label keeps concurrent
+        # servers' series apart deterministically (construction order)
+        self.registry = registry if registry is not None else get_registry()
+        self.events = event_log if event_log is not None else EventLog()
+        self.metrics_label = (metrics_label if metrics_label is not None
+                              else f"serving-{next(_INSTANCE_SEQ)}")
+        lbl = {"instance": self.metrics_label}
+        self._m = {
+            "requests": self.registry.counter(
+                "serving_requests_total", "requests dispatched to a batch",
+                lbl),
+            "batches": self.registry.counter(
+                "serving_batches_total", "dynamic batches launched", lbl),
+            "errors": self.registry.counter(
+                "serving_errors_total", "requests answered 500", lbl),
+            "shed": self.registry.counter(
+                "serving_shed_total", "requests shed 503 (queue full)", lbl),
+            "expired": self.registry.counter(
+                "serving_expired_total",
+                "requests answered 504 (X-Deadline-Ms spent)", lbl),
+        }
+        self._lat_hist = self.registry.histogram(
+            "serving_request_latency_seconds",
+            "enqueue-to-reply latency (p50/p95/p99 derivable)", lbl)
+        self._batch_gauge = self.registry.gauge(
+            "serving_last_batch_size", "rows in the last batch", lbl)
+        self._rows_gauge = self.registry.gauge(
+            "serving_rows_per_s", "handler throughput of the last batch",
+            lbl)
+        self._cb_gauges = [
+            self.registry.gauge(
+                "serving_queue_depth", "requests waiting for a batch slot",
+                lbl),
+            self.registry.gauge(
+                "serving_dispatcher_alive",
+                "1 while the dispatcher thread runs", lbl),
+        ]
+        self._cb_gauges[0].set_function(self._queue.qsize)
+        self._cb_gauges[1].set_function(
+            lambda: 1.0 if (self._disp_thread
+                            and self._disp_thread.is_alive()) else 0.0)
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Counter view (registry-backed; kept for the pre-observability
+        `stats` dict consumers and the /health payload)."""
+        return {k: int(c.value) for k, c in self._m.items()}
 
     # ------------------------------------------------------------ admission
     def _submit(self, pend: _PendingRequest) -> None:
@@ -379,14 +468,16 @@ class ServingServer:
         budgets answer 504 immediately, a full queue sheds with 503 +
         Retry-After (the client's signal to back off and retry elsewhere)."""
         if pend.deadline is not None and pend.deadline.expired:
-            self.stats["expired"] += 1
+            self._m["expired"].inc()
+            self.events.append("expired", pend.trace_id, status=504)
             pend.complete({"status": 504,
                            "body": b'{"error": "deadline exceeded"}'})
             return
         try:
             self._queue.put_nowait(pend)
         except queue.Full:
-            self.stats["shed"] += 1
+            self._m["shed"].inc()
+            self.events.append("shed", pend.trace_id, status=503)
             pend.complete({"status": 503,
                            "headers": {"Retry-After": "1"},
                            "body": b'{"error": "overloaded: '
@@ -401,20 +492,25 @@ class ServingServer:
                 "listener": self.listener,
                 "stats": dict(self.stats)}
 
+    def metrics_text(self) -> str:
+        """GET /metrics payload (Prometheus text exposition)."""
+        return self.registry.render_prometheus()
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingServer":
         if self.listener == "asyncio":
             # persistent-connection listener: the sub-ms HTTP path
-            self._alistener = _AsyncListener(self._submit,
-                                             self.request_timeout,
-                                             self.host, self.port,
-                                             health_fn=self.health).start()
+            self._alistener = _AsyncListener(
+                self._submit, self.request_timeout, self.host, self.port,
+                health_fn=self.health,
+                metrics_fn=self.metrics_text).start()
             self.port = self._alistener.port
         else:
             self._httpd = _make_http_listener(self._submit,
                                               self.request_timeout,
                                               self.host, self.port,
-                                              health_fn=self.health)
+                                              health_fn=self.health,
+                                              metrics_fn=self.metrics_text)
             self.port = self._httpd.server_address[1]  # resolve port 0
             t_http = threading.Thread(target=self._httpd.serve_forever,
                                       daemon=True)
@@ -433,6 +529,16 @@ class ServingServer:
             self._httpd.server_close()
         if self._alistener:
             self._alistener.stop()
+        # freeze collect-time gauges: the registry outlives this server,
+        # and a live callback would pin the stopped server (queue, handler
+        # closure, model arrays) in memory forever. The dispatcher exits
+        # within its 0.05 s poll of _stop, but the freeze must not race
+        # it: a stopped server scrapes as NOT alive, by definition, and
+        # its queue holds nothing servable
+        for g in self._cb_gauges:
+            g.set_function(None)
+        self._cb_gauges[0].set(0.0)   # queue depth
+        self._cb_gauges[1].set(0.0)   # dispatcher alive
 
     @property
     def url(self) -> str:
@@ -479,7 +585,8 @@ class ServingServer:
             live: List[_PendingRequest] = []
             for pend in batch:
                 if pend.deadline is not None and pend.deadline.expired:
-                    self.stats["expired"] += 1
+                    self._m["expired"].inc()
+                    self.events.append("expired", pend.trace_id, status=504)
                     pend.complete({"status": 504,
                                    "body": b'{"error": "deadline '
                                            b'exceeded"}'})
@@ -489,11 +596,15 @@ class ServingServer:
                 self._run_batch(live)
 
     def _run_batch(self, batch: List[_PendingRequest]) -> None:
-        self.stats["requests"] += len(batch)
-        self.stats["batches"] += 1
+        n = len(batch)
+        self._m["requests"].inc(n)
+        self._m["batches"].inc()
+        t0 = time.perf_counter()
+        for pend in batch:
+            self.events.append("queue_wait", pend.trace_id,
+                               dur_s=t0 - pend.t_enq, rid=pend.rid)
         try:
             df = parse_request(batch, self.vector_cols)
-            n = len(batch)
             # pad rows to the next power of two (last row repeated) so the
             # jitted pipeline sees few distinct shapes — no per-batch-size
             # retrace, stable tail latency
@@ -505,15 +616,34 @@ class ServingServer:
                 idx = np.concatenate([np.arange(n),
                                       np.full(cap - n, n - 1)])
                 df = df.take(idx)
+            t_asm = time.perf_counter()
             scored = self.handler(df.drop("id"))
+            t_disp = time.perf_counter()
             replies = make_reply(scored, self.reply_col)[:n]
             for pend, body in zip(batch, replies):
                 pend.complete({"status": 200, "body": body})
+            t_done = time.perf_counter()
+            self._batch_gauge.set(n)
+            if t_disp > t_asm:
+                self._rows_gauge.set(n / (t_disp - t_asm))
+            for pend in batch:
+                self.events.append("batch_assembly", pend.trace_id,
+                                   dur_s=t_asm - t0, batch=n)
+                self.events.append("device_dispatch", pend.trace_id,
+                                   dur_s=t_disp - t_asm)
+                self.events.append("reply", pend.trace_id,
+                                   dur_s=t_done - t_disp, status=200)
+                self._lat_hist.observe(t_done - pend.t_enq)
         except Exception as e:  # reply 500 to the whole batch
-            self.stats["errors"] += len(batch)
+            self._m["errors"].inc(n)
             body = json.dumps({"error": str(e)}).encode()
             for pend in batch:
                 pend.complete({"status": 500, "body": body})
+            t_err = time.perf_counter()
+            for pend in batch:
+                self.events.append("reply", pend.trace_id,
+                                   dur_s=t_err - t0, status=500)
+                self._lat_hist.observe(t_err - pend.t_enq)
 
 
 class HTTPStreamSource:

@@ -1131,6 +1131,24 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             result, best_iter = _boost()
             booster = self._assemble_booster(result, bm, num_class,
                                              objective, f, best_iter, prev)
+        # observability bridge (fit-loop hook): every completed fit lands
+        # its headline throughput in the telemetry registry; a
+        # collectFitTimings fit additionally lands the phase decomposition
+        # and pipelined-construction timeline, so one /metrics scrape (or
+        # the bench snapshot) carries fit-side and serving-side telemetry.
+        # Import inside the guard: telemetry must never fail a fit. The
+        # iteration count is the EXECUTED one (_iters_override on a
+        # checkpoint resume), not the nominal request — the wall time
+        # only covers this run, and rows*iter/s must not inflate on
+        # resume.
+        try:
+            from ...observability import publish_fit_metrics
+            publish_fit_metrics(
+                n, self._iters_override or self.get("numIterations"),
+                __import__("time").perf_counter() - _t_fit0,
+                timings=getattr(booster, "fit_timings", None))
+        except Exception:  # noqa: BLE001 - telemetry never fails a fit
+            pass
         if ckdir:
             # the checkpoint is a crash artifact: a completed fit removes it
             # so the next fit() with this dir starts fresh

@@ -1,0 +1,41 @@
+"""Unified telemetry layer: metrics registry, /metrics export, tracing.
+
+One queryable surface for everything the system measures about itself
+(the reference's StopWatch-diagnostics-DataFrame role, grown into a
+production telemetry plane):
+
+- `MetricsRegistry` — thread-safe counters / gauges / fixed-bucket
+  histograms (interpolated p50/p95/p99), labeled series, deterministic
+  snapshot order, Prometheus-text rendering; `get_registry()` is the
+  process-global default every component lands on.
+- `EventLog` + `X-Trace-Id` propagation — per-hop structured spans
+  (queue wait, batch assembly, device dispatch, reply; gateway forward
+  attempts) in a bounded ring with an optional JSONL sink, so a slow
+  request is explained hop by hop.
+- the profiling bridge — StopWatch / FitTimeline / bring-up probe
+  outcomes published into the registry, so fit-side and serving-side
+  telemetry land in one scrape.
+
+Wired into `io/serving.py` (GET /metrics beside /health), the
+`ServingCoordinator` gateway, `DistributedServingServer` workers,
+`resilience/` (retry/shed/eviction/probe counters), the GBDT fit loop,
+and bench.py (snapshot embedded in the bench JSON).
+tests/test_observability.py lints that io/ and resilience/ grow no new
+ad-hoc latency counters or hand-rolled stat dicts outside this layer.
+"""
+
+from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
+                      MetricsRegistry, get_registry, set_registry)
+from .tracing import (EventLog, TRACE_HEADER, mint_trace_id,
+                      trace_id_from_headers)
+from .bridge import (classify_probe_outcome, publish_bringup,
+                     publish_fit_metrics, publish_fit_timeline,
+                     publish_probe_outcome, publish_stopwatch)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
+    "EventLog", "TRACE_HEADER", "mint_trace_id", "trace_id_from_headers",
+    "classify_probe_outcome", "publish_bringup", "publish_fit_metrics",
+    "publish_fit_timeline", "publish_probe_outcome", "publish_stopwatch",
+]

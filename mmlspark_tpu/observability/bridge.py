@@ -1,0 +1,136 @@
+"""Bridge fit-side profiling artifacts into the metrics registry.
+
+The fit path already measures itself — `StopWatch` phase decompositions,
+the barrier-free `FitTimeline` (overlap_ratio, commit_wait), bring-up
+probe records (`resilience/bringup.py`) — but until now those numbers
+lived only on the fitted booster or inside BENCH_*.json. This module
+publishes them as registry series so one `/metrics` scrape (or one
+`snapshot()` embedded in bench JSON) carries fit-side AND serving-side
+telemetry.
+
+Publication is best-effort by design: a telemetry failure must never
+fail a fit, so each publisher warns once instead of raising.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["publish_stopwatch", "publish_fit_timeline",
+           "publish_fit_metrics", "classify_probe_outcome",
+           "publish_probe_outcome", "publish_bringup"]
+
+
+def publish_stopwatch(summary: Dict[str, Any], prefix: str = "fit_phase",
+                      registry: Optional[MetricsRegistry] = None) -> None:
+    """StopWatch.summary() -> `<prefix>_seconds{phase=...}` gauges (the
+    VW-TrainingStats diagnostics shape, now scrapeable)."""
+    reg = registry or get_registry()
+    try:
+        for phase, slot in summary.items():
+            if isinstance(slot, dict) and "total_s" in slot:
+                reg.gauge(f"{prefix}_seconds",
+                          "wall seconds per fit phase (last fit)",
+                          labels={"phase": phase}).set(slot["total_s"])
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the fit
+        warnings.warn(f"publish_stopwatch failed: {e}", stacklevel=2)
+
+
+def publish_fit_timeline(summary: Dict[str, Any],
+                         prefix: str = "fit_pipeline",
+                         registry: Optional[MetricsRegistry] = None) -> None:
+    """FitTimeline.summary() -> overlap/commit-wait/busy gauges."""
+    reg = registry or get_registry()
+    try:
+        mapping = {"wall_s": "wall_seconds",
+                   "host_busy_s": "host_busy_seconds",
+                   "device_busy_s": "device_busy_seconds",
+                   "wait_s": "commit_wait_seconds",
+                   "overlap_ratio": "overlap_ratio"}
+        for src, dst in mapping.items():
+            if src in summary and summary[src] is not None:
+                reg.gauge(f"{prefix}_{dst}",
+                          "pipelined-fit timeline (last fit)"
+                          ).set(float(summary[src]))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the fit
+        warnings.warn(f"publish_fit_timeline failed: {e}", stacklevel=2)
+
+
+def publish_fit_metrics(rows: int, iters: int, wall_s: float,
+                        timings: Optional[Dict[str, Any]] = None,
+                        registry: Optional[MetricsRegistry] = None) -> None:
+    """The GBDT fit-loop hook: every completed fit lands a counter + the
+    headline throughput gauge; a collectFitTimings fit additionally lands
+    its phase decomposition and pipeline timeline."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("gbdt_fits_total", "completed booster fits").inc()
+        reg.gauge("gbdt_fit_wall_seconds", "last fit wall time").set(wall_s)
+        reg.gauge("gbdt_fit_rows", "rows in the last fit").set(rows)
+        if wall_s > 0:
+            reg.gauge("gbdt_fit_rows_iter_per_s",
+                      "last-fit training throughput (rows*iters/s — the "
+                      "bench headline unit)").set(rows * iters / wall_s)
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the fit
+        warnings.warn(f"publish_fit_metrics failed: {e}", stacklevel=2)
+        return
+    if not timings:
+        return
+    publish_stopwatch({k: v for k, v in timings.items()
+                       if isinstance(v, dict) and "total_s" in v},
+                      registry=reg)
+    tl = timings.get("timeline") or {}
+    if isinstance(tl, dict) and isinstance(tl.get("construction"), dict):
+        publish_fit_timeline(tl["construction"], registry=reg)
+
+
+#: bounded label set for bring-up probe outcomes — the raw outcome
+#: strings carry free text (error details, durations) that must not
+#: become unbounded label cardinality
+_PROBE_CATEGORIES = (("healthy", "healthy"), ("init hang", "hang"),
+                     ("spawn failed", "spawn_failed"),
+                     ("parent", "parent_init"), ("seed", "seed"),
+                     ("error", "error"))
+
+
+def classify_probe_outcome(outcome: str) -> str:
+    for prefix, cat in _PROBE_CATEGORIES:
+        if outcome.startswith(prefix):
+            return cat
+    return "other"
+
+
+def publish_probe_outcome(outcome: str,
+                          registry: Optional[MetricsRegistry] = None
+                          ) -> None:
+    """One bring-up / retry probe record -> outcome-category counter
+    (called from resilience.Attempt.record)."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("bringup_probe_outcomes_total",
+                    "bring-up probe attempts by outcome category",
+                    labels={"outcome": classify_probe_outcome(outcome)}
+                    ).inc()
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail bring-up
+        warnings.warn(f"publish_probe_outcome failed: {e}", stacklevel=2)
+
+
+def publish_bringup(attempts: list, healthy: bool, window_s: float,
+                    registry: Optional[MetricsRegistry] = None) -> None:
+    """End-of-bring-up summary gauges (per-attempt counters land via
+    Attempt.record as the attempts happen)."""
+    reg = registry or get_registry()
+    try:
+        reg.gauge("bringup_last_window_seconds",
+                  "wall seconds of the last bring-up window").set(window_s)
+        reg.gauge("bringup_last_healthy",
+                  "1 when the last bring-up reached an accelerator"
+                  ).set(1.0 if healthy else 0.0)
+        reg.gauge("bringup_last_probes",
+                  "probe attempts in the last bring-up window"
+                  ).set(len(attempts))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail bring-up
+        warnings.warn(f"publish_bringup failed: {e}", stacklevel=2)

@@ -1,0 +1,420 @@
+"""Thread-safe metrics registry with Prometheus-text exposition.
+
+The reference's observability story was ad-hoc `StopWatch` counters
+surfaced as a diagnostics DataFrame (core/utils/StopWatch.scala:35,
+VowpalWabbitBase.scala:268-303 perf stats); the repro inherited that
+shape — `health()` dicts, per-server `stats` dicts, bench numbers that
+only exist inside BENCH_*.json. This module is the single queryable
+telemetry surface those all land on: counters, gauges (optionally
+callback-backed), and fixed-bucket histograms with interpolated
+p50/p95/p99, grouped into labeled families, exported as Prometheus text
+(`GET /metrics` on every serving endpoint) and as a JSON-able snapshot
+(embedded in bench JSON so the scrape and the bench record can never
+disagree).
+
+Determinism: snapshot/render order is sorted by (family, label items) —
+two registries fed the same series in any order emit identical output,
+so scrape diffs and bench-JSON diffs are meaningful.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "set_registry", "DEFAULT_LATENCY_BUCKETS"]
+
+
+#: latency histogram bounds (seconds): sub-ms serving resolution at the
+#: bottom (the asyncio listener's measured p50 is ~0.27 ms), decade-ish
+#: spacing up to the 30 s request-timeout ceiling. +inf is implicit.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _label_key(labels: Optional[Dict[str, str]]
+               ) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter (one labeled series)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Settable value; `set_function` makes it collect-time computed
+    (queue depth, dispatcher liveness — read fresh at every scrape)."""
+
+    __slots__ = ("_lock", "_value", "_fn")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    def set_function(self, fn: Optional[Callable[[], float]]) -> None:
+        """Install a collect-time callback; `None` FREEZES the gauge at its
+        current value and drops the callback — a stopped server must not
+        stay reachable (queue, handler, model arrays) through its own
+        telemetry closure after the registry outlives it."""
+        if fn is None:
+            v = self.value  # one last read through the callback
+            with self._lock:
+                self._fn = None
+                self._value = v
+            return
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            v = float(fn())
+        except Exception:  # a dead callback must not kill the scrape
+            with self._lock:
+                return self._value
+        with self._lock:
+            self._value = v
+            return v
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated quantiles.
+
+    Buckets are cumulative upper bounds (Prometheus `le` semantics) with
+    an implicit +inf bucket. `quantile(q)` linearly interpolates inside
+    the bucket holding the target rank — accurate to one bucket width,
+    which the default latency bounds keep proportional to the value.
+    """
+
+    __slots__ = ("_lock", "bounds", "_counts", "_sum", "_count",
+                 "_min", "_max")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._lock = threading.Lock()
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # + the +inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        # bisect without the import: bucket index by linear scan is fine
+        # for <= ~20 bounds and avoids allocation on the hot path
+        i = 0
+        bounds = self.bounds
+        while i < len(bounds) and v > bounds[i]:
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Interpolated q-quantile (q in [0, 1]); None when empty. Values
+        beyond the last finite bound report the observed max (the +inf
+        bucket has no width to interpolate in)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        with self._lock:
+            total = self._count
+            if total == 0:
+                return None
+            counts = list(self._counts)
+            vmin, vmax = self._min, self._max
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(self.bounds):     # +inf bucket
+                    return vmax
+                lo = self.bounds[i - 1] if i > 0 else min(vmin, 0.0)
+                hi = self.bounds[i]
+                frac = (rank - cum) / c
+                return min(max(lo + (hi - lo) * frac, vmin), vmax)
+            cum += c
+        return vmax
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = list(self._counts)
+            out: Dict[str, Any] = {"count": self._count,
+                                   "sum": round(self._sum, 6)}
+        out["buckets"] = {("+Inf" if i >= len(self.bounds)
+                           else repr(self.bounds[i])): c
+                          for i, c in enumerate(counts)}
+        for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            v = self.quantile(q)
+            if v is not None:
+                out[name] = round(v, 6)
+        return out
+
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+class _Family:
+    __slots__ = ("name", "kind", "help", "series", "buckets")
+
+    def __init__(self, name: str, kind: str, help_: str,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+        self.buckets = buckets
+
+
+class MetricsRegistry:
+    """Families of labeled Counter/Gauge/Histogram series.
+
+    `counter/gauge/histogram(name, labels=...)` returns the (created-once)
+    series for that label set — callers keep the handle and hit only the
+    series lock on the hot path. Name collisions across kinds raise: one
+    name, one kind, forever (the Prometheus contract).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    # -------------------------------------------------------------- create
+    def _family(self, name: str, kind: str, help_: str,
+                buckets: Optional[Sequence[float]] = None) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, kind, help_, buckets)
+                self._families[name] = fam
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}, "
+                    f"requested {kind}")
+            return fam
+
+    def _series(self, name: str, kind: str, help_: str,
+                labels: Optional[Dict[str, str]],
+                buckets: Optional[Sequence[float]] = None):
+        fam = self._family(name, kind, help_, buckets)
+        key = _label_key(labels)
+        with self._lock:
+            s = fam.series.get(key)
+            if s is None:
+                if kind == "counter":
+                    s = Counter()
+                elif kind == "gauge":
+                    s = Gauge()
+                else:
+                    s = Histogram(fam.buckets or DEFAULT_LATENCY_BUCKETS)
+                fam.series[key] = s
+            return s
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._series(name, "counter", help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._series(name, "gauge", help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Optional[Dict[str, str]] = None,
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        return self._series(name, "histogram", help, labels, buckets)
+
+    # --------------------------------------------------------------- query
+    def _sorted_families(self) -> List[_Family]:
+        with self._lock:
+            return [self._families[n] for n in sorted(self._families)]
+
+    def total(self, name: str) -> float:
+        """Sum of a counter/gauge family across all label sets (0.0 when
+        the family does not exist) — the cross-instance reconciliation
+        helper chaos tests and scripts read."""
+        with self._lock:
+            fam = self._families.get(name)
+            series = list(fam.series.values()) if fam else []
+        return float(sum(s.value for s in series))
+
+    def quantile(self, name: str, q: float,
+                 labels: Optional[Dict[str, str]] = None
+                 ) -> Optional[float]:
+        """q-quantile of one histogram series (None when absent/empty)."""
+        with self._lock:
+            fam = self._families.get(name)
+            s = fam.series.get(_label_key(labels)) if fam else None
+        if s is None:
+            return None
+        return s.quantile(q)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view of every series, deterministically ordered
+        (families sorted by name, series by label items)."""
+        out: Dict[str, Any] = {}
+        for fam in self._sorted_families():
+            with self._lock:
+                items = sorted(fam.series.items())
+            rows = []
+            for key, s in items:
+                row: Dict[str, Any] = {"labels": dict(key)}
+                if fam.kind == "histogram":
+                    row.update(s.snapshot())
+                else:
+                    row["value"] = round(s.value, 6)
+                rows.append(row)
+            out[fam.name] = {"kind": fam.kind, "help": fam.help,
+                             "series": rows}
+        return out
+
+    # ------------------------------------------------------------- render
+    @staticmethod
+    def _fmt_labels(key: Tuple[Tuple[str, str], ...],
+                    extra: Optional[Tuple[Tuple[str, str], ...]] = None
+                    ) -> str:
+        pairs = list(key) + list(extra or ())
+        if not pairs:
+            return ""
+        body = ",".join(
+            '%s="%s"' % (k, v.replace("\\", "\\\\").replace('"', '\\"')
+                         .replace("\n", "\\n")) for k, v in pairs)
+        return "{" + body + "}"
+
+    @staticmethod
+    def _fmt_value(v: float) -> str:
+        if v == math.inf:
+            return "+Inf"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4 of the whole registry."""
+        lines: List[str] = []
+        for fam in self._sorted_families():
+            with self._lock:
+                items = sorted(fam.series.items())
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key, s in items:
+                if fam.kind == "histogram":
+                    snap = s.snapshot()
+                    cum = 0
+                    counts = list(snap["buckets"].values())
+                    for i, b in enumerate(list(s.bounds) + [math.inf]):
+                        cum += counts[i]
+                        le = (("le", self._fmt_value(b)),)
+                        lines.append(f"{fam.name}_bucket"
+                                     f"{self._fmt_labels(key, le)} {cum}")
+                    lines.append(f"{fam.name}_sum{self._fmt_labels(key)} "
+                                 f"{repr(snap['sum'])}")
+                    lines.append(f"{fam.name}_count{self._fmt_labels(key)} "
+                                 f"{snap['count']}")
+                else:
+                    lines.append(f"{fam.name}{self._fmt_labels(key)} "
+                                 f"{self._fmt_value(s.value)}")
+        return "\n".join(lines) + "\n"
+
+    def remove(self, name: str, labels: Optional[Dict[str, str]] = None
+               ) -> bool:
+        """Drop one labeled series (or, with labels=None, the whole
+        family). Returns whether anything was removed. Server stop() only
+        FREEZES its series (final counts stay scrapeable); a long-lived
+        process that churns through many servers calls this — e.g.
+        `reg.remove("serving_queue_depth", {"instance": "serving-3"})` —
+        to retire a dead instance's series from scrapes and snapshots."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return False
+            if labels is None:
+                del self._families[name]
+                return True
+            removed = fam.series.pop(_label_key(labels), None) is not None
+            if not fam.series:
+                del self._families[name]
+            return removed
+
+    def reset(self) -> None:
+        """Drop every family (test isolation for the global registry)."""
+        with self._lock:
+            self._families.clear()
+
+
+_default_registry = MetricsRegistry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global default registry: serving servers, the gateway,
+    the profiling bridge, and the bench snapshot all land here unless
+    handed an explicit registry."""
+    with _default_lock:
+        return _default_registry
+
+
+def set_registry(reg: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-global registry (tests); returns the previous."""
+    global _default_registry
+    with _default_lock:
+        prev, _default_registry = _default_registry, reg
+        return prev

@@ -1,0 +1,112 @@
+"""Request tracing: X-Trace-Id propagation + bounded structured event log.
+
+A trace id is minted at the first hop that sees a request (the gateway,
+or a worker hit directly) unless the client already sent `X-Trace-Id`;
+every forward, retry, and failover hop re-sends the same id, and every
+reply carries it back. Each hop appends per-span events (queue wait,
+batch assembly, device dispatch, reply at workers; per-attempt forward
+outcomes at the gateway) to its own `EventLog` — a bounded in-memory
+ring with an optional JSONL file sink — so a slow request can be
+explained hop by hop: grep both logs for the id and read the spans.
+
+Events are plain dicts: {"ts": epoch-seconds, "trace_id", "span",
+"dur_s", ...extras}. The ring bound makes the hot path allocation-cheap
+and the memory ceiling fixed; the file sink is debug-grade (every event,
+line-buffered) and off by default.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+__all__ = ["TRACE_HEADER", "mint_trace_id", "trace_id_from_headers",
+           "EventLog"]
+
+TRACE_HEADER = "X-Trace-Id"
+
+
+def mint_trace_id() -> str:
+    """32-hex-char process-unique trace id."""
+    return uuid.uuid4().hex
+
+
+def trace_id_from_headers(headers: Optional[Dict[str, str]]
+                          ) -> Optional[str]:
+    """Case-insensitive `X-Trace-Id` lookup; None when absent or blank
+    (a malformed id must not kill the request — a fresh one is minted)."""
+    if not headers:
+        return None
+    for k, v in headers.items():
+        if k.lower() == TRACE_HEADER.lower():
+            v = str(v).strip()
+            return v or None
+    return None
+
+
+class EventLog:
+    """Bounded structured event ring + optional JSONL file sink.
+
+    `append(span, trace_id, dur_s, **extra)` stamps the wall clock and
+    records one event; the deque bound evicts the oldest, so a long-lived
+    server holds at most `capacity` events no matter the traffic. The
+    sink (when set) receives every event as one JSON line — including
+    those later evicted from the ring.
+    """
+
+    def __init__(self, capacity: int = 4096,
+                 sink_path: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._ring: "collections.deque[Dict[str, Any]]" = \
+            collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._sink = open(sink_path, "a", buffering=1) if sink_path else None
+
+    def append(self, span: str, trace_id: Optional[str] = None,
+               dur_s: Optional[float] = None, **extra: Any) -> None:
+        ev: Dict[str, Any] = {"ts": round(time.time(), 6), "span": span}
+        if trace_id is not None:
+            ev["trace_id"] = trace_id
+        if dur_s is not None:
+            ev["dur_s"] = round(dur_s, 6)
+        ev.update(extra)
+        with self._lock:
+            self._ring.append(ev)
+            if self._sink is not None:
+                try:
+                    self._sink.write(json.dumps(ev) + "\n")
+                except (OSError, ValueError):
+                    # a torn-off sink (disk full, closed fd) must not take
+                    # the dispatcher down; the ring still has the event
+                    self._sink = None
+
+    def events(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Snapshot of ring events, oldest first; filtered to one trace
+        when `trace_id` is given."""
+        with self._lock:
+            evs = list(self._ring)
+        if trace_id is None:
+            return evs
+        return [e for e in evs if e.get("trace_id") == trace_id]
+
+    def spans(self, trace_id: str) -> List[str]:
+        """The span names recorded for one trace, in arrival order."""
+        return [e["span"] for e in self.events(trace_id)]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                finally:
+                    self._sink = None

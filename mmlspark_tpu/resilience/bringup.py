@@ -203,6 +203,7 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
                                  "outcome": f"parent init error: {e}"[:240]})
                 break  # jax is imported now; can't retry backend selection
             watchdog.cancel()
+            _publish_window(attempts, True, time.time() - t0)
             return jax, jdevs, None, list(attempts)
         detail = (err or out).strip().replace("\n", " ")[-220:]
         attempts.append(a.record(f"error: {detail}", dur))
@@ -230,4 +231,17 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
         # with the probe history rather than crashing before any JSON lands
         raise RuntimeError(f"CPU fallback init failed after bring-up "
                            f"({err_msg}): {e}") from e
+    _publish_window(attempts, False, time.time() - t0)
     return jax, devs, err_msg, list(attempts)
+
+
+def _publish_window(attempts: List[dict], healthy: bool,
+                    window_s: float) -> None:
+    """Bring-up summary gauges into the telemetry registry (per-attempt
+    counters already landed via Attempt.record); import inside the guard —
+    bring-up must complete even with the observability layer broken."""
+    try:
+        from ..observability import publish_bringup
+        publish_bringup(attempts, healthy, window_s)
+    except Exception:  # noqa: BLE001 - telemetry never fails bring-up
+        pass

@@ -69,13 +69,25 @@ class FaultInjector:
         return "ok"
 
     def next_fault(self) -> str:
-        """Draw the next fault decision (thread-safe)."""
+        """Draw the next fault decision (thread-safe). Each decision is
+        also counted into the telemetry registry
+        (`chaos_injected_total{kind=...}`); `self.counts` stays an
+        INDEPENDENT tally so chaos tests can reconcile registry counters
+        against ground truth that does not share the registry's code
+        path."""
         with self._lock:
             u = self._rng.random()
             kind = self._classify(u)
             self.counts["calls"] += 1
             self.counts[kind] += 1
-            return kind
+        try:
+            from ..observability import get_registry
+            get_registry().counter(
+                "chaos_injected_total", "chaos decisions by kind",
+                labels={"kind": kind}).inc()
+        except Exception:  # noqa: BLE001 - telemetry must not alter chaos
+            pass
+        return kind
 
     def schedule(self, n: int) -> List[str]:
         """The first n decisions a fresh injector with this seed makes —

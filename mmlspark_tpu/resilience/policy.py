@@ -148,6 +148,17 @@ class Attempt:
         self.override_sleep_s: Optional[float] = None
 
     def record(self, outcome: str, dur_s: float = 0.0) -> Dict:
+        # every structured probe record also lands in the telemetry
+        # registry (bounded outcome-category label), so bring-up health is
+        # scrapeable alongside serving/fit metrics; the import itself is
+        # inside the guard — telemetry (including a broken or mid-shutdown
+        # observability import) must never be a reason a retry loop can't
+        # record its probe
+        try:
+            from ..observability import publish_probe_outcome
+            publish_probe_outcome(outcome)
+        except Exception:  # noqa: BLE001 - telemetry never fails a probe
+            pass
         return {"t_s": round(self.t_s, 1), "dur_s": round(dur_s, 1),
                 "outcome": outcome}
 

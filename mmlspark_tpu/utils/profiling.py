@@ -23,10 +23,36 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from typing import Any, Dict, Iterator, List, Optional
 
 __all__ = ["device_trace", "annotate", "StopWatch", "FitTimeline",
            "NULL_TIMELINE"]
+
+
+def _flush_device_work(jax) -> None:
+    """Barrier in-flight device work before a trace stops, version-aware:
+    `jax.effects_barrier` where present (0.4+), else block on the live
+    arrays still in flight. A barrier that silently fails produces a
+    trace that silently MISSES in-flight programs — worse than no trace —
+    so every failure path emits a one-line warning instead of swallowing."""
+    barrier = getattr(jax, "effects_barrier", None)
+    try:
+        if barrier is not None:
+            barrier()
+        elif hasattr(jax, "live_arrays"):
+            # older jax without effects_barrier: blocking on the arrays
+            # currently alive flushes the async dispatch queue they're on
+            jax.block_until_ready(jax.live_arrays())
+        else:
+            warnings.warn(
+                "device_trace: this jax has neither effects_barrier nor "
+                "live_arrays — the trace may miss in-flight device work",
+                stacklevel=3)
+    except Exception as e:  # noqa: BLE001 - trace integrity warning below
+        warnings.warn(
+            f"device_trace: device flush failed ({type(e).__name__}: {e}) "
+            f"— the trace may miss in-flight device work", stacklevel=3)
 
 
 @contextlib.contextmanager
@@ -39,11 +65,8 @@ def device_trace(log_dir: str) -> Iterator[None]:
     try:
         yield
     finally:
-        try:
-            # flush async dispatch so the trace covers the block's work
-            jax.effects_barrier()
-        except Exception:
-            pass
+        # flush async dispatch so the trace covers the block's work
+        _flush_device_work(jax)
         jax.profiler.stop_trace()
 
 
@@ -99,6 +122,13 @@ class StopWatch:
                 rec["pct"] = 100.0 * slot["total_s"] / base
             out[name] = rec
         return out
+
+    def publish(self, prefix: str = "fit_phase", registry=None) -> None:
+        """Land this decomposition in the telemetry registry
+        (`<prefix>_seconds{phase=...}` gauges) so a /metrics scrape or a
+        bench snapshot carries it — the observability bridge."""
+        from ..observability import publish_stopwatch
+        publish_stopwatch(self.summary(), prefix=prefix, registry=registry)
 
 
 class FitTimeline:
@@ -221,6 +251,13 @@ class FitTimeline:
             out["ahead_dispatch"] = ahead
         out.update({k: v for k, v in self.meta.items()})
         return out
+
+    def publish(self, prefix: str = "fit_pipeline", registry=None) -> None:
+        """Land overlap_ratio / commit_wait / busy totals in the telemetry
+        registry — the observability bridge for pipelined fits."""
+        from ..observability import publish_fit_timeline
+        publish_fit_timeline(self.summary(), prefix=prefix,
+                             registry=registry)
 
 
 def fit_pipeline_overlap_record(fit_timings: Dict[str, Any],

@@ -135,26 +135,52 @@ def main():
     # max_latency_ms=0.0: a lone request must not sit in the dynamic
     # batcher waiting for companions — this row measures the
     # latency-optimal single-request config (the reference's continuous
-    # mode is per-request; throughput configs raise the window instead)
+    # mode is per-request; throughput configs raise the window instead).
+    # An isolated registry: this measurement run's histogram must not mix
+    # with whatever the process-global registry already accumulated.
+    from mmlspark_tpu.observability import MetricsRegistry
+    reg = MetricsRegistry()
     srv = ServingServer(tpu_handler, reply_col="scored", port=0,
                         vector_cols=("features",),
-                        max_batch_size=64, max_latency_ms=0.0).start()
+                        max_batch_size=64, max_latency_ms=0.0,
+                        registry=reg).start()
     try:
-        body = json.dumps({"features": [float(v) for v in x[0]]}).encode()
-        lat = []
+        example = {"features": [float(v) for v in x[0]]}
+        body = json.dumps(example).encode()
+        # compile + settle BEFORE anything lands in the histogram:
+        # warmup() runs the handler in-process, bypassing the batcher (no
+        # histogram observation), so the first HTTP request below is
+        # steady-state — without this the jit compile would own the p99
+        srv.warmup(example)
         for i in range(120):
-            t0 = time.perf_counter()
             with urllib.request.urlopen(
                     urllib.request.Request(srv.url, data=body), timeout=30):
                 pass
-            lat.append(time.perf_counter() - t0)
-        lat = np.asarray(lat[20:]) * 1e3        # drop warmup
+        # p50/p99 and shed-rate come from the SERVER's registry — the same
+        # series a /metrics scrape exports — not a client-side stopwatch
+        # list, so this script and a production scrape can never disagree.
+        # (The server histogram measures enqueue->reply; the client-side
+        # socket+parse adds ~the listener overhead bounded sub-ms in
+        # tests/test_serving_latency.py.)
+        lbl = {"instance": srv.metrics_label}
+        p50 = reg.quantile("serving_request_latency_seconds", 0.5, lbl)
+        p99 = reg.quantile("serving_request_latency_seconds", 0.99, lbl)
+        snap = reg.snapshot()
+        # shed-rate over everything RECEIVED: dispatched + shed + expired
+        # (serving_requests_total counts only batch-dispatched requests)
+        received = (reg.total("serving_requests_total")
+                    + reg.total("serving_shed_total")
+                    + reg.total("serving_expired_total"))
+        shed_rate = (reg.total("serving_shed_total") / received
+                     if received else 0.0)
         print()
-        print(f"HTTP->TPU->reply (batch-1, localhost, relay in path): "
-              f"p50 {np.percentile(lat, 50):.2f} ms  "
-              f"p99 {np.percentile(lat, 99):.2f} ms  "
+        print(f"HTTP->TPU->reply (batch-1, localhost, relay in path; "
+              f"registry scrape): "
+              f"p50 {p50 * 1e3:.2f} ms  p99 {p99 * 1e3:.2f} ms  "
+              f"shed-rate {shed_rate:.3f}  "
               f"(relay RTT ~{rtt * 1e3:.0f} ms of that; "
               f"listener+batcher sub-ms per test_serving_latency)")
+        print(json.dumps({"serving_telemetry": snap}))
     finally:
         srv.stop()
     return 0

@@ -95,7 +95,14 @@ def test_estimator_epoch_resume(tmp_path):
 
 def test_estimator_pipeline_strategy_and_resume(tmp_path):
     """strategy='pipeline' trains through the GPipe pp x dp step via the
-    SAME estimator surface, and composes with checkpointDir resume."""
+    SAME estimator surface, and composes with checkpointDir resume.
+
+    12 epochs (was 6): convergence RATE on this tiny problem drifts with
+    the jax/XLA build (6 epochs measured acc 0.73 on jax 0.4.37/CPU vs
+    >= 0.8 on the build the test was written against; 10 epochs 0.81, 14
+    epochs 0.91 — the optimizer path is fine, just slower early). The
+    assertion's intent is "the pipeline step actually trains", so train
+    past the drift margin instead of loosening the accuracy bar."""
     from mmlspark_tpu import DataFrame
     from mmlspark_tpu.models.deep import TransformerEncoderClassifier
 
@@ -103,14 +110,14 @@ def test_estimator_pipeline_strategy_and_resume(tmp_path):
     x = rng.normal(size=(64, 6, 16)).astype(np.float32)
     y = (x.mean(axis=(1, 2)) > 0).astype(np.float64)
     df = DataFrame({"sequence": list(x), "label": y})
-    kw = dict(numLayers=2, dModel=16, numHeads=2, dFF=32, epochs=6,
+    kw = dict(numLayers=2, dModel=16, numHeads=2, dFF=32, epochs=12,
               batchSize=16, seed=3, dataParallel=4, modelParallel=2,
               strategy="pipeline", numMicrobatches=2)
     ref = TransformerEncoderClassifier(**kw).fit(df)
     acc = (ref.transform(df)["prediction"] == y).mean()
     assert acc >= 0.8, acc
     ck = str(tmp_path / "pck")
-    TransformerEncoderClassifier(**{**kw, "epochs": 3},
+    TransformerEncoderClassifier(**{**kw, "epochs": 6},
                                  checkpointDir=ck).fit(df)
     resumed = TransformerEncoderClassifier(**kw, checkpointDir=ck).fit(df)
     for a, b in zip(jax.tree_util.tree_leaves(ref.get("weights")),
