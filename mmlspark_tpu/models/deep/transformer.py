@@ -620,7 +620,12 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
     batchSize = _p.Param("batchSize", "global batch size", 32, int)
     causal = _p.Param("causal", "causal masking", False)
     dataParallel = _p.Param("dataParallel",
-                            "data-parallel mesh extent (0/1 = single device)",
+                            "data-parallel mesh extent; 0 (default) = auto "
+                            "— all visible devices for the plain tensor "
+                            "strategy when they divide the batch size "
+                            "(psum-mean gradients match the single-device "
+                            "full-batch step to fp reassociation), one "
+                            "device otherwise; 1 = single device",
                             0, int)
     modelParallel = _p.Param("modelParallel",
                              "model-axis mesh extent: tensor-parallel ranks "
@@ -699,6 +704,22 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
 
         dp = self.get("dataParallel") or 1
         tp = self.get("modelParallel") or 1
+        if (not self.get("dataParallel") and tp <= 1
+                and self.get("strategy") == "tensor"
+                and not self.get("zero1")):
+            # mesh by default: with >1 visible device and a batch the
+            # devices divide evenly, the plain tensor strategy shards the
+            # batch data-parallel automatically (per-shard sum + psum /
+            # global batch == the full-batch mean gradient, so this is
+            # the same training up to fp reassociation). Explicit
+            # dataParallel, model-parallel strategies and zero1 keep
+            # their requested meshes — auto never changes an explicit
+            # distributed layout, and zero1's error surface stays intact.
+            ndev = meshlib.device_count()
+            if ndev > 1 and self.get("batchSize") % ndev == 0 \
+                    and n >= ndev:
+                dp = ndev
+        self._dp_resolved = dp
         # cap at the dataset size (and round to the data-parallel extent) so
         # small datasets still train instead of silently skipping every step
         bs = min(max(self.get("batchSize"), dp), n)

@@ -28,6 +28,7 @@ from ...ops.binning import BinMapper
 from ...ops.boosting import (BoostResult, GBDTConfig, HParams, Tree,
                              make_train_fn)
 from ...parallel import mesh as meshlib
+from ...parallel import strategy as stratlib
 from ...utils.profiling import NULL_TIMELINE, FitTimeline
 from .booster import Booster, concat_boosters
 
@@ -202,9 +203,15 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                      "number of data shards (devices); 0 = all devices "
                      "(ClusterUtil replacement)", 0, int)
     parallelism = Param("parallelism",
-                        "tree learner: data_parallel, voting_parallel or "
-                        "serial (LightGBMExecutionParams.parallelism)",
-                        "data_parallel")
+                        "tree learner: 'auto' (default — sharded fit "
+                        "whenever >1 device is visible, data_parallel vs "
+                        "voting_parallel chosen per (n_features, bins, "
+                        "topK) from the dryrun-validated closed-form comm "
+                        "model, parallel/strategy.py), 'data'/"
+                        "'data_parallel', 'voting'/'voting_parallel', or "
+                        "'off'/'serial' (one device; the reference names "
+                        "from LightGBMExecutionParams.parallelism stay "
+                        "accepted)", "auto")
     topK = Param("topK",
                  "voting_parallel top-k voted features per leaf; larger is "
                  "more accurate but allreduces more histogram traffic "
@@ -272,18 +279,22 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         "eager/full only", 1, int)
     fitPipeline = Param(
         "fitPipeline",
-        "host/device fit pipeline for serial fits: 'auto' (pipelined "
-        "dataset construction at >= 2M float32 rows — binning of row-block "
-        "k+1 overlaps block k's async device transfer, label/weight/margin "
-        "transfers ride under the first blocks, and the itersPerCall chunk "
-        "loop dispatches chunk i+1 before fetching chunk i's host "
+        "host/device fit pipeline: 'auto' (pipelined dataset construction "
+        "at >= 2M float32 rows — binning of row-block k+1 overlaps block "
+        "k's async device transfer, label/weight/margin transfers ride "
+        "under the first blocks, and the itersPerCall chunk loop "
+        "dispatches chunk i+1 before fetching chunk i's host "
         "bookkeeping), 'on' (force the pipeline at any size/dtype — with "
         "collectFitTimings this records a barrier-free FitTimeline with "
         "per-block bin/put spans and a measured overlap ratio instead of "
         "the phase-separated decomposition), or 'off' (sequential "
         "construction; with collectFitTimings this is the separable-phase "
-        "decomposition mode). Boosters are BIT-IDENTICAL across all three "
-        "(regression-pinned incl. NaN and float64-fallback inputs)",
+        "decomposition mode). Sharded fits stream per-shard "
+        "double-buffered blocks placed with the mesh row sharding (each "
+        "device's transfers overlap the next block's binning); the "
+        "grouped lambdarank layout keeps one-shot placement. Boosters "
+        "are BIT-IDENTICAL across all three (regression-pinned incl. NaN "
+        "and float64-fallback inputs)",
         "auto")
     collectFitTimings = Param(
         "collectFitTimings",
@@ -460,9 +471,72 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 buf = write(buf, jax.device_put(bk), jnp.int32(j0))
         return buf
 
+    @staticmethod
+    def _binned_to_device_sharded(bm: BinMapper, x: np.ndarray, mesh,
+                                  blk: Optional[int] = None, timeline=None):
+        """Sharded row-block pipelined dataset construction — the
+        _binned_to_device double-buffering composed with the device mesh.
+
+        Layout: the padded row space is viewed as [ndev, rows_per_dev, F]
+        (device d owns the contiguous global rows [d*ppd, (d+1)*ppd) —
+        plain row order, same digests as the one-shot placement). Block j
+        is the SUPER-BLOCK of every device's rows [j0, j0+blk): binned on
+        host as one [ndev*blk, F] transform, then device_put with a
+        (data, None, None) NamedSharding — one async dispatch whose
+        per-device pieces ride each device's host link in parallel, so
+        every shard's transfer overlaps the next super-block's binning.
+        The donated dynamic_update_slice writes at (0, j0, 0): offset 0 on
+        the SHARDED axis, so every write is shard-local (no collective
+        rides the assembly). The final reshape back to [N, F] merges the
+        two leading axes shard-contiguously — also communication-free.
+        No host sync anywhere (sync-point lint, tests/test_fit_pipeline)."""
+        tl = timeline if timeline is not None else NULL_TIMELINE
+        nd = mesh.shape[meshlib.DATA_AXIS]
+        x, _ = meshlib.pad_to_multiple(np.ascontiguousarray(x), nd)
+        n, fdim = x.shape
+        ppd = n // nd
+        if blk is None:
+            blk = max(1_000_000 // nd, -(-ppd // 8))
+        blk = max(1, min(blk, ppd))
+        tl.meta["blk"] = int(blk * nd)
+        tl.meta["n_blocks"] = 1 + len(range(blk, ppd, blk))
+        tl.meta["ndev"] = int(nd)
+        xv = x.reshape(nd, ppd, fdim)
+        sh3 = jax.sharding.NamedSharding(
+            mesh, P(meshlib.DATA_AXIS, None, None))
+        flat = jax.jit(lambda b: b.reshape(n, fdim),
+                       out_shardings=meshlib.data_sharding(mesh, 2))
+
+        def bin_block(j0):
+            return bm.transform(
+                xv[:, j0:j0 + blk].reshape(-1, fdim)).reshape(nd, blk, fdim)
+
+        with tl.span("bin[0]"):
+            b0 = bin_block(0)
+        with tl.span("put[0]"):
+            first = jax.device_put(b0, sh3)
+        if blk >= ppd:
+            return flat(first)
+        buf = jnp.zeros((nd, ppd, fdim), first.dtype, device=sh3)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def write(buf, block, j0):
+            return jax.lax.dynamic_update_slice(buf, block, (0, j0, 0))
+
+        buf = write(buf, first, jnp.int32(0))
+        for i0 in range(blk, ppd, blk):
+            # the final window shifts back to stay full-size (ONE compiled
+            # write shape); its overlap rows re-bin to identical values
+            j0 = min(i0, ppd - blk)
+            with tl.span(f"bin[{j0}]"):
+                bk = bin_block(j0)
+            with tl.span(f"put[{j0}]"):
+                buf = write(buf, jax.device_put(bk, sh3), jnp.int32(j0))
+        return flat(buf)
+
     def _pipelined_device_data(self, bm: BinMapper, x: np.ndarray, y, w,
                                is_valid, margin, has_init: bool, k: int,
-                               groups, timeline):
+                               groups, timeline, mesh=None):
         """The pipelined construction stage of the host/device fit
         pipeline: every fixed host cost is dispatched ASYNC before the
         row-block loop so it rides the interconnect UNDER the first
@@ -472,23 +546,60 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         Returns (binned_device, (y_d, w_d, t_d, mg_d, gidx)). No host
         sync anywhere in this stage (sync-point lint): the commit barrier
         is first-dispatch time — in collectFitTimings mode, an explicit
-        measured `commit_wait` in _train_booster_once."""
+        measured `commit_wait` in _train_booster_once.
+
+        ``mesh``: the sharded variant. Aux arrays ride shard_rows (row
+        padding to the data-axis extent, NamedSharding placement, padded
+        rows folded to zero weight through the mask product), the binned
+        matrix streams through _binned_to_device_sharded's per-shard
+        double-buffered blocks, and the returned arrays are global
+        row-sharded jax.Arrays ready for the shard_map training program."""
         n = x.shape[0]
         with timeline.span("aux_dispatch"):
-            y_d = jnp.asarray(y)
-            w_d = jnp.asarray(w)
-            t_d = jnp.asarray((~is_valid).astype(np.float32))
-            mg_d = (jnp.asarray(margin) if has_init
-                    else jnp.zeros((n, k), jnp.float32))
             gidx = None
-            if groups is not None:
-                from ...ops.ranking import make_group_layout
-                gidx = jnp.asarray(make_group_layout(groups).group_idx)
+            if mesh is None:
+                y_d = jnp.asarray(y)
+                w_d = jnp.asarray(w)
+                t_d = jnp.asarray((~is_valid).astype(np.float32))
+                mg_d = (jnp.asarray(margin) if has_init
+                        else jnp.zeros((n, k), jnp.float32))
+                if groups is not None:
+                    from ...ops.ranking import make_group_layout
+                    gidx = jnp.asarray(make_group_layout(groups).group_idx)
+            else:
+                # the canonical sharded layout: pad + NamedSharding
+                # placement + zero-weight fold all live in shard_rows
+                # (sharded fits match the serial path's y-as-f64 cast)
+                nd = mesh.shape[meshlib.DATA_AXIS]
+                n_pad = n + ((-n) % nd)
+                if has_init:
+                    y_d, t_d, mg_d, w_d, _mask = meshlib.shard_rows(
+                        mesh, y.astype(np.float64),
+                        (~is_valid).astype(np.float32), margin, weights=w)
+                else:
+                    # [N, K] zeros never cross the host link: the margin
+                    # is EXCLUDED from the transfer set and replaced by
+                    # uncommitted device zeros, resharded free at dispatch
+                    y_d, t_d, w_d, _mask = meshlib.shard_rows(
+                        mesh, y.astype(np.float64),
+                        (~is_valid).astype(np.float32), weights=w)
+                    mg_d = jnp.zeros((n_pad, k), jnp.float32)
         # forced-on fits pipeline at any size (>= 2 blocks whenever the
         # data allows), auto keeps the measured 4M-scale block size
-        blk = (max(1024, -(-n // 8)) if self.get("fitPipeline") == "on"
-               else None)
-        binned = self._binned_to_device(bm, x, blk=blk, timeline=timeline)
+        if mesh is not None:
+            nd = mesh.shape[meshlib.DATA_AXIS]
+            # forced-on: ~1024 global rows per super-block floor (the
+            # serial 'on' floor split over the shards), >= 2 blocks
+            # whenever the per-shard row count allows
+            blk = (max(1024 // nd, -(-n_pad // (8 * nd)))
+                   if self.get("fitPipeline") == "on" else None)
+            binned = self._binned_to_device_sharded(bm, x, mesh, blk=blk,
+                                                    timeline=timeline)
+        else:
+            blk = (max(1024, -(-n // 8)) if self.get("fitPipeline") == "on"
+                   else None)
+            binned = self._binned_to_device(bm, x, blk=blk,
+                                            timeline=timeline)
         return binned, (y_d, w_d, t_d, mg_d, gidx)
 
     def _extract_xyw(self, df: DataFrame
@@ -587,7 +698,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             and not self.get("modelString")
             and self.get("boostingType") != "dart"  # B x [T, N] delta memory
             and self._supports_vmap_fit()
-            and self.get("parallelism") != "voting_parallel")
+            and stratlib.normalize_parallelism(
+                self.get("parallelism")) != "voting_parallel")
         if not vmappable:
             return sequential()
 
@@ -686,7 +798,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             cat_smooth=self.get("catSmooth"),
             max_cat_threshold=self.get("maxCatThreshold"),
             axis_name=axis_name,
-            tree_learner=self.get("parallelism"),
+            # resolved by the comm-model chooser in _train_booster_once
+            # ('auto' never reaches the compiled config); the fallback
+            # covers direct _make_config callers outside a fit
+            tree_learner=(getattr(self, "_tree_learner_resolved", None)
+                          or stratlib.choose_strategy(
+                              self.get("parallelism"), 1, 1,
+                              self.get("maxBin"), self.get("numLeaves"),
+                              self.get("topK")).strategy),
             top_k=self.get("topK"),
             eval_metric=self._resolve_metric(
                 objective or self._objective_name(), num_class),
@@ -806,20 +925,32 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         # FitTimeline instead (overlap measured, not inferred).
         # the serial/sharded decision, made ONCE here and reused by the
         # mesh-placement code below (drift between two copies of this
-        # predicate would route a committed device array into place_global)
-        par = self.get("parallelism")
+        # predicate would route a committed device array into place_rows).
+        # parallelism='auto' (the default) resolves through the comm-model
+        # chooser: sharded whenever >1 device is visible, voting_parallel
+        # exactly where the closed-form traffic model predicts >= threshold
+        # savings over data_parallel (parallel/strategy.py; the dryrun
+        # measures 2.04x vs the model's 1.97x at F=512). The decision is
+        # published to the telemetry registry and attached to the booster.
         ndev = self.get("numTasks") or meshlib.device_count()
+        decision = stratlib.choose_strategy(
+            self.get("parallelism"), ndev, f, self.get("maxBin"),
+            self.get("numLeaves"), self.get("topK"),
+            # a vmapped candidate batch pins data_parallel: per-candidate
+            # voting programs would defeat the single compiled batch
+            allow_voting=getattr(self, "_hp_batch", None) is None)
+        par = decision.strategy
         serial = (par == "serial" or ndev <= 1)
+        self._tree_learner_resolved = par
+        self._strategy_decision = decision
         fp = self.get("fitPipeline")
         if fp not in ("auto", "on", "off"):
             raise ValueError(
                 f"fitPipeline must be auto, on or off, got {fp!r}")
-        if fp == "on" and not serial and prebinned is None:
-            raise ValueError(
-                "fitPipeline='on' requires a serial fit (parallelism="
-                "'serial' or one device/task): the sharded data plane "
-                "places padded global arrays, not a streaming block buffer")
-        _pipelined = (prebinned is None and serial
+        # the grouped (lambdarank) sharded layout reorders rows into
+        # group-aligned shards — incompatible with the streaming block
+        # buffer, so it keeps the one-shot placement path
+        _pipelined = (prebinned is None and (serial or groups is None)
                       and isinstance(x, np.ndarray) and x.ndim == 2
                       and (fp == "on"
                            or (fp == "auto" and _sw is None
@@ -856,7 +987,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 bm = self._fit_bin_mapper(x)
             self._missing_idx = self._missing_idx_of(bm)
             binned, _aux = self._pipelined_device_data(
-                bm, x, y, w, is_valid, margin, has_init, k, groups, _tl)
+                bm, x, y, w, is_valid, margin, has_init, k, groups, _tl,
+                mesh=None if serial else meshlib.get_mesh(ndev))
             if _sw is None:
                 _tl = None
         else:
@@ -880,11 +1012,13 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 raise ValueError(
                     "histScan='compact' requires histRefresh='eager' (lazy "
                     "has no per-split pass to compact)")
-            if self.get("parallelism") == "voting_parallel":
+            if par == "voting_parallel":
                 raise ValueError(
                     "histScan='compact' does not compose with "
                     "parallelism='voting_parallel' (voting needs full local "
-                    "histograms per slot)")
+                    "histograms per slot; with parallelism='auto' the comm "
+                    "model chose voting at this shape — set "
+                    "parallelism='data' to keep compact)")
         if self.get("splitsPerPass") > 1:
             if (self.get("histRefresh") == "lazy"
                     or self.get("histScan") == "compact"):
@@ -907,10 +1041,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                                     dtype=self.get("histDtype"))
             self._hist_method_resolved, self._hist_chunk_resolved = m, c
 
-        if par not in ("serial", "data_parallel", "voting_parallel"):
-            raise ValueError(
-                f"parallelism must be serial, data_parallel or "
-                f"voting_parallel, got {par!r}")
+        # par arrives pre-validated: choose_strategy normalizes the param
+        # (unknown values raise there, naming the accepted surface)
         if par == "voting_parallel" and self.get("topK") < 1:
             raise ValueError("topK must be >= 1 for voting_parallel")
         key = jax.random.PRNGKey(self.get("seed"))
@@ -951,7 +1083,9 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             cfg = self._make_config(num_class, axis, objective, has_init)
             m = meshlib.get_mesh(ndev)
             nd = m.shape[axis]
-            place = lambda a: meshlib.place_global(m, a, P(axis))
+            # replicated small state (PRNG key) keeps place_global — the
+            # device_put lint's allowlist; ROW data must go through
+            # shard_rows/place_rows below
             key = meshlib.place_global(m, key, P())
         if not serial and groups is not None:
             # group-aligned sharding: whole query groups per device
@@ -965,6 +1099,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 out[ok] = arr[lay.order[ok]]
                 return out
 
+            place = lambda a: meshlib.place_rows(m, a)
             gidx = place(lay.group_idx)
             w_pad = take_pad(w)  # padding rows (order == -1) get weight 0
             data = (place(take_pad(binned)),
@@ -977,18 +1112,27 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                          jchunk(*data, k, s, sc, lr, *(st or ()), gidx))
             n_rows_exec = lay.order.shape[0]
         elif not serial:
-            binned_p, _ = meshlib.pad_to_multiple(binned, nd)
-            y_p, _ = meshlib.pad_to_multiple(np.asarray(y, np.float64), nd)
-            w_p, _ = meshlib.pad_to_multiple(w, nd)  # padding rows weight 0
-            t_p, _ = meshlib.pad_to_multiple(is_train, nd)
-            m_p, _ = meshlib.pad_to_multiple(margin, nd)
-            data = (place(binned_p), place(y_p), place(w_p),
-                    place(t_p), place(m_p))
+            if _aux is not None:
+                # pipelined sharded construction: the binned matrix
+                # streamed through per-shard double-buffered blocks and
+                # every aux array was dispatched async under the block
+                # loop (already padded, row-sharded, zero-weight-folded)
+                y_d, w_d, t_d, mg_d, _gu = _aux
+                data = (binned, y_d, w_d, t_d, mg_d)
+            else:
+                # the canonical sharded layout: shard_rows pads the row
+                # dimension to the data axis, places with NamedSharding,
+                # and folds caller weights with the padding mask so a
+                # padded row can never carry weight into a histogram
+                b_p, y_p, t_p, m_p, w_p, _mask = meshlib.shard_rows(
+                    m, binned, np.asarray(y, np.float64), is_train, margin,
+                    weights=w)
+                data = (b_p, y_p, w_p, t_p, m_p)
             jfull, jchunk = _compiled_sharded(cfg, ndev, False)
             run_full = lambda k: jfull(*data, k)
             run_chunk = (lambda k, s, sc, lr, st=None:
                          jchunk(*data, k, s, sc, lr, *(st or ())))
-            n_rows_exec = binned_p.shape[0]
+            n_rows_exec = data[0].shape[0]
 
         rounds = self.get("earlyStoppingRound")
         delegate = self.get("delegate")
@@ -1071,6 +1215,34 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 os.replace(tmp, os.path.join(ckdir, "booster.txt"))
 
         _chunk_tl = None
+        _straggler_gap_s = None
+        if _sw is not None and not serial:
+            # per-shard straggler gap (arxiv 1612.01437: straggler
+            # structure, not FLOPs, dominates distributed wall): POLL
+            # every addressable shard of the binned matrix for readiness
+            # and stamp each shard's first-ready time — max-min is how
+            # long the slowest device's transfer trailed the fastest,
+            # resolved to the poll interval. Polling (is_ready) instead
+            # of sequential block_until_ready: blocking shard 0 first
+            # would hide any straggler that finished while we waited on
+            # it (visit-order bias). Timings mode only (this waits out
+            # every transfer); published as a registry gauge.
+            import time as _tm
+            shards = [s.data for s in data[0].addressable_shards]
+            first_ready = [None] * len(shards)
+            if shards and hasattr(shards[0], "is_ready"):
+                while any(t is None for t in first_ready):
+                    now = _tm.perf_counter()
+                    for i, sd in enumerate(shards):
+                        if first_ready[i] is None and sd.is_ready():
+                            first_ready[i] = now
+                    _tm.sleep(2e-4)
+            else:  # very old jax: fall back to the order-biased bound
+                for i, sd in enumerate(shards):
+                    jax.block_until_ready(sd)
+                    first_ready[i] = _tm.perf_counter()
+            _straggler_gap_s = ((max(first_ready) - min(first_ready))
+                                if first_ready else 0.0)
         if _sw is not None:
             import time as _tm
             if _tl is not None:
@@ -1141,12 +1313,19 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         # checkpoint resume), not the nominal request — the wall time
         # only covers this run, and rows*iter/s must not inflate on
         # resume.
+        booster.fit_strategy = decision._asdict()
+        if _straggler_gap_s is not None and _sw is not None:
+            timings["shard_straggler_gap_s"] = {
+                "total_s": _straggler_gap_s, "count": 1.0}
         try:
-            from ...observability import publish_fit_metrics
+            from ...observability import (publish_fit_metrics,
+                                          publish_multichip_fit)
             publish_fit_metrics(
                 n, self._iters_override or self.get("numIterations"),
                 __import__("time").perf_counter() - _t_fit0,
                 timings=getattr(booster, "fit_timings", None))
+            publish_multichip_fit(decision,
+                                  straggler_gap_s=_straggler_gap_s)
         except Exception:  # noqa: BLE001 - telemetry never fails a fit
             pass
         if ckdir:

@@ -56,7 +56,11 @@ class VowpalWabbitParamsBase(_p.HasFeaturesCol, _p.HasLabelCol,
         "online loop is minibatched for static shapes)", 256, int)
     numTasks = _p.Param(
         "numTasks", "data-parallel shards over the device mesh (reference: "
-        "Spark task count, ClusterUtil); 0 = all local devices", 1, int)
+        "Spark task count, ClusterUtil); 0 (default) = auto — all local "
+        "devices when the dataset is large enough to amortize sharding "
+        "(>= 2^17 rows; per-pass pmean weight averaging is the "
+        "reference's spanning-tree semantics, not bit-identical to the "
+        "serial SGD stream), one device below that", 0, int)
     useBarrierExecutionMode = _p.Param(
         "useBarrierExecutionMode", "accepted for API parity; SPMD launch is "
         "inherently gang-scheduled so this is a no-op", False, bool)
@@ -312,11 +316,27 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
              else np.ones(len(df), np.float32))
         return feats, y, w
 
+    #: auto-shard row floor: below this the serial stream wins (sharding
+    #: overhead + per-shard averaging noise buy nothing on small data)
+    AUTO_SHARD_MIN_ROWS = 1 << 17
+
+    def _resolve_num_tasks(self, n_rows: int) -> int:
+        """numTasks=0 (the default) is auto: the mesh is the default data
+        layout at scale — all local devices once the dataset can amortize
+        sharding, one device below the floor. Explicit values are
+        honored verbatim."""
+        nt = self.get("numTasks")
+        if nt:
+            return int(nt)
+        ndev = jax.local_device_count()
+        return ndev if (ndev > 1 and n_rows >= self.AUTO_SHARD_MIN_ROWS) \
+            else 1
+
     def _train_state(self, feats: SparseFeatures, y: np.ndarray,
                      w: np.ndarray) -> Tuple[VWState, np.ndarray, Dict]:
         eff = self._effective_params()
         nf = 1 << int(eff["numBits"])
-        ntasks = self.get("numTasks") or jax.local_device_count()
+        ntasks = self._resolve_num_tasks(len(y))
         mb = self.get("minibatchSize")
         # row-invariant index detection (dense feature columns and their
         # interactions hash to the same index vector on every row): checked
@@ -370,7 +390,17 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
                 train, mesh=mesh,
                 in_specs=(P(ax), P(ax), P(ax), P(ax), P()),
                 out_specs=(P(), P()), check_vma=False)
-            state, losses = jax.jit(sharded)(idx, val, yy, ww, state)
+            # the canonical sharded data layout (shard_rows: row padding
+            # to the axis extent + NamedSharding placement + caller
+            # weights folded with the padding mask) — pad_examples
+            # already rounded rows to mb*ntasks, so the mask is all-ones
+            # and shard_rows adds no further padding; each device's
+            # example shard rides its own host link
+            idx_s, val_s, y_s, w_s, _mask = meshlib.shard_rows(
+                mesh, idx, val, yy, weights=ww)
+            # the VWState pytree stays uncommitted (init_state zeros /
+            # warm-start asarray): jit replicates it per in_specs P()
+            state, losses = jax.jit(sharded)(idx_s, val_s, y_s, w_s, state)
         else:
             state, losses = jax.jit(train)(idx, val, yy, ww, state)
         jax.block_until_ready(state.w)

@@ -20,8 +20,9 @@ from typing import Any, Dict, Optional
 from .metrics import MetricsRegistry, get_registry
 
 __all__ = ["publish_stopwatch", "publish_fit_timeline",
-           "publish_fit_metrics", "classify_probe_outcome",
-           "publish_probe_outcome", "publish_bringup"]
+           "publish_fit_metrics", "publish_multichip_fit",
+           "classify_probe_outcome", "publish_probe_outcome",
+           "publish_bringup"]
 
 
 def publish_stopwatch(summary: Dict[str, Any], prefix: str = "fit_phase",
@@ -85,6 +86,59 @@ def publish_fit_metrics(rows: int, iters: int, wall_s: float,
     tl = timings.get("timeline") or {}
     if isinstance(tl, dict) and isinstance(tl.get("construction"), dict):
         publish_fit_timeline(tl["construction"], registry=reg)
+
+
+def publish_multichip_fit(decision, straggler_gap_s: Optional[float] = None,
+                          allreduce_wall_s: Optional[float] = None,
+                          registry: Optional[MetricsRegistry] = None) -> None:
+    """The multi-chip fit hook: every strategy decision (even 'serial' on
+    one device) lands as a bounded-label counter plus the comm-model
+    gauges, so the /metrics scrape and the bench snapshot show WHICH
+    learner ran, WHY (predicted voting advantage vs threshold), and what
+    it costs per split. Straggler gap and measured allreduce wall arrive
+    only from instrumented runs (collectFitTimings /
+    scripts/measure_multichip_fit.py) — absent means not measured, not
+    zero.
+
+    `decision` is a parallel/strategy.StrategyDecision (the strategy set
+    {serial, data_parallel, voting_parallel} x requested aliases is a
+    bounded label space)."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("gbdt_fit_strategy_selected_total",
+                    "fits per resolved tree-learner strategy",
+                    labels={"strategy": decision.strategy,
+                            "requested": decision.requested}).inc()
+        reg.gauge("gbdt_fit_ndev",
+                  "data-axis devices of the last fit (1 = serial)"
+                  ).set(float(decision.ndev))
+        reg.gauge("gbdt_fit_comm_bytes_per_split",
+                  "closed-form allreduce payload bytes per split at the "
+                  "last fit's shape", labels={"strategy": "data_parallel"}
+                  ).set(float(decision.dp_bytes_per_split))
+        reg.gauge("gbdt_fit_comm_bytes_per_split",
+                  "closed-form allreduce payload bytes per split at the "
+                  "last fit's shape", labels={"strategy": "voting_parallel"}
+                  ).set(float(decision.voting_bytes_per_split))
+        reg.gauge("gbdt_fit_voting_advantage",
+                  "predicted dp/voting traffic ratio at the last fit's "
+                  "shape (chooser threshold in "
+                  "gbdt_fit_voting_threshold)").set(float(decision.advantage))
+        reg.gauge("gbdt_fit_voting_threshold",
+                  "auto-mode ratio above which voting_parallel is chosen"
+                  ).set(float(decision.threshold))
+        if straggler_gap_s is not None:
+            reg.gauge("gbdt_fit_shard_straggler_gap_seconds",
+                      "slowest-minus-fastest shard transfer completion of "
+                      "the last instrumented sharded fit"
+                      ).set(float(straggler_gap_s))
+        if allreduce_wall_s is not None:
+            reg.gauge("gbdt_fit_allreduce_wall_seconds",
+                      "measured wall of one child-slice allreduce over "
+                      "the fit mesh (scripts/measure_multichip_fit.py)"
+                      ).set(float(allreduce_wall_s))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the fit
+        warnings.warn(f"publish_multichip_fit failed: {e}", stacklevel=2)
 
 
 #: bounded label set for bring-up probe outcomes — the raw outcome

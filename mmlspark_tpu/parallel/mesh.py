@@ -127,6 +127,22 @@ def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def place_rows(mesh: Mesh, arr) -> jax.Array:
+    """Row-shard a host array over the mesh data axis with an explicit
+    NamedSharding (row count must already be a multiple of the axis
+    size — shard_rows pads). Single-process: one async device_put whose
+    per-device pieces ride the host links in parallel (each device
+    receives only its shard — the sharded fit paths' transfer plane).
+    Multi-process: a global array assembled from each process's
+    addressable shards, as in place_global."""
+    arr = np.asarray(arr)
+    sharding = data_sharding(mesh, arr.ndim)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
@@ -148,19 +164,36 @@ def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0,
     return np.pad(arr, pad_widths, constant_values=fill), n
 
 
-def shard_rows(mesh: Mesh, *arrays: np.ndarray):
-    """Pad row dimension to the mesh data-axis size and device_put with row sharding.
+def shard_rows(mesh: Mesh, *arrays: np.ndarray, weights=None):
+    """Pad row dimension to the mesh data-axis size and place with row
+    sharding (NamedSharding via place_rows — multi-process safe). The
+    DEFAULT data layout of every sharded fit entry point (GBDT/VW).
 
-    Returns (sharded_arrays..., valid_mask) where valid_mask is 1.0 for real rows and
-    0.0 for padding — the masking discipline replacing StratifiedRepartition-style
-    partition invariants (SURVEY.md §7 hard parts).
+    Returns ``(*sharded_arrays, valid_mask)`` where valid_mask is 1.0
+    for real rows and 0.0 for padding — the masking discipline replacing
+    StratifiedRepartition-style partition invariants (SURVEY.md §7 hard
+    parts).
+
+    ``weights``: caller-supplied per-row sample weights. The zero-weight
+    contract for padded rows is enforced HERE — the returned weights are
+    ``weights * mask`` (padding slots zeroed) so no fit site can forget
+    the product and let a padded row carry the caller's weight into a
+    histogram. With weights the return is
+    ``(*sharded_arrays, sharded_weights, valid_mask)``.
     """
     ndev = mesh.shape[DATA_AXIS]
     n = arrays[0].shape[0]
-    out = []
-    for a in arrays:
-        padded, _ = pad_to_multiple(np.asarray(a), ndev, axis=0)
-        out.append(jax.device_put(padded, data_sharding(mesh, padded.ndim)))
+    out = [place_rows(mesh, pad_to_multiple(np.asarray(a), ndev, axis=0)[0])
+           for a in arrays]
     mask_host, _ = pad_to_multiple(np.ones(n, np.float32), ndev, axis=0)
-    mask = jax.device_put(mask_host, data_sharding(mesh, 1))
+    if weights is not None:
+        w = np.asarray(weights, np.float32)
+        if w.shape[0] != n:
+            raise ValueError(
+                f"weights rows {w.shape[0]} != data rows {n}")
+        w_pad, _ = pad_to_multiple(w, ndev, axis=0)
+        # padding slots are zero-filled by the pad AND re-masked: the
+        # product is the contract, not an artifact of the fill value
+        out.append(place_rows(mesh, w_pad * mask_host))
+    mask = place_rows(mesh, mask_host)
     return (*out, mask)

@@ -1,0 +1,192 @@
+"""Comm-model-driven tree-learner strategy selection for multi-chip fits.
+
+The reference exposes `parallelism` as a flag the user must already
+understand (LightGBMParams.scala:13-27: data_parallel reduces the full
+child histogram slice per split, voting_parallel reduces only the
+globally-voted top-k features). On a pod slice the right answer is a
+property of the problem shape, not of the user: per-split allreduce
+traffic has a closed form in (n_features, bins, num_leaves, top_k), the
+8-device dryrun validates it against the traced program to within 4%
+(MULTICHIP_r05: measured 2.04x vs closed form 1.97x at F=512), and
+arxiv 1612.01437 shows comm/straggler structure — not FLOPs — dominates
+distributed ML wall-clock. So `parallelism="auto"` (the default) picks
+the learner from the model below, and the decision lands in the
+telemetry registry where it can be audited.
+
+Closed form per split (f32 payload bytes, validated by
+tests/test_comm_volume.py's jaxpr psum-shape audit and the dryrun's
+trip-count-weighted byte walk):
+
+- data_parallel allreduces one child histogram slice ``[F, B, 3]``
+  (sibling subtraction covers the parent), plus an amortized root pass
+  and per-iteration metric scalars — measured ~3% above the slice alone.
+- voting_parallel allreduces the voted hists ``[L, top_k, B, 3]``, the
+  vote table ``[L, F]`` and per-leaf sums ``[L, 3]`` once per PASS; in
+  strict leaf-wise growth one pass == one split.
+
+The ratio dp/voting is independent of the device count (the ring factor
+2*(ndev-1)/ndev multiplies both sides), so `ndev` only gates serial vs
+sharded and scales the absolute byte gauges.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple, Optional
+
+#: bytes per histogram element (histograms allreduce in f32 even when the
+#: MXU contraction runs bf16 — accumulation dtype, ops/histogram.py)
+_F32 = 4
+
+#: dryrun-measured dp-side overhead above the closed-form child slice
+#: (root pass + per-iter metric scalars, amortized over splits):
+#: MULTICHIP_r05 measured 203.2 KB/split vs 196.6 KB closed form at
+#: F=512, B=32, L=31 — the voting side measured exactly closed-form.
+MEASURED_DP_OVERHEAD = 203.2 / 196.6
+
+#: minimum predicted dp/voting traffic ratio before `auto` deviates from
+#: the exact data_parallel learner. Voting is an approximation (top-k
+#: voted features can miss the globally best split), so it must buy a
+#: real traffic cut — the same bar the dryrun asserts on the measured
+#: ratio (__graft_entry__.dryrun_multichip: comm_ratio > 1.5) before
+#: certifying voting at a shape.
+VOTING_ADVANTAGE_THRESHOLD = 1.5
+
+#: user-facing `parallelism` values -> canonical tree learner. The short
+#: names are the documented surface; the long reference names
+#: (LightGBMExecutionParams.parallelism) stay accepted for compat.
+PARALLELISM_ALIASES = {
+    "auto": "auto",
+    "data": "data_parallel", "data_parallel": "data_parallel",
+    "voting": "voting_parallel", "voting_parallel": "voting_parallel",
+    "off": "serial", "serial": "serial",
+}
+
+
+def normalize_parallelism(value: str) -> str:
+    """Canonical learner name ('auto'|'serial'|'data_parallel'|
+    'voting_parallel') or ValueError naming the accepted surface."""
+    try:
+        return PARALLELISM_ALIASES[str(value)]
+    except KeyError:
+        raise ValueError(
+            f"parallelism must be one of {sorted(PARALLELISM_ALIASES)} "
+            f"(auto = comm-model choice, off/serial = single device), "
+            f"got {value!r}") from None
+
+
+def comm_bytes_per_split(n_features: int, bins: int, num_leaves: int,
+                         top_k: int, strategy: str) -> int:
+    """Closed-form allreduce PAYLOAD bytes per split (f32, no ring
+    factor) — the table the dryrun validates: 203.2/99.6 KB at
+    (F=512, B=32, L=31, K=3)."""
+    if strategy == "data_parallel":
+        return _F32 * n_features * bins * 3
+    if strategy == "voting_parallel":
+        k = min(int(top_k), int(n_features))
+        return _F32 * num_leaves * (k * bins * 3 + n_features + 3)
+    raise ValueError(f"no comm model for strategy {strategy!r}")
+
+
+def voting_advantage(n_features: int, bins: int, num_leaves: int,
+                     top_k: int) -> float:
+    """Predicted dp/voting traffic ratio (>1 = voting saves bytes);
+    ndev-independent (ring factor cancels)."""
+    return (comm_bytes_per_split(n_features, bins, num_leaves, top_k,
+                                 "data_parallel")
+            / comm_bytes_per_split(n_features, bins, num_leaves, top_k,
+                                   "voting_parallel"))
+
+
+class StrategyDecision(NamedTuple):
+    """The auditable record of one strategy choice (published to the
+    metrics registry and embedded in bench JSON)."""
+    strategy: str          # resolved learner: serial|data_parallel|voting_parallel
+    requested: str         # normalized user request (may be 'auto')
+    ndev: int              # data-axis extent the fit will use (1 = serial)
+    advantage: float       # predicted dp/voting bytes ratio at this shape
+    dp_bytes_per_split: int
+    voting_bytes_per_split: int
+    threshold: float
+    reason: str
+
+    def as_labels(self) -> dict:
+        return {"strategy": self.strategy, "requested": self.requested}
+
+
+def choose_strategy(requested: str, ndev: int, n_features: int, bins: int,
+                    num_leaves: int, top_k: int,
+                    allow_voting: bool = True) -> StrategyDecision:
+    """Resolve the user's `parallelism` request against the comm model.
+
+    - explicit 'serial'/'data_parallel'/'voting_parallel' (or their short
+      aliases) are honored verbatim — `auto` is a default, not a cage;
+    - 'auto' on one device is serial;
+    - 'auto' on >1 device picks voting_parallel exactly when the model
+      predicts >= VOTING_ADVANTAGE_THRESHOLD traffic savings
+      (allow_voting=False pins data_parallel — the vmapped sweep path,
+      where per-candidate voting programs would defeat the single
+      compiled batch).
+    """
+    req = normalize_parallelism(requested)
+    adv = voting_advantage(n_features, bins, num_leaves, top_k)
+    dp_b = comm_bytes_per_split(n_features, bins, num_leaves, top_k,
+                                "data_parallel")
+    vt_b = comm_bytes_per_split(n_features, bins, num_leaves, top_k,
+                                "voting_parallel")
+
+    def dec(strategy, reason):
+        # ndev records the extent the fit WILL use: a serial resolution
+        # runs on one device no matter how many are visible, and the
+        # gbdt_fit_ndev gauge documents 1 = serial
+        return StrategyDecision(strategy, req,
+                                1 if strategy == "serial" else ndev,
+                                adv, dp_b, vt_b,
+                                VOTING_ADVANTAGE_THRESHOLD, reason)
+
+    if req != "auto":
+        return dec(req, "explicit parallelism param")
+    if ndev <= 1:
+        return dec("serial", "one device visible")
+    if allow_voting and adv >= VOTING_ADVANTAGE_THRESHOLD:
+        return dec("voting_parallel",
+                   f"comm model: voting cuts per-split traffic "
+                   f"{adv:.2f}x >= {VOTING_ADVANTAGE_THRESHOLD}x")
+    if not allow_voting and adv >= VOTING_ADVANTAGE_THRESHOLD:
+        return dec("data_parallel",
+                   "voting profitable but pinned to data_parallel "
+                   "(vmapped candidate batch)")
+    return dec("data_parallel",
+               f"comm model: voting advantage {adv:.2f}x below "
+               f"{VOTING_ADVANTAGE_THRESHOLD}x threshold")
+
+
+def measure_allreduce_wall_s(mesh, n_features: int, bins: int,
+                             reps: int = 10) -> float:
+    """Measured wall of ONE child-slice ([F, B, 3] f32) allreduce over
+    the mesh's data axis — the per-split collective the comm model
+    prices. Warm compile excluded; min over reps (noisy-pool
+    discipline). Used by scripts/measure_multichip_fit.py and bench to
+    ground the closed-form byte gauges in a measured latency."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from . import mesh as meshlib
+
+    axis = meshlib.DATA_AXIS
+    ndev = mesh.shape[axis]
+    payload = jnp.ones((ndev, n_features, bins, 3), jnp.float32)
+
+    fn = jax.jit(meshlib.shard_map(
+        lambda a: jax.lax.psum(a, axis), mesh=mesh,
+        in_specs=P(axis), out_specs=P(axis), check_vma=False))
+    sh = meshlib.data_sharding(mesh, payload.ndim)
+    payload = jax.device_put(payload, sh)
+    jax.block_until_ready(fn(payload))  # compile + warm
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(payload))
+        best = min(best, time.perf_counter() - t0)
+    return best

@@ -22,7 +22,9 @@ EOF
     # cadence from this (fresh marker => 3x shorter inter-probe backoff)
     date +%s > scripts/tpu_last_healthy
     echo "== chip healthy $(date -u +%FT%TZ) — running the pending queue"
-    echo "== fit pipeline overlap (this round's tentpole) $(date -u +%FT%TZ)"
+    echo "== multichip fit scaling ladder (this round's tentpole) $(date -u +%FT%TZ)"
+    python -u scripts/measure_multichip_fit.py
+    echo "== fit pipeline overlap (round-7 tentpole) $(date -u +%FT%TZ)"
     python -u scripts/measure_fit_pipeline.py
     if ! python -u scripts/quick_fit_probe.py; then
       echo "== quick fit probe FAILED $(date -u +%FT%TZ); back to probing"

@@ -159,11 +159,22 @@ class TestFitPipelineParam:
         with pytest.raises(ValueError, match="fitPipeline"):
             LightGBMClassifier(fitPipeline="yes", **KW).fit(df)
 
-    def test_on_requires_serial(self):
-        df, _, _ = _make_df(n=256)
-        with pytest.raises(ValueError, match="serial"):
-            LightGBMClassifier(fitPipeline="on", numIterations=2,
-                               numTasks=8).fit(df)
+    def test_on_sharded_streams_blocks_and_matches(self):
+        """fitPipeline='on' on a sharded fit (PR 9 tentpole: previously a
+        ValueError) streams per-shard double-buffered blocks and produces
+        the same booster digest as the one-shot sharded placement."""
+        df, x, _ = _make_df(n=4096)   # 512 rows/shard -> 4 blocks each
+        kw = dict(KW)
+        kw.pop("numTasks")
+        one_shot = LightGBMClassifier(numTasks=8, **kw)
+        m_os = one_shot.fit(df)
+        assert one_shot._last_fit_pipelined is False
+        piped = LightGBMClassifier(numTasks=8, fitPipeline="on", **kw)
+        m_p = piped.fit(df)
+        assert piped._last_fit_pipelined is True
+        _strings_equal(m_os, m_p)
+        np.testing.assert_array_equal(m_os.booster.raw_predict(x),
+                                      m_p.booster.raw_predict(x))
 
     def test_auto_stays_sequential_small(self):
         """auto only pipelines at >= 2M rows: the small-fit predicate must
@@ -253,7 +264,8 @@ class TestSyncPointLint:
     backoff-loop lint: the concurrency property is enforced by CI."""
 
     #: functions whose bodies must be sync-free
-    TARGETS = ("_binned_to_device", "_pipelined_device_data", "_run_chunked")
+    TARGETS = ("_binned_to_device", "_binned_to_device_sharded",
+               "_pipelined_device_data", "_run_chunked")
     #: nested defs that ARE the designated sync points
     DESIGNATED = {"_fetch_chunk_host", "_finalize_chunks"}
     # np.asarray on a device array is an implicit blocking fetch — both the
