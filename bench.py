@@ -91,7 +91,8 @@ _PROBE_CAP_FROM_ENV = object()
 
 
 def _patient_backend_bringup(budget_s=None, retry_sleep_s=90, min_probe_s=60,
-                             max_probe_s=_PROBE_CAP_FROM_ENV, probe_fn=None):
+                             max_probe_s=_PROBE_CAP_FROM_ENV, probe_fn=None,
+                             blacklist_after_hangs=None):
     """Patient bounded TPU bring-up (round-3 verdict #1; probe policy
     revised per round-5 verdict #1).
 
@@ -128,12 +129,20 @@ def _patient_backend_bringup(budget_s=None, retry_sleep_s=90, min_probe_s=60,
                          "parent grant")
         os._exit(0)
 
+    if blacklist_after_hangs is None:
+        # compile-budget guard (ROADMAP item 4 slice): 4 hang-kills at
+        # the ~3 min cap is ~12 min of hang evidence inside the 22 min
+        # window — a pathological backend, not a busy one. 0 (or any
+        # non-positive value) disables the guard: keep probing all window
+        blacklist_after_hangs = int(
+            os.environ.get("BENCH_BLACKLIST_AFTER_HANGS", "4")) or None
     return backend_bringup(_PROBE_CODE, budget_s=budget_s,
                            retry_sleep_s=retry_sleep_s,
                            min_probe_s=min_probe_s,
                            max_probe_s=max_probe_s, log=_BRINGUP_LOG,
                            on_parent_hang=on_parent_hang,
-                           probe_fn=probe_fn, state_path=state_path)
+                           probe_fn=probe_fn, state_path=state_path,
+                           blacklist_after_hangs=blacklist_after_hangs)
 
 
 def main():

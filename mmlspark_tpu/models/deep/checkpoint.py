@@ -15,16 +15,34 @@ layout the templates carry — so a save from an N-host run restores onto an
 N-host run without gathering anything through one host. Resume equivalence
 (save -> restore -> identical loss trace) is pinned by
 tests/test_deep_checkpoint.py on the virtual 8-device mesh.
+
+Elastic additions (ISSUE 10): every save records a sibling mesh manifest
+(`<step_dir>.mesh.json`, written through the resilience atomic-write
+helper) naming the mesh axes/extents the state was laid out on.
+`restore_train_state` is the SAME-MESH contract — a mismatched mesh now
+fails with an error naming both shapes instead of orbax's raw sharding
+error — while `restore_train_state_resharded` is the documented elastic
+route for resuming across device counts/layouts: the arrays are read back
+from the (sharding-agnostic) on-disk tree and re-placed onto whatever mesh
+the templates carry. `keep_last` bounds the step-dir history (crash
+recovery needs the last snapshot or two, not every epoch of a long run).
 """
 
 from __future__ import annotations
 
+import json
 import os
+import shutil
+import warnings
 from typing import Any, Optional, Tuple
 
 import jax
 
-__all__ = ["save_train_state", "restore_train_state", "latest_step"]
+from ...parallel.mesh import describe_mesh
+from ...resilience.elastic import atomic_write_text, publish_event
+
+__all__ = ["save_train_state", "restore_train_state",
+           "restore_train_state_resharded", "latest_step", "gc_step_dirs"]
 
 
 _CKPTR = None
@@ -45,16 +63,49 @@ def _step_dir(path: str, step: Optional[int]) -> str:
     return os.path.join(path, f"step_{step:08d}") if step is not None else path
 
 
+def _mesh_manifest_path(step_dir: str) -> str:
+    # SIBLING of the orbax dir, not inside it: orbax owns the step dir's
+    # contents and a foreign file must not trip its format validation
+    return step_dir.rstrip(os.sep) + ".mesh.json"
+
+
+def _tree_mesh(*trees: Any) -> Optional[dict]:
+    """Mesh descriptor of the first NamedSharding-bearing leaf (the
+    training state is laid out on ONE mesh; mixed-mesh trees don't occur
+    in this codebase)."""
+    for leaf in jax.tree_util.tree_leaves(trees):
+        sh = getattr(leaf, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and getattr(mesh, "axis_names", None):
+            try:
+                return describe_mesh(mesh)
+            except Exception:  # noqa: BLE001 - descriptor is best-effort
+                return None
+    return None
+
+
 def save_train_state(path: str, params: Any, opt_state: Any,
-                     step: Optional[int] = None) -> str:
+                     step: Optional[int] = None,
+                     keep_last: Optional[int] = None) -> str:
     """Write (params, opt_state) under `path` (optionally path/step_NNNNNNNN).
 
-    Arrays keep their shardings; each process writes only local shards.
-    Returns the directory written."""
+    Arrays keep their shardings; each process writes only local shards. A
+    sibling ``<dir>.mesh.json`` manifest records the mesh layout (used by
+    restore to distinguish same-mesh from needs-reshard). ``keep_last``
+    applies keep-last-K retention to the step-dir history (None keeps
+    everything — the pre-elastic behavior). Returns the directory
+    written."""
     d = _step_dir(os.path.abspath(path), step)
     ckptr = _checkpointer()
     ckptr.save(d, {"params": params, "opt_state": opt_state}, force=True)
     ckptr.wait_until_finished()
+    desc = _tree_mesh(params, opt_state)
+    if desc is not None:
+        atomic_write_text(_mesh_manifest_path(d),
+                          json.dumps({"schema_version": 1, "mesh": desc,
+                                      "step": step}, sort_keys=True))
+    if keep_last is not None and step is not None:
+        gc_step_dirs(os.path.abspath(path), keep_last)
     return d
 
 
@@ -63,7 +114,8 @@ def latest_step(path: str) -> Optional[int]:
     try:
         # fully-numeric suffix only: an interrupted save leaves a sibling
         # 'step_N.orbax-checkpoint-tmp-<ts>' dir which must not crash (or
-        # win) the scan — crash recovery is exactly when this runs
+        # win) the scan — crash recovery is exactly when this runs; the
+        # .mesh.json manifests are filtered by the same rule
         steps = [int(n.split("_", 1)[1]) for n in os.listdir(path)
                  if n.startswith("step_") and n.split("_", 1)[1].isdigit()]
     except FileNotFoundError:
@@ -71,22 +123,108 @@ def latest_step(path: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def gc_step_dirs(path: str, keep_last: int) -> int:
+    """Keep-last-K retention for orbax step dirs: remove the oldest
+    step_NNNNNNNN dirs (and their mesh manifests) beyond ``keep_last``.
+    Interrupted-save tmp dirs are untouched (orbax's own cleanup owns
+    them). Returns the number of step dirs removed."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    try:
+        steps = sorted(int(n.split("_", 1)[1]) for n in os.listdir(path)
+                       if n.startswith("step_")
+                       and n.split("_", 1)[1].isdigit())
+    except FileNotFoundError:
+        return 0
+    removed = 0
+    for s in steps[:-keep_last]:
+        d = _step_dir(path, s)
+        shutil.rmtree(d, ignore_errors=True)
+        try:
+            os.remove(_mesh_manifest_path(d))
+        except OSError:
+            pass
+        removed += 1
+    if removed:
+        publish_event("gc", outcome="step_dirs")
+    return removed
+
+
+def _read_mesh_manifest(step_dir: str) -> Optional[dict]:
+    try:
+        with open(_mesh_manifest_path(step_dir), encoding="utf-8") as fh:
+            return json.load(fh).get("mesh")
+    except (OSError, ValueError):
+        return None
+
+
+def _abstract(params_like: Any, opt_state_like: Any) -> dict:
+    def absify(a):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+
+    return {"params": jax.tree_util.tree_map(absify, params_like),
+            "opt_state": jax.tree_util.tree_map(absify, opt_state_like)}
+
+
 def restore_train_state(path: str, params_like: Any, opt_state_like: Any,
                         step: Optional[int] = None) -> Tuple[Any, Any]:
-    """Restore (params, opt_state) with the templates' shapes, dtypes AND
-    shardings, so the restored arrays drop straight into the compiled step
-    function without relayout.
+    """SAME-MESH restore: (params, opt_state) with the templates' shapes,
+    dtypes AND shardings, so the restored arrays drop straight into the
+    compiled step function without relayout.
 
     Templates must carry the TARGET shardings: a live training state (step
     output) or a previously restored state. A fresh `shard_params` output
     does NOT work — its arrays sit committed on one device, and restoring
-    with that layout hands shard_map single-device operands it rejects."""
+    with that layout hands shard_map single-device operands it rejects.
+
+    The checkpoint's mesh manifest is checked against the templates'
+    mesh: a mismatch (resuming after losing a chip, or onto a resized
+    slice) raises a ValueError NAMING BOTH SHAPES — use
+    `restore_train_state_resharded` for that, which re-places the saved
+    arrays onto the current mesh."""
     d = _step_dir(os.path.abspath(path), step)
+    saved = _read_mesh_manifest(d)
+    cur = _tree_mesh(params_like, opt_state_like)
+    if saved is not None and cur is not None and saved != cur:
+        raise ValueError(
+            f"checkpoint {d} was written on mesh "
+            f"{dict(zip(saved['axis_names'], saved['shape']))} but the "
+            f"restore templates are laid out on mesh "
+            f"{dict(zip(cur['axis_names'], cur['shape']))}: a same-mesh "
+            f"restore cannot cross mesh shapes. Use "
+            f"restore_train_state_resharded(...) to restore this state "
+            f"onto the current mesh (re-shard-on-restore), or rebuild the "
+            f"saved mesh")
+    restored = _checkpointer().restore(d, _abstract(params_like,
+                                                    opt_state_like))
+    return restored["params"], restored["opt_state"]
 
-    def absify(a):
-        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
 
-    abstract = {"params": jax.tree_util.tree_map(absify, params_like),
-                "opt_state": jax.tree_util.tree_map(absify, opt_state_like)}
-    restored = _checkpointer().restore(d, abstract)
+def restore_train_state_resharded(path: str, params_like: Any,
+                                  opt_state_like: Any,
+                                  step: Optional[int] = None
+                                  ) -> Tuple[Any, Any]:
+    """ELASTIC restore across mesh layouts: resume a state saved at one
+    device count/topology onto whatever mesh the templates carry.
+
+    The on-disk tree (OCDBT) is sharding-agnostic: each array is read
+    back from the hosts' shard files and re-placed directly onto the
+    templates' shardings — the re-shard-on-restore route (restore to the
+    host-visible tree, place onto the current mesh) that replaces the
+    same-mesh contract when the pool shrinks or grows between runs. The
+    saved mesh manifest is informational here (a mismatch is the expected
+    case); numerically the restored arrays are identical to a same-mesh
+    restore, so a resumed step matches to fp determinism."""
+    d = _step_dir(os.path.abspath(path), step)
+    saved = _read_mesh_manifest(d)
+    cur = _tree_mesh(params_like, opt_state_like)
+    if saved is not None and cur is not None and saved == cur:
+        warnings.warn(
+            f"restore_train_state_resharded({d}): saved and current mesh "
+            f"match ({dict(zip(cur['axis_names'], cur['shape']))}) — the "
+            f"same-mesh restore_train_state is the cheaper path",
+            stacklevel=2)
+    restored = _checkpointer().restore(d, _abstract(params_like,
+                                                    opt_state_like))
+    publish_event("resume", outcome="reshard")
     return restored["params"], restored["opt_state"]

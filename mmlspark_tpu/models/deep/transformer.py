@@ -671,7 +671,16 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
         "the directory resumes from the latest epoch — shuffles are "
         "per-epoch seeded, so resume replays the uninterrupted run "
         "exactly. Checkpoints are kept on completion (epoch history); "
-        "start a fresh fit with a fresh directory", None)
+        "start a fresh fit with a fresh directory. A resume REQUIRES the "
+        "same mesh layout (a clear mesh-naming error otherwise); to "
+        "continue at a different device count restore through "
+        "models/deep/checkpoint.restore_train_state_resharded", None)
+    checkpointKeepLast = _p.Param(
+        "checkpointKeepLast",
+        "keep-last-K retention for checkpointDir epoch dirs (0 = keep "
+        "every epoch, the legacy history behavior). Crash recovery only "
+        "needs the newest snapshot or two; long fits should bound the "
+        "directory", 0, int)
 
     def __init__(self, **kw):
         super().__init__()
@@ -758,7 +767,9 @@ class TransformerEncoderClassifier(Estimator, _p.HasInputCol,
                                          jnp.asarray(y[idx]))
                 if ckdir:
                     from .checkpoint import save_train_state
-                    save_train_state(ckdir, p_st, o_st, step=ep + 1)
+                    keep = self.get("checkpointKeepLast") or None
+                    save_train_state(ckdir, p_st, o_st, step=ep + 1,
+                                     keep_last=keep)
             return p_st, o_st
 
         strategy = self.get("strategy")

@@ -29,11 +29,14 @@ from ...ops.boosting import (BoostResult, GBDTConfig, HParams, Tree,
                              make_train_fn)
 from ...parallel import mesh as meshlib
 from ...parallel import strategy as stratlib
+from ...resilience.elastic import (CheckpointStore, Preempted,
+                                   PreemptionDrain)
 from ...utils.profiling import NULL_TIMELINE, FitTimeline
 from .booster import Booster, concat_boosters
 
 Param = _p.Param
 
+import contextlib
 import copy
 import functools
 
@@ -306,19 +309,50 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         False, bool)
     checkpointDir = Param(
         "checkpointDir",
-        "directory for crash-resumable training: the booster-so-far is "
-        "written atomically (native text format) at every compiled-chunk "
-        "boundary, and a later fit() with the same checkpointDir resumes "
-        "from it, training only the REMAINING iterations (total stays "
-        "numIterations). The checkpoint is removed on successful "
-        "completion. Early-stopping counters and bagging keys (and the "
-        "fit's PRNG stream, which restarts from the seed) restart at the "
-        "resume point; with bagging off, resumed trees equal the "
-        "uninterrupted fit's. Delegate hooks and delegate-driven learning-"
-        "rate schedules see ABSOLUTE iteration indices (a resume continues "
-        "at the checkpointed tree count). Combine with itersPerCall to "
-        "bound the work lost to an interruption. Not supported with "
-        "numBatches>1, dart, or fit(df, paramMaps)", None)
+        "directory for preemption-safe elastic training: at every "
+        "compiled-chunk boundary the booster-so-far is written as a "
+        "durable snapshot (atomic write-to-temp + fsync + rename, native "
+        "text payload + a JSON manifest recording the content digest, "
+        "tree count, device count and batch index; keep-last-K retention "
+        "via checkpointKeepLast — resilience/elastic.CheckpointStore). A "
+        "later fit() with the same checkpointDir resumes from the newest "
+        "digest-valid snapshot — a corrupt/truncated newest snapshot "
+        "falls back to the previous one instead of crashing or silently "
+        "training from scratch — and trains only the REMAINING "
+        "iterations of the in-flight batch (total stays numIterations "
+        "per batch; the manifest's batch_index resumes numBatches>1 "
+        "fits mid-batch). The resume is ELASTIC: booster state is "
+        "replicated, so a snapshot written at ndev=N restores at ndev=M "
+        "— rows re-shard through parallel/mesh.shard_rows at the current "
+        "device count (docs/RESILIENCE.md contract). While the fit runs, "
+        "SIGTERM/SIGINT triggers a preemption drain (finish the "
+        "in-flight chunk, snapshot, raise resilience.Preempted within "
+        "drainGraceS). Snapshots are removed on successful completion. "
+        "Early-stopping counters and bagging keys (and the fit's PRNG "
+        "stream, which restarts from the seed) restart at the resume "
+        "point; with bagging off, resumed trees equal the uninterrupted "
+        "fit's. Delegate hooks and delegate-driven learning-rate "
+        "schedules see ABSOLUTE iteration indices (a resume continues at "
+        "the checkpointed tree count; completed batches' hooks are not "
+        "replayed). Combine with itersPerCall to bound the work lost to "
+        "an interruption. Not supported with dart (resume needs the "
+        "[T,N,K] dropout delta history — device training state a booster "
+        "snapshot's manifest does not carry) or fit(df, paramMaps)", None)
+    checkpointKeepLast = Param(
+        "checkpointKeepLast",
+        "snapshots retained in checkpointDir (keep-last-K retention). "
+        "Keep >= 2: the corrupt-newest fallback needs a previous "
+        "snapshot to restore from", 2, int)
+    drainGraceS = Param(
+        "drainGraceS",
+        "preemption-drain grace budget (seconds): after SIGTERM/SIGINT "
+        "the fit finishes the in-flight chunk and writes the snapshot; "
+        "if that cannot complete within the grace, the drain watchdog "
+        "hard-exits (status 75) before the pool's SIGKILL can land "
+        "mid-write. None (default) resolves the fleet-wide "
+        "MMLSPARK_TPU_DRAIN_GRACE_S env var, falling back to 30 s. Size "
+        "itersPerCall so one chunk always fits inside the pool's kill "
+        "grace", None)
     itersPerCall = Param(
         "itersPerCall",
         "split training into device programs of at most this many boosting "
@@ -842,27 +876,62 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         self._prebinned = None
         num_batches = self.get("numBatches")
         ckdir = self.get("checkpointDir")
+        self._ck_store = None
+        self._ck_resume_trees = 0
+        self._ck_resume_batch = 0
         if ckdir:
-            if num_batches and num_batches > 1:
-                raise ValueError(
-                    "checkpointDir is not supported with numBatches > 1 "
-                    "(the checkpoint does not record the batch index)")
-            ck_file = os.path.join(ckdir, "booster.txt")
-            self._ck_resume_trees = 0
-            if os.path.exists(ck_file):
+            store = CheckpointStore(ckdir,
+                                    keep_last=self.get("checkpointKeepLast"))
+            self._ck_store = store
+            restored = store.restore()
+            if restored is None:
+                legacy = os.path.join(ckdir, "booster.txt")
+                if os.path.exists(legacy):
+                    # pre-elastic single-file checkpoint (no manifest, no
+                    # digest): accepted once for continuity and superseded
+                    # by store snapshots at the first chunk boundary
+                    with open(legacy) as fh:
+                        restored = (fh.read(), None)
+            if restored is not None:
                 from .native_format import parse_model_string
+                payload, man = restored
                 # the checkpoint's tree count includes any modelString
                 # warm-start trees save_ck folded in — only the NEW trees
-                # count against this fit's numIterations budget
+                # of the in-flight batch count against numIterations
                 base_trees = (int(jax.tree_util.tree_leaves(
                     prev.trees)[0].shape[0]) if prev is not None else 0)
-                with open(ck_file) as fh:
-                    ck_prev = parse_model_string(fh.read())
+                ck_prev = parse_model_string(payload)
+                ck_trees = int(jax.tree_util.tree_leaves(
+                    ck_prev.trees)[0].shape[0])
                 # the checkpoint supersedes modelString: it was written by
                 # a fit that had already folded modelString into its margins
                 prev = ck_prev
-                self._ck_resume_trees = int(jax.tree_util.tree_leaves(
-                    ck_prev.trees)[0].shape[0]) - base_trees
+                if man is not None:
+                    self._ck_resume_batch = int(man.get("batch_index", 0))
+                    start_trees = int(man.get("extra", {}).get(
+                        "batch_start_trees", base_trees))
+                else:
+                    start_trees = base_trees
+                self._ck_resume_trees = ck_trees - start_trees
+                if num_batches and num_batches > 1 \
+                        and self._ck_resume_trees >= \
+                        self.get("numIterations"):
+                    # the crash landed in the window between a batch's
+                    # final snapshot and the next batch's first one: the
+                    # in-flight batch is count-complete, so resume STARTS
+                    # at the next batch — its delegate batch hooks must
+                    # not re-fire around a no-op train
+                    self._ck_resume_batch += 1
+                    self._ck_resume_trees = 0
+                # elastic-resume telemetry: was the snapshot written at a
+                # different device count than this fit resumes at? Booster
+                # state is replicated either way; rows re-shard at the
+                # current mesh (shard_rows) inside the fit below.
+                from ...resilience.elastic import publish_event
+                cur = self.get("numTasks") or meshlib.device_count()
+                same = man is None or int(man.get("ndev", cur)) == cur
+                publish_event("resume",
+                              outcome="same_ndev" if same else "reshard")
         if num_batches and num_batches > 1:
             rng = np.random.default_rng(self.get("seed"))
             if groups is not None:
@@ -880,6 +949,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             booster = prev
             delegate = self.get("delegate")
             for bi, part in enumerate(parts):
+                if bi < self._ck_resume_batch:
+                    # this batch's trees are already inside the restored
+                    # snapshot (its margins fold back in through `booster`
+                    # below); its delegate batch hooks ran in the crashed
+                    # fit and are not replayed
+                    continue
                 self._batch_index = bi
                 if delegate is not None:
                     delegate.before_train_batch(bi, None, booster)
@@ -892,13 +967,34 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     # dataset bins are full-data: slice rows, keep edges
                     prebinned=((pb[0], pb[1][part], pb[2])
                                if pb is not None else None))
+                # only the in-flight batch resumes mid-way; later batches
+                # train their full numIterations
+                self._ck_resume_trees = 0
                 if delegate is not None:
                     delegate.after_train_batch(bi, None, booster)
+            self._clear_checkpoints()
             return booster
         self._batch_index = 0
-        return self._train_booster_once(x, y, w, is_valid, num_class,
-                                        objective, init_score, prev, groups,
-                                        prebinned=pb)
+        booster = self._train_booster_once(x, y, w, is_valid, num_class,
+                                           objective, init_score, prev,
+                                           groups, prebinned=pb)
+        self._clear_checkpoints()
+        return booster
+
+    def _clear_checkpoints(self) -> None:
+        """A completed fit's snapshots are crash artifacts: remove them
+        (legacy single-file checkpoints included) so the next fit with
+        this checkpointDir starts fresh. Never called on the failure
+        path — a crash/drain leaves the snapshots for the resume."""
+        store = getattr(self, "_ck_store", None)
+        if store is None:
+            return
+        store.clear()
+        try:
+            os.remove(os.path.join(store.directory, "booster.txt"))
+        except OSError:
+            pass
+        self._iters_override = None
 
     def _train_booster_once(self, x: np.ndarray, y: np.ndarray, w: np.ndarray,
                             is_valid: np.ndarray, num_class: int,
@@ -1143,9 +1239,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             raise ValueError(
                 "checkpointDir is not supported with boostingType='dart': "
                 "resuming dropout needs the per-iteration delta history "
-                "([T,N,K] device state), which is training state, not a "
-                "booster checkpoint. itersPerCall DOES compose with dart "
-                "(the delta history is carried on-device across chunks)")
+                "([T,N,K] device state) — training state the snapshot "
+                "manifest does not carry (it would take a schema_version-2 "
+                "manifest recording the delta/rescale arrays beside "
+                "'step', resilience/elastic.SCHEMA_VERSION). itersPerCall "
+                "DOES compose with dart (the delta history is carried "
+                "on-device across chunks)")
         if rounds and has_valid and self.get("boostingType") == "dart":
             raise ValueError(
                 "earlyStoppingRound is not supported with "
@@ -1161,13 +1260,10 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             resume_trees = getattr(self, "_ck_resume_trees", 0)
             remaining = self.get("numIterations") - resume_trees
             if remaining <= 0:
-                # the crashed fit had already checkpointed every requested
-                # iteration: deliver it, and clear the crash artifact so
-                # the next fit with this dir starts fresh
-                try:
-                    os.remove(os.path.join(ckdir, "booster.txt"))
-                except FileNotFoundError:
-                    pass
+                # the crashed fit had already snapshotted every requested
+                # iteration of this batch: deliver it (the crash artifacts
+                # are cleared by _train_booster once the WHOLE fit — all
+                # batches — completes)
                 return prev
             if resume_trees:
                 self._iters_override = remaining
@@ -1204,15 +1300,30 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 
         save_ck = None
         if ckdir:
+            ck_store = self._ck_store
+            ck_ndev = 1 if serial else ndev
+            # trees in the booster when THIS batch began (warm start +
+            # completed batches; on a resume, `prev` additionally carries
+            # the in-flight batch's partial trees — subtract them): the
+            # manifest field a mid-batch resume subtracts from the
+            # snapshot's total to find the in-flight batch's progress
+            _batch_start_trees = (int(jax.tree_util.tree_leaves(
+                prev.trees)[0].shape[0]) if prev is not None else 0) \
+                - getattr(self, "_ck_resume_trees", 0)
+
             def save_ck(partial: BoostResult) -> None:
-                """Atomic booster-so-far snapshot at a chunk boundary."""
+                """Durable booster-so-far snapshot at a chunk boundary:
+                atomic payload + digest manifest, keep-last-K retention
+                (resilience/elastic.CheckpointStore)."""
                 bst = self._assemble_booster(partial, bm, num_class,
                                              objective, f, None, prev)
-                os.makedirs(ckdir, exist_ok=True)
-                tmp = os.path.join(ckdir, ".booster.txt.tmp")
-                with open(tmp, "w") as fh:
-                    fh.write(bst.model_string())
-                os.replace(tmp, os.path.join(ckdir, "booster.txt"))
+                ck_store.save(
+                    bst.model_string(),
+                    step=int(jax.tree_util.tree_leaves(
+                        bst.trees)[0].shape[0]),
+                    ndev=ck_ndev,
+                    batch_index=getattr(self, "_batch_index", 0),
+                    extra={"batch_start_trees": _batch_start_trees})
 
         _chunk_tl = None
         _straggler_gap_s = None
@@ -1276,9 +1387,23 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 
         def _boost():
             if use_chunked:
-                return self._run_chunked(
-                    run_chunk, key, n_rows_exec, k, rounds, has_valid,
-                    delegate, save_ck=save_ck, timeline=_chunk_tl)
+                # preemption drain: SIGTERM/SIGINT handlers live exactly as
+                # long as the chunk loop can act on them — the loop checks
+                # drain.requested at every chunk boundary, finishes the
+                # in-flight chunk, snapshots, and raises Preempted inside
+                # the grace budget
+                drain_cm = (PreemptionDrain(grace_s=self.get("drainGraceS"))
+                            if save_ck is not None
+                            else contextlib.nullcontext(None))
+                with drain_cm as drain:
+                    self._drain = drain
+                    try:
+                        return self._run_chunked(
+                            run_chunk, key, n_rows_exec, k, rounds,
+                            has_valid, delegate, save_ck=save_ck,
+                            timeline=_chunk_tl)
+                    finally:
+                        self._drain = None
             res = jax.tree.map(np.asarray, run_full(key))
             return res, self._select_best_iteration(res, has_valid)
 
@@ -1328,14 +1453,9 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                                   straggler_gap_s=_straggler_gap_s)
         except Exception:  # noqa: BLE001 - telemetry never fails a fit
             pass
-        if ckdir:
-            # the checkpoint is a crash artifact: a completed fit removes it
-            # so the next fit() with this dir starts fresh
-            try:
-                os.remove(os.path.join(ckdir, "booster.txt"))
-            except FileNotFoundError:
-                pass
-            self._iters_override = None
+        # checkpoint snapshots are NOT cleared here: numBatches>1 calls
+        # this once per batch, and only the whole fit's completion makes
+        # them safe to drop (_train_booster._clear_checkpoints)
         return booster
 
     def _assemble_booster(self, result: BoostResult, bm, num_class: int,
@@ -1429,6 +1549,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         tol = self.get("improvementTolerance")
         tl = timeline if timeline is not None else NULL_TIMELINE
         ahead = delegate is None and not (rounds and has_valid)
+        drain = getattr(self, "_drain", None)
+        # fit-level chaos hook (resilience.chaos.TrainingFaultInjector):
+        # fired per fetched chunk AFTER its snapshot landed — a seeded
+        # InjectedKill here is exactly a pool preemption's timing
+        boundary_hook = getattr(self, "_chunk_boundary_hook", None)
+        fetched_chunks = 0
 
         def _cat(a, b):
             return np.concatenate([a, b], axis=0)
@@ -1443,7 +1569,7 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             ahead-dispatch this whole body executes while the NEXT chunk
             runs on the device."""
             nonlocal trees_acc, tm_acc, vm_acc, best, best_at, stopped, \
-                init_out
+                init_out, fetched_chunks
             with tl.span(f"fetch_wait[{start}]", kind="wait"):
                 tm_h, vm_h = np.asarray(tm_c), np.asarray(vm_c)
             with tl.span(f"bookkeep[{start}]"):
@@ -1478,6 +1604,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                         break
                 if save_ck is not None:
                     save_ck(BoostResult(trees_acc, init_out, tm_acc, vm_acc))
+            if boundary_hook is not None:
+                # after the snapshot write: a kill injected here loses no
+                # durable state (the chaos contract under test)
+                idx = fetched_chunks
+                fetched_chunks += 1
+                boundary_hook(idx, start)
 
         def _finalize_chunks():
             """Designated end-of-training sync (dart's carried rescale
@@ -1497,6 +1629,9 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 
         pending = None
         while done < T and not stopped:
+            if drain is not None and drain.requested:
+                break  # preemption drain: the in-flight chunk (pending)
+                # is flushed + snapshotted below, then Preempted raised
             c = min(chunk, T - done)
             lrs = []
             for i in range(done, done + c):
@@ -1535,6 +1670,15 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 _fetch_chunk_host(*this)
         if pending is not None:
             _fetch_chunk_host(*pending)
+        if drain is not None and drain.requested and done < T and not stopped:
+            # the drained chunk's snapshot is durable: disarm the grace
+            # watchdog and surface the clean-exit contract
+            drain.completed()
+            raise Preempted(
+                f"fit drained after preemption signal: {done}/{T} "
+                f"iterations snapshotted to checkpointDir — re-run fit() "
+                f"with the same checkpointDir (at any device count) to "
+                f"resume")
         result = _finalize_chunks()
         best_iter = (best_at + 1) if (rounds and has_valid) else None
         return result, best_iter

@@ -29,15 +29,15 @@ from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
 from .tracing import (EventLog, TRACE_HEADER, mint_trace_id,
                       trace_id_from_headers)
 from .bridge import (classify_probe_outcome, publish_bringup,
-                     publish_fit_metrics, publish_fit_timeline,
-                     publish_multichip_fit, publish_probe_outcome,
-                     publish_stopwatch)
+                     publish_checkpoint_event, publish_fit_metrics,
+                     publish_fit_timeline, publish_multichip_fit,
+                     publish_probe_outcome, publish_stopwatch)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
     "EventLog", "TRACE_HEADER", "mint_trace_id", "trace_id_from_headers",
-    "classify_probe_outcome", "publish_bringup", "publish_fit_metrics",
-    "publish_fit_timeline", "publish_multichip_fit",
+    "classify_probe_outcome", "publish_bringup", "publish_checkpoint_event",
+    "publish_fit_metrics", "publish_fit_timeline", "publish_multichip_fit",
     "publish_probe_outcome", "publish_stopwatch",
 ]

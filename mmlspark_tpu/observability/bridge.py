@@ -22,7 +22,37 @@ from .metrics import MetricsRegistry, get_registry
 __all__ = ["publish_stopwatch", "publish_fit_timeline",
            "publish_fit_metrics", "publish_multichip_fit",
            "classify_probe_outcome", "publish_probe_outcome",
-           "publish_bringup"]
+           "publish_bringup", "publish_checkpoint_event"]
+
+#: checkpoint save/restore durations span ~1 ms (tiny boosters) to tens of
+#: seconds (orbax trees over NFS) — the serving-latency buckets top out
+#: far too low for them
+_CHECKPOINT_SECONDS_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0,
+                               30.0, 120.0)
+
+
+def publish_checkpoint_event(event: str, outcome: str = "ok",
+                             seconds: Optional[float] = None,
+                             registry: Optional[MetricsRegistry] = None
+                             ) -> None:
+    """One elastic-recovery event (resilience/elastic.py + the fit resume
+    paths) -> a bounded-label counter and, when timed, a duration
+    histogram. Events: save / restore / fallback / resume / drain_signal /
+    drain_complete / drain_grace_exceeded / gc; outcomes are bounded
+    per-event categories (ok, none, digest_mismatch, reshard, ...)."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("checkpoint_events_total",
+                    "elastic checkpoint/drain events by kind and outcome",
+                    labels={"event": event, "outcome": outcome}).inc()
+        if seconds is not None:
+            reg.histogram("checkpoint_event_seconds",
+                          "duration of timed elastic checkpoint events",
+                          labels={"event": event},
+                          buckets=_CHECKPOINT_SECONDS_BUCKETS
+                          ).observe(float(seconds))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail recovery
+        warnings.warn(f"publish_checkpoint_event failed: {e}", stacklevel=2)
 
 
 def publish_stopwatch(summary: Dict[str, Any], prefix: str = "fit_phase",
@@ -147,7 +177,7 @@ def publish_multichip_fit(decision, straggler_gap_s: Optional[float] = None,
 _PROBE_CATEGORIES = (("healthy", "healthy"), ("init hang", "hang"),
                      ("spawn failed", "spawn_failed"),
                      ("parent", "parent_init"), ("seed", "seed"),
-                     ("error", "error"))
+                     ("blacklisted", "blacklisted"), ("error", "error"))
 
 
 def classify_probe_outcome(outcome: str) -> str:

@@ -147,6 +147,16 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def describe_mesh(mesh: Mesh) -> dict:
+    """JSON-able mesh identity (ordered axis names + extents) — what the
+    checkpoint manifests record so a restore can tell same-mesh from
+    needs-reshard without touching orbax internals
+    (models/deep/checkpoint.py mesh manifest; resilience/elastic.py
+    snapshot `ndev`)."""
+    return {"axis_names": [str(a) for a in mesh.axis_names],
+            "shape": [int(mesh.shape[a]) for a in mesh.axis_names]}
+
+
 def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0,
                     fill=0) -> Tuple[np.ndarray, int]:
     """Pad along axis to a multiple; returns (padded, original_length).

@@ -1,4 +1,4 @@
-"""Unified resilience layer: retry policy, deadlines, fault injection.
+"""Unified resilience layer: retries, deadlines, chaos, elastic recovery.
 
 The single home for retry/backoff/deadline logic (reference:
 FaultToleranceUtils, HandlingUtils.sendWithRetries, the rendezvous retry
@@ -10,12 +10,18 @@ that no other module defines its own backoff loop.
 
 from .policy import (Attempt, Deadline, DeadlineExceeded, RetryError,
                      RetryPolicy, parse_retry_after)
-from .chaos import FaultInjector, InjectedDrop, InjectedFault
+from .chaos import (FaultInjector, InjectedDrop, InjectedFault, InjectedKill,
+                    TrainingFaultInjector)
 from .bringup import backend_bringup
+from .elastic import (CheckpointStore, Preempted, PreemptionDrain,
+                      atomic_write_bytes, atomic_write_text)
 
 __all__ = [
     "Attempt", "Deadline", "DeadlineExceeded", "RetryError", "RetryPolicy",
     "parse_retry_after",
-    "FaultInjector", "InjectedDrop", "InjectedFault",
+    "FaultInjector", "InjectedDrop", "InjectedFault", "InjectedKill",
+    "TrainingFaultInjector",
     "backend_bringup",
+    "CheckpointStore", "Preempted", "PreemptionDrain",
+    "atomic_write_bytes", "atomic_write_text",
 ]

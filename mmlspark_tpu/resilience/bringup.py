@@ -92,7 +92,8 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
                     on_parent_hang: Optional[Callable[[], None]] = None,
                     probe_fn: Optional[Callable[[], str]] = None,
                     state_path: Optional[str] = None,
-                    state_fresh_s: float = 900.0
+                    state_fresh_s: float = 900.0,
+                    blacklist_after_hangs: Optional[int] = None
                     ) -> Tuple[object, list, Optional[str], List[dict]]:
     """Probe the backend until healthy or the budget ends, capping each
     probe at `max_probe_s` so one hang cannot eat the window.
@@ -109,6 +110,15 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
     state_path: optional last-known-healthy marker written by
     scripts/tpu_recovery_watch.sh; a fresh marker (< state_fresh_s old)
     shrinks the inter-probe backoff 3x.
+    blacklist_after_hangs: ROADMAP item 4's compile-budget guard — a
+    backend whose init/compile hangs this many times in one window is
+    PATHOLOGICAL (wedged grant, runaway compile), not merely busy: the
+    hung probe is killed as usual and the backend is then BLACKLISTED for
+    the rest of the window (no further probes; immediate CPU fallback
+    with a 'blacklisted' record), instead of feeding it the remaining
+    budget one capped probe at a time. None (default) keeps probing —
+    hangs and recoveries interleave on the shared pool, so the bar is
+    opt-in per caller (bench.py sets it from BENCH_BLACKLIST_AFTER_HANGS).
     Returns (jax, devices, error_or_None, attempts).
     """
     import subprocess
@@ -127,6 +137,8 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
     policy = RetryPolicy(attempts=None, backoff_s=retry_sleep_s,
                          multiplier=1.0, jitter=0.1,
                          max_backoff_s=retry_sleep_s * 1.2)
+    hang_kills = 0
+    blacklisted = False
     # min_attempt_s: don't spawn a probe that can't get a fair shot — a
     # probe killed seconds into init is both useless and (if the pool is in
     # hang mode) a fresh grant-holding kill
@@ -176,6 +188,20 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
             # LOOPING — the next attempt may land in a recovery window
             attempts.append(a.record(
                 f"init hang — killed at probe cap ({round(dur)}s)", dur))
+            hang_kills += 1
+            if blacklist_after_hangs is not None \
+                    and blacklist_after_hangs > 0 \
+                    and hang_kills >= blacklist_after_hangs:
+                # pathologically-compiling/wedged backend: killed for the
+                # last time and barred for the rest of this window — the
+                # remaining budget goes to the caller (CPU fallback), not
+                # to more doomed probes
+                blacklisted = True
+                attempts.append(a.record(
+                    f"blacklisted: {hang_kills} init hangs in "
+                    f"{round(time.time() - t0)}s — backend barred for "
+                    f"the rest of the window"))
+                break
             continue
         platform = out.strip().rsplit(" ", 1)[-1] if out.strip() else "?"
         if rc == 0 and platform not in ("cpu", "?"):
@@ -217,12 +243,15 @@ def backend_bringup(probe_code: str, budget_s: float = 1320.0,
         pass
     n_probes = sum(1 for a in attempts
                    if not a["outcome"].startswith(("parent", "healthy",
-                                                   "seed")))
+                                                   "seed", "blacklisted")))
     err_msg = (f"no healthy TPU across {n_probes} probe(s) in a "
                f"{round(time.time() - t0)} s bring-up window"
+               + (f" (backend blacklisted after {hang_kills} init hangs)"
+                  if blacklisted else "")
                + (" (a probe succeeded but the parent's own init failed)"
                   if n_probes != sum(1 for a in attempts
-                                     if not a["outcome"].startswith("seed"))
+                                     if not a["outcome"].startswith(
+                                         ("seed", "blacklisted")))
                   else ""))
     try:
         devs = jax.devices()

@@ -16,6 +16,14 @@ Fault kinds:
   1612.01437: straggler behavior dominates tail latency).
 - drop: raise `InjectedDrop` (a TimeoutError) before invoking — models a
   request lost in flight with no response ever coming back.
+
+`TrainingFaultInjector` extends the suite from transport faults to
+TRAINING faults (ISSUE 10): a seeded kill at a chunk boundary (the GBDT
+chunk loop's `_chunk_boundary_hook`, fired after that chunk's snapshot
+lands — exactly a pool preemption's timing), a seeded device-loss
+downshift (resume at fewer devices than the killed fit), and snapshot
+corruption (truncation / bit flips / tmp litter) against which
+`resilience.elastic.CheckpointStore`'s digest fallback is proved.
 """
 
 from __future__ import annotations
@@ -23,7 +31,7 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 
 class InjectedFault(ConnectionError):
@@ -32,6 +40,11 @@ class InjectedFault(ConnectionError):
 
 class InjectedDrop(TimeoutError):
     """A chaos-injected silent drop (no reply ever arrives)."""
+
+
+class InjectedKill(RuntimeError):
+    """A chaos-injected process death (pool preemption / OOM-kill): the
+    fit dies at a chunk boundary, after that chunk's snapshot landed."""
 
 
 class FaultInjector:
@@ -107,3 +120,96 @@ class FaultInjector:
                 time.sleep(self.delay_s)
             return fn(*args, **kw)
         return chaotic
+
+
+class TrainingFaultInjector:
+    """Seeded fit-level faults: kill-at-chunk-boundary + ndev downshift.
+
+    ``arm(estimator)`` installs ``chunk_boundary`` as the estimator's
+    `_chunk_boundary_hook`; the GBDT chunk loop calls it (inside the
+    designated host-sync point, AFTER the chunk's snapshot write) with
+    the chunk's starting iteration. The kill boundary comes from the seed
+    unless pinned, so a chaos run replays exactly — the same determinism
+    contract as `FaultInjector.schedule`.
+
+    ``self.counts`` stays an INDEPENDENT tally (boundaries seen, kills
+    fired) so tests can reconcile registry counters against ground truth
+    that does not share the registry's code path.
+    """
+
+    def __init__(self, seed: int = 0, kill_at_chunk: Optional[int] = None,
+                 max_chunk: int = 4):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.kill_at_chunk = (self._rng.randrange(max_chunk)
+                              if kill_at_chunk is None else int(kill_at_chunk))
+        self.counts: Dict[str, int] = {"boundaries": 0, "kills": 0}
+
+    def chunk_boundary(self, chunk_index: int, start_iter: int) -> None:
+        """The fit loop's per-chunk callback; raises `InjectedKill` at the
+        scheduled boundary. The kill ordinal counts boundaries GLOBALLY
+        across an estimator's whole fit (numBatches>1 restarts
+        `chunk_index` per batch — a global ordinal can kill mid-batch-1,
+        a per-batch one never could)."""
+        idx = self.counts["boundaries"]
+        self.counts["boundaries"] += 1
+        if idx != self.kill_at_chunk:
+            return
+        self.counts["kills"] += 1
+        try:
+            from ..observability import get_registry
+            get_registry().counter(
+                "chaos_injected_total", "chaos decisions by kind",
+                labels={"kind": "train_kill"}).inc()
+        except Exception:  # noqa: BLE001 - telemetry must not alter chaos
+            pass
+        raise InjectedKill(
+            f"injected kill at chunk boundary {chunk_index} "
+            f"(iteration {start_iter}: snapshot already durable)")
+
+    def arm(self, estimator):
+        """Install on a LightGBM-style estimator; returns it for chaining."""
+        estimator._chunk_boundary_hook = self.chunk_boundary
+        return estimator
+
+    def downshift_ndev(self, ndev: int) -> int:
+        """Seeded device-loss model: a resume-time device count drawn
+        (seeded) from the proper divisors of ``ndev`` — the shrunken mesh
+        must still evenly tile the original shard layout's row space."""
+        divisors = [d for d in range(1, ndev) if ndev % d == 0]
+        if not divisors:
+            raise ValueError(f"cannot downshift from ndev={ndev}")
+        return self._rng.choice(divisors)
+
+    @staticmethod
+    def corrupt_latest_snapshot(store, mode: str = "truncate") -> int:
+        """Damage the newest committed snapshot's payload — the
+        crash-during/after-write fault the digest check exists to catch.
+        ``truncate`` halves the file (torn write); ``flip`` xors one byte
+        (bit rot); ``tmp_litter`` only drops an interrupted temp file
+        beside the snapshots (must be IGNORED by restore, not a fault).
+        Returns the affected sequence number."""
+        seqs = store.snapshot_seqs()
+        if not seqs:
+            raise ValueError("store holds no snapshot to corrupt")
+        seq = seqs[-1]
+        ppath, _ = store._paths(seq)
+        if mode == "tmp_litter":
+            import os
+            with open(os.path.join(store.directory,
+                                   ".snapshot_corrupt.txt.tmp"), "w") as fh:
+                fh.write("torn")
+            return seq
+        with open(ppath, "r+b") as fh:
+            data = fh.read()
+            fh.seek(0)
+            if mode == "truncate":
+                fh.truncate(0)
+                fh.write(data[:max(1, len(data) // 2)])
+            elif mode == "flip":
+                mid = len(data) // 2
+                fh.write(data[:mid] + bytes([data[mid] ^ 0xFF])
+                         + data[mid + 1:])
+            else:
+                raise ValueError(f"unknown corruption mode {mode!r}")
+        return seq

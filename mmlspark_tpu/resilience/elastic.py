@@ -1,0 +1,380 @@
+"""Elastic-recovery layer for training: durable checkpoints + preemption drain.
+
+On shared TPU pools preemption is the normal case, not the exception —
+failure/straggler recovery structure, not steady-state compute, dominates
+distributed ML wall-clock (arxiv 1612.01437) — and PR 9's mesh-default fit
+means one preempted chip now loses an entire 8-shard fit. The reference
+inherited Spark's task-retry lineage story (PAPER.md §0); this module is
+the TPU-native replacement, built around three primitives:
+
+- ``atomic_write_bytes``/``atomic_write_text`` — THE one write-to-temp +
+  fsync + rename helper. Every checkpoint byte in the codebase goes
+  through it (tests/test_elastic.py lints that no checkpoint-owning
+  module opens a file for writing or calls os.replace anywhere else), so
+  a crash can truncate only a temp file, never a committed snapshot.
+- ``CheckpointStore`` — numbered snapshots, each a payload file plus a
+  JSON manifest (schema version, sha256 content digest, step, ndev,
+  batch index). The manifest is written AFTER its payload: a snapshot
+  without a valid manifest is in-progress garbage, not state. Restore
+  walks newest-first, verifies the digest, and falls back to the
+  previous snapshot on a corrupt/truncated file instead of crashing —
+  keep-last-K retention guarantees there is a previous one. Save /
+  restore / fallback events land in the PR 8 metrics registry.
+- ``PreemptionDrain`` — a SIGTERM/SIGINT handler installed for the
+  duration of fit(): the first signal requests a drain (finish the
+  in-flight chunk, write the snapshot, raise ``Preempted``) and arms a
+  grace-budget watchdog that hard-exits if the drain cannot complete in
+  time; a second signal interrupts immediately. Wired into the GBDT
+  chunk loop (models/lightgbm/base.py) and honored by
+  scripts/tpu_recovery_watch.sh, which forwards TERM to its children.
+
+The elastic-resume CONTRACT this enables (docs/RESILIENCE.md): booster
+state is replicated, row data is not — a snapshot written at ndev=N
+restores at ndev=M because resume re-bins and re-shards rows through
+`parallel/mesh.shard_rows` at the CURRENT device count, and PR 9's
+sharded==serial digest gate makes the result provably identical to an
+uninterrupted serial fit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import signal
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "SCHEMA_VERSION", "Preempted", "atomic_write_bytes", "atomic_write_text",
+    "CheckpointStore", "PreemptionDrain", "publish_event",
+]
+
+#: manifest schema. v1: digest/payload/step/ndev/batch_index/extra. Bump on
+#: any field whose ABSENCE a reader cannot default (dart resume would be
+#: v2: it additionally needs the per-iteration dropout delta history —
+#: device training state the booster payload does not carry).
+SCHEMA_VERSION = 1
+
+_SNAP_RE = re.compile(r"^snapshot_(\d{8})\.json$")
+
+
+class Preempted(RuntimeError):
+    """A fit drained cleanly after SIGTERM/SIGINT: the in-flight chunk was
+    finished and snapshotted. Re-running fit() with the same checkpointDir
+    resumes from that snapshot (at any device count)."""
+
+
+def publish_event(event: str, outcome: str = "ok",
+                  seconds: Optional[float] = None) -> None:
+    """Checkpoint/drain telemetry — guarded: the elastic layer (and every
+    resume/GC site that reports through it) must keep working with the
+    observability layer broken or mid-shutdown. The ONE guarded wrapper:
+    callers never hand-roll the try/import/except-pass pattern."""
+    try:
+        from ..observability import publish_checkpoint_event
+        publish_checkpoint_event(event, outcome=outcome, seconds=seconds)
+    except Exception:  # noqa: BLE001 - telemetry never fails recovery
+        pass
+
+
+_publish = publish_event  # internal alias
+
+
+# ------------------------------------------------------------ atomic write
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """THE durable-write primitive: temp file in the destination directory
+    -> flush -> fsync -> rename over the target -> fsync the directory.
+    A crash at any point leaves either the old committed file or a stray
+    ``.tmp`` — never a truncated target (the fsync-before-rename ordering
+    is what makes the rename a commit point on a journaled fs)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix="." + os.path.basename(path) + ".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; rename still atomic
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def _digest(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+# --------------------------------------------------------- checkpoint store
+
+class CheckpointStore:
+    """Durable, integrity-checked, keep-last-K snapshot directory.
+
+    Layout: ``snapshot_NNNNNNNN.txt`` (payload) + ``snapshot_NNNNNNNN.json``
+    (manifest) per snapshot, NNNNNNNN a monotonically increasing sequence.
+    The manifest commits a snapshot (written after the payload, both via
+    the atomic helper): restore treats payload-without-manifest as an
+    interrupted save and skips it silently; manifest-with-bad-payload is a
+    FALLBACK event (counted, warned) and restore returns the previous
+    snapshot. ``keep_last`` >= 2 so there always IS a previous snapshot to
+    fall back to.
+    """
+
+    def __init__(self, directory: str, keep_last: int = 2):
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        self.directory = os.path.abspath(directory)
+        self.keep_last = int(keep_last)
+
+    # ------------------------------------------------------------- listing
+    def snapshot_seqs(self) -> List[int]:
+        """Committed (manifest-bearing) snapshot sequence numbers, oldest
+        first. In-progress payloads and stray tmp litter are invisible."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        return sorted(int(m.group(1)) for n in names
+                      if (m := _SNAP_RE.match(n)))
+
+    def _paths(self, seq: int) -> Tuple[str, str]:
+        base = os.path.join(self.directory, f"snapshot_{seq:08d}")
+        return base + ".txt", base + ".json"
+
+    # ---------------------------------------------------------------- save
+    def save(self, payload: str, *, step: int, ndev: int,
+             batch_index: int = 0,
+             extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Write one snapshot (payload then manifest, both atomic), then
+        apply keep-last-K retention. Returns the manifest dict."""
+        t0 = time.perf_counter()
+        data = payload.encode("utf-8")
+        seqs = self.snapshot_seqs()
+        seq = (seqs[-1] + 1) if seqs else 0
+        ppath, mpath = self._paths(seq)
+        manifest = {
+            "schema_version": SCHEMA_VERSION,
+            "payload": os.path.basename(ppath),
+            "digest": _digest(data),
+            "bytes": len(data),
+            "step": int(step),
+            "ndev": int(ndev),
+            "batch_index": int(batch_index),
+            "extra": dict(extra or {}),
+        }
+        try:
+            atomic_write_bytes(ppath, data)
+            atomic_write_text(mpath, json.dumps(manifest, sort_keys=True))
+        except BaseException:
+            _publish("save", outcome="error")
+            raise
+        self._gc(keep=self.keep_last)
+        _publish("save", seconds=time.perf_counter() - t0)
+        return manifest
+
+    def _gc(self, keep: int) -> None:
+        for seq in self.snapshot_seqs()[:-keep] if keep else []:
+            self._remove(seq)
+
+    def _remove(self, seq: int) -> None:
+        for p in self._paths(seq):
+            try:
+                os.remove(p)
+            except OSError:
+                # a read-only/permission-lost dir (common post-crash state)
+                # must not break restore's never-crash contract: the corpse
+                # stays, the fallback still returns the valid snapshot
+                pass
+
+    # ------------------------------------------------------------- restore
+    def restore(self) -> Optional[Tuple[str, Dict[str, Any]]]:
+        """Newest digest-valid snapshot as ``(payload, manifest)``, or None
+        when the store holds none. A corrupt/truncated newest snapshot is
+        a counted FALLBACK to the one before it — never a crash, and never
+        a silent train-from-scratch (the caller sees None only when no
+        committed snapshot verifies)."""
+        t0 = time.perf_counter()
+        seqs = self.snapshot_seqs()
+        for seq in reversed(seqs):
+            ppath, mpath = self._paths(seq)
+            reason = None
+            try:
+                with open(mpath, encoding="utf-8") as fh:
+                    manifest = json.load(fh)
+            except (OSError, ValueError):
+                reason = "manifest_unreadable"
+            else:
+                if int(manifest.get("schema_version", -1)) > SCHEMA_VERSION:
+                    reason = "schema_newer_than_reader"
+                else:
+                    try:
+                        with open(ppath, "rb") as fh:
+                            data = fh.read()
+                    except OSError:
+                        reason = "payload_missing"
+                    else:
+                        if _digest(data) != manifest.get("digest"):
+                            reason = "digest_mismatch"
+            if reason is None:
+                _publish("restore", seconds=time.perf_counter() - t0)
+                return data.decode("utf-8"), manifest
+            import warnings
+            warnings.warn(
+                f"checkpoint snapshot_{seq:08d} failed verification "
+                f"({reason}); falling back to the previous snapshot",
+                stacklevel=2)
+            _publish("fallback", outcome=reason)
+            if reason != "schema_newer_than_reader":
+                # drop the corpse NOW: a corrupt snapshot left in place
+                # would count toward keep-last-K retention and could evict
+                # the valid previous snapshot on the next save (a newer-
+                # schema snapshot is NOT a corpse — a newer reader may
+                # still want it)
+                self._remove(seq)
+        _publish("restore", outcome="none",
+                 seconds=time.perf_counter() - t0)
+        return None
+
+    # --------------------------------------------------------------- clear
+    def clear(self) -> None:
+        """Remove every snapshot (and orphaned payloads/tmp litter) — the
+        crash artifacts of a now-completed fit."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for n in names:
+            if n.startswith((".snapshot_", "snapshot_")):
+                try:
+                    os.remove(os.path.join(self.directory, n))
+                except OSError:
+                    pass
+
+
+# --------------------------------------------------------- preemption drain
+
+#: default drain grace (seconds) — shared pools typically send SIGTERM
+#: ~30 s before SIGKILL; override per-fit via the estimator param or
+#: globally via this env var
+DRAIN_GRACE_ENV = "MMLSPARK_TPU_DRAIN_GRACE_S"
+
+
+class PreemptionDrain:
+    """SIGTERM/SIGINT -> finish the in-flight chunk, snapshot, exit clean.
+
+    Context manager installed for the duration of fit(). First signal:
+    ``requested`` flips True (the chunk loop checks it at every chunk
+    boundary and raises ``Preempted`` after the snapshot lands) and a
+    watchdog timer is armed with the grace budget — if the drain cannot
+    complete in time (a chunk longer than the pool's kill grace), the
+    watchdog hard-exits with status 75 (EX_TEMPFAIL: retryable) rather
+    than letting SIGKILL fall mid-write. Second signal: immediate
+    ``KeyboardInterrupt`` (the operator insists).
+
+    A signal that arrives too late to drain anything — during the FINAL
+    chunk, or after early stopping — must not be swallowed: if the
+    context exits with ``requested`` set but the drain never completed,
+    ``__exit__`` re-delivers the signal to the process AFTER restoring
+    the previous handlers, so the default disposition (or an outer
+    handler) runs exactly as if the drain had never intercepted it. The
+    just-finished fit's snapshots are still on disk at that point, so the
+    re-delivered SIGTERM costs nothing: the next run resumes with zero
+    remaining iterations and delivers the model instantly.
+
+    Handlers install only in the main thread (signal.signal raises
+    elsewhere); off-main-thread fits get a no-op drain, recorded on
+    ``installed``. Previous handlers are restored on exit.
+    """
+
+    def __init__(self, grace_s: Optional[float] = None,
+                 signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
+                 on_grace_exceeded=None):
+        if grace_s is None:
+            grace_s = float(os.environ.get(DRAIN_GRACE_ENV, "30"))
+        self.grace_s = float(grace_s)
+        self.signals = tuple(signals)
+        self._on_grace_exceeded = on_grace_exceeded or (lambda: os._exit(75))
+        self._prev: Dict[int, Any] = {}
+        self._watchdog: Optional[threading.Timer] = None
+        self._requested_at: Optional[float] = None
+        self._signum: Optional[int] = None
+        self.installed = False
+        self.drained = False
+
+    # ------------------------------------------------------------- signals
+    def _handler(self, signum, frame):
+        if self._requested_at is not None:
+            raise KeyboardInterrupt(
+                f"second signal {signum} during drain — interrupting")
+        self._requested_at = time.perf_counter()
+        self._signum = signum
+        _publish("drain_signal", outcome=f"sig{signum}")
+        self._watchdog = threading.Timer(self.grace_s, self._grace_exceeded)
+        self._watchdog.daemon = True
+        self._watchdog.start()
+
+    def _grace_exceeded(self):
+        _publish("drain_grace_exceeded", outcome="hard_exit")
+        self._on_grace_exceeded()
+
+    @property
+    def requested(self) -> bool:
+        return self._requested_at is not None
+
+    def completed(self) -> None:
+        """The snapshot is on disk: disarm the watchdog and record the
+        signal-to-safe duration."""
+        if self._watchdog is not None:
+            self._watchdog.cancel()
+            self._watchdog = None
+        if self._requested_at is not None and not self.drained:
+            self.drained = True
+            _publish("drain_complete",
+                     seconds=time.perf_counter() - self._requested_at)
+
+    # ------------------------------------------------------------- context
+    def __enter__(self) -> "PreemptionDrain":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._handler)
+            self.installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._watchdog is not None:
+            self._watchdog.cancel()
+            self._watchdog = None
+        was_installed = self.installed
+        for s, h in self._prev.items():
+            signal.signal(s, h)
+        self._prev.clear()
+        self.installed = False
+        if was_installed and self._signum is not None and not self.drained:
+            # the signal landed but the loop finished before it could act
+            # (final chunk / early stop): re-deliver under the restored
+            # handlers instead of silently consuming an operator's Ctrl-C
+            # or the pool's preemption notice
+            _publish("drain_redelivered", outcome=f"sig{self._signum}")
+            os.kill(os.getpid(), self._signum)
+        return None

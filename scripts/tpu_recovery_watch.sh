@@ -6,8 +6,33 @@
 # or errors — and on the first healthy probe runs the round-5 pending queue
 # in priority order, each step fenced so one failure cannot cost the rest.
 #
+# Preemption drain (PR 10): checkpointed fits honor SIGTERM by finishing the
+# in-flight chunk, snapshotting, and exiting cleanly within the grace budget
+# (resilience/elastic.PreemptionDrain, docs/RESILIENCE.md). The watcher runs
+# each step as a tracked child and FORWARDS its own TERM/INT to it, so a
+# pool preemption of the watcher drains the fit instead of orphan-killing it
+# mid-write — the next watcher run resumes from the durable snapshot.
+#
 # Usage: nohup bash scripts/tpu_recovery_watch.sh >> docs/tpu_watch.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
+CHILD=0
+forward_term() {
+  echo "== watcher signalled $(date -u +%FT%TZ) — draining child $CHILD"
+  if [ "$CHILD" != 0 ]; then
+    kill -TERM "$CHILD" 2>/dev/null
+    wait "$CHILD" 2>/dev/null
+  fi
+  exit 143
+}
+trap forward_term TERM INT
+run() {
+  "$@" &
+  CHILD=$!
+  wait "$CHILD"
+  local rc=$?
+  CHILD=0
+  return $rc
+}
 echo "== watcher start $(date -u +%FT%TZ)"
 while true; do
   if python - <<'EOF'
@@ -22,23 +47,23 @@ EOF
     # cadence from this (fresh marker => 3x shorter inter-probe backoff)
     date +%s > scripts/tpu_last_healthy
     echo "== chip healthy $(date -u +%FT%TZ) — running the pending queue"
-    echo "== multichip fit scaling ladder (this round's tentpole) $(date -u +%FT%TZ)"
-    python -u scripts/measure_multichip_fit.py
+    echo "== multichip fit scaling ladder (round-9 tentpole) $(date -u +%FT%TZ)"
+    run python -u scripts/measure_multichip_fit.py
     echo "== fit pipeline overlap (round-7 tentpole) $(date -u +%FT%TZ)"
-    python -u scripts/measure_fit_pipeline.py
-    if ! python -u scripts/quick_fit_probe.py; then
+    run python -u scripts/measure_fit_pipeline.py
+    if ! run python -u scripts/quick_fit_probe.py; then
       echo "== quick fit probe FAILED $(date -u +%FT%TZ); back to probing"
       sleep 120
       continue
     fi
     echo "== serving (incl. HTTP->TPU->reply E2E) $(date -u +%FT%TZ)"
-    python -u scripts/measure_serving_tpu.py
+    run python -u scripts/measure_serving_tpu.py
     echo "== bench (validates binning fast path on chip) $(date -u +%FT%TZ)"
-    python -u bench.py
+    run python -u bench.py
     echo "== vw throughput (validates shared-index fast path) $(date -u +%FT%TZ)"
-    python -u scripts/measure_vw_tpu.py
+    run python -u scripts/measure_vw_tpu.py
     echo "== image featurizer ladder $(date -u +%FT%TZ)"
-    python -u scripts/measure_image_featurizer.py
+    run python -u scripts/measure_image_featurizer.py
     echo "== watcher done $(date -u +%FT%TZ)"
     exit 0
   fi

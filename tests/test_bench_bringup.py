@@ -121,6 +121,28 @@ def test_faultinjector_recovery_mid_window_is_caught():
     assert outcomes[-1].startswith("healthy:")
 
 
+def test_pathologically_compiling_backend_is_blacklisted():
+    """ISSUE 10 satellite (ROADMAP item 4 slice): a backend that hangs
+    init/compile repeatedly is killed at the probe cap AND blacklisted for
+    the rest of the window — exactly blacklist_after_hangs hang-kills,
+    then an immediate CPU fallback with a 'blacklisted' record, with most
+    of the budget returned to the caller instead of burned on more doomed
+    probes."""
+    from mmlspark_tpu.resilience.chaos import FaultInjector
+    inj = FaultInjector(seed=7, delay_rate=1.0, delay_s=60.0)
+    probe = inj.wrap(lambda: "8.0 tpu")
+    t0 = time.time()
+    _, devs, err, attempts = bench._patient_backend_bringup(
+        budget_s=60, retry_sleep_s=0.2, min_probe_s=0.1, max_probe_s=0.5,
+        probe_fn=probe, blacklist_after_hangs=2)
+    assert time.time() - t0 < 15        # nowhere near the 60 s budget
+    assert devs[0].platform == "cpu"
+    capped = [a for a in attempts if "killed at probe cap" in a["outcome"]]
+    assert len(capped) == 2             # killed exactly twice, then barred
+    assert attempts[-1]["outcome"].startswith("blacklisted: 2 init hangs")
+    assert err is not None and "blacklisted" in err
+
+
 def test_healthy_probe_reports_platform(probe_code):
     # A probe that reports a cpu platform is NOT healthy (the whole point is
     # reaching an accelerator): bring-up must keep probing, then fall back.

@@ -188,3 +188,94 @@ def test_restore_without_step_dir(tmp_path):
     _, _, l_r = step(pr, orr, x, y)
     _, _, l_d = step(p1, o1, x, y)
     assert float(l_r) == float(l_d)
+
+
+# --------------------------------------------------- elastic (ISSUE 10)
+
+def test_gc_keep_last_bounds_step_dirs(tmp_path):
+    """keep-last-K retention for orbax step dirs: older epochs (and their
+    mesh manifests) are removed; latest_step survives."""
+    import os
+    from mmlspark_tpu.models.deep.checkpoint import gc_step_dirs
+    step, p, o, x, y = _setup()
+    ck = str(tmp_path / "gck")
+    for s in range(1, 5):
+        p, o, _ = step(p, o, x, y)
+        save_train_state(ck, p, o, step=s, keep_last=2)
+    names = sorted(os.listdir(ck))
+    assert [n for n in names
+            if n.startswith("step_") and n.split("_", 1)[1].isdigit()] == \
+        ["step_00000003", "step_00000004"]
+    assert latest_step(ck) == 4
+    # manifests track their dirs
+    assert sorted(n for n in names if n.endswith(".mesh.json")) == \
+        ["step_00000003.mesh.json", "step_00000004.mesh.json"]
+    # the kept steps still restore
+    pr, orr = restore_train_state(ck, p, o, step=4)
+    _, _, l_r = step(pr, orr, x, y)
+    assert np.isfinite(float(l_r))
+    assert gc_step_dirs(ck, keep_last=1) == 1
+    assert latest_step(ck) == 4
+
+
+def test_mismatched_mesh_restore_names_both_shapes(tmp_path):
+    """A same-mesh restore across mismatched meshes must fail with an
+    error naming BOTH mesh shapes (and pointing at the resharded route),
+    not orbax's raw sharding error."""
+    step42, p42, o42, x, y = _setup()
+    p1, o1, _ = step42(p42, o42, x, y)
+    ck = str(tmp_path / "mck")
+    save_train_state(ck, p1, o1, step=1)
+    # a (2, 4) data x model layout of the same 8 devices
+    mesh24 = meshlib.get_mesh(
+        8, axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS), shape=(2, 4))
+    from mmlspark_tpu.models.deep.transformer import make_tp_dp_train_step
+    step24, shard24 = make_tp_dp_train_step(mesh24, 4, 1e-3, 2)
+    key = jax.random.PRNGKey(0)
+    from mmlspark_tpu.models.deep.transformer import (init_encoder_params,
+                                                      init_head_params)
+    enc = init_encoder_params(key, 2, 8, 2, 16)
+    head = init_head_params(jax.random.fold_in(key, 1), 8, 2)
+    p24, o24 = shard24(enc, head)
+    p24, o24, _ = step24(p24, o24, jnp.asarray(x), jnp.asarray(y))
+    with pytest.raises(ValueError) as ei:
+        restore_train_state(ck, p24, o24, step=1)
+    msg = str(ei.value)
+    assert "'data': 4" in msg and "'model': 2" in msg
+    assert "'data': 2" in msg and "'model': 4" in msg
+    assert "restore_train_state_resharded" in msg
+
+
+def test_resharded_restore_re_places_onto_current_mesh(tmp_path):
+    """The documented elastic route — DEVICE LOSS: state saved on a
+    (dp=4, tp=2) 8-device mesh restores onto a (dp=2, tp=2) 4-device mesh
+    (the tp extent must match: tensor-parallel layouts physically reshape
+    the arrays, so only the data axis is elastic). Values come back
+    identical to the saved arrays, laid out on the CURRENT mesh."""
+    from mmlspark_tpu.models.deep.checkpoint import \
+        restore_train_state_resharded
+    step42, p42, o42, x, y = _setup()
+    p1, o1, _ = step42(p42, o42, x, y)
+    ck = str(tmp_path / "rck")
+    save_train_state(ck, p1, o1, step=1)
+    mesh22 = meshlib.get_mesh(
+        4, axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS), shape=(2, 2))
+    from mmlspark_tpu.models.deep.transformer import (init_encoder_params,
+                                                      init_head_params,
+                                                      make_tp_dp_train_step)
+    step22, shard22 = make_tp_dp_train_step(mesh22, 2, 1e-3, 2)
+    key = jax.random.PRNGKey(0)
+    enc = init_encoder_params(key, 2, 8, 2, 16)
+    head = init_head_params(jax.random.fold_in(key, 1), 8, 2)
+    p22, o22 = shard22(enc, head)
+    p22, o22, _ = step22(p22, o22, jnp.asarray(x), jnp.asarray(y))
+    pr, orr = restore_train_state_resharded(ck, p22, o22, step=1)
+    # re-placed, not re-trained: exact values on the new mesh layout
+    for a, b in zip(jax.tree_util.tree_leaves(pr),
+                    jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.sharding.mesh.shape[meshlib.DATA_AXIS] == 2
+    # and the resumed step runs on the 4-device mesh without relayout
+    # errors — the downshifted fleet continues training
+    _, _, l_r = step22(pr, orr, jnp.asarray(x), jnp.asarray(y))
+    assert np.isfinite(float(l_r))

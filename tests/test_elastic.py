@@ -1,0 +1,540 @@
+"""Preemption-safe elastic training (ISSUE 10 tentpole).
+
+Contracts under test:
+
+1. DURABLE STORE — `resilience/elastic.CheckpointStore`: atomic
+   payload+manifest snapshots (write-to-temp + fsync + rename), sha256
+   digest verification on restore, fallback to the PREVIOUS snapshot on a
+   corrupt/truncated newest (never a crash, never a silent
+   train-from-scratch), keep-last-K retention, schema-versioned manifest
+   fields (digest / step / ndev / batch_index).
+2. CHAOS KILL + ELASTIC RESUME — a GBDT fit killed by the seeded
+   `TrainingFaultInjector` at a chunk boundary resumes at a DIFFERENT
+   device count (simulated device loss) and the final booster matches the
+   uninterrupted SERIAL fit's structural digest — PR 9's sharded==serial
+   digest gate is what makes cross-ndev resume provable.
+3. MID-BATCH RESUME — numBatches>1 now composes with checkpointDir (the
+   manifest's batch_index / batch_start_trees fields), resuming inside
+   the in-flight batch.
+4. PREEMPTION DRAIN — SIGTERM during fit() finishes the in-flight chunk,
+   snapshots, and raises `Preempted` inside the grace budget; the grace
+   watchdog fires when the drain cannot complete.
+5. TELEMETRY — save / restore / fallback / resume / drain events land as
+   `checkpoint_events_total` counters (+ duration histograms) in the PR 8
+   registry.
+6. ATOMIC-WRITE LINT — no checkpoint-owning module may open a file for
+   writing or call os.replace/os.rename outside the designated atomic
+   helper (same CI-enforced posture as the backoff-loop / sync-point /
+   device-put lints).
+
+Digest = the dryrun's structural gate (tests/test_multichip.py), applied
+in CANONICAL form: both boosters are round-tripped through
+`parse_model_string(model_string())` first, because a resumed booster's
+restored trees live in the parser's BFS slot layout (a representational
+permutation of the training layout, not a model difference). After
+canonicalization the integer split records AND real thresholds must match
+EXACTLY — every tree makes the same decisions at the same values — and
+raw predictions must agree to fp noise. Per-leaf values are NOT compared
+directly: model_string distributes the boost-from-average init score over
+leaves as init/t_used, so snapshots taken at different tree counts bake
+different per-leaf shifts whose SUM is identical (prediction equality is
+the semantic gate).
+"""
+
+import ast
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+from mmlspark_tpu.observability import get_registry
+from mmlspark_tpu.resilience.chaos import (InjectedKill,
+                                           TrainingFaultInjector)
+from mmlspark_tpu.resilience.elastic import (CheckpointStore, Preempted,
+                                             PreemptionDrain,
+                                             atomic_write_text)
+
+DIGEST_FIELDS = ("split_slot", "split_feat", "split_valid", "split_is_cat",
+                 "split_default_left", "split_missing_type")
+
+#: small but non-trivial: NaN-bearing, weighted, row count NOT a multiple
+#: of 8 (padding + mask discipline exercised on every sharded resume)
+KW = dict(numIterations=9, numLeaves=7, maxBin=32, seed=3, itersPerCall=3,
+          weightCol="w")
+
+
+def _assert_digest_equal(m_a, m_b, x, ctx=""):
+    from mmlspark_tpu.models.lightgbm.native_format import parse_model_string
+    ca = parse_model_string(m_a.booster.model_string())
+    cb = parse_model_string(m_b.booster.model_string())
+    for fld in DIGEST_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ca.trees, fld)),
+            np.asarray(getattr(cb.trees, fld)),
+            err_msg=f"{ctx}: structural digest field {fld} diverged")
+    np.testing.assert_array_equal(
+        ca.thresholds, cb.thresholds,
+        err_msg=f"{ctx}: split thresholds diverged")
+    np.testing.assert_allclose(
+        m_a.booster.raw_predict(x), m_b.booster.raw_predict(x),
+        rtol=1e-5, atol=1e-5,
+        err_msg=f"{ctx}: raw predictions beyond fp noise")
+
+
+def _n_trees(model):
+    import jax
+    return int(jax.tree_util.tree_leaves(model.booster.trees)[0].shape[0])
+
+
+def _ctr(name, **labels):
+    """Sum of a registry counter family's series matching the labels."""
+    fam = get_registry().snapshot().get(name, {"series": []})
+    return sum(row.get("value", 0.0) for row in fam["series"]
+               if all(row["labels"].get(k) == v for k, v in labels.items()))
+
+
+@pytest.fixture(scope="module")
+def elastic_df():
+    rng = np.random.default_rng(0)
+    n, f = 1201, 8
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    x[rng.random((n, f)) < 0.08] = np.nan
+    y = (np.nansum(x[:, :3], axis=1) > 0).astype(np.float64)
+    w = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    return DataFrame({"features": x, "label": y, "w": w}), x
+
+
+@pytest.fixture(scope="module")
+def serial_ref(elastic_df):
+    """The uninterrupted SERIAL fit every chaos recovery must match."""
+    df, _ = elastic_df
+    return LightGBMClassifier(numTasks=1, **KW).fit(df)
+
+
+# ------------------------------------------------------------------- store
+
+class TestCheckpointStore:
+    def _fill(self, tmp_path, n=3, keep_last=5):
+        store = CheckpointStore(str(tmp_path / "st"), keep_last=keep_last)
+        for i in range(n):
+            store.save(f"payload-{i}", step=(i + 1) * 3, ndev=8,
+                       batch_index=0, extra={"batch_start_trees": 0})
+        return store
+
+    def test_roundtrip_and_manifest_fields(self, tmp_path):
+        store = self._fill(tmp_path)
+        payload, man = store.restore()
+        assert payload == "payload-2"
+        assert man["schema_version"] == 1
+        assert man["digest"].startswith("sha256:")
+        assert man["step"] == 9 and man["ndev"] == 8
+        assert man["batch_index"] == 0
+        assert man["extra"] == {"batch_start_trees": 0}
+
+    def test_keep_last_retention(self, tmp_path):
+        store = CheckpointStore(str(tmp_path / "st"), keep_last=2)
+        for i in range(4):
+            store.save(f"p{i}", step=i, ndev=1)
+        # oldest GC'd; sequence numbers keep climbing (no reuse)
+        assert store.snapshot_seqs() == [2, 3]
+        assert store.restore()[0] == "p3"
+
+    def test_truncated_newest_falls_back(self, tmp_path):
+        store = self._fill(tmp_path)
+        before = _ctr("checkpoint_events_total", event="fallback")
+        TrainingFaultInjector.corrupt_latest_snapshot(store, "truncate")
+        with pytest.warns(UserWarning, match="falling back"):
+            payload, man = store.restore()
+        assert payload == "payload-1"          # the PREVIOUS snapshot
+        assert man["step"] == 6
+        assert _ctr("checkpoint_events_total", event="fallback",
+                    outcome="digest_mismatch") >= before + 1
+        # the corpse is dropped on fallback so it can never count toward
+        # keep-last retention and evict the valid previous snapshot
+        assert store.snapshot_seqs() == [0, 1]
+
+    def test_bitflip_falls_back(self, tmp_path):
+        store = self._fill(tmp_path)
+        TrainingFaultInjector.corrupt_latest_snapshot(store, "flip")
+        with pytest.warns(UserWarning, match="falling back"):
+            payload, _ = store.restore()
+        assert payload == "payload-1"
+
+    def test_tmp_litter_is_invisible(self, tmp_path):
+        """An interrupted atomic write leaves only a temp file — restore
+        must not even warn about it (it is not a committed snapshot)."""
+        store = self._fill(tmp_path)
+        TrainingFaultInjector.corrupt_latest_snapshot(store, "tmp_litter")
+        payload, _ = store.restore()           # no warning expected
+        assert payload == "payload-2"
+
+    def test_payload_without_manifest_is_in_progress(self, tmp_path):
+        """The manifest commits a snapshot: a payload whose manifest never
+        landed (crash between the two writes) is skipped silently."""
+        store = self._fill(tmp_path)
+        _, mpath = store._paths(store.snapshot_seqs()[-1])
+        os.remove(mpath)
+        payload, _ = store.restore()
+        assert payload == "payload-1"
+
+    def test_every_snapshot_corrupt_returns_none(self, tmp_path):
+        """When NOTHING verifies, restore says so (None) — the caller
+        decides to train from scratch, it is never decided silently."""
+        store = self._fill(tmp_path, n=2)
+        for seq in store.snapshot_seqs():
+            ppath, _ = store._paths(seq)
+            with open(ppath, "r+b") as fh:
+                fh.truncate(1)
+        with pytest.warns(UserWarning, match="falling back"):
+            assert store.restore() is None
+
+    def test_atomic_write_overwrites_in_place(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        atomic_write_text(p, "one")
+        atomic_write_text(p, "two")
+        assert open(p).read() == "two"
+        # no temp litter after successful commits
+        assert os.listdir(str(tmp_path)) == ["f.txt"]
+
+
+# ------------------------------------------------- chaos kill + elastic resume
+
+class TestChaosKillElasticResume:
+    """The acceptance bar: seeded kill at a chunk boundary, resume at a
+    DIFFERENT device count, digest-identical to the uninterrupted serial
+    fit; save/restore/resume counters visible in the registry."""
+
+    def test_kill_at_8_resume_at_2_matches_serial(self, elastic_df,
+                                                  serial_ref, tmp_path):
+        df, x = elastic_df
+        ck = str(tmp_path / "ck82")
+        saves0 = _ctr("checkpoint_events_total", event="save")
+        inj = TrainingFaultInjector(seed=11, kill_at_chunk=1)
+        with pytest.raises(InjectedKill, match="chunk boundary 1"):
+            inj.arm(LightGBMClassifier(numTasks=8, checkpointDir=ck,
+                                       **KW)).fit(df)
+        assert inj.counts == {"boundaries": 2, "kills": 1}
+        # the killed fit's snapshots are durable and carry its ndev
+        store = CheckpointStore(ck)
+        payload, man = store.restore()
+        assert man["ndev"] == 8 and man["step"] == 6
+        assert _ctr("checkpoint_events_total", event="save") >= saves0 + 2
+        # simulated device loss: the injector picks the downshifted mesh
+        nd2 = inj.downshift_ndev(8)
+        assert 1 <= nd2 < 8 and 8 % nd2 == 0
+        resumes0 = _ctr("checkpoint_events_total", event="resume",
+                        outcome="reshard")
+        m = LightGBMClassifier(numTasks=nd2, checkpointDir=ck,
+                               **KW).fit(df)
+        assert _n_trees(m) == 9
+        _assert_digest_equal(serial_ref, m, x, f"kill@8 -> resume@{nd2}")
+        assert _ctr("checkpoint_events_total", event="resume",
+                    outcome="reshard") >= resumes0 + 1
+        # completed fit cleared its crash artifacts
+        assert store.snapshot_seqs() == []
+
+    def test_kill_serial_resume_at_8_matches_serial(self, elastic_df,
+                                                    serial_ref, tmp_path):
+        """The upshift direction: snapshot written at ndev=1, resumed on
+        the full mesh (rows re-shard through shard_rows on resume)."""
+        df, x = elastic_df
+        ck = str(tmp_path / "ck18")
+        inj = TrainingFaultInjector(seed=5, kill_at_chunk=0)
+        with pytest.raises(InjectedKill):
+            inj.arm(LightGBMClassifier(numTasks=1, checkpointDir=ck,
+                                       **KW)).fit(df)
+        assert CheckpointStore(ck).restore()[1]["ndev"] == 1
+        m = LightGBMClassifier(numTasks=8, checkpointDir=ck, **KW).fit(df)
+        assert _n_trees(m) == 9
+        _assert_digest_equal(serial_ref, m, x, "kill@1 -> resume@8")
+
+    def test_corrupt_newest_snapshot_resume_falls_back(self, elastic_df,
+                                                       serial_ref,
+                                                       tmp_path):
+        """Checkpoint-write crash chaos: the newest snapshot is truncated
+        (torn write). Resume must fall back to the previous snapshot —
+        re-training only that chunk — and still match serial; it must NOT
+        crash and NOT restart from scratch (proved by the resumed fit
+        writing exactly the snapshots for the re-trained tail)."""
+        df, x = elastic_df
+        ck = str(tmp_path / "ckc")
+        inj = TrainingFaultInjector(seed=2, kill_at_chunk=2)
+        with pytest.raises(InjectedKill):
+            inj.arm(LightGBMClassifier(numTasks=2, checkpointDir=ck,
+                                       **KW)).fit(df)
+        store = CheckpointStore(ck)
+        assert len(store.snapshot_seqs()) == 2    # keep-last default 2
+        TrainingFaultInjector.corrupt_latest_snapshot(store, "truncate")
+        fb0 = _ctr("checkpoint_events_total", event="fallback")
+        saves0 = _ctr("checkpoint_events_total", event="save")
+        with pytest.warns(UserWarning, match="falling back"):
+            m = LightGBMClassifier(numTasks=8, checkpointDir=ck,
+                                   **KW).fit(df)
+        assert _n_trees(m) == 9
+        _assert_digest_equal(serial_ref, m, x, "corrupt fallback resume")
+        assert _ctr("checkpoint_events_total", event="fallback") >= fb0 + 1
+        # fallback snapshot held 6 trees -> ONE remaining chunk was
+        # trained and snapshotted; a silent from-scratch restart would
+        # have written three
+        assert _ctr("checkpoint_events_total",
+                    event="save") == saves0 + 1
+
+    def test_registry_carries_the_chaos_story(self):
+        """Acceptance: the save/restore/fallback counter families from the
+        runs above are present in one registry snapshot (the same snapshot
+        bench.py embeds in its JSON)."""
+        snap = get_registry().snapshot()
+        assert "checkpoint_events_total" in snap
+        events = {row["labels"].get("event")
+                  for row in snap["checkpoint_events_total"]["series"]}
+        assert {"save", "restore", "fallback", "resume"} <= events
+        assert "checkpoint_event_seconds" in snap
+        assert _ctr("chaos_injected_total", kind="train_kill") >= 3
+
+
+# ----------------------------------------------------------- mid-batch resume
+
+class TestMidBatchResume:
+    """Satellite: the checkpointDir x numBatches>1 restriction is lifted —
+    the manifest records the batch index and resume continues INSIDE the
+    in-flight batch."""
+
+    def test_kill_in_batch1_resumes_mid_batch(self, elastic_df, tmp_path):
+        df, x = elastic_df
+        kw = dict(KW, numIterations=4, itersPerCall=2, numBatches=2)
+        ref = LightGBMClassifier(numTasks=1, **kw).fit(df)
+        assert _n_trees(ref) == 8              # 2 batches x 4 iterations
+        ck = str(tmp_path / "ckb")
+        # global boundary ordinal 2 = batch 1's first chunk boundary
+        inj = TrainingFaultInjector(seed=0, kill_at_chunk=2)
+        with pytest.raises(InjectedKill):
+            inj.arm(LightGBMClassifier(numTasks=1, checkpointDir=ck,
+                                       **kw)).fit(df)
+        _, man = CheckpointStore(ck).restore()
+        assert man["batch_index"] == 1
+        assert man["extra"]["batch_start_trees"] == 4
+        assert man["step"] == 6                # batch 0 + 2 trees of batch 1
+        m = LightGBMClassifier(numTasks=1, checkpointDir=ck, **kw).fit(df)
+        assert _n_trees(m) == 8
+        _assert_digest_equal(ref, m, x, "mid-batch resume")
+
+    def test_crash_between_batches_resumes_next_batch(self, elastic_df,
+                                                      tmp_path):
+        """A kill exactly at a batch's LAST boundary leaves a snapshot
+        with the batch count-complete: resume must deliver it and
+        continue with the NEXT batch — batch 0 is neither retrained nor
+        has its delegate batch hooks re-fired around a no-op train."""
+        from mmlspark_tpu.models.lightgbm.delegate import LightGBMDelegate
+        df, x = elastic_df
+        kw = dict(KW, numIterations=4, itersPerCall=2, numBatches=2)
+        ck = str(tmp_path / "ckb2")
+        inj = TrainingFaultInjector(seed=0, kill_at_chunk=1)
+        with pytest.raises(InjectedKill):
+            inj.arm(LightGBMClassifier(numTasks=1, checkpointDir=ck,
+                                       **kw)).fit(df)
+        _, man = CheckpointStore(ck).restore()
+        assert man["batch_index"] == 0 and man["step"] == 4
+
+        batch_hooks = []
+
+        class Rec(LightGBMDelegate):
+            def before_train_batch(self, bi, log, booster):
+                batch_hooks.append(("before", bi))
+
+            def after_train_batch(self, bi, log, booster):
+                batch_hooks.append(("after", bi))
+
+        m = LightGBMClassifier(numTasks=1, checkpointDir=ck,
+                               delegate=Rec(), **kw).fit(df)
+        assert _n_trees(m) == 8
+        # completed batch 0's hooks are NOT replayed (docstring contract)
+        assert batch_hooks == [("before", 1), ("after", 1)]
+        ref = LightGBMClassifier(numTasks=1, **kw).fit(df)
+        _assert_digest_equal(ref, m, x, "between-batches resume")
+
+
+# ---------------------------------------------------------- preemption drain
+
+class TestPreemptionDrain:
+    def test_drain_unit_signal_flow(self):
+        fired = []
+        with PreemptionDrain(grace_s=60,
+                             on_grace_exceeded=lambda: fired.append(1)
+                             ) as drain:
+            assert drain.installed and not drain.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.01)
+            assert drain.requested
+            drain.completed()
+            assert drain.drained
+        assert not fired
+        # handlers restored
+        assert signal.getsignal(signal.SIGTERM) != drain._handler
+
+    def test_grace_watchdog_fires_without_completion(self):
+        fired = []
+        with PreemptionDrain(grace_s=0.05,
+                             on_grace_exceeded=lambda: fired.append(1)
+                             ) as drain:
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.3)
+            assert fired == [1]
+            # mark handled so __exit__ does not re-deliver into pytest
+            drain.completed()
+
+    def test_late_signal_is_redelivered_not_swallowed(self):
+        """A signal that lands too late to drain (final chunk / early
+        stop: the loop finishes, completed() never runs) must be
+        RE-DELIVERED under the restored handlers on exit — an operator's
+        Ctrl-C or the pool's preemption notice is never consumed
+        silently."""
+        redelivered = []
+        prev = signal.signal(signal.SIGTERM,
+                             lambda s, f: redelivered.append(s))
+        try:
+            with PreemptionDrain(grace_s=60) as drain:
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(0.01)
+                assert drain.requested and not redelivered
+            time.sleep(0.01)
+            assert redelivered == [signal.SIGTERM]
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_sigterm_mid_fit_drains_and_resumes(self, elastic_df,
+                                                serial_ref, tmp_path):
+        """The drain contract end to end: SIGTERM lands during the fit;
+        the in-flight chunk finishes, its snapshot is durable, fit raises
+        Preempted (clean-exit contract) within the grace, and a later
+        fit() resumes to a serial-digest-identical booster."""
+        df, x = elastic_df
+        ck = str(tmp_path / "ckd")
+        est = LightGBMClassifier(numTasks=2, checkpointDir=ck,
+                                 drainGraceS=30.0, **KW)
+        # deliver the signal from inside the loop (first chunk boundary):
+        # deterministic timing without a second process
+        est._chunk_boundary_hook = (
+            lambda idx, start: os.kill(os.getpid(), signal.SIGTERM)
+            if idx == 0 else None)
+        d0 = _ctr("checkpoint_events_total", event="drain_complete")
+        # the signal lands while chunk 1 is already ahead-dispatched: the
+        # drain finishes (and snapshots) that in-flight chunk too — 6/9
+        with pytest.raises(Preempted, match="6/9 iterations snapshotted"):
+            est.fit(df)
+        assert _ctr("checkpoint_events_total",
+                    event="drain_complete") >= d0 + 1
+        store = CheckpointStore(ck)
+        assert store.restore()[1]["step"] == 6
+        m = LightGBMClassifier(numTasks=8, checkpointDir=ck, **KW).fit(df)
+        assert _n_trees(m) == 9
+        _assert_digest_equal(serial_ref, m, x, "drain -> resume@8")
+
+
+# ------------------------------------------------------------- resume storm
+
+@pytest.mark.slow
+class TestResumeStorm:
+    def test_kill_every_chunk_alternating_ndev(self, elastic_df,
+                                               serial_ref, tmp_path):
+        """Preemption as the steady state: the fit is killed at its FIRST
+        chunk boundary on every attempt, each resume lands on a different
+        mesh (8 -> 2 -> 4 -> 1), and the final completion still matches
+        the uninterrupted serial digest."""
+        df, x = elastic_df
+        ck = str(tmp_path / "storm")
+        ndevs = [8, 2, 4]
+        for nd in ndevs:
+            inj = TrainingFaultInjector(seed=nd, kill_at_chunk=0)
+            with pytest.raises(InjectedKill):
+                inj.arm(LightGBMClassifier(numTasks=nd, checkpointDir=ck,
+                                           **KW)).fit(df)
+        _, man = CheckpointStore(ck).restore()
+        assert man["step"] == 9                # 3 storms x 3 iterations
+        m = LightGBMClassifier(numTasks=1, checkpointDir=ck, **KW).fit(df)
+        assert _n_trees(m) == 9
+        _assert_digest_equal(serial_ref, m, x, "resume storm")
+
+
+# --------------------------------------------------------- atomic-write lint
+
+class TestAtomicCheckpointWriteLint:
+    """No checkpoint-owning module may write checkpoint bytes around the
+    atomic helper: any `open(..., 'w'/'a'/'x'/'+')` or os.replace/os.rename
+    outside resilience/elastic's designated helper is an offense. Same
+    CI-enforced posture as the backoff-loop (PR 4), sync-point (PR 6) and
+    device-put placement (PR 9) lints."""
+
+    #: module -> function names EXCLUDED (the designated helper itself)
+    TARGETS = {
+        "mmlspark_tpu.resilience.elastic": {"atomic_write_bytes"},
+        "mmlspark_tpu.models.lightgbm.base": set(),
+        "mmlspark_tpu.models.deep.checkpoint": set(),
+    }
+    _WRITE_MODES = ("w", "a", "x", "+")
+
+    @classmethod
+    def _offenders(cls, src: str, excluded_funcs):
+        tree = ast.parse(src)
+        lines = src.split("\n")
+        excluded = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name in excluded_funcs:
+                excluded.update(range(node.lineno, node.end_lineno + 1))
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or node.lineno in excluded:
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in ("replace",
+                                                             "rename"):
+                base = fn.value
+                if isinstance(base, ast.Name) and base.id == "os":
+                    out.append(f"{node.lineno}: {lines[node.lineno - 1].strip()}")
+            if isinstance(fn, ast.Name) and fn.id == "open":
+                mode = None
+                if len(node.args) >= 2 and isinstance(node.args[1],
+                                                      ast.Constant):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value,
+                                                       ast.Constant):
+                        mode = kw.value.value
+                if isinstance(mode, str) and any(c in mode for c in
+                                                 cls._WRITE_MODES):
+                    out.append(f"{node.lineno}: "
+                               f"{lines[node.lineno - 1].strip()}")
+        return out
+
+    def test_no_checkpoint_write_bypasses_the_helper(self):
+        import importlib
+        for mod_name, excluded in self.TARGETS.items():
+            mod = importlib.import_module(mod_name)
+            src = open(mod.__file__, encoding="utf-8").read()
+            offenders = self._offenders(src, excluded)
+            assert not offenders, (
+                f"{mod_name}: file write / rename outside the atomic "
+                f"write-rename helper (checkpoint bytes must go through "
+                f"resilience.elastic.atomic_write_bytes so a crash can "
+                f"only ever truncate a temp file):\n" + "\n".join(offenders))
+
+    def test_lint_catches_planted_offenders(self):
+        probe = ("def save(p):\n"
+                 "    with open(p, 'w') as fh:\n"
+                 "        fh.write('x')\n"
+                 "    os.replace(p, p)\n"
+                 "    open(p).read()\n"
+                 "    open(p, mode='wb').close()\n")
+        offenders = self._offenders(probe, set())
+        assert len(offenders) == 3, offenders
+
+    def test_probe_outcome_blacklist_category(self):
+        """Bounded-label bridge knows the new bring-up outcome."""
+        from mmlspark_tpu.observability import classify_probe_outcome
+        assert classify_probe_outcome(
+            "blacklisted: 4 init hangs in 720s — backend barred for the "
+            "rest of the window") == "blacklisted"

@@ -131,7 +131,8 @@ class TestPipelinedBitExactness:
                                   **KW).fit(df)
         m_plain = LightGBMClassifier(itersPerCall=3, **KW).fit(df)
         _strings_equal(m_ck, m_plain)
-        assert not os.path.exists(os.path.join(ck, "booster.txt"))
+        from mmlspark_tpu.resilience.elastic import CheckpointStore
+        assert CheckpointStore(ck).snapshot_seqs() == []
 
     def test_early_stopping_stays_sequential(self):
         """active early stopping gates the next chunk launch on this
