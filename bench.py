@@ -51,6 +51,14 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
         extra.setdefault("telemetry", get_registry().snapshot())
     except Exception as e:  # noqa: BLE001 - the JSON line must still land
         extra.setdefault("telemetry_error", str(e)[:200])
+    # compile/cold-start telemetry (ISSUE-11): cache hit/miss counts and
+    # total compile-seconds per run, so BENCH_r06+ can show bring-up
+    # shrinking as the persistent cache and AOT artifacts land
+    try:
+        from mmlspark_tpu.compile import cache_stats
+        extra.setdefault("compile_telemetry", cache_stats())
+    except Exception as e:  # noqa: BLE001
+        extra.setdefault("compile_telemetry_error", str(e)[:200])
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]
@@ -150,6 +158,14 @@ def main():
     # Fit/extra deadlines are relative to backend-ready time, NOT process
     # start: a 20-min bring-up window must not eat the measurement budget.
     t_start = time.time()
+    # persistent XLA cache: the second bench round on the same pool skips
+    # recompiles entirely (compile_telemetry in the emitted JSON records
+    # hits/misses per round)
+    try:
+        from mmlspark_tpu.compile import configure_persistent_cache
+        configure_persistent_cache()
+    except Exception:
+        pass
     platform = devs[0].platform
     on_accel = platform not in ("cpu",)
 

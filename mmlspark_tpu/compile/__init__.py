@@ -1,0 +1,21 @@
+"""Compilation layer: kill cold start.
+
+- ``cache`` — the ``cached_jit`` in-memory wrapper registry + persistent
+  on-disk XLA cache management + cache_stats telemetry hook.
+- ``aot`` — ``jax.export`` artifact store (serialize / digest-verified
+  deserialize-or-fall-back) for serving-critical predict programs.
+
+See docs/SERVING.md ("Cold start") and docs/RESILIENCE.md
+(resume-to-first-chunk) for the measured before/after.
+"""
+
+from .aot import AOT_SCHEMA_VERSION, AOTStore
+from .cache import (CachedFunction, cache_stats, cached_jit,
+                    clear_memory_cache, configure_persistent_cache,
+                    persistent_cache_dir)
+
+__all__ = [
+    "AOT_SCHEMA_VERSION", "AOTStore", "CachedFunction", "cache_stats",
+    "cached_jit", "clear_memory_cache", "configure_persistent_cache",
+    "persistent_cache_dir",
+]

@@ -1,0 +1,368 @@
+"""AOT-exported executables: serialize serving-critical programs to disk.
+
+Two artifact layers per entry, stored beside model checkpoints as manifest
+entries — the executable analogue of the reference's pre-built model
+artifacts shipped to executors (ModelDownloader/CNTKModel), and the layer
+Flare argues for with ahead-of-time native compilation (arxiv 1703.08219):
+
+- ``<name>.xexec`` — a PRE-COMPILED XLA executable
+  (``jax.experimental.serialize_executable``): load + run, zero tracing,
+  zero compilation. Strictly pinned to (jax version, platform, device
+  kind, device count) — any skew is a counted fallback.
+- ``<name>.jaxexport`` — the portable ``jax.export`` layer (versioned
+  StableHLO + calling convention): skips Python tracing; its XLA compile
+  resolves through the persistent cache (``compile/cache.py``).
+
+The loader tries compiled -> exported -> (caller's) fresh JIT.
+
+Discipline (inherited from the PR 10 checkpoint layer):
+
+- every write goes through ``resilience.elastic.atomic_write_bytes`` /
+  ``atomic_write_text`` — a preempted export can never leave a torn artifact;
+- every artifact carries a sha256 digest in ``MANIFEST.json``; the loader
+  verifies it before deserializing (the ``.xexec`` pickle in particular is
+  only ever fed bytes that hash to the manifest digest — same trust domain
+  as the model-weight files beside it);
+- every load failure (missing, truncated/digest, schema or jax version skew,
+  platform or device-count/kind mismatch, aval mismatch, deserialize error)
+  is a COUNTED, logged fallback — never a crash
+  (``compile_aot_fallback_total{reason}``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+
+from ..resilience.elastic import atomic_write_bytes, atomic_write_text
+
+__all__ = ["AOT_SCHEMA_VERSION", "AOTStore", "aval_strs", "count_fallback",
+           "load_serving_callable"]
+
+log = logging.getLogger(__name__)
+
+AOT_SCHEMA_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+ARTIFACT_SUFFIX = ".jaxexport"
+COMPILED_SUFFIX = ".xexec"
+
+
+def _registry():
+    from ..observability import get_registry
+    return get_registry()
+
+
+def _count_fallback(reason: str, name: str) -> None:
+    log.warning("AOT artifact %r unusable (%s); falling back to JIT",
+                name, reason)
+    try:
+        _registry().counter(
+            "compile_aot_fallback_total",
+            "AOT artifact loads that fell back to fresh JIT, by reason",
+            {"reason": reason}).inc()
+    except Exception:
+        pass
+
+
+#: public alias — callers that do their own late validation (e.g. a booster
+#: comparing exported avals against the live tree shapes) report through the
+#: same counted-fallback funnel
+count_fallback = _count_fallback
+
+
+def _count_ok(event: str) -> None:
+    try:
+        _registry().counter(
+            f"compile_aot_{event}_total",
+            f"AOT artifact {event} operations that succeeded").inc()
+    except Exception:
+        pass
+
+
+def aval_strs(exported) -> list:
+    """Canonical short form ("float32[8,28]") — what the manifest stores
+    and what ``_leaf_sig_strs`` derives from live call arguments."""
+    return [a.str_short() for a in exported.in_avals]
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+class AOTStore:
+    """A directory of serialized executables + one atomic MANIFEST.json.
+
+    Manifest schema (documented in docs/SERVING.md "AOT artifact
+    contract")::
+
+        {"schema_version": 1,
+         "entries": {
+           "<name>": {"uri": "<name>.jaxexport", "sha256": "...",
+                      "size": 1234, "jax_version": "0.4.37",
+                      "platforms": ["cpu"], "nr_devices": 1,
+                      "in_avals": ["float32[8,28]", ...],
+                      "calling_convention_version": 9,
+                      "extra": {...caller metadata...}}}}
+
+    The store usually lives beside the checkpoints it accelerates (a zoo
+    entry's ``aot/`` sibling, or ``<checkpointDir>/aot/``).
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.manifest_path = os.path.join(directory, MANIFEST_NAME)
+
+    # ----------------------------------------------------------- manifest
+    def manifest(self) -> Dict[str, Any]:
+        try:
+            with open(self.manifest_path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and isinstance(doc.get("entries"), dict):
+                return doc
+        except FileNotFoundError:
+            pass
+        except Exception as e:
+            log.warning("AOT manifest unreadable (%s); treating as empty", e)
+        return {"schema_version": AOT_SCHEMA_VERSION, "entries": {}}
+
+    def entries(self) -> Dict[str, Dict[str, Any]]:
+        return self.manifest()["entries"]
+
+    # ------------------------------------------------------------- export
+    def save(self, name: str, exported, compiled=None,
+             extra: Optional[Dict] = None) -> str:
+        """Serialize one ``jax.export.Exported`` (+ optionally the
+        matching pre-compiled ``jax.stages.Compiled``); artifacts then
+        manifest (manifest-commits ordering, same as the checkpoint
+        store)."""
+        os.makedirs(self.directory, exist_ok=True)
+        data = exported.serialize()
+        uri = name + ARTIFACT_SUFFIX
+        atomic_write_bytes(os.path.join(self.directory, uri), bytes(data))
+        entry = {
+            "uri": uri,
+            "sha256": _sha256(bytes(data)),
+            "size": len(data),
+            "jax_version": jax.__version__,
+            "platforms": list(exported.platforms),
+            "nr_devices": int(exported.nr_devices),
+            "in_avals": aval_strs(exported),
+            "calling_convention_version":
+                int(exported.calling_convention_version),
+            "extra": dict(extra or {}),
+        }
+        if compiled is not None:
+            from jax.experimental import serialize_executable as _se
+            blob, in_tree, out_tree = _se.serialize(compiled)
+            xdata = pickle.dumps({"xexec": blob, "in_tree": in_tree,
+                                  "out_tree": out_tree})
+            xuri = name + COMPILED_SUFFIX
+            atomic_write_bytes(os.path.join(self.directory, xuri), xdata)
+            entry["xexec_uri"] = xuri
+            entry["xexec_sha256"] = _sha256(xdata)
+            entry["xexec_size"] = len(xdata)
+            entry["device_kind"] = jax.devices()[0].device_kind
+        doc = self.manifest()
+        doc["schema_version"] = AOT_SCHEMA_VERSION
+        doc["entries"][name] = entry
+        atomic_write_text(self.manifest_path,
+                          json.dumps(doc, indent=1, sort_keys=True))
+        if compiled is not None:
+            # verify the fast layer round-trips ON THIS BACKEND before
+            # publishing it: XLA cannot serialize every executable (e.g.
+            # some CPU fusion thunks), and a worker should not pay a
+            # doomed deserialize on every cold start — strip the layer
+            # and let the portable jax.export artifact carry the entry
+            if self.load_compiled(name) is None:
+                log.warning("AOT compiled layer for %r failed its "
+                            "publish-time round-trip; keeping only the "
+                            "jax.export layer", name)
+                for k in ("xexec_uri", "xexec_sha256", "xexec_size",
+                          "device_kind"):
+                    entry.pop(k, None)
+                atomic_write_text(self.manifest_path,
+                                  json.dumps(doc, indent=1, sort_keys=True))
+        _count_ok("export")
+        return uri
+
+    # ------------------------------------------------- pre-compiled layer
+    def load_compiled(self, name: str,
+                      expect_nr_devices: Optional[int] = None,
+                      expect_in_avals: Optional[Sequence[str]] = None):
+        """Deserialize the pre-compiled executable layer, or None (counted
+        fallback). Strictly pinned: jax version, platform, device kind and
+        count, and input avals must all match the manifest entry."""
+        doc = self.manifest()
+        entry = doc["entries"].get(name)
+        if entry is None:
+            _count_fallback("missing", name)
+            return None
+        if "xexec_uri" not in entry:
+            return None  # fast layer never published — not a fallback
+        if doc.get("schema_version") != AOT_SCHEMA_VERSION:
+            _count_fallback("schema_version", name)
+            return None
+        if entry.get("jax_version") != jax.__version__:
+            _count_fallback("jax_version", name)
+            return None
+        if jax.default_backend() not in tuple(entry.get("platforms", ())):
+            _count_fallback("platform", name)
+            return None
+        try:
+            dev = jax.devices()[0]
+        except Exception:
+            _count_fallback("platform", name)
+            return None
+        if entry.get("device_kind") != dev.device_kind:
+            _count_fallback("device_kind", name)
+            return None
+        if expect_nr_devices is not None and \
+                int(entry.get("nr_devices", -1)) != int(expect_nr_devices):
+            _count_fallback("mesh", name)
+            return None
+        if expect_in_avals is not None and \
+                list(entry.get("in_avals", ())) != list(expect_in_avals):
+            _count_fallback("avals", name)
+            return None
+        try:
+            with open(os.path.join(self.directory,
+                                   entry["xexec_uri"]), "rb") as f:
+                xdata = f.read()
+        except OSError:
+            _count_fallback("missing", name)
+            return None
+        if _sha256(xdata) != entry.get("xexec_sha256"):
+            _count_fallback("digest", name)
+            return None
+        try:
+            from jax.experimental import serialize_executable as _se
+            d = pickle.loads(xdata)
+            compiled = _se.deserialize_and_load(d["xexec"], d["in_tree"],
+                                                d["out_tree"])
+        except Exception as e:
+            log.warning("AOT compiled-executable load failed for %r: %s",
+                        name, e)
+            _count_fallback("deserialize", name)
+            return None
+        _count_ok("load_ok")
+        return compiled
+
+    # --------------------------------------------------------------- load
+    def load(self, name: str, *, expect_platform: Optional[str] = None,
+             expect_nr_devices: Optional[int] = None,
+             expect_in_avals: Optional[Sequence[str]] = None):
+        """Deserialize-or-fall-back: returns the ``Exported`` or None.
+
+        Every None is a counted ``compile_aot_fallback_total{reason}`` —
+        callers MUST treat None as "use cached_jit", never as an error.
+        """
+        doc = self.manifest()
+        entry = doc["entries"].get(name)
+        if entry is None:
+            _count_fallback("missing", name)
+            return None
+        if doc.get("schema_version") != AOT_SCHEMA_VERSION:
+            _count_fallback("schema_version", name)
+            return None
+        if entry.get("jax_version") != jax.__version__:
+            # jax.export promises limited cross-version compat; stay strict
+            # and recompile rather than risk a miscompiled serve
+            _count_fallback("jax_version", name)
+            return None
+        path = os.path.join(self.directory, entry.get("uri", ""))
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            _count_fallback("missing", name)
+            return None
+        if _sha256(data) != entry.get("sha256"):
+            _count_fallback("digest", name)  # truncated or corrupt artifact
+            return None
+        platform = expect_platform or jax.default_backend()
+        if platform not in tuple(entry.get("platforms", ())):
+            _count_fallback("platform", name)
+            return None
+        if expect_nr_devices is not None and \
+                int(entry.get("nr_devices", -1)) != int(expect_nr_devices):
+            _count_fallback("mesh", name)
+            return None
+        if expect_in_avals is not None and \
+                list(entry.get("in_avals", ())) != list(expect_in_avals):
+            _count_fallback("avals", name)
+            return None
+        try:
+            from jax import export as jax_export
+            exported = jax_export.deserialize(bytearray(data))
+        except Exception as e:
+            log.warning("AOT deserialize failed for %r: %s", name, e)
+            _count_fallback("deserialize", name)
+            return None
+        # double-check the artifact itself agrees with its manifest row
+        # (a hand-edited manifest must not smuggle a mismatched program in)
+        if platform not in exported.platforms:
+            _count_fallback("platform", name)
+            return None
+        if expect_nr_devices is not None and \
+                int(exported.nr_devices) != int(expect_nr_devices):
+            _count_fallback("mesh", name)
+            return None
+        if expect_in_avals is not None and \
+                aval_strs(exported) != list(expect_in_avals):
+            _count_fallback("avals", name)
+            return None
+        _count_ok("load_ok")
+        return exported
+
+
+def compile_for_export(jitfn, *specs):
+    """Fresh AOT compile for serialization: bypasses the persistent cache
+    (a cache-retrieved executable serializes without its symbol payload on
+    XLA:CPU — see ``cache.uncached_compile``)."""
+    from .cache import uncached_compile
+    with uncached_compile():
+        return jitfn.lower(*specs).compile()
+
+
+def _leaf_sig_strs(args) -> list:
+    """Aval strings for concrete call arguments, in the format
+    ``aval_strs`` records at export time (flattened pytree order)."""
+    out = []
+    for leaf in jax.tree.leaves(args):
+        shape = ",".join(str(d) for d in getattr(leaf, "shape", ()))
+        dtype = jax.numpy.asarray(leaf).dtype.name \
+            if not hasattr(leaf, "dtype") else leaf.dtype.name
+        out.append(f"{dtype}[{shape}]")
+    return out
+
+
+def load_serving_callable(store: AOTStore, name: str, args,
+                          expect_nr_devices: int = 1):
+    """Resolve one manifest entry to the fastest usable callable.
+
+    Order: pre-compiled executable (zero compile) -> ``jax.export``
+    artifact wrapped once in ``cached_jit`` (zero tracing; compile rides
+    the persistent cache) -> None (caller falls back to fresh JIT).
+    ``args`` are the concrete call arguments; their avals gate both layers
+    so a model that drifted since export can never run a stale program.
+    """
+    expect = _leaf_sig_strs(args)
+    compiled = store.load_compiled(name, expect_nr_devices=expect_nr_devices,
+                                   expect_in_avals=expect)
+    if compiled is not None:
+        return compiled
+    exported = store.load(name, expect_nr_devices=expect_nr_devices,
+                          expect_in_avals=expect)
+    if exported is None:
+        return None
+    from .cache import cached_jit
+    entry = store.entries().get(name, {})
+    return cached_jit(exported.call,
+                      key=("aot_exported", name, entry.get("sha256")),
+                      name="aot_exported")

@@ -1,0 +1,383 @@
+"""Compilation caching: one `cached_jit` front door + the persistent XLA cache.
+
+Recompiles are the fleet's dominant recovery cost (ROADMAP item 3: a hung
+ResNet-50 compile wedged the pool for a round; bench budgets ~22 min of
+bring-up), so every hot entry point acquires its jitted callable here instead
+of calling ``jax.jit`` ad hoc. Two layers:
+
+- **In-memory (process) layer** — ``cached_jit(fn, key=...)`` memoizes the
+  *wrapper object* on an explicit static-config key plus the backend
+  fingerprint, so two estimator instances with the same static config share
+  ONE executable instead of re-tracing per instance (the round-11 churn:
+  ``DNNModel``'s per-instance ``_jitted`` dict, the transformer models'
+  per-instance ``_fwd_cache``, and per-fit ``jax.jit(train)`` closures in VW).
+  jax.jit's own trace cache handles shape/dtype specialization below that.
+
+- **Persistent layer** — JAX's on-disk XLA compilation cache
+  (``jax_compilation_cache_dir``), enabled and managed by
+  ``configure_persistent_cache``. Keys there are XLA's own (backend +
+  topology + HLO + compile options), which subsume the (backend/topology,
+  shapes, dtypes, donation/sharding) tuple; a freshly scheduled or
+  elastic-resumed worker re-deserializes executables instead of recompiling.
+
+Both layers feed hit/miss/compile-second counters into the metrics registry
+(``cache_stats`` is the snapshot hook; bench embeds it per emitted JSON).
+
+The Flare argument (arxiv 1703.08219) for ahead-of-time native compilation is
+exactly this layer; the reference ships pre-built model artifacts to executors
+(ModelDownloader/CNTKModel) where we ship serialized executables (see
+``compile/aot.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "CachedFunction", "cached_jit", "cache_stats", "clear_memory_cache",
+    "configure_persistent_cache", "persistent_cache_dir",
+]
+
+_LOCK = threading.RLock()
+_REGISTRY: Dict[Any, "CachedFunction"] = {}
+
+# persistent-layer state: configured dir (None until configure) and the
+# monitoring-listener event tallies (XLA cache hits are only observable
+# through jax's monitoring events)
+_PERSISTENT: Dict[str, Any] = {"dir": None, "listeners": False,
+                               "hits": 0, "requests": 0,
+                               "retrieval_seconds": 0.0}
+
+#: env switches — MMLSPARK_COMPILE_CACHE=0 disables the persistent layer
+#: (the in-memory layer is always on; it has no failure mode), and
+#: MMLSPARK_COMPILE_CACHE_DIR overrides the on-disk location.
+ENV_ENABLE = "MMLSPARK_COMPILE_CACHE"
+ENV_DIR = "MMLSPARK_COMPILE_CACHE_DIR"
+_DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
+                            "mmlspark_tpu", "xla-cache")
+
+
+def _metrics():
+    """Registry handles, resolved lazily so importing compile/ never forces
+    the observability module (and tests can swap the process registry)."""
+    from ..observability import get_registry
+    return get_registry()
+
+
+def _count(layer: str, event: str, entry_point: str) -> None:
+    try:
+        _metrics().counter(
+            "compile_cache_events_total",
+            "compilation cache lookups by layer (memory|persistent) and "
+            "event (hit|miss)",
+            {"layer": layer, "event": event, "entry_point": entry_point},
+        ).inc()
+    except Exception:
+        pass  # telemetry must never break a fit or a serve
+
+
+def _add_compile_seconds(entry_point: str, secs: float) -> None:
+    try:
+        _metrics().counter(
+            "compile_seconds_total",
+            "wall seconds spent inside first-call trace+compile per entry "
+            "point (new argument signatures only)",
+            {"entry_point": entry_point}).inc(secs)
+    except Exception:
+        pass
+
+
+def _backend_fingerprint() -> Tuple[str, int]:
+    """(platform, visible device count) — the topology part of the cache
+    key. XLA's own persistent key covers the full topology; this keeps the
+    in-memory layer from handing a 1-device executable to an 8-device mesh
+    config (mesh extent is also in every caller's explicit key)."""
+    try:
+        return (jax.default_backend(), jax.device_count())
+    except Exception:  # backend not initializable (e.g. doc builds)
+        return ("uninitialized", 0)
+
+
+def _leaf_sig(leaf: Any) -> Any:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype))
+    try:
+        hash(leaf)
+        return ("v", leaf)
+    except TypeError:
+        return ("t", type(leaf).__name__)
+
+
+class CachedFunction:
+    """A shared jitted callable with hit/miss/compile-seconds accounting.
+
+    The first call with a previously unseen argument signature (pytree
+    structure + leaf shapes/dtypes + static values) is counted as a
+    **memory miss** and its wall time booked to ``compile_seconds_total`` —
+    that call pays trace+compile (or a persistent-cache deserialize).
+    Every later call with a seen signature is a **memory hit** and goes
+    straight to jax.jit's executable lookup.
+    """
+
+    __slots__ = ("name", "key", "_fn", "_jitted", "_signatures", "_lock")
+
+    def __init__(self, fn: Callable, name: str, key: Any,
+                 static_argnames=(), donate_argnums=(), **jit_kwargs):
+        self.name = name
+        self.key = key
+        self._fn = fn
+        self._jitted = jax.jit(fn, static_argnames=static_argnames,
+                               donate_argnums=donate_argnums, **jit_kwargs)
+        self._signatures: set = set()
+        self._lock = threading.Lock()
+
+    def _signature(self, args, kwargs) -> Any:
+        leaves, treedef = jax.tree.flatten((args, kwargs))
+        return (treedef, tuple(_leaf_sig(l) for l in leaves))
+
+    def __call__(self, *args, **kwargs):
+        sig = self._signature(args, kwargs)
+        with self._lock:
+            seen = sig in self._signatures
+            if not seen:
+                self._signatures.add(sig)
+        if seen:
+            _count("memory", "hit", self.name)
+            return self._jitted(*args, **kwargs)
+        _count("memory", "miss", self.name)
+        t0 = time.perf_counter()
+        try:
+            return self._jitted(*args, **kwargs)
+        finally:
+            _add_compile_seconds(self.name, time.perf_counter() - t0)
+
+    # jit-object passthroughs used by AOT export and tests
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    @property
+    def jitted(self):
+        return self._jitted
+
+    @property
+    def signatures_seen(self) -> int:
+        return len(self._signatures)
+
+    def __repr__(self) -> str:
+        return (f"CachedFunction({self.name!r}, "
+                f"signatures={len(self._signatures)})")
+
+
+def cached_jit(fn: Callable, *, key: Any, name: Optional[str] = None,
+               static_argnames=(), donate_argnums=(),
+               **jit_kwargs) -> CachedFunction:
+    """The one front door for jitted callables on hot fit/serve paths.
+
+    ``key`` must be a hashable value that FULLY determines the traced
+    computation modulo traced arguments (static config, mesh extent,
+    donation/sharding choice — anything baked into the closure). Two calls
+    with equal keys share one ``CachedFunction`` (the first caller's ``fn``
+    wins), so identical configs across estimator instances — or across a
+    preempt→resume pair in one process — share one executable. The backend
+    fingerprint (platform, device count) is appended automatically.
+
+    Enables the persistent on-disk layer as a side effect (first call only;
+    no-op when disabled via ``MMLSPARK_COMPILE_CACHE=0``).
+    """
+    name = name or getattr(fn, "__name__", "anonymous")
+    full_key = (name, key, static_argnames, donate_argnums,
+                _backend_fingerprint())
+    with _LOCK:
+        entry = _REGISTRY.get(full_key)
+        if entry is not None:
+            _count("memory", "wrapper_hit", name)
+            return entry
+        configure_persistent_cache()
+        entry = CachedFunction(fn, name, full_key,
+                               static_argnames=static_argnames,
+                               donate_argnums=donate_argnums, **jit_kwargs)
+        _REGISTRY[full_key] = entry
+        try:
+            _metrics().gauge(
+                "compile_cache_entries",
+                "cached_jit wrapper objects resident in-process"
+            ).set(float(len(_REGISTRY)))
+        except Exception:
+            pass
+        return entry
+
+
+# --------------------------------------------------------- persistent layer
+
+def _on_cache_event(event: str, **kw) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        _PERSISTENT["hits"] += 1
+        _count("persistent", "hit", "_xla")
+    elif event == "/jax/compilation_cache/compile_requests_use_cache":
+        _PERSISTENT["requests"] += 1
+
+
+def _on_cache_duration(event: str, duration: float, **kw) -> None:
+    if "compilation_cache" in event and "retrieval" in event:
+        _PERSISTENT["retrieval_seconds"] += duration
+
+
+def configure_persistent_cache(cache_dir: Optional[str] = None,
+                               min_compile_secs: Optional[float] = None,
+                               ) -> Optional[str]:
+    """Enable JAX's on-disk compilation cache (idempotent).
+
+    Resolution order: explicit ``cache_dir`` > ``MMLSPARK_COMPILE_CACHE_DIR``
+    > ``~/.cache/mmlspark_tpu/xla-cache``. Returns the active directory, or
+    None when disabled (``MMLSPARK_COMPILE_CACHE=0``). The default
+    min-compile-time threshold is 0 s — the fleet's pain is many medium
+    compiles at bring-up, not a single giant one, so everything is cached
+    (override via MMLSPARK_COMPILE_CACHE_MIN_SECS).
+    """
+    if os.environ.get(ENV_ENABLE, "1").lower() in ("0", "off", "false"):
+        return None
+    with _LOCK:
+        if _PERSISTENT["dir"] is not None and cache_dir is None:
+            return _PERSISTENT["dir"]
+        path = (cache_dir or os.environ.get(ENV_DIR) or _DEFAULT_DIR)
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            if min_compile_secs is None:
+                min_compile_secs = float(os.environ.get(
+                    "MMLSPARK_COMPILE_CACHE_MIN_SECS", "0"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              min_compile_secs)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception:
+            return None  # cache is an optimization, never a crash
+        try:
+            # jax initializes its cache object AT MOST ONCE, at the first
+            # compile of the process; if that compile ran before this
+            # configure call — a jnp.asarray during model load is enough —
+            # the cache is latched as "initialized, no backing store"
+            # (_cache_initialized=True, _cache=None) and every later
+            # read/write silently no-ops. Un-latch so late enablement
+            # works; reset_cache() is jax's own back-to-pristine hook.
+            from jax._src import compilation_cache as _cc
+            if getattr(_cc, "_cache_initialized", False) \
+                    and getattr(_cc, "_cache", None) is None:
+                _cc.reset_cache()
+        except Exception:
+            pass
+        if not _PERSISTENT["listeners"]:
+            try:
+                from jax._src import monitoring
+                monitoring.register_event_listener(_on_cache_event)
+                monitoring.register_event_duration_secs_listener(
+                    _on_cache_duration)
+                _PERSISTENT["listeners"] = True
+            except Exception:
+                pass  # stats degrade, caching still works
+        _PERSISTENT["dir"] = path
+        return path
+
+
+def persistent_cache_dir() -> Optional[str]:
+    return _PERSISTENT["dir"]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def uncached_compile():
+    """Force compiles inside the block to bypass the persistent cache.
+
+    An executable RETRIEVED from the persistent cache serializes without
+    its symbol payload on XLA:CPU — exporting it produces an artifact that
+    fails to deserialize ("Symbols not found"). AOT export therefore
+    compiles from scratch inside this context. Not thread-safe (it resets
+    jax's process-wide cache latch); export is an offline publish step.
+    """
+    from jax._src import compilation_cache as _cc
+    old_dir = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _cc.reset_cache()
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        _cc.reset_cache()
+
+
+# ----------------------------------------------------------------- snapshot
+
+def cache_stats() -> Dict[str, Any]:
+    """Snapshot for bench JSON / measure scripts: both layers + AOT."""
+    reg = _metrics()
+    snap = {"entries": len(_REGISTRY),
+            "persistent_dir": _PERSISTENT["dir"],
+            "persistent_hits": _PERSISTENT["hits"],
+            "persistent_requests": _PERSISTENT["requests"],
+            "persistent_retrieval_seconds":
+                round(_PERSISTENT["retrieval_seconds"], 4)}
+    try:
+        fam = reg.snapshot().get("compile_cache_events_total", {})
+        mem_hit = mem_miss = 0.0
+        per_entry: Dict[str, Dict[str, float]] = {}
+        for row in fam.get("series", ()):
+            labels, v = row.get("labels", {}), float(row.get("value", 0))
+            if labels.get("layer") != "memory":
+                continue
+            ev = labels.get("event", "")
+            if ev == "hit":
+                mem_hit += v
+            elif ev == "miss":
+                mem_miss += v
+            if ev in ("hit", "miss"):
+                ep = per_entry.setdefault(labels.get("entry_point", "?"),
+                                          {"hit": 0.0, "miss": 0.0})
+                ep[ev] += v
+        snap["memory_hits"] = mem_hit
+        snap["memory_misses"] = mem_miss
+        snap["per_entry_point"] = per_entry
+    except Exception:
+        pass
+    try:
+        snap["compile_seconds_total"] = reg.total("compile_seconds_total")
+    except Exception:
+        pass
+    try:
+        snap["aot_fallbacks_total"] = reg.total("compile_aot_fallback_total")
+        snap["aot_loads_ok_total"] = reg.total("compile_aot_load_ok_total")
+    except Exception:
+        pass
+    return snap
+
+
+_CLEAR_HOOKS: list = []
+
+
+def on_clear(fn: Callable[[], None]) -> Callable[[], None]:
+    """Register a callback run by clear_memory_cache — modules that memoize
+    cached_jit wrappers themselves (e.g. the lru-cached GBDT program
+    factories) register their cache_clear here so one clear drops BOTH
+    layers; a stale outer memo would otherwise keep handing back wrappers
+    whose jit executables a jax.clear_caches() already destroyed."""
+    _CLEAR_HOOKS.append(fn)
+    return fn
+
+
+def clear_memory_cache() -> None:
+    """Drop every cached wrapper (tests; pairs with jax.clear_caches())."""
+    with _LOCK:
+        _REGISTRY.clear()
+        for fn in _CLEAR_HOOKS:
+            try:
+                fn()
+            except Exception:
+                pass

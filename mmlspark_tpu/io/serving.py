@@ -438,6 +438,11 @@ class ServingServer:
         self._lat_hist = self.registry.histogram(
             "serving_request_latency_seconds",
             "enqueue-to-reply latency (p50/p95/p99 derivable)", lbl)
+        self._cold_start_gauge = self.registry.gauge(
+            "serving_cold_start_seconds",
+            "start() to first successful reply (includes any first-request "
+            "compile the cache/AOT layers did not absorb)", lbl)
+        self._t_started: Optional[float] = None
         self._batch_gauge = self.registry.gauge(
             "serving_last_batch_size", "rows in the last batch", lbl)
         self._rows_gauge = self.registry.gauge(
@@ -498,6 +503,19 @@ class ServingServer:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingServer":
+        # armed BEFORE the listener accepts: the first reply may land
+        # while start() is still returning
+        self._t_started = time.perf_counter()
+        # arm the persistent XLA compile cache before the first request can
+        # trigger a handler compile: a re-scheduled worker deserializes the
+        # executable instead of recompiling (no-op when disabled; AOT
+        # artifacts are loaded model-side, e.g. Booster.
+        # load_serving_artifacts — docs/SERVING.md "Cold start")
+        try:
+            from ..compile.cache import configure_persistent_cache
+            configure_persistent_cache()
+        except Exception:
+            pass
         if self.listener == "asyncio":
             # persistent-connection listener: the sub-ms HTTP path
             self._alistener = _AsyncListener(
@@ -623,6 +641,11 @@ class ServingServer:
             for pend, body in zip(batch, replies):
                 pend.complete({"status": 200, "body": body})
             t_done = time.perf_counter()
+            if self._t_started is not None:
+                # cold-start-to-first-reply: the metric the compile cache /
+                # AOT artifacts exist to shrink (scripts/measure_cold_start)
+                self._cold_start_gauge.set(t_done - self._t_started)
+                self._t_started = None
             self._batch_gauge.set(n)
             if t_disp > t_asm:
                 self._rows_gauge.set(n / (t_disp - t_asm))

@@ -31,16 +31,98 @@ class GraphModel:
         self.module = module
         self.variables = variables
         self.schema = schema
-        self._jitted = {}
+        # AOT serving artifacts (compile/aot.py), armed by
+        # load_serving_artifacts; keyed per (layer, batch) bucket.
+        # (__reduce__ rebuilds via __init__, so a pickled GraphModel
+        # rehydrates with these reset — executables are process-local.)
+        self._aot_store = None
+        self._aot_cache: dict = {}
 
     def apply_fn(self, layer: Optional[str]):
-        """jitted apply capturing the fetch layer (CNTK outputMap analogue)."""
-        key = layer
-        if key not in self._jitted:
-            def fn(variables, x):
-                return self.module.apply(variables, x, capture=layer)
-            self._jitted[key] = jax.jit(fn)
-        return self._jitted[key]
+        """jitted apply capturing the fetch layer (CNTK outputMap analogue).
+
+        Acquired via the shared cached_jit registry instead of a
+        per-instance dict: two GraphModels of the same zoo schema (the
+        common featurizer fleet shape) share ONE executable per fetch
+        layer instead of recompiling per instance. The flax module repr
+        (its full static config) disambiguates hand-built models that
+        reuse a zoo name."""
+        from ...compile.cache import cached_jit
+        module = self.module
+
+        def fn(variables, x):
+            return module.apply(variables, x, capture=layer)
+
+        return cached_jit(
+            fn, key=("dnn_apply", self.schema.name, repr(module), layer),
+            name="dnn_apply")
+
+    # --------------------------------------------------------- AOT export
+    def _aot_name(self, layer, batch: int) -> str:
+        return f"apply_{layer or 'logits'}_b{batch}"
+
+    def export_serving_artifacts(self, directory: str, batch_sizes=(1, 16),
+                                 layers=(None, "pool"),
+                                 include_compiled: bool = True) -> list:
+        """AOT-export the forward for the given fetch layers and batch
+        buckets into ``directory`` beside the zoo checkpoint: the portable
+        ``jax.export`` layer plus (by default) the pre-compiled executable
+        for this exact backend. A serving/featurizer worker loading these
+        starts without tracing or compiling the CNN — the reference ships
+        pre-built model artifacts to executors the same way
+        (ModelDownloader/CNTKModel)."""
+        from jax import export as jax_export
+
+        from ...compile.aot import AOTStore, compile_for_export
+        store = AOTStore(directory)
+        h, w, c = self.schema.input_dims
+        vspecs = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(jnp.shape(l),
+                                           jnp.asarray(l).dtype),
+            self.variables)
+        names = []
+        for layer in layers:
+            fn = self.apply_fn(layer).jitted
+            for b in batch_sizes:
+                xspec = jax.ShapeDtypeStruct((int(b), h, w, c), jnp.float32)
+                exported = jax_export.export(fn)(vspecs, xspec)
+                compiled = (compile_for_export(fn, vspecs, xspec)
+                            if include_compiled else None)
+                name = self._aot_name(layer, int(b))
+                store.save(name, exported, compiled=compiled, extra={
+                    "entry_point": "dnn_apply", "model": self.schema.name,
+                    "layer": layer or "logits", "batch": int(b)})
+                names.append(name)
+        return names
+
+    def load_serving_artifacts(self, directory: str) -> "GraphModel":
+        """Arm AOT serving: apply_fn consults ``directory``'s manifest per
+        (layer, batch bucket) with counted fallback to fresh JIT."""
+        from ...compile.aot import AOTStore
+        self._aot_store = AOTStore(directory)
+        self._aot_cache = {}
+        return self
+
+    def _aot_apply(self, layer, variables, x):
+        """Exported-executable forward for this (layer, batch), or None
+        (counted fallback) so the caller JITs. Never raises."""
+        if self._aot_store is None:
+            return None
+        from ...compile.aot import count_fallback, load_serving_callable
+        name = self._aot_name(layer, int(x.shape[0]))
+        if name not in self._aot_cache:
+            self._aot_cache[name] = load_serving_callable(
+                self._aot_store, name, (variables, x),
+                expect_nr_devices=1)
+        fn = self._aot_cache[name]
+        if fn is None:
+            return None
+        try:
+            return fn(variables, x)
+        except Exception:
+            count_fallback("call_error", name)
+            self._aot_cache[name] = None
+            return None
 
     def __reduce__(self):
         # pickled via the zoo name + host numpy leaves (model-bytes broadcast
@@ -125,7 +207,8 @@ class DNNModel(Model, _p.HasInputCol, _p.HasOutputCol, _p.HasBatchSize):
         arr = self._coerce_batch(df[self.get("inputCol")])
         n = len(arr)
         b = self.get("batchSize")
-        fn = gm.apply_fn(self.get("outputNode"))
+        layer = self.get("outputNode")
+        fn = None  # fresh-JIT path acquired lazily (AOT may cover all)
         outs = []
         for start in range(0, n, b):
             chunk = arr[start:start + b]
@@ -133,7 +216,13 @@ class DNNModel(Model, _p.HasInputCol, _p.HasOutputCol, _p.HasBatchSize):
             if pad:  # fixed batch shape => one compiled program
                 chunk = np.concatenate(
                     [chunk, np.zeros((pad,) + chunk.shape[1:], np.float32)])
-            res = np.asarray(fn(gm.variables, jnp.asarray(chunk)))
+            xb = jnp.asarray(chunk)
+            res = gm._aot_apply(layer, gm.variables, xb)
+            if res is None:
+                if fn is None:
+                    fn = gm.apply_fn(layer)
+                res = fn(gm.variables, xb)
+            res = np.asarray(res)
             outs.append(res[:b - pad] if pad else res)
         out = np.concatenate(outs, axis=0)
         return df.with_column(self.get("outputCol"),

@@ -543,9 +543,12 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
         self._set(**kw)
 
     def _compiled(self):
-        """Cache the jitted forward per static config — rebuilding the
-        shard_map/jit closure every call would retrace + recompile on each
-        transform."""
+        """Acquire the jitted forward from the shared cached_jit registry,
+        keyed on the full static config — rebuilding the shard_map/jit
+        closure every call would retrace + recompile on each transform,
+        and a per-instance cache would still recompile identical configs
+        across instances (round-11 churn fix)."""
+        from ...compile.cache import cached_jit
         from ...parallel import mesh as meshlib
         nh = self.get("numHeads")
         causal = self.get("causal")
@@ -555,25 +558,21 @@ class TransformerEncoderModel(Model, _p.HasInputCol, _p.HasOutputCol):
         if seq_attn not in ("ring", "ulysses"):
             raise ValueError(f"sequenceAttention must be 'ring' or "
                              f"'ulysses', got {seq_attn!r}")
-        key = (nh, causal, ndev, pos, seq_attn)
-        cached = getattr(self, "_fwd_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        key = ("transformer_encoder_fwd", nh, causal, ndev, pos, seq_attn)
         if ndev and ndev > 1:
             from jax.sharding import PartitionSpec as P
             mesh = meshlib.get_mesh(ndev)
             axis = meshlib.DATA_AXIS
-            fn = jax.jit(_shard_map(
+            fn = _shard_map(
                 partial(encoder_forward, num_heads=nh, causal=causal,
                         axis_name=axis, positional=pos,
                         attention_impl=seq_attn),
                 mesh=mesh, in_specs=(P(), P(None, axis, None)),
-                out_specs=P(None, axis, None), check_vma=False))
+                out_specs=P(None, axis, None), check_vma=False)
         else:
-            fn = jax.jit(partial(encoder_forward, num_heads=nh,
-                                 causal=causal, positional=pos))
-        self._fwd_cache = (key, fn)
-        return fn
+            fn = partial(encoder_forward, num_heads=nh, causal=causal,
+                         positional=pos)
+        return cached_jit(fn, key=key, name="transformer_encoder_fwd")
 
     def _forward(self, x: jax.Array) -> jax.Array:
         p = self.get("weights")
@@ -956,35 +955,32 @@ class TransformerClassificationModel(Model, _p.HasInputCol):
             self._set(weights=weights, head=head)
 
     def _compiled(self):
-        """Cache the jitted forward per static config — defining @jax.jit
-        inside transform would retrace + recompile on every call (the same
-        cache discipline as TransformerEncoderModel._compiled)."""
+        """Acquire the jitted forward from the shared cached_jit registry
+        — defining @jax.jit inside transform would retrace + recompile on
+        every call, and the old per-instance `_fwd_cache` still recompiled
+        identical configs per instance (round-11 churn fix; the MoE
+        sharded forward shares the same registry)."""
+        from ...compile.cache import cached_jit
         nh, causal = self.get("numHeads"), self.get("causal")
         ne, cf = self.get("numExperts"), self.get("capacityFactor")
-        key = (nh, causal, ne, cf)
-        cached = getattr(self, "_fwd_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        key = ("transformer_clf_fwd", nh, causal, ne, cf)
 
         if ne > 0:
             from .moe_encoder import moe_encoder_forward
 
-            @jax.jit
             def fwd(p, h, xb):
                 enc, _ = moe_encoder_forward(p, xb, nh, ne, cf,
                                              causal=causal)
                 logits = enc.mean(axis=1) @ h["w"] + h["b"]
                 return jax.nn.softmax(logits, axis=-1)
         else:
-            @jax.jit
             def fwd(p, h, xb):
                 enc = encoder_forward(p, xb, nh, causal,
                                       attention_impl="reference")
                 logits = enc.mean(axis=1) @ h["w"] + h["b"]
                 return jax.nn.softmax(logits, axis=-1)
 
-        self._fwd_cache = (key, fwd)
-        return fwd
+        return cached_jit(fwd, key=key, name="transformer_clf_fwd")
 
     def transform(self, df: DataFrame) -> DataFrame:
         if self.get("weights") is None or self.get("head") is None:

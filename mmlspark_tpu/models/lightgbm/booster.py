@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...compile.aot import AOTStore, load_serving_callable
+from ...compile.cache import cached_jit
 from ...ops.binning import BinMapper
 from ...ops.boosting import Tree, tree_apply_raw
 from ...ops.objectives import get_objective
@@ -55,6 +57,25 @@ class Booster:
         # rf mode: prediction is the average of tree outputs, not the sum
         # (LightGBM model-file `average_output` flag)
         self.average_output = average_output
+        # AOT serving artifacts (compile/aot.py): set by
+        # load_serving_artifacts; _aot_cache memoizes per-batch-bucket
+        # Exported programs (None = counted fallback already taken)
+        self._aot_store = None
+        self._aot_cache: dict = {}
+
+    def __getstate__(self):
+        # Exported executables are process-local (and not picklable);
+        # a rehydrated booster re-loads them from its store lazily
+        state = dict(self.__dict__)
+        state["_aot_cache"] = {}
+        return state
+
+    def __setstate__(self, state):
+        # boosters pickled before the AOT fields existed must rehydrate
+        # with them present (pickle bypasses __init__)
+        self.__dict__.update(state)
+        self.__dict__.setdefault("_aot_store", None)
+        self.__dict__.setdefault("_aot_cache", {})
 
     # ------------------------------------------------------------ properties
     @property
@@ -101,19 +122,99 @@ class Booster:
         return np.concatenate([x, pad], axis=0)
 
     def raw_predict(self, x: np.ndarray) -> np.ndarray:
-        """Margin scores: [N] (single-output) or [N, K]. Batched jit traversal."""
+        """Margin scores: [N] (single-output) or [N, K]. Batched jit
+        traversal; when AOT serving artifacts are loaded
+        (load_serving_artifacts) the matching per-batch-bucket exported
+        executable runs instead, with counted fallback to fresh JIT on any
+        mismatch."""
         n = x.shape[0]
         x = jnp.asarray(self._pad_rows_pow2(self._prep_x(x)))
         t_used = self._used_iters()
         trees = Tree(*[jnp.asarray(a[:t_used]) for a in self.trees])
         thr = jnp.asarray(self.thresholds[:t_used])
         init = jnp.asarray(self.init_score)
-        raw = np.asarray(_raw_predict_jit(trees, thr, init, x,
-                                          self.multiclass))[:n]
+        raw = None
+        if self._aot_store is not None:
+            raw = self._aot_raw_predict(trees, thr, init, x)
+        if raw is None:
+            raw = _raw_predict_jit(trees, thr, init, x, self.multiclass)
+        raw = np.asarray(raw)[:n]
         if self.average_output and t_used > 0:
             raw = np.asarray(self.init_score) + (
                 raw - np.asarray(self.init_score)) / t_used
         return raw
+
+    # ------------------------------------------------------- AOT artifacts
+    def _aot_flat_args(self, trees: Tree, thr, init, x) -> list:
+        return list(trees) + [thr, init, x]
+
+    def _aot_raw_predict(self, trees: Tree, thr, init, x):
+        """Run the exported program for this batch bucket, or None (counted
+        fallback) so the caller JITs. Never raises."""
+        name = f"raw_predict_b{x.shape[0]}"
+        flat = self._aot_flat_args(trees, thr, init, x)
+        if name not in self._aot_cache:
+            self._aot_cache[name] = load_serving_callable(
+                self._aot_store, name, tuple(flat), expect_nr_devices=1)
+        fn = self._aot_cache[name]
+        if fn is None:
+            return None
+        try:
+            return fn(*flat)
+        except Exception:
+            from ...compile.aot import count_fallback
+            count_fallback("call_error", name)
+            self._aot_cache[name] = None
+            return None
+
+    def export_serving_artifacts(self, directory: str,
+                                 batch_sizes=(1, 2, 4, 8, 16, 32, 64),
+                                 include_compiled: bool = True
+                                 ) -> List[str]:
+        """AOT-export the raw-predict program for the given serving batch
+        buckets (rounded up to the pow2 discipline of _pad_rows_pow2) into
+        ``directory`` (artifact files + atomic MANIFEST.json): the portable
+        ``jax.export`` layer plus (by default) the pre-compiled executable
+        layer for this exact backend. Stored beside the model's
+        checkpoint/zoo entry so a serving worker starts from precompiled
+        executables. Returns the manifest entry names."""
+        from jax import export as jax_export
+        store = AOTStore(directory)
+        t_used = self._used_iters()
+        trees = Tree(*[jnp.asarray(a[:t_used]) for a in self.trees])
+        flat = list(trees) + [jnp.asarray(self.thresholds[:t_used]),
+                              jnp.asarray(self.init_score)]
+        fn = jax.jit(partial(_flat_raw_predict, self.multiclass))
+        names = []
+        done = set()
+        for b in batch_sizes:
+            b = 1 << max(int(b) - 1, 0).bit_length()
+            if b in done:
+                continue
+            done.add(b)
+            specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat]
+            specs.append(jax.ShapeDtypeStruct((b, self.num_features),
+                                              jnp.float32))
+            exported = jax_export.export(fn)(*specs)
+            from ...compile.aot import compile_for_export
+            compiled = (compile_for_export(fn, *specs) if include_compiled
+                        else None)
+            name = f"raw_predict_b{b}"
+            store.save(name, exported, compiled=compiled, extra={
+                "entry_point": "gbdt_raw_predict", "batch": b,
+                "t_used": int(t_used), "num_class": int(self.num_class),
+                "num_features": int(self.num_features),
+                "objective": self.objective,
+                "multiclass": bool(self.multiclass)})
+            names.append(name)
+        return names
+
+    def load_serving_artifacts(self, directory: str) -> "Booster":
+        """Arm AOT serving: predict calls consult ``directory``'s manifest
+        first and fall back (counted) to fresh JIT on any mismatch."""
+        self._aot_store = AOTStore(directory)
+        self._aot_cache = {}
+        return self
 
     def score(self, x: np.ndarray) -> np.ndarray:
         """Prediction-space output (probability / mean), matching
@@ -554,8 +655,7 @@ def _tree_to_json(tree: Tree, thr: np.ndarray, value_shift: float) -> dict:
 _PREDICT_VMAP_MAX_ROWS = 4096
 
 
-@partial(jax.jit, static_argnames=("multiclass",))
-def _raw_predict_jit(trees: Tree, thresholds, init, x, multiclass: bool):
+def _raw_predict_impl(trees: Tree, thresholds, init, x, multiclass: bool):
     def one_tree(tree, thr):
         slot = tree_apply_raw(tree, x, thr)
         return tree.leaf_value[slot]
@@ -586,8 +686,7 @@ def _raw_predict_jit(trees: Tree, thresholds, init, x, multiclass: bool):
     return out
 
 
-@partial(jax.jit, static_argnames=("multiclass",))
-def _predict_leaf_jit(trees: Tree, thresholds, x, multiclass: bool):
+def _predict_leaf_impl(trees: Tree, thresholds, x, multiclass: bool):
     def one_tree(tree, thr):
         return tree_apply_raw(tree, x, thr)
 
@@ -595,3 +694,31 @@ def _predict_leaf_jit(trees: Tree, thresholds, x, multiclass: bool):
         return jax.lax.map(lambda tk: jax.vmap(one_tree)(tk[0], tk[1]),
                            (trees, thresholds))
     return jax.lax.map(lambda tk: one_tree(tk[0], tk[1]), (trees, thresholds))
+
+
+def _raw_predict_jit(trees: Tree, thresholds, init, x, multiclass: bool):
+    """Serving-critical margin program, acquired via the shared cached_jit
+    registry (compile/): every booster in the process shares one executable
+    per (shape, dtype, multiclass) signature, counted in cache_stats."""
+    fn = cached_jit(_raw_predict_impl, key="gbdt_raw_predict",
+                    name="gbdt_raw_predict", static_argnames=("multiclass",))
+    return fn(trees, thresholds, init, x, multiclass=multiclass)
+
+
+def _predict_leaf_jit(trees: Tree, thresholds, x, multiclass: bool):
+    fn = cached_jit(_predict_leaf_impl, key="gbdt_predict_leaf",
+                    name="gbdt_predict_leaf",
+                    static_argnames=("multiclass",))
+    return fn(trees, thresholds, x, multiclass=multiclass)
+
+
+def _flat_raw_predict(multiclass: bool, *arrays):
+    """Flat-argument adapter for jax.export: Tree is a NamedTuple and
+    export serialization wants plain positional arrays, so artifacts carry
+    ``(*tree_fields, thresholds, init, x)`` flattened in Tree._fields
+    order (the loader reassembles identically — a stable calling
+    convention independent of pytree registration)."""
+    nf = len(Tree._fields)
+    trees = Tree(*arrays[:nf])
+    thresholds, init, x = arrays[nf], arrays[nf + 1], arrays[nf + 2]
+    return _raw_predict_impl(trees, thresholds, init, x, multiclass)

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...compile import cache as compilecache
 from ...core import params as _p
 from ...core.dataframe import DataFrame
 from ...core.pipeline import Estimator, Model
@@ -278,11 +279,18 @@ def _assemble_features(df: DataFrame, features_col: str, additional,
     return SparseFeatures(indices.astype(np.int32), values, nf)
 
 
-@jax.jit
-def _score_batch(w, bias, indices, values):
-    """Batched margin: sum_k w[idx]*val + bias (module-level jit => cached
-    across transform calls; weights are traced args, not baked-in constants)."""
+def _score_batch_impl(w, bias, indices, values):
+    """Batched margin: sum_k w[idx]*val + bias (weights are traced args,
+    not baked-in constants)."""
     return (w[indices] * values).sum(axis=-1) + bias
+
+
+def _score_batch(w, bias, indices, values):
+    """Serving-side margin, acquired via the shared cached_jit registry
+    (compile/): cached across transform calls AND counted in cache_stats."""
+    return compilecache.cached_jit(
+        _score_batch_impl, key="vw_score",
+        name="vw_score")(w, bias, indices, values)
 
 
 class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
@@ -400,9 +408,16 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
                 mesh, idx, val, yy, weights=ww)
             # the VWState pytree stays uncommitted (init_state zeros /
             # warm-start asarray): jit replicates it per in_specs P()
-            state, losses = jax.jit(sharded)(idx_s, val_s, y_s, w_s, state)
+            # the VW train step rides the shared compile cache: a resumed
+            # or re-scheduled worker with the same VWConfig + mesh extent
+            # reuses the executable instead of paying full JIT
+            state, losses = compilecache.cached_jit(
+                sharded, key=("vw_train_sharded", cfg, ntasks),
+                name="vw_train_sharded")(idx_s, val_s, y_s, w_s, state)
         else:
-            state, losses = jax.jit(train)(idx, val, yy, ww, state)
+            state, losses = compilecache.cached_jit(
+                train, key=("vw_train", cfg),
+                name="vw_train")(idx, val, yy, ww, state)
         jax.block_until_ready(state.w)
         t_end = time.perf_counter_ns()
         stats = {

@@ -58,6 +58,8 @@ EOF
     fi
     echo "== serving (incl. HTTP->TPU->reply E2E) $(date -u +%FT%TZ)"
     run python -u scripts/measure_serving_tpu.py
+    echo "== cold start: compile cache + AOT (round-11 tentpole) $(date -u +%FT%TZ)"
+    run python -u scripts/measure_cold_start.py --out docs/COLD_START_chip.json
     echo "== bench (validates binning fast path on chip) $(date -u +%FT%TZ)"
     run python -u bench.py
     echo "== vw throughput (validates shared-index fast path) $(date -u +%FT%TZ)"

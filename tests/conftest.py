@@ -12,6 +12,9 @@ import os
 # alone is not enough when a site hook (e.g. axon) registers a TPU plugin and
 # re-points jax_platforms, so also reset the config after importing jax.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# keep the suite hermetic: no on-disk XLA cache reads/writes unless a test
+# opts in explicitly (warm-start tests re-enable it in their subprocesses)
+os.environ.setdefault("MMLSPARK_COMPILE_CACHE", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -116,7 +119,62 @@ def _clear_jax_caches_per_module():
     compiling test_sp_gradients_match_single_device's program crashed in
     backend_compile_and_load (the same test passes standalone and in every
     subset tried). Clearing per module keeps each module's compilation
-    context close to the standalone one."""
+    context close to the standalone one.
+
+    The cached_jit wrapper registry (compile/cache.py) is cleared with it:
+    its wrappers hold jax.jit objects whose executables clear_caches just
+    dropped, and its seen-signature sets would otherwise count stale
+    hits."""
     yield
     import jax as _jax
     _jax.clear_caches()
+    from mmlspark_tpu.compile import clear_memory_cache
+    clear_memory_cache()
+
+
+# --------------------------------------------------------------------------
+# Tier-1 duration audit (ISSUE-11): the suite runs near the 870 s cap, so
+# per-test durations are always reported (pyproject --durations addopt) and
+# the fast tier's summed test time is checked against a budget here. By
+# default breaching the budget only prints a loud warning (one slow shared
+# box must not fail an otherwise-green run); set TIER1_DURATION_GATE=1 (the
+# recovery watcher / CI does) to turn the breach into a failed exit.
+TIER1_BUDGET_S = float(os.environ.get("TIER1_TEST_BUDGET_S", "780"))
+
+_durations: dict = {}
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    out = yield
+    rep = out.get_result()
+    if rep.when == "call":
+        _durations[item.nodeid] = rep.duration
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _durations:
+        return
+    marks = config.option.markexpr or ""
+    if "not slow" not in marks:
+        return  # budget applies to the tier-1 selection only
+    total = sum(_durations.values())
+    top = sorted(_durations.items(), key=lambda kv: -kv[1])[:10]
+    tw = terminalreporter
+    tw.write_line(
+        f"[tier-1 audit] summed test time {total:.1f}s "
+        f"(budget {TIER1_BUDGET_S:.0f}s, wall cap 870s)")
+    if total > TIER1_BUDGET_S:
+        tw.write_line("[tier-1 audit] BUDGET EXCEEDED — slowest tests:")
+        for nid, d in top:
+            tw.write_line(f"  {d:7.2f}s  {nid}")
+        tw.write_line("[tier-1 audit] mark new heavy tests @pytest.mark."
+                      "slow or add them to conftest SLOW_MODULES/SLOW_TESTS")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if (os.environ.get("TIER1_DURATION_GATE") == "1"
+            and "not slow" in (session.config.option.markexpr or "")
+            and sum(_durations.values()) > TIER1_BUDGET_S
+            and exitstatus == 0):
+        session.exitstatus = 1

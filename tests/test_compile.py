@@ -1,0 +1,402 @@
+"""compile/ layer: cached_jit registry, persistent cache, AOT artifacts.
+
+ISSUE-11 acceptance surface:
+- cache correctness: digest parity (the established structural-equality
+  gate) between fresh-JIT and warm-cache fits at ndev {1, 2}, and between
+  fresh-JIT and AOT-loaded predictions;
+- every mismatch-fallback path (wrong mesh, stale export version, truncated
+  artifact, jax version skew, aval mismatch, missing entry) falls back to
+  JIT with the `compile_aot_fallback_total{reason}` counter incremented and
+  predictions still exact;
+- the persistent XLA cache registers cross-process hits;
+- AST lint: serving-/fit-entry-point modules acquire jitted callables only
+  via cached_jit / the AOT loader (explicit allowlist below);
+- marker/duration audit: the tier-1 duration report stays armed so new
+  tests can't push the suite past the 870 s cap unnoticed.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from mmlspark_tpu.compile import (AOTStore, cache_stats, cached_jit,
+                                  clear_memory_cache)
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+from mmlspark_tpu.observability import get_registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mmlspark_tpu")
+
+KW = dict(numIterations=6, numLeaves=7, maxBin=32, seed=3)
+
+#: structural digest fields (the dryrun/multichip gate): integer/bool split
+#: records must match EXACTLY between fresh and warm/AOT paths
+DIGEST_FIELDS = ("split_slot", "split_feat", "split_bin", "split_valid",
+                 "split_is_cat", "split_default_left")
+
+
+def _make_df(n=801, f=8, seed=5):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return DataFrame({"features": x, "label": y}), x
+
+
+def _assert_digest_equal(b_a, b_b, ctx=""):
+    for fld in DIGEST_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(b_a.trees, fld)),
+            np.asarray(getattr(b_b.trees, fld)),
+            err_msg=f"{ctx}: structural digest field {fld} diverged")
+    np.testing.assert_allclose(
+        np.asarray(b_a.trees.leaf_value), np.asarray(b_b.trees.leaf_value),
+        rtol=1e-4, atol=5e-6, err_msg=f"{ctx}: leaf values diverged")
+
+
+def _fallbacks(reason=None):
+    reg = get_registry()
+    if reason is None:
+        return reg.total("compile_aot_fallback_total")
+    fam = reg.snapshot().get("compile_aot_fallback_total", {})
+    return sum(r["value"] for r in fam.get("series", ())
+               if r["labels"].get("reason") == reason)
+
+
+# ---------------------------------------------------------------- cached_jit
+
+class TestCachedJit:
+    def test_same_key_shares_wrapper_across_closures(self):
+        f1 = cached_jit(lambda x: x * 2, key=("t_share", 1), name="t_share")
+        f2 = cached_jit(lambda x: x * 9, key=("t_share", 1), name="t_share")
+        assert f1 is f2  # first closure wins — by contract
+        assert float(f1(np.float32(3.0))) == 6.0
+        f3 = cached_jit(lambda x: x * 9, key=("t_share", 2), name="t_share")
+        assert f3 is not f1
+        assert float(f3(np.float32(3.0))) == 27.0
+
+    def test_hit_miss_and_compile_seconds_accounting(self):
+        name = "t_account"
+        f = cached_jit(lambda x: (x + 1).sum(), key=("t_account",),
+                       name=name)
+        before = cache_stats()
+        f(np.ones(8, np.float32))          # miss (new signature)
+        f(np.ones(8, np.float32))          # hit
+        f(np.ones(4, np.float32))          # miss (new shape)
+        after = cache_stats()
+        ep = after["per_entry_point"][name]
+        ep0 = before.get("per_entry_point", {}).get(
+            name, {"hit": 0.0, "miss": 0.0})
+        assert ep["miss"] - ep0["miss"] == 2
+        assert ep["hit"] - ep0["hit"] == 1
+        assert after["compile_seconds_total"] > before.get(
+            "compile_seconds_total", 0.0)
+
+    def test_static_argnames_thread_through(self):
+        f = cached_jit(lambda x, scale: x * scale, key=("t_static",),
+                       name="t_static", static_argnames=("scale",))
+        assert float(f(np.float32(2.0), scale=3.0)) == 6.0
+
+    def test_clear_memory_cache(self):
+        f1 = cached_jit(lambda x: x, key=("t_clear",), name="t_clear")
+        clear_memory_cache()
+        f2 = cached_jit(lambda x: x, key=("t_clear",), name="t_clear")
+        assert f1 is not f2
+
+
+# ------------------------------------------------------------- AOT artifacts
+
+@pytest.fixture(scope="module")
+def trained():
+    df, x = _make_df()
+    model = LightGBMClassifier(**KW).fit(df)
+    return model.booster, x
+
+
+@pytest.fixture()
+def aot_dir(trained, tmp_path):
+    booster, _ = trained
+    d = str(tmp_path / "aot")
+    booster.export_serving_artifacts(d, batch_sizes=(8,))
+    return d
+
+
+class TestAOTArtifacts:
+    def test_roundtrip_digest_parity(self, trained, aot_dir):
+        booster, x = trained
+        fresh = booster.raw_predict(x[:8])
+        booster.load_serving_artifacts(aot_dir)
+        try:
+            ok0 = get_registry().total("compile_aot_load_ok_total")
+            warm = booster.raw_predict(x[:8])
+            np.testing.assert_array_equal(fresh, warm)  # bit-exact digest
+            assert get_registry().total("compile_aot_load_ok_total") > ok0
+            assert booster._aot_cache["raw_predict_b8"] is not None
+        finally:
+            booster._aot_store = None
+            booster._aot_cache = {}
+
+    def test_manifest_schema(self, aot_dir):
+        with open(os.path.join(aot_dir, "MANIFEST.json")) as f:
+            doc = json.load(f)
+        assert doc["schema_version"] == 1
+        e = doc["entries"]["raw_predict_b8"]
+        for field in ("uri", "sha256", "size", "jax_version", "platforms",
+                      "nr_devices", "in_avals",
+                      "calling_convention_version"):
+            assert field in e, field
+        assert e["extra"]["entry_point"] == "gbdt_raw_predict"
+
+    def _predict_expect_fallback(self, booster, xs, aot_dir, reason,
+                                 fresh):
+        before = _fallbacks(reason)
+        booster.load_serving_artifacts(aot_dir)
+        try:
+            out = booster.raw_predict(xs)
+            np.testing.assert_array_equal(fresh, out)  # JIT fallback exact
+            # >= 1: both artifact layers (compiled + exported) may count
+            # the same reason on their way down to JIT
+            assert _fallbacks(reason) >= before + 1, (
+                f"expected a counted {reason!r} fallback")
+        finally:
+            booster._aot_store = None
+            booster._aot_cache = {}
+
+    def test_truncated_artifact_falls_back_counted(self, trained, aot_dir):
+        booster, x = trained
+        fresh = booster.raw_predict(x[:8])
+        for suffix in (".jaxexport", ".xexec"):  # truncate BOTH layers
+            p = os.path.join(aot_dir, "raw_predict_b8" + suffix)
+            if not os.path.exists(p):
+                continue
+            with open(p, "rb") as f:
+                data = f.read()
+            with open(p, "wb") as f:
+                f.write(data[:len(data) // 2])
+        self._predict_expect_fallback(booster, x[:8], aot_dir, "digest",
+                                      fresh)
+
+    def test_stale_export_version_falls_back_counted(self, trained,
+                                                     aot_dir):
+        booster, x = trained
+        fresh = booster.raw_predict(x[:8])
+        mp = os.path.join(aot_dir, "MANIFEST.json")
+        with open(mp) as f:
+            doc = json.load(f)
+        doc["schema_version"] = 999
+        with open(mp, "w") as f:
+            json.dump(doc, f)
+        self._predict_expect_fallback(booster, x[:8], aot_dir,
+                                      "schema_version", fresh)
+
+    def test_jax_version_skew_falls_back_counted(self, trained, aot_dir):
+        booster, x = trained
+        fresh = booster.raw_predict(x[:8])
+        mp = os.path.join(aot_dir, "MANIFEST.json")
+        with open(mp) as f:
+            doc = json.load(f)
+        doc["entries"]["raw_predict_b8"]["jax_version"] = "0.0.1"
+        with open(mp, "w") as f:
+            json.dump(doc, f)
+        self._predict_expect_fallback(booster, x[:8], aot_dir,
+                                      "jax_version", fresh)
+
+    def test_wrong_mesh_shape_falls_back_counted(self, trained, aot_dir):
+        booster, x = trained
+        fresh = booster.raw_predict(x[:8])
+        mp = os.path.join(aot_dir, "MANIFEST.json")
+        with open(mp) as f:
+            doc = json.load(f)
+        # artifact claims an 8-device program; serving predict is 1-device
+        doc["entries"]["raw_predict_b8"]["nr_devices"] = 8
+        with open(mp, "w") as f:
+            json.dump(doc, f)
+        self._predict_expect_fallback(booster, x[:8], aot_dir, "mesh",
+                                      fresh)
+
+    def test_aval_mismatch_falls_back_counted(self, trained, aot_dir):
+        """Model shape drifted since export (fewer used iterations =>
+        different tree avals): counted 'avals' fallback, exact JIT result."""
+        booster, x = trained
+        import copy
+        shrunk = copy.copy(booster)
+        shrunk._aot_store, shrunk._aot_cache = None, {}
+        shrunk.best_iteration = 3
+        fresh = shrunk.raw_predict(x[:8])
+        self._predict_expect_fallback(shrunk, x[:8], aot_dir, "avals",
+                                      fresh)
+
+    def test_missing_bucket_falls_back_counted(self, trained, aot_dir):
+        booster, x = trained
+        fresh = booster.raw_predict(x[:16])  # bucket 16 was never exported
+        self._predict_expect_fallback(booster, x[:16], aot_dir,
+                                      "missing", fresh)
+
+
+# ------------------------------------------- warm-cache fit digest parity
+
+class TestWarmFitDigestParity:
+    @pytest.mark.parametrize("ndev", [1, 2])
+    def test_second_fit_is_warm_and_digest_identical(self, ndev):
+        """Fresh-JIT fit vs warm-cache fit at ndev {1, 2}: the second fit
+        re-uses the cached executables (no new entry-point misses) and its
+        booster is digest-identical."""
+        df, _ = _make_df(seed=20 + ndev)
+        kw = dict(KW, numTasks=ndev, maxBin=24 + ndev)  # unique config
+        entry = "gbdt_full" if ndev == 1 else "gbdt_sharded_full"
+        m1 = LightGBMClassifier(**kw).fit(df)
+        s1 = cache_stats()["per_entry_point"].get(entry,
+                                                  {"hit": 0, "miss": 0})
+        m2 = LightGBMClassifier(**kw).fit(df)
+        s2 = cache_stats()["per_entry_point"][entry]
+        assert s2["miss"] == s1["miss"], (
+            f"warm fit recompiled {entry} (miss {s1['miss']} -> "
+            f"{s2['miss']})")
+        assert s2["hit"] > s1.get("hit", 0), "warm fit never hit the cache"
+        _assert_digest_equal(m1.booster, m2.booster, f"ndev={ndev} warm")
+
+
+# ------------------------------------------------- persistent (disk) layer
+
+CHILD = r"""
+import os, json
+import numpy as np
+import jax, jax.numpy as jnp
+from mmlspark_tpu.compile import cached_jit, cache_stats
+
+def prog(x):
+    for _ in range(8):
+        x = jnp.sin(x @ x.T) * 0.5 + x * 0.25   # bounded: stays finite
+    return x
+
+f = cached_jit(prog, key=("persist_child",), name="persist_child")
+out = np.asarray(f(jnp.ones((32, 32), jnp.float32)))
+print(json.dumps({"sum": float(out.sum()),
+                  "stats": cache_stats()}))
+"""
+
+
+def test_persistent_cache_cross_process_hits(tmp_path):
+    """Two fresh processes, same cache dir: the second one's compiles
+    resolve as persistent-layer hits and produce identical results."""
+    env = dict(os.environ)
+    env.update(MMLSPARK_COMPILE_CACHE="1",
+               MMLSPARK_COMPILE_CACHE_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+    def run():
+        out = subprocess.run([sys.executable, "-c", CHILD], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    r1, r2 = run(), run()
+    assert r1["sum"] == r2["sum"], "cached executable changed the result"
+    assert r2["stats"]["persistent_hits"] > 0, (
+        f"second process never hit the persistent cache: {r2['stats']}")
+    assert r1["stats"]["persistent_dir"] == str(tmp_path)
+
+
+# ------------------------------------------------------------------- lints
+
+#: serving- and fit-entry-point modules: jitted callables come ONLY from
+#: cached_jit / the AOT loader. Allowlisted enclosing defs are cold paths:
+#: per-fit donated train-step factories (the fit holds the returned step
+#: for its whole lifetime; their FORWARD counterparts are routed), the
+#: numerical-anchor single-device step tests pin against, and the AOT
+#: export path itself (which must jit to export).
+LINT_MODULES = {
+    "models/lightgbm/base.py": set(),
+    "models/lightgbm/booster.py": {"export_serving_artifacts"},
+    "models/lightgbm/classifier.py": set(),
+    "models/lightgbm/regressor.py": set(),
+    "models/lightgbm/ranker.py": set(),
+    "models/deep/dnn.py": set(),
+    "models/deep/transformer.py": {"make_tp_dp_train_step",
+                                   "make_single_train_step",
+                                   "make_sp_train_step"},
+    "models/vw/base.py": set(),
+    "models/vw/classifier.py": set(),
+    "io/serving.py": set(),
+    "io/distributed_serving.py": set(),
+}
+
+
+def _jax_jit_sites(tree):
+    """Yield (lineno, ancestor function names) for every `jax.jit` use.
+
+    All ancestors are reported (a `@jax.jit` decorator's immediate parent
+    is the decorated def itself; the allowlist names the factory that
+    owns it)."""
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    def ancestors(node):
+        names = set()
+        while node in parents:
+            node = parents[node]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+        return names or {"<module>"}
+
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"):
+            yield node.lineno, ancestors(node)
+
+
+def test_lint_entry_points_use_cached_jit_only():
+    offenders = []
+    for rel, allow in LINT_MODULES.items():
+        path = os.path.join(PKG, rel)
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        for lineno, fns in _jax_jit_sites(tree):
+            if not (fns & allow):
+                where = "/".join(sorted(fns))
+                offenders.append(f"{rel}:{lineno} (in {where}) uses jax.jit "
+                                 f"directly — route through compile."
+                                 f"cached_jit or the AOT loader")
+    assert not offenders, "\n".join(offenders)
+
+
+def test_lint_aot_writes_are_atomic():
+    """compile/aot.py must write artifacts/manifests only through the
+    PR 10 atomic helper (no bare open-for-write)."""
+    with open(os.path.join(PKG, "compile", "aot.py")) as f:
+        tree = ast.parse(f.read())
+    bad = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "open" and len(node.args) >= 2):
+            mode = node.args[1]
+            if isinstance(mode, ast.Constant) and "w" in str(mode.value):
+                bad.append(node.lineno)
+    assert not bad, f"bare open-for-write in compile/aot.py lines {bad}"
+
+
+# ------------------------------------------------ duration / marker audit
+
+def test_duration_report_stays_armed():
+    """New tier-1 tests must not push the suite past the 870 s cap
+    unnoticed: the --durations report and the slow marker must stay
+    registered, and conftest's SLOW_MODULES must name real files."""
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        cfg = f.read()
+    assert "--durations" in cfg.split("[tool.pytest.ini_options]")[1], (
+        "pyproject addopts lost the --durations report")
+    assert '"slow:' in cfg, "slow marker unregistered"
+    import conftest
+    for mod in conftest.SLOW_MODULES:
+        assert os.path.exists(os.path.join(REPO, "tests", mod + ".py")), (
+            f"conftest.SLOW_MODULES names a missing module {mod!r}")
+    assert hasattr(conftest, "TIER1_BUDGET_S")

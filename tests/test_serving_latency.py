@@ -18,6 +18,7 @@ import socket
 import time
 
 import numpy as np
+import pytest
 
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.io.serving import ServingServer
@@ -116,7 +117,51 @@ def _loopback_echo_floor_p99(rounds: int = 3, n: int = 300) -> float:
     return best
 
 
+def test_http_round_trip_smoke():
+    """Tier-1 gate on the keep-alive HTTP path: correctness plus a LOOSE
+    latency ceiling. The strict sub-ms percentile gate lives in the
+    slow-marked variant below — under a loaded tier-1 suite (the whole run
+    sits near the 870 s cap on a shared 1-vCPU box) scheduler noise pushes
+    even a healthy listener past wall-clock gates calibrated for an idle
+    machine (ISSUE-11 satellite). This smoke gate is floor-scaled and
+    generous: it only fails on a structural regression (a lost batch
+    wakeup, an extra thread hop measured in tens of ms), never on load."""
+    srv = ServingServer(_handler, reply_col="prediction",
+                        max_batch_size=8, max_latency_ms=0.0,
+                        port=0).start()
+    try:
+        cli = _KeepAliveClient("127.0.0.1", srv.port)
+        body = json.dumps({"x": 3.0}).encode()
+        out = json.loads(cli.request(body))
+        assert out["prediction"] == 7.0
+        for _ in range(20):                     # warm
+            cli.request(body)
+        lat = []
+        for _ in range(100):
+            t0 = time.perf_counter()
+            cli.request(body)
+            lat.append(time.perf_counter() - t0)
+        lat = np.sort(lat)
+        p50 = float(lat[len(lat) // 2])
+        floor_p99 = _loopback_echo_floor_p99(rounds=1, n=100)
+        gate = max(50e-3, 20.0 * floor_p99)
+        print(f"HTTP smoke p50 {p50*1e3:.3f} ms "
+              f"(echo floor p99 {floor_p99*1e3:.3f} ms, "
+              f"gate {gate*1e3:.1f} ms)")
+        assert p50 < gate, (
+            f"p50 {p50*1e3:.1f} ms >= loose gate {gate*1e3:.1f} ms — "
+            f"structural listener regression (not load: gate is 20x the "
+            f"concurrently measured echo floor)")
+        cli.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
 def test_http_round_trip_sub_ms():
+    """Strict percentile gate (sub-ms p99 where the box allows), slow tier:
+    run it on an otherwise idle machine (`pytest -m slow`), where the
+    machine-calibrated gate below is meaningful."""
     srv = ServingServer(_handler, reply_col="prediction",
                         max_batch_size=8, max_latency_ms=0.0,
                         port=0).start()
