@@ -59,6 +59,21 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
         extra.setdefault("compile_telemetry", cache_stats())
     except Exception as e:  # noqa: BLE001
         extra.setdefault("compile_telemetry_error", str(e)[:200])
+    # serving-load provenance (ISSUE-12): the most recent sustained-load
+    # harness summary (scripts/measure_serving_load.py) rides in the bench
+    # record, minus the bulky per-trace exemplars — the bench line then
+    # shows both the fit side AND what the serving data plane sustained
+    try:
+        _lp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "docs", "SERVING_load.json")
+        if os.path.exists(_lp):
+            with open(_lp) as _f:
+                _load = json.load(_f)
+            for _v in _load.get("variants", []):
+                _v.pop("trace_exemplars", None)
+            extra.setdefault("serving_load", _load)
+    except Exception as e:  # noqa: BLE001
+        extra.setdefault("serving_load_error", str(e)[:200])
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]

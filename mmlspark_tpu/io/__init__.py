@@ -5,10 +5,12 @@ from .files import (decode_image, read_binary_files, read_csv,
                     read_images, read_libsvm, write_to_powerbi)
 from .http import (AsyncClient, CustomInputParser, CustomOutputParser,
                    HTTPRequestData, HTTPResponseData, HTTPTransformer,
-                   JSONInputParser, JSONOutputParser, SimpleHTTPTransformer,
-                   StringOutputParser, send_with_retries)
-from .serving import (HTTPStreamSource, ServingServer, ServingUDFs,
-                      make_reply, parse_request)
+                   JSONInputParser, JSONOutputParser, KeepAliveTransport,
+                   SimpleHTTPTransformer, StringOutputParser,
+                   send_with_retries)
+from .rowcodec import BufferPool
+from .serving import (DynamicBatcher, HTTPStreamSource, ServingServer,
+                      ServingUDFs, make_reply, parse_request)
 from .shared import (PartitionConsolidator, RateLimiter, SharedSingleton,
                      SharedVariable)
 from .streaming import FileStreamSource, StreamingQuery
@@ -21,9 +23,9 @@ __all__ = [
     "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
     "SimpleHTTPTransformer", "JSONInputParser", "JSONOutputParser",
     "StringOutputParser", "CustomInputParser", "CustomOutputParser",
-    "AsyncClient", "send_with_retries",
+    "AsyncClient", "send_with_retries", "KeepAliveTransport",
     "ServingServer", "ServingUDFs", "HTTPStreamSource", "parse_request",
-    "make_reply",
+    "make_reply", "DynamicBatcher", "BufferPool",
     "SharedSingleton", "SharedVariable", "PartitionConsolidator",
     "RateLimiter",
     "read_binary_files", "read_images", "read_csv", "read_libsvm",

@@ -32,10 +32,24 @@ Failure handling (resilience layer):
 - request budgets ride the `X-Deadline-Ms` header: the gateway answers 504
   when the budget is spent and re-encodes only the REMAINING budget on each
   forward hop, so a retry can never exceed the client's patience.
+
+Round 12 (load-aware data plane): the forward path reuses keep-alive
+connections per worker (`io.http.KeepAliveTransport`, still injectable for
+chaos), routing is LEAST-LOADED by default — scored from the queue-depth
+load report each worker now piggybacks on its heartbeat plus the gateway's
+own in-flight count (rows/s rides the same beat, surfaced via /health for
+operators/autoscalers), round-robin among ties so idle fleets keep the
+reference's channel rotation — and concurrent gateway requests to one
+service COALESCE: handler threads cooperatively lead, each packing up to
+`coalesce_max` queued client bodies into one length-prefixed forward
+(io/rowcodec.py packs); the worker splits them into per-part batcher
+entries and the reply pack fans back out. Every routing decision is
+counted (`gateway_route_decisions_total{decision}`).
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import socket
@@ -49,6 +63,8 @@ from typing import Dict, List, Optional, Tuple
 from ..observability import (EventLog, TRACE_HEADER, get_registry,
                              mint_trace_id, trace_id_from_headers)
 from ..resilience import Deadline, RetryError, RetryPolicy
+from . import rowcodec
+from .http import KeepAliveTransport
 from .serving import _INSTANCE_SEQ, ServingServer
 
 
@@ -101,6 +117,79 @@ def _default_transport(url: str, body: bytes, headers: Dict[str, str],
         return r.status, r.read()
 
 
+class _GatewayEntry:
+    """One client request riding the gateway: its raw reply writer wrapped
+    with the coordinator's telemetry (latency histogram, 503/504 counters,
+    per-reply span, trace-id echo), an exactly-once guard (a coalescing
+    leader and the stall safety net must never double-write a socket), and
+    a done event the owning handler thread parks on."""
+
+    __slots__ = ("body", "headers", "trace_id", "client_deadline",
+                 "deadline", "done", "_coord", "_raw_reply", "_t_recv",
+                 "_lock", "_replied")
+
+    def __init__(self, coord: "ServingCoordinator", raw_reply, body: bytes,
+                 headers: Dict[str, str]):
+        self.body = body
+        self.headers = headers
+        self.trace_id = trace_id_from_headers(headers) or mint_trace_id()
+        self.client_deadline = Deadline.from_headers(headers)
+        self.deadline = (self.client_deadline
+                         or Deadline.after(coord.forward_timeout))
+        self.done = threading.Event()
+        self._coord = coord
+        self._raw_reply = raw_reply
+        self._t_recv = time.perf_counter()
+        self._lock = threading.Lock()
+        self._replied = False
+
+    def reply(self, status: int, rbody: bytes, rheaders=None) -> None:
+        with self._lock:
+            if self._replied:
+                return
+            self._replied = True
+        coord = self._coord
+        dur = time.perf_counter() - self._t_recv
+        coord._lat_hist.observe(dur)
+        if status == 504:
+            coord._m_expired.inc()
+        elif status == 503:
+            coord._m_shed.inc()
+        coord.events.append("reply", self.trace_id, dur_s=dur,
+                            status=status)
+        try:
+            self._raw_reply(status, rbody,
+                            {TRACE_HEADER: self.trace_id,
+                             **(rheaders or {})})
+        except Exception:
+            # this entry's client hung up: its loss must stay ITS loss — a
+            # coalescing leader writing a dead follower's socket must not
+            # die mid-distribution and strand the other entries (and a
+            # disconnect can never be misread as a worker failure)
+            pass
+        finally:
+            self.done.set()
+
+    def expire_if_due(self) -> bool:
+        if self.deadline.expired:
+            self.reply(504, b'{"error": "deadline exceeded"}')
+            return True
+        return False
+
+
+class _Coalescer:
+    """Per-service staging between gateway handler threads and leader
+    forwards (the pending deque + active-leader count)."""
+
+    __slots__ = ("lock", "pending", "leaders")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.pending: "collections.deque[_GatewayEntry]" = \
+            collections.deque()
+        self.leaders = 0
+
+
 class ServingCoordinator:
     """Driver-role registration + routing service with worker health.
 
@@ -123,13 +212,36 @@ class ServingCoordinator:
                  forward_transport=None,
                  forward_retry: Optional[RetryPolicy] = None,
                  registry=None, event_log=None,
-                 metrics_label: Optional[str] = None):
+                 metrics_label: Optional[str] = None,
+                 route_policy: str = "least_loaded",
+                 coalesce_max: int = 8, coalesce_wait_ms: float = 0.0,
+                 coalesce_parallel: int = 4):
         self.host, self.port = host, port
         self.forward_timeout = forward_timeout
         self.heartbeat_timeout_s = heartbeat_timeout_s
+        if route_policy not in ("least_loaded", "round_robin"):
+            raise ValueError(f"route_policy must be 'least_loaded' or "
+                             f"'round_robin', got {route_policy!r}")
+        self.route_policy = route_policy
+        # gateway-side request coalescing: leaders pack up to coalesce_max
+        # queued client bodies into ONE forward; <=1 disables. wait_ms is
+        # an optional pre-grab window (0 = pack only what is already
+        # queued — the forward round-trip itself is the natural window
+        # under load, so the default adds zero idle latency);
+        # coalesce_parallel bounds concurrent leader forwards per service
+        self.coalesce_max = coalesce_max
+        self.coalesce_wait_ms = coalesce_wait_ms
+        self.coalesce_parallel = max(1, coalesce_parallel)
+        self._coalescers: Dict[str, "_Coalescer"] = {}
         self._routes: Dict[str, List[ServiceInfo]] = {}
         self._rr: Dict[str, int] = {}
         self._last_seen: Dict[Tuple[str, str, int], float] = {}
+        # worker load reports (heartbeat-piggybacked queue depth) and the
+        # gateway's own in-flight forwards — the least-loaded score
+        # inputs; rows/s rides the same beat for /health consumers
+        self._load: Dict[Tuple[str, str, int], float] = {}
+        self._rates: Dict[Tuple[str, str, int], float] = {}
+        self._inflight: Dict[Tuple[str, int], int] = {}
         self._known: set = set()  # services that have EVER had a worker
         # workers subject to silence-based eviction: declared heartbeating
         # at registration, or actually heartbeat at least once — a plain
@@ -140,7 +252,12 @@ class ServingCoordinator:
         self._lock = threading.Lock()
         self._stopev = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
-        self._transport = forward_transport or _default_transport
+        # default: keep-alive connection reuse per worker; chaos tests and
+        # custom stacks still inject any (url, body, headers, timeout)
+        # callable (FaultInjector.wrap composes with either)
+        self._owns_transport = forward_transport is None
+        self._transport = (KeepAliveTransport() if forward_transport is None
+                           else forward_transport)
         # bounded fail-fast: ~8 attempts spanning ~1.5 s rides out a
         # transient all-evicted dip (heartbeat re-registration is sub-second)
         # without hanging a doomed request for the full forward_timeout
@@ -184,6 +301,26 @@ class ServingCoordinator:
             "gateway_registered_workers",
             "workers currently routable (all services)", lbl)
         self._workers_gauge.set_function(self._worker_count)
+        # routing + coalescing telemetry (round 12): which policy branch
+        # picked the worker, and how many client requests shared a forward
+        self._m_route: Dict[str, object] = {}
+        self._route_lbl = lbl
+        self._m_coal_fwd = self.registry.counter(
+            "gateway_coalesced_forwards_total",
+            "forwards carrying >= 2 coalesced client requests", lbl)
+        self._m_coal_reqs = self.registry.counter(
+            "gateway_coalesced_requests_total",
+            "client requests that rode a shared forward", lbl)
+
+    def _route_counter(self, decision: str):
+        c = self._m_route.get(decision)
+        if c is None:
+            c = self.registry.counter(
+                "gateway_route_decisions_total",
+                "worker-selection outcomes by policy branch",
+                {**self._route_lbl, "decision": decision})
+            self._m_route[decision] = c
+        return c
 
     def _worker_count(self) -> int:
         with self._lock:
@@ -240,9 +377,12 @@ class ServingCoordinator:
                 if len(lst) < before:
                     self._m["evictions"].inc()
             self._last_seen.pop((name, info.host, info.port), None)
+            self._load.pop((name, info.host, info.port), None)
+            self._rates.pop((name, info.host, info.port), None)
             self._hb_seen.discard((name, info.host, info.port))
 
-    def heartbeat(self, info: ServiceInfo) -> str:
+    def heartbeat(self, info: ServiceInfo, load: Optional[float] = None,
+                  rate: Optional[float] = None) -> str:
         """Record a worker heartbeat. Returns:
         "ok"         — worker is routable, beat recorded;
         "gone"       — worker is not in the table and its (machine,
@@ -261,6 +401,22 @@ class ServingCoordinator:
                 self._last_seen[key] = time.monotonic()
                 self._hb_seen.add(key)
                 self._m["heartbeats"].inc()
+                if load is not None:
+                    # heartbeat-piggybacked load report (worker queue
+                    # depth): the least-loaded router's freshest signal
+                    try:
+                        self._load[key] = float(load)
+                    except (TypeError, ValueError):
+                        pass
+                if rate is not None:
+                    # throughput rides the same beat: surfaced via
+                    # /health for operators/autoscalers (routing scores
+                    # on queue depth; a momentary rows/s says little
+                    # about REMAINING capacity)
+                    try:
+                        self._rates[key] = float(rate)
+                    except (TypeError, ValueError):
+                        pass
                 return "ok"
             if any((s.machine, s.partition) == (info.machine, info.partition)
                    for s in lst):
@@ -268,15 +424,48 @@ class ServingCoordinator:
             return "gone"
 
     def _next_worker(self, name: str) -> Optional[ServiceInfo]:
-        """Round-robin channel selection (MultiChannelMap.addToNextList,
-        DistributedHTTPSource.scala:81-83)."""
+        """Worker selection. Policy "least_loaded" (default) scores each
+        worker as (heartbeat-reported queue depth) + (this gateway's
+        in-flight forwards to it) and picks the minimum, rotating
+        round-robin among ties — an idle fleet therefore keeps the exact
+        reference channel rotation (MultiChannelMap.addToNextList,
+        DistributedHTTPSource.scala:81-83), while a hot or slow worker
+        sheds new routes until its queue drains. The chosen worker's
+        in-flight count is bumped here; `_release_worker` undoes it."""
         with self._lock:
             lst = self._routes.get(name)
             if not lst:
                 return None
-            i = self._rr.get(name, 0) % len(lst)
-            self._rr[name] = i + 1
-            return lst[i]
+            i0 = self._rr.get(name, 0) % len(lst)
+            decision = "round_robin"
+            pick = i0
+            if self.route_policy == "least_loaded":
+                scores = [self._load.get((name, s.host, s.port), 0.0)
+                          + self._inflight.get((s.host, s.port), 0)
+                          for s in lst]
+                best = min(scores)
+                for k in range(len(lst)):
+                    i = (i0 + k) % len(lst)
+                    if scores[i] == best:
+                        pick = i
+                        break
+                decision = ("rr_tie" if best == max(scores)
+                            else "least_loaded")
+            self._rr[name] = pick + 1
+            worker = lst[pick]
+            wkey = (worker.host, worker.port)
+            self._inflight[wkey] = self._inflight.get(wkey, 0) + 1
+        self._route_counter(decision).inc()
+        return worker
+
+    def _release_worker(self, worker: ServiceInfo) -> None:
+        with self._lock:
+            wkey = (worker.host, worker.port)
+            n = self._inflight.get(wkey, 0) - 1
+            if n > 0:
+                self._inflight[wkey] = n
+            else:
+                self._inflight.pop(wkey, None)
 
     # --------------------------------------------------------------- health
     def _monitor_loop(self) -> None:
@@ -299,67 +488,130 @@ class ServingCoordinator:
                         for s in stale:
                             self._last_seen.pop((name, s.host, s.port),
                                                 None)
+                            self._load.pop((name, s.host, s.port), None)
+                            self._rates.pop((name, s.host, s.port), None)
                             self._hb_seen.discard((name, s.host, s.port))
                             self._m["evictions"].inc()
 
     def health(self) -> Dict:
         with self._lock:
             services = {name: len(lst) for name, lst in self._routes.items()}
+            loads = {f"{n}:{h}:{p}": {"queue_depth": v,
+                                      "rows_per_s": self._rates.get(
+                                          (n, h, p), 0.0)}
+                     for (n, h, p), v in self._load.items()}
         return {"services": services,
                 "heartbeat_timeout_s": self.heartbeat_timeout_s,
+                "route_policy": self.route_policy,
+                "worker_loads": loads,
                 "stats": dict(self.stats)}
 
     # -------------------------------------------------------------- gateway
+    def _coalescer(self, name: str) -> "_Coalescer":
+        with self._lock:
+            co = self._coalescers.get(name)
+            if co is None:
+                co = _Coalescer()
+                self._coalescers[name] = co
+            return co
+
     def _handle_gateway(self, reply, name: str, body: bytes,
                         headers: Dict[str, str]) -> None:
-        """Forward with bounded retry + eviction + deadline propagation.
-        `reply(status, body)` writes the client response. The trace id
-        (client-sent X-Trace-Id or minted here) rides every forward hop —
-        retries and failovers included — and comes back on the reply, so
-        the gateway's per-attempt spans and the worker's dispatch spans
-        join on one id."""
-        trace_id = trace_id_from_headers(headers) or mint_trace_id()
-        t_recv = time.perf_counter()
-        raw_reply = reply
+        """Gateway entry: wrap the client's reply with telemetry, then
+        either forward directly or ride the per-service coalescer — a
+        LEADER thread packs queued client bodies into one forward
+        (io/rowcodec packs) while followers park on their reply event.
+        A single-entry group forwards the raw body, bit-identical to the
+        pre-coalescing wire path."""
+        entry = _GatewayEntry(self, reply, body, headers)
+        if self.coalesce_max <= 1:
+            self._forward_entries(name, [entry])
+            return
+        co = self._coalescer(name)
+        with co.lock:
+            co.pending.append(entry)
+        # cooperative leadership: every handler thread whose entry is
+        # still pending competes to drive ONE group at a time (up to
+        # coalesce_parallel concurrently), then re-checks its own entry.
+        # A thread never drains the deque past its own reply — a leader
+        # that kept forwarding other clients' groups would starve its OWN
+        # connection's next pipelined request (observed as client
+        # timeouts under chaos churn) — and every entry has a live thread
+        # pushing, so work is conserved and FIFO groups bound the wait.
+        while not entry.done.is_set():
+            with co.lock:
+                lead = bool(co.pending) and \
+                    co.leaders < self.coalesce_parallel
+                if lead:
+                    co.leaders += 1
+            if lead:
+                if self.coalesce_wait_ms > 0:
+                    time.sleep(self.coalesce_wait_ms / 1000.0)
+                with co.lock:
+                    group = [co.pending.popleft()
+                             for _ in range(min(len(co.pending),
+                                                self.coalesce_max))]
+                try:
+                    if group:
+                        self._forward_entries(name, group)
+                finally:
+                    with co.lock:
+                        co.leaders -= 1
+                continue
+            if entry.deadline.expired:
+                # stuck in the deque past the budget (all leader slots
+                # pinned in deadline-length chaos retries): answer the
+                # 504 NOW; the exactly-once guard turns the eventual
+                # dequeue's expire_if_due into a silent drop
+                entry.reply(504, b'{"error": "deadline exceeded '
+                                 b'waiting for a forward slot"}')
+                return
+            entry.done.wait(0.005)
 
-        def reply(status: int, rbody: bytes, rheaders=None) -> None:
-            dur = time.perf_counter() - t_recv
-            self._lat_hist.observe(dur)
-            if status == 504:
-                self._m_expired.inc()
-            elif status == 503:
-                self._m_shed.inc()
-            self.events.append("reply", trace_id, dur_s=dur, status=status)
-            raw_reply(status, rbody,
-                      {TRACE_HEADER: trace_id, **(rheaders or {})})
-
+    def _forward_entries(self, name: str,
+                         entries: List["_GatewayEntry"]) -> None:
+        """Forward one group (1 = plain body, >=2 = coalesced pack) with
+        bounded retry + eviction + deadline propagation. Each entry's
+        trace id rides its own reply; the forward hop itself carries the
+        lead entry's id so gateway attempt spans and worker dispatch
+        spans join on one id."""
         if name not in self._known:
-            reply(503, json.dumps(
-                {"error": f"no workers for {name!r}: never registered"}
-            ).encode())
+            for e in entries:
+                e.reply(503, json.dumps(
+                    {"error": f"no workers for {name!r}: never registered"}
+                ).encode())
             return
-        client_deadline = Deadline.from_headers(headers)
-        deadline = (client_deadline
-                    or Deadline.after(self.forward_timeout))
-        if deadline.expired:
-            reply(504, b'{"error": "deadline exceeded"}')
+        entries = [e for e in entries if not e.expire_if_due()]
+        if not entries:
             return
+        n = len(entries)
+        trace_id = entries[0].trace_id
+        if n == 1:
+            body = entries[0].body
+            extra_headers = {}
+        else:
+            body = rowcodec.encode_pack([e.body for e in entries],
+                                        [e.trace_id for e in entries])
+            extra_headers = {rowcodec.COALESCE_HEADER: str(n)}
+            self._m_coal_fwd.inc()
+            self._m_coal_reqs.inc(n)
+        # the pack's budget is the TIGHTEST member's; with every entry
+        # carrying an explicit client budget the deadline (not the attempt
+        # count) is the retry contract, as in the single-request path
+        all_client = all(e.client_deadline is not None for e in entries)
+        deadline = min((e.deadline for e in entries),
+                       key=lambda d: d.expires_at)
         policy = self.forward_retry
-        if client_deadline is not None:
-            # an explicit client budget makes the DEADLINE the retry
-            # contract: keep failing over for as long as the client is
-            # still waiting (rides out transient all-evicted churn), not
-            # just for the fail-fast attempt count
+        if all_client:
             policy = dataclasses.replace(policy, attempts=None)
         elif policy.attempts is not None:
             # bounded fail-fast must still be able to try EVERY registered
-            # worker once (the pre-resilience per-worker bound): a
-            # correlated failure of N-1 workers out of many should reach
-            # the survivor, not give up at a fixed count
+            # worker once: a correlated failure of N-1 workers out of many
+            # should reach the survivor, not give up at a fixed count
             policy = dataclasses.replace(
                 policy, attempts=max(policy.attempts,
                                      len(self.routes(name)) + 1))
-        self._m["forwards"].inc()
+        self._m["forwards"].inc(n)
         last_err = "routing table empty (all workers evicted)"
         last_shed = None  # most recent worker 503 (queue-full) response
         for attempt in policy.attempts_iter(deadline=deadline):
@@ -375,10 +627,17 @@ class ServingCoordinator:
                 continue
             remaining = deadline.remaining()
             if remaining <= 0:
+                self._release_worker(worker)
                 break
             fwd_headers = {"Content-Type": "application/json",
                            TRACE_HEADER: trace_id,
-                           Deadline.HEADER: deadline.to_header()}
+                           Deadline.HEADER: deadline.to_header(),
+                           # provenance: a client-declared budget may drive
+                           # the worker's continuous batch fill; the
+                           # gateway's own hop-protection default must not
+                           "X-Deadline-Source": ("client" if all_client
+                                                 else "gateway"),
+                           **extra_headers}
             w_id = f"{worker.host}:{worker.port}"
             t_fwd = time.perf_counter()
             try:
@@ -408,9 +667,11 @@ class ServingCoordinator:
                     "forward_attempt", trace_id, attempt=attempt.index,
                     dur_s=time.perf_counter() - t_fwd, worker=w_id,
                     outcome=f"http_{e.code}")
-                reply(e.code, e.read(),
-                      {k: v for k, v in e.headers.items()
-                       if k.lower() == "retry-after"})
+                eh = {k: v for k, v in e.headers.items()
+                      if k.lower() == "retry-after"}
+                ebody = e.read()
+                for en in entries:
+                    en.reply(e.code, ebody, eh)
                 return
             except Exception as e:  # unreachable: evict + retry next worker
                 last_err = str(e)
@@ -425,28 +686,64 @@ class ServingCoordinator:
                     "forward_attempt", trace_id, attempt=attempt.index,
                     dur_s=time.perf_counter() - t_fwd, worker=w_id,
                     outcome="ok")
-                # reply OUTSIDE the try: a client that disconnects while the
-                # response is being written must not be misread as a worker
-                # failure (which would evict the healthy worker and re-send
-                # the already-processed request — a duplicate inference)
-                reply(status, rbody)
+                # replies OUTSIDE the try: a client that disconnects while
+                # the response is being written must not be misread as a
+                # worker failure (which would evict the healthy worker and
+                # re-send the already-processed request — a duplicate
+                # inference)
+                if n == 1:
+                    entries[0].reply(status, rbody)
+                else:
+                    self._distribute_pack(entries, status, rbody)
                 return
+            finally:
+                self._release_worker(worker)
         if last_shed is not None and not deadline.expired:
             # every attempt landed on a full queue: propagate the shed
             # (503 + Retry-After) so the client backs off correctly
-            reply(503, last_shed[0], last_shed[1])
+            for en in entries:
+                en.reply(503, last_shed[0], last_shed[1])
             return
         # unbounded mode only exits on budget exhaustion -> 504; bounded
         # mode distinguishes attempts-exhausted (502) from expired (504)
-        reply(504 if (client_deadline is not None or deadline.expired)
-              else 502,
-              json.dumps({"error": f"forward failed: {last_err}"}).encode())
+        status = 504 if (all_client or deadline.expired) else 502
+        ebody = json.dumps({"error": f"forward failed: {last_err}"}).encode()
+        for en in entries:
+            en.reply(status, ebody)
+
+    @staticmethod
+    def _distribute_pack(entries: List["_GatewayEntry"], status: int,
+                         rbody: bytes) -> None:
+        """Fan a reply pack back out to its client entries; an undecodable
+        pack answers 502 (the worker is alive — no eviction — but this
+        forward produced nothing usable)."""
+        try:
+            parts = rowcodec.decode_reply_pack(rbody)
+            if len(parts) != len(entries):
+                raise rowcodec.BinaryFormatError(
+                    f"{len(parts)} parts for {len(entries)} entries")
+        except rowcodec.BinaryFormatError as e:
+            ebody = json.dumps({"error": f"bad reply pack: {e}"}).encode()
+            for en in entries:
+                en.reply(502, ebody)
+            return
+        for en, (pstatus, pbody) in zip(entries, parts):
+            # the reply-pack framing carries no headers: restore the
+            # back-off contract for a part-level shed (a part only sheds
+            # on the rare admit race past the whole-pack capacity check;
+            # the worker's shed replies always say Retry-After: 1)
+            en.reply(pstatus, pbody,
+                     {"Retry-After": "1"} if pstatus == 503 else None)
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "ServingCoordinator":
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: load-test clients and forwarding proxies
+            # reuse gateway connections (every reply sets Content-Length)
+            protocol_version = "HTTP/1.1"
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
@@ -460,8 +757,10 @@ class ServingCoordinator:
                             {"error": str(e)}).encode())
                 elif self.path == "/heartbeat":
                     try:
-                        state = outer.heartbeat(ServiceInfo.from_dict(
-                            json.loads(body.decode())))
+                        d = json.loads(body.decode())
+                        state = outer.heartbeat(ServiceInfo.from_dict(d),
+                                                load=d.get("queue_depth"),
+                                                rate=d.get("rows_per_s"))
                     except (ValueError, KeyError) as e:
                         self._reply(400, json.dumps(
                             {"error": str(e)}).encode())
@@ -526,6 +825,11 @@ class ServingCoordinator:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self._owns_transport:
+            try:
+                self._transport.close()
+            except Exception:
+                pass
         # freeze the collect-time gauge so the registry (which outlives
         # this coordinator) does not pin it in memory via the callback; a
         # stopped coordinator routes to nobody, so it scrapes as 0
@@ -601,8 +905,14 @@ class DistributedServingServer(ServingServer):
 
     def _heartbeat_loop(self) -> None:
         url = self.coordinator_url.rstrip("/") + "/heartbeat"
-        body = json.dumps(self._info.to_dict()).encode()
         while not self._hb_stop.wait(self.heartbeat_interval_s):
+            # each beat piggybacks a load report: queue depth (the
+            # least-loaded router's score input) + last-batch throughput —
+            # the "autoscaling hooks" gauges used as control inputs
+            d = self._info.to_dict()
+            d["queue_depth"] = self._queue.qsize()
+            d["rows_per_s"] = self._rows_gauge.value
+            body = json.dumps(d).encode()
             try:
                 req = urllib.request.Request(
                     url, data=body,

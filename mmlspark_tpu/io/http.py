@@ -13,10 +13,15 @@ Reference files replaced here:
 
 from __future__ import annotations
 
+import http.client
+import io
 import json
+import threading
+import urllib.error
+import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -85,6 +90,127 @@ def _count_http_client(outcome: str) -> None:
             return
         _HTTP_CLIENT_COUNTERS[outcome] = c
     c.inc()
+
+
+class KeepAliveTransport:
+    """Per-endpoint HTTP/1.1 connection pool for the gateway forward path.
+
+    The gateway used to open a fresh TCP connection per forward ATTEMPT
+    (`urllib.request.urlopen`), paying connect latency and a socket churn
+    tax on every hop at high request rates. This transport keeps a small
+    freelist of `http.client.HTTPConnection`s per (host, port), reusing
+    them across forwards to the same worker; a stale pooled connection
+    (worker restarted, idle timeout) is retried ONCE on a fresh connect
+    before the failure propagates, so reuse can never turn a healthy
+    worker into a false eviction.
+
+    Signature-compatible with `_default_transport(url, body, headers,
+    timeout) -> (status, bytes)` — raises `urllib.error.HTTPError` for
+    alive-but-erroring workers (status >= 400, headers preserved for
+    Retry-After propagation) and connection errors for unreachable ones,
+    so `FaultInjector.wrap` and the gateway's failover logic apply
+    unchanged. Reuse vs fresh connects land in the shared client-attempt
+    counter family (`http_client_attempts_total{outcome=conn_reused|
+    conn_fresh}`) and on the `reused`/`fresh` int attributes.
+    """
+
+    def __init__(self, max_per_host: int = 8):
+        self.max_per_host = max_per_host
+        self._free: Dict[Tuple[str, int], List[http.client.HTTPConnection]] \
+            = {}
+        self._lock = threading.Lock()
+        self.reused = 0
+        self.fresh = 0
+
+    def _acquire(self, key: Tuple[str, int], timeout: float):
+        with self._lock:
+            lst = self._free.get(key)
+            if lst:
+                conn = lst.pop()
+                self.reused += 1
+                reused = True
+            else:
+                conn = None
+        if conn is None:
+            conn = http.client.HTTPConnection(key[0], key[1],
+                                              timeout=timeout)
+            with self._lock:
+                self.fresh += 1
+            reused = False
+        elif conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        _count_http_client("conn_reused" if reused else "conn_fresh")
+        return conn, reused
+
+    def _release(self, key: Tuple[str, int],
+                 conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            lst = self._free.setdefault(key, [])
+            if len(lst) < self.max_per_host:
+                lst.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            conns = [c for lst in self._free.values() for c in lst]
+            self._free.clear()
+        for c in conns:
+            c.close()
+
+    def __call__(self, url: str, body: bytes, headers: Dict[str, str],
+                 timeout: float) -> Tuple[int, bytes]:
+        parsed = urllib.parse.urlsplit(url)
+        key = (parsed.hostname or "127.0.0.1", parsed.port or 80)
+        path = parsed.path or "/"
+        if parsed.query:
+            path += "?" + parsed.query
+        conn, was_reused = self._acquire(key, timeout)
+        try:
+            status, data, resp_headers, will_close = self._round_trip(
+                conn, path, body, headers)
+        except (http.client.HTTPException, OSError) as e:
+            conn.close()
+            # a TIMEOUT proves nothing about delivery — the worker may be
+            # mid-inference; re-sending would duplicate the request AND
+            # block past the deadline loop's reaction time. Only a
+            # connection-level failure on a REUSED socket earns the one
+            # fresh retry ("idle pooled socket died" vs "worker died").
+            if not was_reused or isinstance(e, TimeoutError):
+                raise
+            # every other pooled socket to this worker predates the same
+            # restart: drop them, and retry on a GUARANTEED-fresh connect
+            # (re-acquiring from the pool could hand back another stale
+            # socket and turn a healthy restarted worker into an eviction)
+            with self._lock:
+                stale = self._free.pop(key, [])
+                self.fresh += 1
+            for c in stale:
+                c.close()
+            _count_http_client("conn_fresh")
+            conn = http.client.HTTPConnection(key[0], key[1],
+                                              timeout=timeout)
+            try:
+                status, data, resp_headers, will_close = self._round_trip(
+                    conn, path, body, headers)
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                raise
+        if will_close:
+            conn.close()
+        else:
+            self._release(key, conn)
+        if status >= 400:
+            raise urllib.error.HTTPError(url, status, "", resp_headers,
+                                         io.BytesIO(data))
+        return status, data
+
+    @staticmethod
+    def _round_trip(conn, path, body, headers):
+        conn.request("POST", path, body=body, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, data, resp.headers, resp.will_close
 
 
 def send_with_retries(req: HTTPRequestData,

@@ -1,0 +1,284 @@
+"""Binary row format + pooled batch assembly for the serving hot path.
+
+The JSON request path (`serving.parse_request`) decodes every body with
+`json.loads`, builds per-request Python lists, and `np.stack`s them —
+three copies and a pile of allocations per batch, which caps the host
+side of serving far below what the device sustains (docs/SERVING.md:
+~1.1M rows/s at batch 1024 on chip vs the JSON decode path's ~tens of
+thousands). This module is the vectorized alternative:
+
+- a self-describing binary wire format (magic + dtype + shape header,
+  C-order little-endian payload) carrying one named vector column per
+  request — one request may carry MANY rows (shape [r, k]), which is how
+  "mixed batch sizes" ride through the gateway;
+- `peek` parses only the fixed header (no payload touch) so the batcher
+  can count rows at admission time;
+- `assemble` copies every request's payload straight into a pooled,
+  reusable batch buffer — the ONE host copy between socket bytes and the
+  device-bound array (the `np.frombuffer` views are zero-copy);
+- a length-prefixed request/reply *pack* codec for gateway coalescing
+  (one forward hop carrying several client requests).
+
+JSON stays as the compatibility fallback: `is_binary` routes per body,
+and mixed batches degrade to the generic path in `serving.py`.
+
+Wire format v1 (little-endian throughout):
+
+    offset 0   4s   magic  b"MT01"
+    offset 4   u8   dtype code (see _DTYPES)
+    offset 5   u8   ndim (1 = one row of k features; 2 = [rows, k])
+    offset 6   u16  column-name length L
+    offset 8   u32 * ndim  dims
+    then       L bytes of utf-8 column name
+    then       C-order array payload
+
+Reply bodies reuse the same format (name = reply column). Packs:
+
+    request pack  = N * ( u32 length | body )
+    reply pack    = N * ( u32 length | u16 status | body )
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"MT01"
+
+#: dtype code <-> numpy dtype (little-endian on the wire)
+_DTYPES = {1: np.dtype("<f4"), 2: np.dtype("<f8"),
+           3: np.dtype("<i4"), 4: np.dtype("u1"), 5: np.dtype("<i8")}
+_DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
+
+_HEAD = struct.Struct("<4sBBH")
+
+
+class BinaryFormatError(ValueError):
+    """Body advertised the magic but the header/payload is malformed."""
+
+
+def is_binary(body: bytes) -> bool:
+    return len(body) >= 4 and body[:4] == MAGIC
+
+
+def encode(name: str, arr: np.ndarray) -> bytes:
+    """One request/reply body: header + C-order little-endian payload."""
+    a = np.ascontiguousarray(arr)
+    code = _DTYPE_CODES.get(a.dtype.newbyteorder("<"))
+    if code is None:
+        raise BinaryFormatError(f"unsupported dtype {a.dtype}")
+    if a.ndim not in (1, 2):
+        raise BinaryFormatError(f"ndim must be 1 or 2, got {a.ndim}")
+    nb = name.encode("utf-8")
+    head = _HEAD.pack(MAGIC, code, a.ndim, len(nb))
+    dims = struct.pack("<%dI" % a.ndim, *a.shape)
+    return head + dims + nb + (a.astype(a.dtype.newbyteorder("<"),
+                                        copy=False).tobytes())
+
+
+def encode_reply(name: str, arr) -> bytes:
+    """`encode` with dtype coercion for handler outputs: a reply column
+    in a dtype the wire does not carry (bool predictions, an odd float
+    width, object arrays of Python numbers) is cast to float64 rather
+    than 500-ing a working model's whole batch."""
+    a = np.asarray(arr)
+    if a.dtype.newbyteorder("<") not in _DTYPE_CODES:
+        a = a.astype(np.float64)
+    return encode(name, a)
+
+
+class BinaryHeader:
+    """Parsed header of a binary body (payload untouched until assembly)."""
+
+    __slots__ = ("name", "dtype", "shape", "offset", "nrows", "ncols")
+
+    def __init__(self, name: str, dtype: np.dtype,
+                 shape: Tuple[int, ...], offset: int):
+        self.name = name
+        self.dtype = dtype
+        self.shape = shape
+        self.offset = offset
+        self.nrows = 1 if len(shape) == 1 else int(shape[0])
+        self.ncols = int(shape[-1])
+
+
+def peek(body: bytes) -> Optional[BinaryHeader]:
+    """Header-only parse (row count for the batcher's admission math).
+    Returns None for non-binary bodies; raises BinaryFormatError when the
+    magic is present but the rest does not hold together."""
+    if not is_binary(body):
+        return None
+    if len(body) < _HEAD.size:
+        raise BinaryFormatError("truncated header")
+    _, code, ndim, name_len = _HEAD.unpack_from(body, 0)
+    dtype = _DTYPES.get(code)
+    if dtype is None:
+        raise BinaryFormatError(f"unknown dtype code {code}")
+    if ndim not in (1, 2):
+        raise BinaryFormatError(f"bad ndim {ndim}")
+    dims_off = _HEAD.size
+    payload_off = dims_off + 4 * ndim + name_len
+    if len(body) < payload_off:
+        raise BinaryFormatError("truncated dims/name")
+    shape = struct.unpack_from("<%dI" % ndim, body, dims_off)
+    name = body[dims_off + 4 * ndim:payload_off].decode("utf-8")
+    expected = int(np.prod(shape)) * dtype.itemsize
+    if len(body) != payload_off + expected:
+        raise BinaryFormatError(
+            f"payload size {len(body) - payload_off} != expected {expected}")
+    return BinaryHeader(name, dtype, tuple(int(d) for d in shape),
+                        payload_off)
+
+
+def decode(body: bytes) -> Tuple[str, np.ndarray]:
+    """Full decode -> (column name, ZERO-COPY read-only array view)."""
+    h = peek(body)
+    if h is None:
+        raise BinaryFormatError("not a binary body")
+    view = np.frombuffer(body, dtype=h.dtype, offset=h.offset)
+    return h.name, view.reshape(h.shape)
+
+
+def rows_view(body: bytes, h: BinaryHeader) -> np.ndarray:
+    """[nrows, ncols] zero-copy view of one request's payload."""
+    view = np.frombuffer(body, dtype=h.dtype, offset=h.offset)
+    return view.reshape(h.nrows, h.ncols)
+
+
+# ------------------------------------------------------------- buffer pool
+
+class BufferPool:
+    """Reusable host-side batch buffers keyed by (dtype, shape).
+
+    The dispatcher acquires the device-bound staging array here instead
+    of allocating per batch; `release` returns it once the batch's
+    replies are serialized (with reply writing overlapped, the PREVIOUS
+    batch's buffer can still be live while the next assembles — distinct
+    buffers from the freelist make that safe). `hits`/`misses` are plain
+    ints surfaced through the serving metrics, not a stats dict.
+    """
+
+    def __init__(self, max_per_key: int = 4):
+        self.max_per_key = max_per_key
+        self._free: Dict[Tuple[str, Tuple[int, ...]], List[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, dtype, shape: Tuple[int, ...]) -> np.ndarray:
+        key = (np.dtype(dtype).str, tuple(int(d) for d in shape))
+        with self._lock:
+            lst = self._free.get(key)
+            if lst:
+                self.hits += 1
+                return lst.pop()
+            self.misses += 1
+        return np.empty(key[1], dtype=np.dtype(dtype))
+
+    def release(self, arr: np.ndarray) -> None:
+        key = (arr.dtype.str, arr.shape)
+        with self._lock:
+            lst = self._free.setdefault(key, [])
+            if len(lst) < self.max_per_key:
+                lst.append(arr)
+
+
+def assemble(bodies: Sequence[bytes], headers: Sequence[BinaryHeader],
+             pool: BufferPool, cap_rows: int) -> Tuple[np.ndarray, int]:
+    """Copy every request's rows into one pooled [cap_rows, k] buffer.
+
+    Returns (buffer, total_rows). This is the single host copy: socket
+    bytes -> device-bound staging array. Rows beyond total (padding to
+    the jit-stable cap) repeat the last row so the compiled program sees
+    one shape per power-of-two bucket. All requests must agree on
+    (dtype, ncols); the caller groups/falls back otherwise."""
+    h0 = headers[0]
+    buf = pool.acquire(h0.dtype, (cap_rows, h0.ncols))
+    off = 0
+    for body, h in zip(bodies, headers):
+        buf[off:off + h.nrows] = rows_view(body, h)
+        off += h.nrows
+    if off < cap_rows and off > 0:
+        buf[off:cap_rows] = buf[off - 1]
+    return buf, off
+
+
+# ------------------------------------------------------- coalescing packs
+
+def encode_pack(bodies: Sequence[bytes],
+                trace_ids: Optional[Sequence[str]] = None) -> bytes:
+    """Gateway -> worker: N client bodies in one forward hop. Each part
+    carries its OWN trace id so a coalesced follower's worker-side spans
+    join its gateway-side trace (empty when the caller has none)."""
+    out = bytearray()
+    for i, b in enumerate(bodies):
+        tid = (trace_ids[i] if trace_ids is not None else "").encode(
+            "latin1", "replace")
+        out += struct.pack("<IH", len(b), len(tid))
+        out += tid
+        out += b
+    return bytes(out)
+
+
+def decode_pack(body: bytes) -> List[Tuple[str, bytes]]:
+    """-> [(trace_id_or_empty, part_body), ...]"""
+    parts: List[Tuple[str, bytes]] = []
+    off = 0
+    while off < len(body):
+        if off + 6 > len(body):
+            raise BinaryFormatError("truncated pack header")
+        n, tl = struct.unpack_from("<IH", body, off)
+        off += 6
+        if off + tl + n > len(body):
+            raise BinaryFormatError("truncated pack part")
+        tid = body[off:off + tl].decode("latin1")
+        off += tl
+        parts.append((tid, body[off:off + n]))
+        off += n
+    return parts
+
+
+def encode_reply_pack(replies: Sequence[Tuple[int, bytes]]) -> bytes:
+    """Worker -> gateway: per-part (status, body)."""
+    out = bytearray()
+    for status, b in replies:
+        out += struct.pack("<IH", len(b), status)
+        out += b
+    return bytes(out)
+
+
+def decode_reply_pack(body: bytes) -> List[Tuple[int, bytes]]:
+    parts: List[Tuple[int, bytes]] = []
+    off = 0
+    while off < len(body):
+        if off + 6 > len(body):
+            raise BinaryFormatError("truncated reply-pack header")
+        n, status = struct.unpack_from("<IH", body, off)
+        off += 6
+        if off + n > len(body):
+            raise BinaryFormatError("truncated reply-pack part")
+        parts.append((int(status), body[off:off + n]))
+        off += n
+    return parts
+
+
+#: header the gateway sets on a coalesced forward (value = part count);
+#: echoed on the worker's reply so the gateway knows to unpack it
+COALESCE_HEADER = "X-Coalesced-Count"
+
+
+def coalesced_count(headers: Optional[Dict[str, str]]) -> int:
+    """Part count from headers (0 when absent/malformed — treat as a
+    plain request; a malformed count must not kill the request)."""
+    if not headers:
+        return 0
+    for k, v in headers.items():
+        if k.lower() == COALESCE_HEADER.lower():
+            try:
+                return max(0, int(v))
+            except (TypeError, ValueError):
+                return 0
+    return 0

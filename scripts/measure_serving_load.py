@@ -1,0 +1,415 @@
+"""Sustained serving load harness (round-12 tentpole, ROADMAP item 2).
+
+Drives >= 100k row-requests/s of MIXED batch sizes through the
+ServingCoordinator gateway for minutes on the host CPU path and records
+what the serving data plane actually sustains:
+
+- worker fleet: N separate OS processes, each a DistributedServingServer
+  (continuous deadline-driven batching, binary row decode, heartbeat load
+  reports feeding the gateway's least-loaded router);
+- clients: keep-alive socket threads posting binary-format bodies whose
+  row counts cycle the mixed-size schedule (1/8/64/256 rows per request —
+  "requests/s" below counts ROWS, i.e. logical single-row requests, the
+  unit the chip-side 1.1M rows/s number uses), every request carrying an
+  X-Deadline-Ms budget so the continuous batcher is exercised end to end;
+- gateway: keep-alive forwards, request coalescing, least-loaded routing;
+- chaos variant: the same run with a seeded FaultInjector failing 30% of
+  gateway forwards PLUS one worker killed mid-run (it must be evicted and
+  traffic rebalanced) — the acceptance bar is ZERO accepted (HTTP 200)
+  requests with a wrong/missing payload, every reply accounted for.
+
+Outputs: a markdown row block on stdout (append to docs/SERVING.md) and a
+JSON summary at --out (default docs/SERVING_load.json; bench.py embeds it
+in its emitted record's `extra.serving_load`). Armed in
+scripts/tpu_recovery_watch.sh; env knobs for quick runs:
+MEASURE_LOAD_S (per-variant seconds, default 120), MEASURE_LOAD_CLIENTS,
+MEASURE_LOAD_WORKERS, MEASURE_LOAD_SKIP_CHAOS=1.
+"""
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import re
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FEATURES = 16
+BATCH_MIX = (1, 8, 64, 256)
+DEADLINE_MS = 10_000
+SERVICE = "load"
+
+
+def _weights() -> np.ndarray:
+    return (np.arange(FEATURES, dtype=np.float32) + 1.0) / FEATURES
+
+
+def _worker_main(coord_url: str, partition: int, ready, stop) -> None:
+    """One serving worker in its own process (own GIL): numpy linear
+    scorer — the host-path cost model; the chip handler swaps in the
+    jitted booster (scripts/measure_serving_tpu.py)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mmlspark_tpu.io.distributed_serving import DistributedServingServer
+
+    w = _weights()
+
+    def handler(df):
+        x = np.asarray(df["features"], np.float32)
+        return df.with_column("prediction", (x @ w).astype(np.float32))
+
+    server = DistributedServingServer(
+        handler, coord_url, SERVICE, partition=partition,
+        machine=f"load-{partition}", port=0,
+        max_batch_size=1024, max_latency_ms=0.5,
+        heartbeat_interval_s=0.25, max_queue=4096).start()
+    ready.set()
+    stop.wait(3600)
+    server.stop()
+
+
+class _Client(threading.Thread):
+    """Keep-alive HTTP/1.1 client hammering the gateway with binary
+    bodies of mixed row counts; verifies EVERY 200 payload exactly."""
+
+    def __init__(self, host, port, path, bodies, expected, deadline_s,
+                 stop_ev):
+        super().__init__(daemon=True)
+        self.addr = (host, port)
+        self.path = path.encode()
+        self.bodies = bodies          # [(nrows, body, expected_first)]
+        self.deadline_s = deadline_s
+        self.stop_ev = stop_ev
+        self.expected = expected
+        self.sent = 0
+        self.ok_requests = 0
+        self.ok_rows = 0
+        self.shed = 0
+        self.expired = 0
+        self.errors = 0
+        self.bad_payload = 0
+        self.lost = 0
+
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=30.0)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def run(self):
+        from mmlspark_tpu.io import rowcodec
+        sock = self._connect()
+        buf = b""
+        i = 0
+        head_tpl = (b"POST %s HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Type: application/octet-stream\r\n"
+                    b"X-Deadline-Ms: %d\r\n"
+                    b"Content-Length: %%d\r\n\r\n"
+                    % (self.path, DEADLINE_MS))
+        while not self.stop_ev.is_set():
+            nrows, body, exp_first = self.bodies[i % len(self.bodies)]
+            i += 1
+            try:
+                sock.sendall(head_tpl % len(body) + body)
+                self.sent += 1
+                # read one response
+                while b"\r\n\r\n" not in buf:
+                    chunk = sock.recv(262144)
+                    if not chunk:
+                        raise ConnectionError("closed")
+                    buf += chunk
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                status = int(head.split(b" ", 2)[1])
+                length = 0
+                for ln in head.split(b"\r\n"):
+                    if ln.lower().startswith(b"content-length:"):
+                        length = int(ln.split(b":", 1)[1])
+                while len(rest) < length:
+                    chunk = sock.recv(262144)
+                    if not chunk:
+                        raise ConnectionError("closed")
+                    rest += chunk
+                payload, buf = rest[:length], rest[length:]
+                if status == 200:
+                    _, preds = rowcodec.decode(payload)
+                    if (preds.shape[0] != nrows
+                            or abs(float(preds[0]) - exp_first) > 1e-4):
+                        self.bad_payload += 1
+                    else:
+                        self.ok_requests += 1
+                        self.ok_rows += nrows
+                elif status == 503:
+                    self.shed += 1
+                elif status == 504:
+                    self.expired += 1
+                else:
+                    self.errors += 1
+            except Exception:
+                # connection died mid-request (gateway restart, teardown
+                # race): the in-flight request got NO reply
+                self.lost += 1
+                try:
+                    sock.close()
+                except Exception:
+                    pass
+                if self.stop_ev.is_set():
+                    return
+                try:
+                    sock = self._connect()
+                    buf = b""
+                except Exception:
+                    time.sleep(0.05)
+        try:
+            sock.close()
+        except Exception:
+            pass
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        return r.read().decode()
+
+
+def _prom_value(text: str, name: str) -> float:
+    total = 0.0
+    for m in re.finditer(rf"^{name}(?:{{[^}}]*}})? ([0-9.e+-]+)$", text,
+                         re.M):
+        total += float(m.group(1))
+    return total
+
+
+def _spawn_workers(ctx, coord_url, n):
+    """Each worker gets its OWN stop event: terminate()-ing a worker that
+    shares an Event can kill it while it holds the event's internal lock,
+    deadlocking the parent's later set() (observed on the chaos path)."""
+    procs, readies, stops = [], [], []
+    for p in range(n):
+        ready = ctx.Event()
+        stop = ctx.Event()
+        proc = ctx.Process(target=_worker_main,
+                           args=(coord_url, p, ready, stop), daemon=True)
+        proc.start()
+        procs.append(proc)
+        readies.append(ready)
+        stops.append(stop)
+    for r in readies:
+        if not r.wait(60):
+            raise RuntimeError("worker failed to start/register")
+    return procs, stops
+
+
+def run_variant(chaos: bool, duration_s: float, n_workers: int,
+                n_clients: int) -> dict:
+    from mmlspark_tpu.io import rowcodec
+    from mmlspark_tpu.io.distributed_serving import ServingCoordinator
+    from mmlspark_tpu.io.http import KeepAliveTransport
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+    from mmlspark_tpu.resilience import FaultInjector
+
+    # fresh process-global registry per variant: worker processes have
+    # their own; the gateway's series live here
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    injector = None
+    transport = None
+    if chaos:
+        transport = KeepAliveTransport()
+        injector = FaultInjector(seed=12, error_rate=0.3)
+    coord = ServingCoordinator(
+        heartbeat_timeout_s=2.0, registry=reg,
+        forward_transport=(injector.wrap(transport) if chaos else None),
+        coalesce_max=8).start()
+    ctx = mp.get_context("spawn")
+    procs, worker_stops = _spawn_workers(ctx, coord.url, n_workers)
+
+    w = _weights()
+    rng = np.random.default_rng(5)
+    bodies = []
+    for nrows in BATCH_MIX:
+        x = rng.normal(size=(nrows, FEATURES)).astype(np.float32)
+        bodies.append((nrows, rowcodec.encode("features", x),
+                       float(x[0] @ w)))
+
+    stop_clients = threading.Event()
+    import urllib.parse
+    parsed = urllib.parse.urlsplit(coord.url)
+    clients = [_Client(parsed.hostname, parsed.port,
+                       f"/gateway/{SERVICE}", bodies, w,
+                       DEADLINE_MS / 1000.0, stop_clients)
+               for _ in range(n_clients)]
+    t0 = time.perf_counter()
+    for c in clients:
+        c.start()
+    killed_at = None
+    if chaos:
+        # kill one worker a third of the way in: it must be evicted and
+        # the fleet rebalanced with zero accepted-request loss
+        time.sleep(max(duration_s / 3.0, 1.0))
+        procs[0].terminate()
+        killed_at = time.perf_counter() - t0
+        time.sleep(max(duration_s * 2.0 / 3.0, 1.0))
+    else:
+        time.sleep(duration_s)
+    stop_clients.set()
+    for c in clients:
+        c.join(15.0)
+    wall = time.perf_counter() - t0
+
+    # worker-side scrape BEFORE teardown: batch fill + request accounting
+    worker_stats = []
+    for s in coord.routes(SERVICE):
+        try:
+            text = _scrape(f"http://{s.host}:{s.port}/metrics")
+            cnt = _prom_value(text, "serving_batch_rows_count")
+            tot = _prom_value(text, "serving_batch_rows_sum")
+            worker_stats.append({
+                "worker": f"{s.machine}:{s.partition}",
+                "batches": cnt,
+                "mean_batch_rows": round(tot / cnt, 2) if cnt else 0.0,
+                "requests": _prom_value(text, "serving_requests_total"),
+                "shed": _prom_value(text, "serving_shed_total"),
+                "coalesced_packs": _prom_value(
+                    text, "serving_coalesced_packs_total"),
+            })
+        except Exception as e:
+            worker_stats.append({"worker": f"{s.machine}:{s.partition}",
+                                 "scrape_error": str(e)[:100]})
+
+    # trace exemplars: a few gateway traces with their per-attempt spans
+    exemplars = []
+    seen = set()
+    for ev in list(coord.events.events())[-400:]:
+        tid = ev.get("trace_id")
+        if tid and tid not in seen:
+            seen.add(tid)
+            spans = [{k: v for k, v in e.items() if k != "trace_id"}
+                     for e in coord.events.events(tid)]
+            exemplars.append({"trace_id": tid, "spans": spans[:8]})
+        if len(exemplars) >= 3:
+            break
+
+    lbl = {"instance": coord.metrics_label}
+    p50 = reg.quantile("gateway_request_latency_seconds", 0.5, lbl)
+    p99 = reg.quantile("gateway_request_latency_seconds", 0.99, lbl)
+    sent = sum(c.sent for c in clients)
+    ok_req = sum(c.ok_requests for c in clients)
+    ok_rows = sum(c.ok_rows for c in clients)
+    shed = sum(c.shed for c in clients)
+    expired = sum(c.expired for c in clients)
+    errors = sum(c.errors for c in clients)
+    bad = sum(c.bad_payload for c in clients)
+    lost = sum(c.lost for c in clients)
+    mean_fill_rows = [ws["mean_batch_rows"] for ws in worker_stats
+                      if ws.get("batches")]
+    summary = {
+        "variant": "chaos" if chaos else "baseline",
+        "duration_s": round(wall, 1),
+        "workers": n_workers,
+        "clients": n_clients,
+        "batch_mix_rows": list(BATCH_MIX),
+        "client_requests": sent,
+        "ok_requests": ok_req,
+        "ok_rows": ok_rows,
+        "row_requests_per_s": round(ok_rows / wall, 1),
+        "client_requests_per_s": round(sent / wall, 1),
+        "shed": shed,
+        "expired": expired,
+        "errors": errors,
+        "bad_payload_on_200": bad,
+        "no_reply_lost": lost,
+        "shed_rate": round(shed / sent, 5) if sent else 0.0,
+        "gateway_p50_ms": round(p50 * 1e3, 3) if p50 else None,
+        "gateway_p99_ms": round(p99 * 1e3, 3) if p99 else None,
+        "coalesced_forwards": reg.total("gateway_coalesced_forwards_total"),
+        "coalesced_requests": reg.total("gateway_coalesced_requests_total"),
+        "route_decisions": reg.total("gateway_route_decisions_total"),
+        "forward_failures": reg.total("gateway_forward_failures_total"),
+        "evictions": reg.total("gateway_evictions_total"),
+        "worker_stats": worker_stats,
+        "mean_batch_rows": (round(float(np.mean(mean_fill_rows)), 1)
+                            if mean_fill_rows else 0.0),
+        "trace_exemplars": exemplars,
+    }
+    if chaos:
+        summary["injected"] = dict(injector.counts)
+        summary["worker_killed_at_s"] = round(killed_at, 1)
+
+    for p, st in zip(procs, worker_stops):
+        if p.is_alive():
+            st.set()
+    for p in procs:
+        p.join(10.0)
+        if p.is_alive():
+            p.terminate()
+    coord.stop()
+    set_registry(prev)
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/SERVING_load.json")
+    ap.add_argument("--duration-s", type=float, default=float(
+        os.environ.get("MEASURE_LOAD_S", "120")))
+    ap.add_argument("--workers", type=int, default=int(
+        os.environ.get("MEASURE_LOAD_WORKERS", "4")))
+    ap.add_argument("--clients", type=int, default=int(
+        os.environ.get("MEASURE_LOAD_CLIENTS", "32")))
+    ap.add_argument("--target-rows-s", type=float, default=100_000.0)
+    args = ap.parse_args()
+
+    variants = [False]
+    if os.environ.get("MEASURE_LOAD_SKIP_CHAOS") != "1":
+        variants.append(True)
+    results = []
+    for chaos in variants:
+        tag = "chaos" if chaos else "baseline"
+        print(f"== {tag}: {args.duration_s:.0f}s, {args.workers} workers, "
+              f"{args.clients} clients", flush=True)
+        s = run_variant(chaos, args.duration_s, args.workers, args.clients)
+        results.append(s)
+        print(json.dumps({k: v for k, v in s.items()
+                          if k not in ("worker_stats", "trace_exemplars")},
+                         indent=1), flush=True)
+
+    record = {
+        "host": "cpu",
+        "date_utc": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        "target_row_requests_per_s": args.target_rows_s,
+        "variants": results,
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"wrote {args.out}")
+
+    print("\n| variant | rows/s (row-requests/s) | client req/s | p50 | "
+          "p99 | shed rate | mean batch rows | accepted lost |")
+    print("|---|---|---|---|---|---|---|---|")
+    rc = 0
+    for s in results:
+        accepted_lost = s["bad_payload_on_200"]
+        print(f"| {s['variant']} | {s['row_requests_per_s']:.0f} | "
+              f"{s['client_requests_per_s']:.0f} | "
+              f"{s['gateway_p50_ms']} ms | {s['gateway_p99_ms']} ms | "
+              f"{s['shed_rate']:.4f} | {s['mean_batch_rows']} | "
+              f"{accepted_lost} |")
+        if s["variant"] == "baseline" \
+                and s["row_requests_per_s"] < args.target_rows_s:
+            print(f"  !! baseline below target "
+                  f"{args.target_rows_s:.0f} rows/s")
+            rc = 1
+        if accepted_lost:
+            print("  !! accepted (200) requests with wrong payload")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,8 @@ EOF
     fi
     echo "== serving (incl. HTTP->TPU->reply E2E) $(date -u +%FT%TZ)"
     run python -u scripts/measure_serving_tpu.py
+    echo "== serving sustained load (round-12 tentpole) $(date -u +%FT%TZ)"
+    run python -u scripts/measure_serving_load.py --out docs/SERVING_load_chip_host.json
     echo "== cold start: compile cache + AOT (round-11 tentpole) $(date -u +%FT%TZ)"
     run python -u scripts/measure_cold_start.py --out docs/COLD_START_chip.json
     echo "== bench (validates binning fast path on chip) $(date -u +%FT%TZ)"

@@ -1,0 +1,753 @@
+"""Serving data plane (round 12): continuous deadline-driven batching,
+vectorized binary decode, gateway coalescing + least-loaded routing,
+keep-alive forwards.
+
+The batching-policy tests drive `DynamicBatcher` against SEEDED arrival
+traces with an injected clock — fully deterministic, no wall-clock
+assertions: the same simulator harness runs both the legacy fixed-window
+policy and the continuous policy on the SAME trace and compares mean
+batch fill and p99 (ISSUE-12 acceptance: strictly higher fill at
+equal-or-lower p99, and no launched batch ever contains an expired
+request).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.io import rowcodec
+from mmlspark_tpu.io.http import KeepAliveTransport
+from mmlspark_tpu.io.serving import DynamicBatcher, ServingServer
+from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                 ServingCoordinator)
+from mmlspark_tpu.observability import MetricsRegistry
+
+
+# ------------------------------------------------------------ wire format
+
+class TestRowCodec:
+    def test_roundtrip_bit_exact(self):
+        rng = np.random.default_rng(0)
+        for arr in (rng.normal(size=(7, 5)).astype(np.float32),
+                    rng.normal(size=13).astype(np.float64),
+                    rng.integers(0, 9, size=(3, 4)).astype(np.int32),
+                    rng.integers(0, 255, size=(2, 8)).astype(np.uint8)):
+            body = rowcodec.encode("features", arr)
+            name, back = rowcodec.decode(body)
+            assert name == "features"
+            assert back.dtype == arr.dtype.newbyteorder("<")
+            assert back.shape == arr.shape
+            assert back.tobytes() == arr.tobytes()  # bit exact
+
+    def test_peek_counts_rows_without_payload_decode(self):
+        h1 = rowcodec.peek(rowcodec.encode("x", np.zeros(4, np.float32)))
+        assert (h1.nrows, h1.ncols) == (1, 4)       # 1-D = one row
+        h2 = rowcodec.peek(rowcodec.encode(
+            "x", np.zeros((256, 4), np.float32)))
+        assert (h2.nrows, h2.ncols) == (256, 4)
+        assert rowcodec.peek(b'{"x": 1.0}') is None  # JSON passes through
+
+    def test_malformed_binary_rejected(self):
+        good = rowcodec.encode("x", np.zeros((2, 3), np.float32))
+        with pytest.raises(rowcodec.BinaryFormatError):
+            rowcodec.peek(good[:-1])                # truncated payload
+        with pytest.raises(rowcodec.BinaryFormatError):
+            rowcodec.peek(rowcodec.MAGIC + b"\xff\x01\x00\x00")
+
+    def test_pack_roundtrip(self):
+        bodies = [b"alpha", b"", b"\x00binary\xff"]
+        tids = ["tr-aaa", "", "tr-ccc"]
+        assert rowcodec.decode_pack(
+            rowcodec.encode_pack(bodies, tids)) == list(zip(tids, bodies))
+        assert rowcodec.decode_pack(rowcodec.encode_pack(bodies)) \
+            == [("", b) for b in bodies]
+        replies = [(200, b"ok"), (503, b"full"), (504, b"")]
+        assert rowcodec.decode_reply_pack(
+            rowcodec.encode_reply_pack(replies)) == replies
+
+    def test_one_copy_assembly_and_pool_reuse(self):
+        """A 1024-row batch assembles into the pooled device-bound array
+        with ONE host copy: the assembled staging buffer IS the pool
+        buffer (no intermediate stacking), and releasing it makes the
+        next batch reuse the same allocation."""
+        rng = np.random.default_rng(1)
+        chunks = [rng.normal(size=(256, 8)).astype(np.float32)
+                  for _ in range(4)]
+        bodies = [rowcodec.encode("features", c) for c in chunks]
+        headers = [rowcodec.peek(b) for b in bodies]
+        pool = rowcodec.BufferPool()
+        buf, rows = rowcodec.assemble(bodies, headers, pool, 1024)
+        assert rows == 1024 and buf.shape == (1024, 8)
+        assert np.array_equal(buf, np.concatenate(chunks))  # bit exact
+        assert pool.misses == 1 and pool.hits == 0
+        pool.release(buf)
+        buf2, _ = rowcodec.assemble(bodies, headers, pool, 1024)
+        assert buf2 is buf                       # the SAME allocation
+        assert pool.hits == 1
+
+    def test_assembly_pads_with_last_row(self):
+        bodies = [rowcodec.encode("x", np.full((3, 2), i, np.float32))
+                  for i in (1, 2)]
+        headers = [rowcodec.peek(b) for b in bodies]
+        buf, rows = rowcodec.assemble(bodies, headers,
+                                      rowcodec.BufferPool(), 8)
+        assert rows == 6
+        assert np.all(buf[6:] == buf[5])         # pow2 pad repeats last row
+
+
+# ------------------------------------------- batching policy (sim harness)
+
+class SimClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class SimDeadline:
+    """Deadline duck-type bound to the injected clock."""
+
+    def __init__(self, clock, expires_at):
+        self.clock = clock
+        self.expires_at = expires_at
+
+    def remaining(self):
+        return max(0.0, self.expires_at - self.clock.t)
+
+    @property
+    def expired(self):
+        return self.clock.t >= self.expires_at
+
+
+class SimReq:
+    __slots__ = ("rid", "nrows", "t_enq", "deadline", "trace_id")
+
+    def __init__(self, rid, t_enq, deadline, nrows=1):
+        self.rid = rid
+        self.nrows = nrows
+        self.t_enq = t_enq
+        self.deadline = deadline
+        self.trace_id = f"sim-{rid}"
+
+
+def simulate(mode, trace, clock, max_rows=32, max_latency_ms=2.0,
+             base_service_s=0.0015, per_row_s=0.00005,
+             reply_per_row_s=0.0, overlap_replies=None):
+    """Drive DynamicBatcher.collect over a scripted arrival trace.
+
+    `trace` is a list of (arrival_s, deadline_s_or_None); the service
+    model charges base + per_row per batch (the dispatcher is busy for
+    that long, during which later arrivals queue). `reply_per_row_s`
+    models reply serialization: the LEGACY dispatcher wrote replies
+    inline (blocking the next batch — the dead time round 12 removed),
+    the new one overlaps them on the writer thread, so by default the
+    cost blocks the dispatcher only in "fixed" mode (override with
+    `overlap_replies`). Returns per-request latencies, per-batch fills,
+    launched batches, and expired count."""
+    if overlap_replies is None:
+        overlap_replies = mode == "continuous"
+    batcher = DynamicBatcher(max_rows, max_latency_ms, mode=mode,
+                             clock=clock)
+    pending = []
+    for i, (t_arr, ddl) in enumerate(trace):
+        pending.append(SimReq(
+            i, t_arr,
+            None if ddl is None else SimDeadline(clock, t_arr + ddl)))
+    pending.sort(key=lambda r: r.t_enq)
+
+    def try_get(timeout_s):
+        if pending and pending[0].t_enq <= clock.t:
+            return pending.pop(0)
+        if timeout_s <= 0:
+            return None
+        if pending and pending[0].t_enq <= clock.t + timeout_s:
+            clock.t = max(clock.t, pending[0].t_enq)
+            return pending.pop(0)
+        clock.t += timeout_s
+        return None
+
+    latencies, fills, batches, n_expired = [], [], [], 0
+    while pending:
+        clock.t = max(clock.t, pending[0].t_enq)
+        first = try_get(0.0)
+        batch = batcher.collect(first, try_get)
+        live, expired = DynamicBatcher.split_expired(batch)
+        n_expired += len(expired)
+        # THE invariant, checked at launch time (the clock has not moved
+        # since split_expired ran): no launched batch contains an expired
+        # request
+        assert all(r.deadline is None or not r.deadline.expired
+                   for r in live), "expired request admitted to a batch"
+        if not live:
+            continue
+        rows = sum(r.nrows for r in live)
+        service = base_service_s + per_row_s * rows
+        clock.t += service
+        batcher.observe_dispatch(service)
+        reply_cost = reply_per_row_s * rows
+        for r in live:
+            latencies.append(clock.t + reply_cost - r.t_enq)
+        fills.append(rows / max_rows)
+        batches.append(live)
+        if not overlap_replies:
+            clock.t += reply_cost     # legacy: replies block the dispatcher
+    return latencies, fills, batches, n_expired
+
+
+def seeded_trace(seed=7, n=500, mean_gap_s=0.0002, deadline_s=0.03):
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(mean_gap_s, size=n)
+    arrivals = np.cumsum(gaps)
+    return [(float(t), deadline_s) for t in arrivals]
+
+
+class TestContinuousBatcher:
+    def test_continuous_beats_fixed_window_on_seeded_trace(self):
+        """ISSUE-12 acceptance: same seeded trace, injected clock —
+        strictly higher mean batch fill at equal-or-lower p99.
+
+        The regime is the one the round-12 rework targets: a sustained
+        arrival rate the legacy pipeline (fixed 1 ms window + replies
+        serialized on the dispatcher thread) cannot keep up with — its
+        queue oscillates and ~half the 30 ms budgets expire in-queue —
+        while the continuous batcher (deadline-budget fill + overlapped
+        reply writing) absorbs the same trace with fuller batches, lower
+        p99, and ZERO expirations. Stable across seeds (checked 0-9);
+        pinned seed keeps it deterministic."""
+        trace = seeded_trace()
+        kw = dict(max_rows=32, max_latency_ms=1.0, base_service_s=0.002,
+                  per_row_s=0.00001, reply_per_row_s=0.0004)
+        lat_f, fill_f, _, exp_f = simulate("fixed", trace, SimClock(), **kw)
+        lat_c, fill_c, _, exp_c = simulate("continuous", trace, SimClock(),
+                                           **kw)
+        mean_fill_f = float(np.mean(fill_f))
+        mean_fill_c = float(np.mean(fill_c))
+        p99_f = float(np.percentile(lat_f, 99))
+        p99_c = float(np.percentile(lat_c, 99))
+        print(f"\nfixed:      fill {mean_fill_f:.3f}  p99 {p99_f*1e3:.2f}ms"
+              f"  expired {exp_f} ({len(fill_f)} batches)")
+        print(f"continuous: fill {mean_fill_c:.3f}  p99 {p99_c*1e3:.2f}ms"
+              f"  expired {exp_c} ({len(fill_c)} batches)")
+        assert len(lat_c) == len(trace) and exp_c == 0, \
+            "continuous must complete the whole trace in-budget"
+        assert exp_f > 0, \
+            "trace must overload the fixed window or the comparison is moot"
+        assert mean_fill_c > mean_fill_f, "continuous must fill strictly more"
+        assert p99_c <= p99_f, "continuous must not worsen p99"
+
+    def test_no_launched_batch_contains_expired_request(self):
+        """Property over a seeded mixed-deadline trace (some budgets far
+        too tight to survive queueing): at every launch, every request in
+        the live batch is unexpired, and the tight ones are answered 504
+        out of band rather than occupying slots."""
+        rng = np.random.default_rng(11)
+        clock = SimClock()
+        trace = []
+        t = 0.0
+        for i in range(300):
+            t += float(rng.exponential(0.0005))
+            # a third get budgets (1-4 ms) that often expire in-queue
+            ddl = (float(rng.uniform(0.001, 0.004)) if i % 3 == 0
+                   else float(rng.uniform(0.05, 0.2)))
+            trace.append((t, ddl))
+        # simulate() asserts the launch-time invariant itself on every
+        # batch (see the harness); here: the trace must actually have
+        # exercised it, and no request may be lost
+        _, _, batches, n_expired = simulate("continuous", trace, clock,
+                                            base_service_s=0.004)
+        assert n_expired > 0, "trace produced no expirations: proves nothing"
+        assert sum(len(b) for b in batches) + n_expired == len(trace)
+
+    def test_fixed_window_final_get_bounded_by_remaining_window(self):
+        """Satellite: the remaining window is computed once per wait and
+        bounds the final blocking get — an empty queue consumes the window
+        in ONE bounded wait, not per-request re-armed sleeps."""
+        clock = SimClock()
+        waits = []
+
+        def try_get(timeout_s):
+            waits.append(timeout_s)
+            if timeout_s > 0:
+                clock.t += timeout_s
+            return None
+
+        b = DynamicBatcher(8, 5.0, mode="fixed", clock=clock)
+        first = SimReq(0, 0.0, None)
+        batch = b.collect(first, try_get)
+        assert batch == [first]
+        blocking = [w for w in waits if w > 0]
+        assert len(blocking) == 1                 # one bounded final get
+        assert blocking[0] == pytest.approx(0.005)
+
+    def test_continuous_idle_grace_bounds_sparse_latency(self):
+        """A lone deadline-carrying request must launch after one idle
+        grace, not sit on its (large) budget."""
+        clock = SimClock()
+        b = DynamicBatcher(32, 2.0, mode="continuous", clock=clock)
+        first = SimReq(0, 0.0, SimDeadline(clock, 20.0))  # 20 s budget
+
+        def try_get(timeout_s):
+            if timeout_s > 0:
+                clock.t += timeout_s
+            return None
+
+        batch = b.collect(first, try_get)
+        assert batch == [first]
+        assert clock.t <= 0.0021                  # idle grace ~= window
+
+    def test_gateway_default_budget_does_not_drive_fill(self):
+        """Budget provenance: a deadline stamped X-Deadline-Source:
+        gateway (the hop-protection default on every forward) must keep
+        the FIXED window — otherwise moderate no-SLO traffic would batch
+        toward a 30 s budget it never declared."""
+        class FlaggedReq(SimReq):  # SimReq is slotted; this gains a dict
+            pass
+
+        clock = SimClock()
+        b = DynamicBatcher(64, 5.0, mode="continuous", clock=clock)
+        first = FlaggedReq(0, 0.0, SimDeadline(clock, 30.0))
+        first.deadline_from_client = True
+        first_gw = FlaggedReq(1, 0.0, SimDeadline(clock, 30.0))
+        first_gw.deadline_from_client = False
+        assert b.fill_budget_s(first, 0.0, 0.0) == pytest.approx(
+            30.0, abs=0.1)
+        assert b.fill_budget_s(first_gw, 0.0, 0.0) == pytest.approx(0.005)
+
+    def test_deadline_source_header_parsed(self):
+        from mmlspark_tpu.io.serving import _PendingRequest
+        p1 = _PendingRequest("a", b"", {"X-Deadline-Ms": "1000"}, "/")
+        assert p1.deadline_from_client
+        p2 = _PendingRequest("b", b"", {"X-Deadline-Ms": "1000",
+                                       "x-deadline-source": "gateway"},
+                             "/")
+        assert not p2.deadline_from_client
+        p3 = _PendingRequest("c", b"", {}, "/")
+        assert not p3.deadline_from_client   # no deadline at all
+
+    def test_dispatch_estimate_ewma(self):
+        b = DynamicBatcher(8, 1.0)
+        b.observe_dispatch(0.010)
+        assert b.dispatch_est_s == pytest.approx(0.010)
+        b.observe_dispatch(0.020)
+        assert 0.010 < b.dispatch_est_s < 0.020
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError):
+            DynamicBatcher(8, 1.0, mode="adaptive")
+        with pytest.raises(ValueError):
+            ServingServer(lambda df: df, batching="adaptive")
+
+
+# --------------------------------------------------- binary path, live HTTP
+
+def _linear_handler(df: DataFrame) -> DataFrame:
+    x = np.asarray(df["features"], np.float32)
+    w = np.arange(x.shape[1], dtype=np.float32) + 1.0
+    return df.with_column("prediction", (x @ w).astype(np.float64))
+
+
+def _post_raw(url, body, headers=None, timeout=10.0):
+    req = urllib.request.Request(url, data=body, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+class TestBinaryServing:
+    def test_binary_round_trips_bit_exact_vs_json(self):
+        """Acceptance: same rows through the JSON path and the binary path
+        produce digest-identical predictions (digest = exact array
+        equality), and the binary reply decodes to the same values."""
+        rng = np.random.default_rng(3)
+        rows = rng.normal(size=(16, 6)).astype(np.float32)
+        srv = ServingServer(_linear_handler, reply_col="prediction",
+                            port=0, max_latency_ms=1.0,
+                            vector_cols=("features",),
+                            registry=MetricsRegistry()).start()
+        try:
+            # per-row: identical batch composition (one row, padded to 1)
+            # through both wire formats must be BIT-exact
+            for r in rows:
+                _, jbody, _ = _post_raw(
+                    srv.url, json.dumps(
+                        {"features": [float(v) for v in r]}).encode())
+                jpred = json.loads(jbody)["prediction"]
+                _, bbody, _ = _post_raw(
+                    srv.url, rowcodec.encode("features",
+                                             r.reshape(1, -1)))
+                name, bpred = rowcodec.decode(bbody)
+                assert name == "prediction"
+                assert bpred.shape == (1,)
+                assert float(bpred[0]) == jpred, \
+                    "binary and JSON paths disagree bit-for-bit"
+            # whole-batch: one binary request carrying all 16 rows must be
+            # bit-exact vs the handler run directly on the same [16, 6]
+            # staging shape (digest = exact equality)
+            _, body, _ = _post_raw(srv.url,
+                                   rowcodec.encode("features", rows))
+            _, bin_preds = rowcodec.decode(body)
+            direct = np.asarray(_linear_handler(
+                DataFrame({"features": rows}))["prediction"])
+            np.testing.assert_array_equal(direct, bin_preds)
+        finally:
+            srv.stop()
+
+    def test_multi_row_request_counts_rows_and_fill(self):
+        reg = MetricsRegistry()
+        srv = ServingServer(_linear_handler, reply_col="prediction",
+                            port=0, max_batch_size=64, max_latency_ms=0.0,
+                            registry=reg).start()
+        try:
+            rows = np.ones((32, 4), np.float32)
+            _post_raw(srv.url, rowcodec.encode("features", rows))
+            lbl = {"instance": srv.metrics_label}
+            snap = reg.snapshot()
+            assert snap["serving_last_batch_size"]["series"][0]["value"] \
+                == 32
+            fill = [s for s in snap["serving_batch_fill_ratio"]["series"]
+                    if s["labels"] == lbl][0]["value"]
+            assert fill == pytest.approx(0.5)
+            hist = snap["serving_batch_rows"]["series"][0]
+            assert hist["count"] == 1
+        finally:
+            srv.stop()
+
+    def test_int_and_bool_reply_columns_coerced(self):
+        """A handler producing int64 labels (np.argmax) or bools must not
+        500 the batch over the binary wire — i8 is carried natively and
+        unsupported dtypes coerce to f8 (review finding, round 12)."""
+        def label_handler(df):
+            x = np.asarray(df["features"], np.float32)
+            return df.with_column("prediction",
+                                  np.argmax(x, axis=1))   # int64
+        srv = ServingServer(label_handler, reply_col="prediction",
+                            port=0, max_latency_ms=0.0,
+                            registry=MetricsRegistry()).start()
+        try:
+            rows = np.eye(4, dtype=np.float32)
+            _, body, _ = _post_raw(srv.url,
+                                   rowcodec.encode("features", rows))
+            _, preds = rowcodec.decode(body)
+            assert preds.dtype == np.dtype("<i8")
+            np.testing.assert_array_equal(preds, np.arange(4))
+        finally:
+            srv.stop()
+        assert rowcodec.decode(rowcodec.encode_reply(
+            "p", np.array([True, False])))[1].tolist() == [1.0, 0.0]
+
+    def test_transport_timeout_not_retried(self):
+        """A read timeout proves nothing about delivery: the keep-alive
+        transport must raise (deadline loop reacts), NOT re-send — a
+        duplicate inference plus double the blocking time."""
+        calls = []
+        release = threading.Event()
+
+        def slow(df):
+            calls.append(len(df))
+            release.wait(3.0)
+            return _linear_handler(df)
+
+        srv = ServingServer(slow, port=0, max_latency_ms=0.0,
+                            registry=MetricsRegistry()).start()
+        try:
+            tr = KeepAliveTransport()
+            body = rowcodec.encode("features", np.ones((1, 3), np.float32))
+            release.set()
+            tr(srv.url, body, {}, 10.0)  # pool a connection
+            release.clear()
+            with pytest.raises(OSError):
+                tr(srv.url, body, {}, 0.4)
+            release.set()
+            time.sleep(0.3)
+            assert len(calls) == 2, "timeout must not re-send the request"
+            tr.close()
+        finally:
+            release.set()
+            srv.stop()
+
+    def test_malformed_binary_answers_400(self):
+        srv = ServingServer(_linear_handler, port=0,
+                            max_latency_ms=0.0,
+                            registry=MetricsRegistry()).start()
+        try:
+            bad = rowcodec.encode("features",
+                                  np.ones((2, 3), np.float32))[:-2]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post_raw(srv.url, bad)
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+
+
+# --------------------------------------------------------- coalesced packs
+
+class TestCoalescedWorker:
+    def test_pack_splits_into_parts_and_repacks_replies(self):
+        srv = ServingServer(_linear_handler, reply_col="prediction",
+                            port=0, max_latency_ms=1.0,
+                            registry=MetricsRegistry()).start()
+        try:
+            parts = [rowcodec.encode(
+                "features", np.full((2, 3), float(i + 1), np.float32))
+                for i in range(3)]
+            tids = [f"tr-part-{i}" for i in range(3)]
+            status, body, hdrs = _post_raw(
+                srv.url, rowcodec.encode_pack(parts, tids),
+                headers={rowcodec.COALESCE_HEADER: "3"})
+            assert status == 200
+            assert hdrs.get(rowcodec.COALESCE_HEADER) == "3"
+            replies = rowcodec.decode_reply_pack(body)
+            assert [s for s, _ in replies] == [200, 200, 200]
+            for i, (_, rb) in enumerate(replies):
+                _, preds = rowcodec.decode(rb)
+                assert np.all(preds == (i + 1) * 6.0)  # (1+2+3)*v per row
+            # trace continuity for coalesced FOLLOWERS: each part's worker
+            # spans key on its own trace id, not the pack lead's
+            for tid in tids:
+                spans = srv.events.spans(tid)
+                assert "device_dispatch" in spans and "reply" in spans, \
+                    (tid, spans)
+        finally:
+            srv.stop()
+
+    def test_pack_that_overflows_queue_sheds_whole(self):
+        release = threading.Event()
+
+        def slow(df):
+            release.wait(5.0)
+            return _linear_handler(df)
+
+        srv = ServingServer(slow, port=0, max_batch_size=1,
+                            max_latency_ms=0.0, max_queue=2,
+                            registry=MetricsRegistry()).start()
+        try:
+            # occupy dispatcher + queue (reply errors at teardown are fine)
+            def _bg():
+                try:
+                    _post_raw(srv.url, rowcodec.encode(
+                        "features", np.ones((1, 3), np.float32)))
+                except Exception:
+                    pass
+
+            t = threading.Thread(target=_bg, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            parts = [rowcodec.encode("features",
+                                     np.ones((1, 3), np.float32))] * 3
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post_raw(srv.url, rowcodec.encode_pack(parts),
+                          headers={rowcodec.COALESCE_HEADER: "3"})
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") == "1"
+        finally:
+            release.set()
+            srv.stop()
+
+
+class TestGatewayCoalescing:
+    def test_concurrent_gateway_requests_share_forwards(self):
+        reg = MetricsRegistry()
+        coord = ServingCoordinator(registry=reg, coalesce_wait_ms=10.0,
+                                   coalesce_parallel=1).start()
+        srv = ServingServer(_linear_handler, reply_col="prediction",
+                            port=0, max_latency_ms=1.0,
+                            registry=reg).start()
+        try:
+            coord.register(ServiceInfo("svc", "127.0.0.1", srv.port,
+                                       "m0", 0))
+            import concurrent.futures as cf
+
+            def call(i):
+                body = rowcodec.encode(
+                    "features", np.full((1, 3), float(i), np.float32))
+                _, rb, _ = _post_raw(coord.url + "/gateway/svc", body,
+                                     timeout=20.0)
+                _, preds = rowcodec.decode(rb)
+                return i, float(preds[0])
+
+            with cf.ThreadPoolExecutor(8) as ex:
+                for i, p in ex.map(call, range(24)):
+                    assert p == i * 6.0, (i, p)
+            assert reg.total("gateway_coalesced_requests_total") > 0
+            assert reg.total("gateway_coalesced_forwards_total") > 0
+            # coalescing actually REDUCED forward hops
+            assert reg.total("gateway_coalesced_forwards_total") < \
+                reg.total("gateway_coalesced_requests_total")
+        finally:
+            srv.stop()
+            coord.stop()
+
+
+# ------------------------------------------------------ routing + transport
+
+class TestLeastLoadedRouting:
+    def test_busy_worker_avoided_until_drained(self):
+        reg = MetricsRegistry()
+        coord = ServingCoordinator(registry=reg)
+        idle = ServiceInfo("svc", "127.0.0.1", 1001, "m0", 0)
+        busy = ServiceInfo("svc", "127.0.0.1", 1002, "m0", 1)
+        coord.register(idle)
+        coord.register(busy)
+        coord.heartbeat(busy, load=50.0)   # deep queue reported via beat
+        coord.heartbeat(idle, load=0.0)
+        picks = []
+        for _ in range(6):
+            w = coord._next_worker("svc")
+            picks.append(w.port)
+            coord._release_worker(w)
+        assert picks == [1001] * 6, "least-loaded must avoid the busy worker"
+        coord.heartbeat(busy, load=0.0)    # drained: rotation resumes
+        picks2 = set()
+        for _ in range(4):
+            w = coord._next_worker("svc")
+            picks2.add(w.port)
+            coord._release_worker(w)
+        assert picks2 == {1001, 1002}
+        assert reg.total("gateway_route_decisions_total") == 10
+
+    def test_inflight_counts_as_load(self):
+        coord = ServingCoordinator(registry=MetricsRegistry())
+        a = ServiceInfo("svc", "127.0.0.1", 2001, "m0", 0)
+        b = ServiceInfo("svc", "127.0.0.1", 2002, "m0", 1)
+        coord.register(a)
+        coord.register(b)
+        w1 = coord._next_worker("svc")     # in-flight on w1 (not released)
+        w2 = coord._next_worker("svc")
+        assert {w1.port, w2.port} == {2001, 2002}, \
+            "second pick must avoid the worker with an in-flight forward"
+
+    def test_round_robin_policy_still_available(self):
+        coord = ServingCoordinator(registry=MetricsRegistry(),
+                                   route_policy="round_robin")
+        for port in (3001, 3002):
+            coord.register(ServiceInfo("svc", "127.0.0.1", port, "m0",
+                                       port))
+        coord.heartbeat(ServiceInfo("svc", "127.0.0.1", 3001, "m0", 3001),
+                        load=99.0)
+        picks = []
+        for _ in range(4):
+            w = coord._next_worker("svc")
+            picks.append(w.port)
+            coord._release_worker(w)
+        assert picks == [3001, 3002, 3001, 3002]  # load ignored by policy
+
+
+class TestKeepAliveTransport:
+    def test_connection_reused_across_forwards(self):
+        srv = ServingServer(_linear_handler, reply_col="prediction",
+                            port=0, max_latency_ms=0.0,
+                            registry=MetricsRegistry()).start()
+        try:
+            tr = KeepAliveTransport()
+            body = rowcodec.encode("features", np.ones((1, 3), np.float32))
+            for _ in range(3):
+                status, rb = tr(srv.url, body,
+                                {"Content-Type": "application/json"}, 10.0)
+                assert status == 200
+            assert tr.fresh == 1
+            assert tr.reused == 2
+            tr.close()
+        finally:
+            srv.stop()
+
+    def test_error_statuses_raise_http_error_with_headers(self):
+        def bad(df):
+            raise RuntimeError("boom")
+
+        srv = ServingServer(bad, port=0, max_latency_ms=0.0,
+                            registry=MetricsRegistry()).start()
+        try:
+            tr = KeepAliveTransport()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                tr(srv.url, b'{"x": 1.0}',
+                   {"Content-Type": "application/json"}, 10.0)
+            assert ei.value.code == 500
+            assert b"boom" in ei.value.read()
+            tr.close()
+        finally:
+            srv.stop()
+
+    def test_stale_pooled_connection_retried_fresh(self):
+        """A worker restart between forwards must look like ONE transparent
+        reconnect, not a forward failure (false eviction)."""
+        srv = ServingServer(_linear_handler, reply_col="prediction",
+                            port=0, max_latency_ms=0.0,
+                            registry=MetricsRegistry()).start()
+        port = srv.port
+        tr = KeepAliveTransport()
+        body = rowcodec.encode("features", np.ones((1, 3), np.float32))
+        try:
+            tr(f"http://127.0.0.1:{port}/", body, {}, 10.0)
+            srv.stop()
+            time.sleep(0.1)
+            srv2 = ServingServer(_linear_handler, reply_col="prediction",
+                                 host="127.0.0.1", port=port,
+                                 max_latency_ms=0.0,
+                                 registry=MetricsRegistry()).start()
+            try:
+                status, _ = tr(f"http://127.0.0.1:{port}/", body, {}, 10.0)
+                assert status == 200
+                assert tr.fresh >= 2      # stale socket fell back to fresh
+            finally:
+                srv2.stop()
+        finally:
+            tr.close()
+
+
+@pytest.mark.slow
+def test_load_harness_mini_run(tmp_path):
+    """End-to-end mini run of the sustained-load harness (baseline +
+    chaos variants, scaled down): zero accepted-request loss, JSON
+    summary shape intact. The full >=100k rows/s x 2 min acceptance run
+    is recorded in docs/SERVING_load.json / docs/SERVING.md."""
+    out = tmp_path / "load.json"
+    env = {**os.environ, "MEASURE_LOAD_S": "4",
+           "MEASURE_LOAD_WORKERS": "2", "MEASURE_LOAD_CLIENTS": "6",
+           "JAX_PLATFORMS": "cpu"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "scripts/measure_serving_load.py",
+         "--out", str(out), "--target-rows-s", "1000"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    variants = {v["variant"]: v for v in rec["variants"]}
+    assert set(variants) == {"baseline", "chaos"}
+    for v in variants.values():
+        assert v["bad_payload_on_200"] == 0, v
+        assert v["ok_requests"] > 0
+    assert variants["chaos"]["injected"]["error"] > 0
+    assert variants["chaos"]["evictions"] > 0
+
+
+class TestHeartbeatLoadReport:
+    def test_worker_heartbeat_carries_queue_depth(self):
+        """DistributedServingServer beats report queue depth; the
+        coordinator stores it as the routing load signal."""
+        from mmlspark_tpu.io.distributed_serving import (
+            DistributedServingServer)
+        reg = MetricsRegistry()
+        coord = ServingCoordinator(registry=reg).start()
+        w = DistributedServingServer(
+            _linear_handler, coord.url, "svc", partition=0, port=0,
+            max_latency_ms=1.0, heartbeat_interval_s=0.05,
+            registry=reg).start()
+        try:
+            deadline = time.time() + 5.0
+            key = ("svc", w.host, w.port)
+            while time.time() < deadline and key not in coord._load:
+                time.sleep(0.05)
+            assert key in coord._load, "no load report arrived via heartbeat"
+        finally:
+            w.stop()
+            coord.stop()
