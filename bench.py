@@ -74,6 +74,23 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
             extra.setdefault("serving_load", _load)
     except Exception as e:  # noqa: BLE001
         extra.setdefault("serving_load_error", str(e)[:200])
+    # model-lifecycle provenance (ISSUE-13): the swap-under-load and
+    # autoscaler-ramp summaries ride the same way (same harness,
+    # --scenario swap/autoscale)
+    for _name, _fn in (("serving_swap", "SERVING_swap.json"),
+                       ("serving_autoscale", "SERVING_autoscale.json")):
+        try:
+            _lp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", _fn)
+            if os.path.exists(_lp):
+                with open(_lp) as _f:
+                    _load = json.load(_f)
+                for _v in _load.get("variants", []):
+                    _v.pop("trace_exemplars", None)
+                    _v.pop("fleet_series", None)
+                extra.setdefault(_name, _load)
+        except Exception as e:  # noqa: BLE001
+            extra.setdefault(_name + "_error", str(e)[:200])
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]

@@ -9,8 +9,11 @@ from .http import (AsyncClient, CustomInputParser, CustomOutputParser,
                    SimpleHTTPTransformer, StringOutputParser,
                    send_with_retries)
 from .rowcodec import BufferPool
+from .registry import (ModelRegistry, RegistryError, RegistryModelSource,
+                       golden_reply_digest, load_aot_callable)
 from .serving import (DynamicBatcher, HTTPStreamSource, ServingServer,
-                      ServingUDFs, make_reply, parse_request)
+                      ServingUDFs, SwapResult, make_reply, parse_request)
+from .autoscale import Autoscaler
 from .shared import (PartitionConsolidator, RateLimiter, SharedSingleton,
                      SharedVariable)
 from .streaming import FileStreamSource, StreamingQuery
@@ -25,7 +28,9 @@ __all__ = [
     "StringOutputParser", "CustomInputParser", "CustomOutputParser",
     "AsyncClient", "send_with_retries", "KeepAliveTransport",
     "ServingServer", "ServingUDFs", "HTTPStreamSource", "parse_request",
-    "make_reply", "DynamicBatcher", "BufferPool",
+    "make_reply", "DynamicBatcher", "BufferPool", "SwapResult",
+    "ModelRegistry", "RegistryError", "RegistryModelSource",
+    "golden_reply_digest", "load_aot_callable", "Autoscaler",
     "SharedSingleton", "SharedVariable", "PartitionConsolidator",
     "RateLimiter",
     "read_binary_files", "read_images", "read_csv", "read_libsvm",

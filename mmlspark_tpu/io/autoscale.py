@@ -1,0 +1,203 @@
+"""Autoscaler actuation: spawn/retire serving workers from heartbeat load.
+
+ROADMAP item 2's last open piece: the PR 12 least-loaded router already
+consumes per-worker queue-depth reports piggybacked on heartbeats; this
+module closes the loop by ACTING on the very same signals — the fleet
+grows when the observed mean queue depth says the workers are saturating
+and shrinks when it says capacity is idle, with nothing new measured
+(`ServingCoordinator.worker_loads` is the one signal source).
+
+Control discipline (the part that keeps chaos from flapping the fleet):
+
+- **smoothing** — the per-beat queue-depth snapshot is spiky (a queue
+  drains in milliseconds between beats); decisions compare an EWMA of
+  the observed mean (`ewma_alpha`) against the watermarks, so only a
+  SUSTAINED deficit or surplus registers;
+- **hysteresis** — a scale decision needs `up_after`/`down_after`
+  CONSECUTIVE breaching observations; a single chaos-induced blip (one
+  slow batch, one killed worker's redistributed queue) resets the streak;
+- **cooldown** — after any action, no further action for `cooldown_s`:
+  a freshly spawned worker needs time to register and absorb load before
+  the controller may judge the new steady state;
+- **bounds** — the observed fleet never leaves [min_workers, max_workers],
+  and scale-down only retires workers THIS autoscaler spawned (the base
+  fleet an operator started is never touched).
+
+Retire = the PR 10 drain discipline applied to serving: the `retire`
+callable must deregister (stop routing) -> drain (every admitted request
+answered) -> stop — `DistributedServingServer.retire()` is exactly that,
+so scale-down loses zero requests (proved by the autoscale scenario of
+scripts/measure_serving_load.py and tests/test_model_lifecycle.py).
+
+Everything is injectable (signals, spawn, retire, clock) so the
+hysteresis/cooldown logic is tested deterministically without sockets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..observability import get_registry
+
+__all__ = ["Autoscaler"]
+
+
+class Autoscaler:
+    """Grow/shrink a serving fleet from worker queue-depth signals.
+
+    `signals()` returns the current per-worker queue depths (one float
+    per ROUTED worker — `ServingCoordinator.worker_loads(service)` values;
+    `for_service` builds it). `spawn()` starts one worker and returns an
+    opaque handle; `retire(handle)` must deregister -> drain -> stop it.
+    `tick()` makes one observation and at most one action; `start()` runs
+    ticks on a daemon thread every `interval_s`.
+    """
+
+    def __init__(self, signals: Callable[[], List[float]],
+                 spawn: Callable[[], Any],
+                 retire: Callable[[Any], None], *,
+                 min_workers: int = 1, max_workers: int = 8,
+                 high_queue_depth: float = 32.0,
+                 low_queue_depth: float = 2.0,
+                 up_after: int = 2, down_after: int = 5,
+                 cooldown_s: float = 10.0, interval_s: float = 0.5,
+                 ewma_alpha: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None, metrics_label: Optional[str] = None):
+        if min_workers < 1 or max_workers < min_workers:
+            raise ValueError(f"need 1 <= min_workers <= max_workers, got "
+                             f"[{min_workers}, {max_workers}]")
+        if low_queue_depth >= high_queue_depth:
+            raise ValueError("low_queue_depth must be < high_queue_depth "
+                             "(the hysteresis band)")
+        self.signals = signals
+        self.spawn = spawn
+        self.retire = retire
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
+        self.high_queue_depth = float(high_queue_depth)
+        self.low_queue_depth = float(low_queue_depth)
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.cooldown_s = float(cooldown_s)
+        self.interval_s = float(interval_s)
+        if not (0.0 < ewma_alpha <= 1.0):
+            raise ValueError("ewma_alpha must be in (0, 1]")
+        self.ewma_alpha = float(ewma_alpha)
+        self.smoothed_depth: Optional[float] = None
+        self.clock = clock
+        #: handles of workers THIS autoscaler spawned (LIFO retire order —
+        #: the newest worker has the least affinity to shed)
+        self.handles: List[Any] = []
+        self.actions: List[Dict[str, Any]] = []   # decision audit trail
+        self._hot = 0
+        self._cold = 0
+        self._last_action_at: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = registry if registry is not None else get_registry()
+        lbl = {"instance": metrics_label or "autoscaler"}
+        self._m_actions = {
+            a: reg.counter("autoscaler_actions_total",
+                           "scale actions taken", {**lbl, "action": a})
+            for a in ("scale_up", "scale_down")}
+        self._g_workers = reg.gauge(
+            "autoscaler_workers", "workers observed at the last tick", lbl)
+        self._g_depth = reg.gauge(
+            "autoscaler_mean_queue_depth",
+            "mean per-worker queue depth at the last tick", lbl)
+
+    # ------------------------------------------------------------- decisions
+    def tick(self) -> Optional[str]:
+        """One observation, at most one action. Returns "scale_up",
+        "scale_down", or None."""
+        depths = list(self.signals())
+        n = len(depths)
+        raw = (sum(depths) / n) if n else 0.0
+        if self.smoothed_depth is None:
+            self.smoothed_depth = raw
+        else:
+            self.smoothed_depth += self.ewma_alpha * (raw
+                                                      - self.smoothed_depth)
+        mean = self.smoothed_depth
+        self._g_workers.set(float(n))
+        self._g_depth.set(mean)
+        # hysteresis streaks: any observation inside the band resets both
+        if mean > self.high_queue_depth and n < self.max_workers:
+            self._hot += 1
+            self._cold = 0
+        elif mean < self.low_queue_depth and n > self.min_workers \
+                and self.handles:
+            self._cold += 1
+            self._hot = 0
+        else:
+            self._hot = 0
+            self._cold = 0
+        now = self.clock()
+        if self._last_action_at is not None \
+                and now - self._last_action_at < self.cooldown_s:
+            return None
+        if self._hot >= self.up_after:
+            self.handles.append(self.spawn())
+            self._after_action("scale_up", now, n, mean)
+            return "scale_up"
+        if self._cold >= self.down_after:
+            # pop only AFTER retire() returns: a retire that raises (HTTP
+            # deregister down, process join failed) must leave the worker
+            # tracked so stop(retire_spawned=True) / the next cold streak
+            # can still reach it
+            handle = self.handles[-1]
+            self.retire(handle)
+            self.handles.pop()
+            self._after_action("scale_down", now, n, mean)
+            return "scale_down"
+        return None
+
+    def _after_action(self, action: str, now: float, n: int,
+                      mean: float) -> None:
+        self._hot = 0
+        self._cold = 0
+        self._last_action_at = now
+        self._m_actions[action].inc()
+        self.actions.append({"t": now, "action": action,
+                             "workers_before": n,
+                             "mean_queue_depth": round(mean, 2)})
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - one bad scrape must not
+                pass           # kill the control loop
+
+    def stop(self, retire_spawned: bool = False) -> None:
+        """Stop ticking; optionally retire every worker this autoscaler
+        spawned (clean shutdown of the dynamic pool)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval_s * 4 + 1.0)
+        if retire_spawned:
+            while self.handles:
+                self.retire(self.handles.pop())
+
+    # ------------------------------------------------------------ conveniences
+    @classmethod
+    def for_service(cls, coordinator, service: str,
+                    spawn: Callable[[], Any],
+                    retire: Callable[[Any], None], **kw) -> "Autoscaler":
+        """Signals wired to `coordinator.worker_loads(service)` — the same
+        heartbeat-piggybacked queue depths the least-loaded router scores
+        on; nothing new is measured."""
+        def signals() -> List[float]:
+            return [v["queue_depth"]
+                    for v in coordinator.worker_loads(service).values()]
+        return cls(signals, spawn, retire, **kw)

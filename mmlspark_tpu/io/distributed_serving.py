@@ -45,6 +45,23 @@ service COALESCE: handler threads cooperatively lead, each packing up to
 (io/rowcodec.py packs); the worker splits them into per-part batcher
 entries and the reply pack fans back out. Every routing decision is
 counted (`gateway_route_decisions_total{decision}`).
+
+Round 13 (model lifecycle): the heartbeat becomes the rollout control
+channel. Each beat piggybacks the worker's model_version, last swap
+outcome, error/request totals, and p99 beside the PR 12 load report, and
+the coordinator's reply carries that worker's `target_version`; a worker
+with a `RegistryModelSource` (io/registry.py) hot-swaps toward its target
+on its own swap thread. `start_rollout` drives the HEALTH-GATED state
+machine: canary (one worker swaps first; its post-swap error-rate delta
+and p99 are judged against its pre-rollout baseline over `canary_beats`
+beats) -> promoting (every routed worker targets the version) -> done;
+any swap failure, health breach, canary eviction, or timeout rolls the
+whole fleet back to the previous version — an automatic, counted
+transition, never an operator page. State is visible in `/health`
+(`rollouts`, `worker_models`) and as `gateway_rollout_state{service}` /
+`gateway_rollout_transitions_total{state}`. `retire()` is the scale-down
+path: stand down the heartbeat, deregister, drain, stop (io/autoscale.py
+actuates it from the same heartbeat load signals the router consumes).
 """
 
 from __future__ import annotations
@@ -65,7 +82,11 @@ from ..observability import (EventLog, TRACE_HEADER, get_registry,
 from ..resilience import Deadline, RetryError, RetryPolicy
 from . import rowcodec
 from .http import KeepAliveTransport
-from .serving import _INSTANCE_SEQ, ServingServer
+from .serving import _INSTANCE_SEQ, ServingServer, SwapResult
+
+#: rollout state machine vocabulary; the index is the
+#: `gateway_rollout_state{service}` gauge value
+ROLLOUT_STATES = ("idle", "canary", "promoting", "done", "rolled_back")
 
 
 class ServiceInfo:
@@ -215,7 +236,13 @@ class ServingCoordinator:
                  metrics_label: Optional[str] = None,
                  route_policy: str = "least_loaded",
                  coalesce_max: int = 8, coalesce_wait_ms: float = 0.0,
-                 coalesce_parallel: int = 4):
+                 coalesce_parallel: int = 4,
+                 canary_beats: int = 3,
+                 rollout_timeout_s: float = 60.0,
+                 canary_max_error_rate: float = 0.05,
+                 canary_min_requests: int = 20,
+                 canary_max_p99_factor: float = 3.0,
+                 canary_p99_floor_ms: float = 5.0):
         self.host, self.port = host, port
         self.forward_timeout = forward_timeout
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -249,6 +276,19 @@ class ServingCoordinator:
         # keeps the pre-resilience contract (evicted only by gateway
         # failure detection)
         self._hb_seen: set = set()
+        # rollout control (round 13): latest heartbeat-piggybacked report
+        # per worker (model_version, swap outcome, error/request totals,
+        # p99) and the per-service rollout record the state machine runs on
+        self.canary_beats = int(canary_beats)
+        self.rollout_timeout_s = float(rollout_timeout_s)
+        self.canary_max_error_rate = float(canary_max_error_rate)
+        self.canary_min_requests = int(canary_min_requests)
+        self.canary_max_p99_factor = float(canary_max_p99_factor)
+        self.canary_p99_floor_ms = float(canary_p99_floor_ms)
+        self._reports: Dict[Tuple[str, str, int], Dict] = {}
+        self._rollouts: Dict[str, Dict] = {}
+        self._rollout_gauges: Dict[str, object] = {}
+        self._rollout_counters: Dict[Tuple[str, str], object] = {}
         self._lock = threading.Lock()
         self._stopev = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -379,10 +419,12 @@ class ServingCoordinator:
             self._last_seen.pop((name, info.host, info.port), None)
             self._load.pop((name, info.host, info.port), None)
             self._rates.pop((name, info.host, info.port), None)
+            self._reports.pop((name, info.host, info.port), None)
             self._hb_seen.discard((name, info.host, info.port))
 
     def heartbeat(self, info: ServiceInfo, load: Optional[float] = None,
-                  rate: Optional[float] = None) -> str:
+                  rate: Optional[float] = None,
+                  report: Optional[Dict] = None) -> str:
         """Record a worker heartbeat. Returns:
         "ok"         — worker is routable, beat recorded;
         "gone"       — worker is not in the table and its (machine,
@@ -417,11 +459,267 @@ class ServingCoordinator:
                         self._rates[key] = float(rate)
                     except (TypeError, ValueError):
                         pass
+                if report is not None:
+                    # the rollout control channel: model_version / swap
+                    # outcome / error totals / p99 ride the same beat,
+                    # and every beat advances the rollout state machine
+                    self._reports[key] = dict(report)
+                    self._observe_rollout_locked(info, report)
                 return "ok"
             if any((s.machine, s.partition) == (info.machine, info.partition)
                    for s in lst):
                 return "superseded"
             return "gone"
+
+    # -------------------------------------------------------------- rollout
+    def _rollout_gauge(self, name: str):
+        g = self._rollout_gauges.get(name)
+        if g is None:
+            g = self.registry.gauge(
+                "gateway_rollout_state",
+                "rollout state machine position "
+                "(0 idle, 1 canary, 2 promoting, 3 done, 4 rolled_back)",
+                {**self._route_lbl, "service": name})
+            self._rollout_gauges[name] = g
+        return g
+
+    def _rollout_transition(self, name: str, state: str):
+        c = self._rollout_counters.get((name, state))
+        if c is None:
+            c = self.registry.counter(
+                "gateway_rollout_transitions_total",
+                "rollout state transitions by destination state",
+                {**self._route_lbl, "service": name, "state": state})
+            self._rollout_counters[(name, state)] = c
+        return c
+
+    def _set_rollout_state_locked(self, name: str, ro: Dict, state: str,
+                                  reason: Optional[str]) -> None:
+        ro["state"] = state
+        ro["reason"] = reason
+        self._rollout_gauge(name).set(float(ROLLOUT_STATES.index(state)))
+        self._rollout_transition(name, state).inc()
+        self.events.append("rollout", mint_trace_id(), service=name,
+                           state=state, target=ro["target"],
+                           reason=reason)
+
+    def start_rollout(self, name: str, version: int,
+                      previous: Optional[int] = None,
+                      canary: Optional[Tuple[str, int]] = None) -> Dict:
+        """Begin a health-gated rollout of `version` for one service.
+
+        One worker — the explicit `canary` (host, port) or the first in
+        stable (machine, partition) order — is targeted first; its
+        post-swap error-rate delta and p99, judged against the baseline
+        captured HERE from its last heartbeat report, must stay clean for
+        `canary_beats` beats before the target goes fleet-wide. Any swap
+        failure, health breach, canary eviction, or `rollout_timeout_s`
+        expiry rolls every worker back to `previous` (defaulted from the
+        canary's reported model_version). Returns the rollout record."""
+        with self._lock:
+            lst = list(self._routes.get(name, []))
+            if not lst:
+                raise ValueError(f"no workers registered for {name!r}")
+            active = self._rollouts.get(name)
+            if active and active["state"] in ("canary", "promoting"):
+                raise ValueError(
+                    f"rollout already active for {name!r} "
+                    f"(state {active['state']})")
+            cw = None
+            if canary is not None:
+                host, port = canary[0], int(canary[1])
+                for s in lst:
+                    if (s.host, s.port) == (host, port):
+                        cw = s
+                        break
+                if cw is None:
+                    raise ValueError(
+                        f"canary {host}:{port} not in routing table")
+            else:
+                cw = sorted(lst,
+                            key=lambda s: (s.machine, s.partition))[0]
+            if previous is None:
+                # default rollback target: the canary's reported version,
+                # else ANY worker's (a rollout started before the first
+                # beat landed must still know where "back" is)
+                rep = self._reports.get((name, cw.host, cw.port)) or {}
+                previous = rep.get("model_version")
+                if previous is None:
+                    for s in lst:
+                        rep = self._reports.get((name, s.host, s.port)) or {}
+                        if rep.get("model_version") is not None:
+                            previous = rep.get("model_version")
+                            break
+            baseline = {}
+            for s in lst:
+                rep = self._reports.get((name, s.host, s.port)) or {}
+                baseline[f"{s.host}:{s.port}"] = {
+                    "errors": int(rep.get("errors_total") or 0),
+                    "requests": int(rep.get("requests_total") or 0),
+                    "p99_ms": rep.get("p99_ms")}
+            ro = {"service": name, "target": int(version),
+                  "previous": previous,
+                  "state": "idle", "reason": None,
+                  "canary": [cw.host, cw.port],
+                  "started_s": time.monotonic(),
+                  "canary_ok_beats": 0,
+                  "baseline": baseline}
+            self._rollouts[name] = ro
+            self._set_rollout_state_locked(name, ro, "canary", None)
+            return dict(ro)
+
+    def _target_for_locked(self, name: str, host: str,
+                           port: int) -> Optional[int]:
+        """The version this worker should run, per the rollout state (None
+        = no opinion, worker keeps what it has). Canary phase targets only
+        the canary — every other worker is pinned to `previous`, which is
+        also what makes rollback an ordinary re-target."""
+        ro = self._rollouts.get(name)
+        if ro is None:
+            return None
+        state = ro["state"]
+        if state == "canary":
+            if [host, port] == ro["canary"]:
+                return ro["target"]
+            return ro["previous"]
+        if state in ("promoting", "done"):
+            return ro["target"]
+        if state == "rolled_back":
+            return ro["previous"]
+        return None
+
+    def heartbeat_target(self, info: ServiceInfo) -> Optional[int]:
+        """The `target_version` the heartbeat reply carries for this
+        worker (the rollout actuation channel)."""
+        with self._lock:
+            return self._target_for_locked(info.name, info.host, info.port)
+
+    def _report_breach_locked(self, ro: Dict, key_str: str,
+                              rep: Dict) -> Optional[str]:
+        """Health gate for a worker ALREADY reporting the target version.
+
+        Error-rate deltas are judged against the worker's POST-SWAP
+        baseline — captured from its first target-version beat — so
+        traffic it served on the old version (long for late-promoting
+        workers) is never misattributed to the new one; a pre-swap error
+        blip cannot roll the fleet back, and a bad new version's errors
+        are not diluted by the pre-swap window. p99 compares against the
+        PRE-ROLLOUT baseline * factor (it is a distribution snapshot,
+        not a cumulative counter), floored so sub-ms noise can't trip
+        the ratio. Requires `canary_min_requests` post-swap requests
+        before judging — a 1-error-in-2-requests blip must not roll a
+        fleet."""
+        swap_base = ro.setdefault("swap_base", {})
+        base = swap_base.get(key_str)
+        if base is None:
+            # first beat on the target version: this IS the post-swap
+            # origin; nothing to judge yet
+            swap_base[key_str] = {
+                "errors": int(rep.get("errors_total") or 0),
+                "requests": int(rep.get("requests_total") or 0)}
+            base = None
+        else:
+            err_d = int(rep.get("errors_total") or 0) - base["errors"]
+            req_d = int(rep.get("requests_total") or 0) - base["requests"]
+            if req_d >= self.canary_min_requests \
+                    and err_d / req_d > self.canary_max_error_rate:
+                return f"error_rate {err_d}/{req_d}"
+        b99 = (ro["baseline"].get(key_str) or {}).get("p99_ms")
+        p99 = rep.get("p99_ms")
+        if p99 and b99 and p99 > max(b99 * self.canary_max_p99_factor,
+                                     self.canary_p99_floor_ms):
+            return f"p99 {p99}ms vs baseline {b99}ms"
+        return None
+
+    def _observe_rollout_locked(self, info: ServiceInfo,
+                                rep: Dict) -> None:
+        """Advance the rollout state machine on one heartbeat report
+        (called under self._lock from `heartbeat`)."""
+        name = info.name
+        ro = self._rollouts.get(name)
+        if ro is None or ro["state"] not in ("canary", "promoting"):
+            return
+        target = ro["target"]
+        key_str = f"{info.host}:{info.port}"
+        # a swap attempt at the target that failed ANYWHERE = rollback
+        # ("rejected" means a concurrent swap was in flight — retried on a
+        # later beat, not a failure)
+        if rep.get("swap_version") == target and \
+                rep.get("swap_outcome") not in (None, "success", "rejected"):
+            self._set_rollout_state_locked(
+                name, ro, "rolled_back",
+                f"{key_str}: swap {rep['swap_outcome']}")
+            return
+        mv = rep.get("model_version")
+        if mv == target:
+            breach = self._report_breach_locked(ro, key_str, rep)
+            if breach:
+                self._set_rollout_state_locked(name, ro, "rolled_back",
+                                               f"{key_str}: {breach}")
+                return
+        if ro["state"] == "canary":
+            if [info.host, info.port] == ro["canary"] and mv == target:
+                ro["canary_ok_beats"] += 1
+                if ro["canary_ok_beats"] >= self.canary_beats:
+                    self._set_rollout_state_locked(name, ro, "promoting",
+                                                   None)
+        if ro["state"] == "promoting":
+            lst = self._routes.get(name, [])
+            if lst and all(
+                    (self._reports.get((name, s.host, s.port)) or {}
+                     ).get("model_version") == target for s in lst):
+                self._set_rollout_state_locked(name, ro, "done", None)
+
+    def rollout_tick(self) -> None:
+        """Clock-driven rollout checks the beat-driven observer cannot
+        make: overall timeout, and canary loss (killed mid-swap and
+        evicted by the heartbeat monitor). Runs on the monitor loop's
+        cadence; tests call it directly."""
+        now = time.monotonic()
+        with self._lock:
+            for name, ro in self._rollouts.items():
+                if ro["state"] not in ("canary", "promoting"):
+                    continue
+                if now - ro["started_s"] > self.rollout_timeout_s:
+                    self._set_rollout_state_locked(
+                        name, ro, "rolled_back",
+                        f"timeout after {self.rollout_timeout_s:.0f}s")
+                    continue
+                if ro["state"] == "canary":
+                    ch, cp = ro["canary"]
+                    if not any((s.host, s.port) == (ch, cp)
+                               for s in self._routes.get(name, [])):
+                        # hysteresis: a chaos-blip eviction heals on the
+                        # next beat (410 -> re-register); only a canary
+                        # missing for 3 consecutive ticks — actually dead
+                        # (e.g. killed mid-swap) — rolls the fleet back
+                        ro["canary_lost_ticks"] = \
+                            ro.get("canary_lost_ticks", 0) + 1
+                        if ro["canary_lost_ticks"] >= 3:
+                            self._set_rollout_state_locked(
+                                name, ro, "rolled_back",
+                                f"canary {ch}:{cp} lost (evicted)")
+                    else:
+                        ro["canary_lost_ticks"] = 0
+
+    def rollout_status(self, name: str) -> Optional[Dict]:
+        with self._lock:
+            ro = self._rollouts.get(name)
+            return dict(ro) if ro else None
+
+    def worker_loads(self, name: str) -> Dict[str, Dict[str, float]]:
+        """Per-ROUTED-worker load signals for one service (queue depth +
+        rows/s from the latest beat; a worker yet to report counts as 0).
+        The autoscaler's signal set — the same numbers the least-loaded
+        router scores on (io/autoscale.py)."""
+        with self._lock:
+            out = {}
+            for s in self._routes.get(name, []):
+                key = (name, s.host, s.port)
+                out[f"{s.host}:{s.port}"] = {
+                    "queue_depth": float(self._load.get(key, 0.0)),
+                    "rows_per_s": float(self._rates.get(key, 0.0))}
+            return out
 
     def _next_worker(self, name: str) -> Optional[ServiceInfo]:
         """Worker selection. Policy "least_loaded" (default) scores each
@@ -490,8 +788,12 @@ class ServingCoordinator:
                                                 None)
                             self._load.pop((name, s.host, s.port), None)
                             self._rates.pop((name, s.host, s.port), None)
+                            self._reports.pop((name, s.host, s.port), None)
                             self._hb_seen.discard((name, s.host, s.port))
                             self._m["evictions"].inc()
+            # clock-driven rollout checks (timeout, canary eviction) ride
+            # the same monitor cadence
+            self.rollout_tick()
 
     def health(self) -> Dict:
         with self._lock:
@@ -500,10 +802,20 @@ class ServingCoordinator:
                                       "rows_per_s": self._rates.get(
                                           (n, h, p), 0.0)}
                      for (n, h, p), v in self._load.items()}
+            rollouts = {name: {k: v for k, v in ro.items()
+                               if k not in ("baseline", "swap_base")}
+                        for name, ro in self._rollouts.items()}
+            models = {f"{n}:{h}:{p}": {
+                          "model_version": rep.get("model_version"),
+                          "swap_state": rep.get("swap_state"),
+                          "swap_outcome": rep.get("swap_outcome")}
+                      for (n, h, p), rep in self._reports.items()}
         return {"services": services,
                 "heartbeat_timeout_s": self.heartbeat_timeout_s,
                 "route_policy": self.route_policy,
                 "worker_loads": loads,
+                "rollouts": rollouts,
+                "worker_models": models,
                 "stats": dict(self.stats)}
 
     # -------------------------------------------------------------- gateway
@@ -758,21 +1070,55 @@ class ServingCoordinator:
                 elif self.path == "/heartbeat":
                     try:
                         d = json.loads(body.decode())
-                        state = outer.heartbeat(ServiceInfo.from_dict(d),
+                        info = ServiceInfo.from_dict(d)
+                        state = outer.heartbeat(info,
                                                 load=d.get("queue_depth"),
-                                                rate=d.get("rows_per_s"))
+                                                rate=d.get("rows_per_s"),
+                                                report=d)
                     except (ValueError, KeyError) as e:
                         self._reply(400, json.dumps(
                             {"error": str(e)}).encode())
                         return
                     if state == "ok":
-                        self._reply(200, b'{"ok": true}')
+                        # the rollout actuation channel: the beat's reply
+                        # tells the worker which version it should run
+                        self._reply(200, json.dumps(
+                            {"ok": True,
+                             "target_version":
+                                 outer.heartbeat_target(info)}).encode())
                     elif state == "superseded":
                         self._reply(409, b'{"error": "identity taken by a '
                                          b'newer registration; stand down"}')
                     else:
                         self._reply(410, b'{"error": "unknown worker; '
                                          b're-register"}')
+                elif self.path == "/deregister":
+                    # the retire discipline's first step: stop routing to
+                    # a worker that is about to drain (autoscaler
+                    # scale-down); in-flight forwards still complete
+                    try:
+                        info = ServiceInfo.from_dict(json.loads(
+                            body.decode()))
+                        outer.deregister(info.name, info)
+                        self._reply(200, b'{"ok": true}')
+                    except (ValueError, KeyError) as e:
+                        self._reply(400, json.dumps(
+                            {"error": str(e)}).encode())
+                elif self.path.startswith("/rollout/"):
+                    name = self.path[len("/rollout/"):].strip("/")
+                    try:
+                        d = json.loads(body.decode()) if body else {}
+                        ro = outer.start_rollout(
+                            name, int(d["version"]),
+                            previous=d.get("previous"),
+                            canary=(tuple(d["canary"])
+                                    if d.get("canary") else None))
+                        self._reply(200, json.dumps(
+                            {k: v for k, v in ro.items()
+                             if k != "baseline"}).encode())
+                    except (ValueError, KeyError, TypeError) as e:
+                        self._reply(400, json.dumps(
+                            {"error": str(e)}).encode())
                 elif self.path.startswith("/gateway/"):
                     name = self.path[len("/gateway/"):].strip("/")
                     outer._handle_gateway(self._reply, name, body,
@@ -874,12 +1220,26 @@ class DistributedServingServer(ServingServer):
     coordinator on start (WorkerServer + ServiceInfo POST,
     HTTPSourceV2.scala:318-430) and HEARTBEATS for liveness — a worker the
     coordinator evicted (crash suspected, chaos-injected forward failure)
-    re-registers itself on the next beat if it is actually alive."""
+    re-registers itself on the next beat if it is actually alive.
+
+    With a `model_source` (io/registry.RegistryModelSource) the worker is
+    REGISTRY-BACKED: `handler=None` loads the registry's CURRENT version
+    at construction, every beat reports the installed model_version +
+    last swap outcome, and a `target_version` in the beat's reply triggers
+    a hot swap toward it on the swap thread (the coordinator's rollout
+    actuation). `retire()` leaves the fleet without dropping a request."""
 
     def __init__(self, handler, coordinator_url: str, service_name: str,
                  partition: Optional[int] = None,
                  machine: Optional[str] = None,
-                 heartbeat_interval_s: float = 1.0, **kw):
+                 heartbeat_interval_s: float = 1.0,
+                 model_source=None, **kw):
+        self.model_source = model_source
+        if handler is None:
+            if model_source is None:
+                raise ValueError("handler=None requires a model_source")
+            handler, version = model_source.load_current()
+            kw.setdefault("model_version", version)
         super().__init__(handler, **kw)
         self.coordinator_url = coordinator_url
         self.service_name = service_name
@@ -888,6 +1248,11 @@ class DistributedServingServer(ServingServer):
         self.heartbeat_interval_s = heartbeat_interval_s
         self._info: Optional[ServiceInfo] = None
         self._hb_stop = threading.Event()
+        #: last target this worker LAUNCHED a swap for: a failed target is
+        #: attempted once — the coordinator sees the failure report and
+        #: re-targets (rollback); only a CHANGED target re-triggers
+        self._attempted_target: Optional[int] = None
+        self._swap_res: Optional[SwapResult] = None
 
     def start(self) -> "DistributedServingServer":
         super().start()
@@ -903,22 +1268,111 @@ class DistributedServingServer(ServingServer):
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         return self
 
+    def _heartbeat_report(self) -> Dict:
+        """One beat's payload: the PR 12 load report plus the rollout
+        control fields (installed version, swap outcome, error/request
+        totals, p99) the coordinator's health gate judges on."""
+        d = self._info.to_dict()
+        d["queue_depth"] = self._queue.qsize()
+        d["rows_per_s"] = self._rows_gauge.value
+        d["model_version"] = self.model_version
+        d["swap_state"] = self.swap_state
+        last = self.last_swap or {}
+        d["swap_version"] = last.get("version")
+        d["swap_outcome"] = last.get("outcome")
+        d["requests_total"] = int(self._m["requests"].value)
+        d["errors_total"] = int(self._m["errors"].value)
+        try:
+            p99 = self.registry.quantile(
+                "serving_request_latency_seconds", 0.99,
+                {"instance": self.metrics_label})
+        except Exception:  # noqa: BLE001 - telemetry never breaks the beat
+            p99 = None
+        d["p99_ms"] = round(p99 * 1e3, 3) if p99 else None
+        return d
+
+    def _maybe_swap(self, target) -> None:
+        """Act on the beat reply's target_version: launch at most one swap
+        per DISTINCT target (a failed attempt is reported back and the
+        coordinator re-targets; a 'rejected' attempt — another swap was in
+        flight — re-arms so a later beat retries)."""
+        if target is None or self.model_source is None:
+            return
+        target = int(target)
+        if self._swap_res is not None and self._swap_res.done.is_set() \
+                and self._swap_res.outcome == "rejected" \
+                and self._attempted_target == target:
+            self._attempted_target = None
+        if target == self.model_version or target == self._attempted_target:
+            return
+        if self.swap_state != "idle":
+            return  # a swap is in flight; re-check on the next beat
+        self._attempted_target = target
+        self.request_swap(target)
+
+    def request_swap(self, version: int) -> SwapResult:
+        """Resolve `version` through the model source and launch the hot
+        swap. A source that cannot even DESCRIBE the version (manifest
+        missing/unreadable) resolves immediately as a counted
+        rollback_load — the same funnel as a load failure."""
+        try:
+            load_fn, golden, expected = self.model_source.describe(version)
+        except Exception as e:  # noqa: BLE001 - counted rollback
+            self._swap_counter("rollback_load").inc()
+            res = SwapResult(version)
+            with self._swap_lock:
+                self.last_swap = {"version": version,
+                                  "outcome": "rollback_load",
+                                  "error": f"{type(e).__name__}: {e}"}
+            res._resolve("rollback_load", e)
+            self._swap_res = res
+            return res
+        res = self.hot_swap(load_fn, version, golden_body=golden,
+                            expected_reply_sha256=expected)
+        self._swap_res = res
+        return res
+
+    def retire(self, drain_timeout_s: float = 30.0) -> bool:
+        """Leave the fleet without dropping a request (the autoscaler's
+        scale-down path): stand the heartbeat down FIRST (so the
+        410-heal cannot re-register a retiring worker), DEREGISTER (no
+        new routes; in-flight forwards still complete on the live
+        sockets), DRAIN every admitted request, then stop — the PR 10
+        deregister -> drain -> stop discipline applied to serving."""
+        self._hb_stop.set()
+        try:
+            req = urllib.request.Request(
+                self.coordinator_url.rstrip("/") + "/deregister",
+                data=json.dumps(self._info.to_dict()).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+        except Exception:  # noqa: BLE001 - coordinator gone: the
+            pass           # heartbeat-timeout monitor evicts us anyway
+        ok = self.drain(drain_timeout_s)
+        self.stop()
+        return ok
+
     def _heartbeat_loop(self) -> None:
         url = self.coordinator_url.rstrip("/") + "/heartbeat"
-        while not self._hb_stop.wait(self.heartbeat_interval_s):
+        wait_s = self.heartbeat_interval_s
+        while not self._hb_stop.wait(wait_s):
+            wait_s = self.heartbeat_interval_s
             # each beat piggybacks a load report: queue depth (the
             # least-loaded router's score input) + last-batch throughput —
-            # the "autoscaling hooks" gauges used as control inputs
-            d = self._info.to_dict()
-            d["queue_depth"] = self._queue.qsize()
-            d["rows_per_s"] = self._rows_gauge.value
-            body = json.dumps(d).encode()
+            # the "autoscaling hooks" gauges used as control inputs — plus
+            # the round-13 rollout fields (_heartbeat_report)
+            body = json.dumps(self._heartbeat_report()).encode()
             try:
                 req = urllib.request.Request(
                     url, data=body,
                     headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=5.0):
-                    pass
+                with urllib.request.urlopen(req, timeout=5.0) as r:
+                    try:
+                        rep = json.loads(r.read() or b"{}")
+                    except ValueError:
+                        rep = {}
+                self._maybe_swap(rep.get("target_version"))
             except urllib.error.HTTPError as e:
                 # 409 (identity superseded by a newer registration) is a
                 # deliberate stand-down: keep beating WITHOUT re-registering,
@@ -933,6 +1387,12 @@ class DistributedServingServer(ServingServer):
                             self.coordinator_url, self._info, retries=3,
                             delay_s=max(0.05,
                                         self.heartbeat_interval_s / 4.0))
+                        # beat again NOW: under eviction churn (chaos
+                        # forward faults) the healed registration must
+                        # deliver its report and receive its rollout
+                        # target before the next fault can evict it —
+                        # waiting a full interval loses that race
+                        wait_s = 0.01
                     except ConnectionError:
                         pass  # next beat tries again
             except Exception:  # noqa: BLE001 - coordinator briefly

@@ -1,0 +1,357 @@
+"""Versioned model registry: the durable side of zero-downtime serving.
+
+Reference: the ModelDownloader / Spark Serving lifecycle (SURVEY §0) —
+models are published as immutable, integrity-checked artifacts and
+serving processes move between them without restarting. Rebuilt here on
+the repo's own substrate:
+
+- every byte is written through the PR 10 atomic-write helper
+  (``resilience.elastic.atomic_write_bytes``): a preempted publish can
+  never leave a torn version;
+- each version is a numbered payload directory (``v_NNNNNNNN/``) plus a
+  JSON manifest (``version_NNNNNNNN.json``) carrying a sha256 digest per
+  payload file — the manifest commits the version (same
+  manifest-commits-the-snapshot ordering as ``CheckpointStore``), and
+  ``resolve()`` verifies every digest before a worker may load it;
+- ``CURRENT``/``CANARY`` pointer files pin versions for rollout: retention
+  (keep-last-K) never evicts a pinned version;
+- a version may carry a **golden probe**: one binary rowcodec request body
+  plus the sha256 of the reply the model must produce for it. The hot-swap
+  warm step (io/serving.py ``hot_swap``) replays the golden row through
+  the freshly loaded handler and rolls back on digest mismatch — a wrong
+  or stale artifact can never take over a worker.
+
+Loading is the caller's ``loader(version_dir, manifest) -> handler``;
+AOT-backed versions route through ``load_aot_callable`` below, which
+reuses the compiled -> exported -> fresh-JIT resolver from
+``compile/aot.py`` verbatim (the version directory IS an ``AOTStore``).
+
+Every verification failure is a counted, logged event
+(``model_registry_verify_failures_total{reason}``) — never a crash on the
+serving path; the swap layer converts it into a counted rollback.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import os
+import re
+import shutil
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..resilience.elastic import atomic_write_bytes, atomic_write_text
+from ..core.dataframe import DataFrame
+from . import rowcodec
+
+__all__ = [
+    "REGISTRY_SCHEMA_VERSION", "RegistryError", "ModelRegistry",
+    "RegistryModelSource", "golden_reply_digest", "load_aot_callable",
+]
+
+log = logging.getLogger(__name__)
+
+REGISTRY_SCHEMA_VERSION = 1
+
+_VERSION_RE = re.compile(r"^version_(\d{8})\.json$")
+CURRENT_POINTER = "CURRENT.json"
+CANARY_POINTER = "CANARY.json"
+
+
+class RegistryError(RuntimeError):
+    """A version could not be verified/resolved (missing, digest mismatch,
+    schema skew). The hot-swap layer treats this as a counted rollback —
+    it must never crash a serving worker."""
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _count_verify_failure(reason: str, version: Any) -> None:
+    log.warning("model registry version %s unusable (%s)", version, reason)
+    try:
+        from ..observability import get_registry
+        get_registry().counter(
+            "model_registry_verify_failures_total",
+            "registry version loads that failed verification, by reason",
+            {"reason": reason}).inc()
+    except Exception:  # noqa: BLE001 - telemetry never fails resolution
+        pass
+
+
+def golden_reply_digest(handler: Callable[[DataFrame], DataFrame],
+                        golden_body: bytes,
+                        reply_col: str = "prediction") -> str:
+    """Run one binary rowcodec golden request through ``handler`` and
+    digest the reply bytes — computed once at publish time (the expected
+    digest stored in the manifest) and again by the swap warm probe (the
+    first-batch digest gate). Byte-identical replies <=> equal digests."""
+    name, arr = rowcodec.decode(golden_body)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    scored = handler(DataFrame({name: np.ascontiguousarray(arr)}))
+    return _sha256(rowcodec.encode_reply(reply_col, scored[reply_col]))
+
+
+class ModelRegistry:
+    """A directory of numbered, digest-verified model versions.
+
+    Layout::
+
+        <dir>/v_00000001/...payload files...   (weights, AOT artifacts)
+        <dir>/version_00000001.json            (manifest — commits the version)
+        <dir>/CURRENT.json                     ({"version": N} pointer)
+        <dir>/CANARY.json                      (optional canary pointer)
+
+    Manifest schema::
+
+        {"schema_version": 1, "version": 1,
+         "files": {"<relpath>": {"sha256": "...", "bytes": 123}, ...},
+         "golden": {"body_b64": "...", "reply_sha256": "...",
+                    "reply_col": "prediction"} | null,
+         "extra": {...publisher metadata...}}
+
+    Retention: ``keep_last`` most recent versions survive ``publish``;
+    versions pinned by the CURRENT or CANARY pointer are never evicted
+    (a rollback target must still exist when the rollback fires).
+    """
+
+    def __init__(self, directory: str, keep_last: int = 4):
+        if keep_last < 2:
+            # a failed swap rolls back to the PREVIOUS version; retention
+            # must never leave only the version being rolled away from
+            raise ValueError(f"keep_last must be >= 2, got {keep_last}")
+        self.directory = os.path.abspath(directory)
+        self.keep_last = int(keep_last)
+
+    # -------------------------------------------------------------- listing
+    def versions(self) -> List[int]:
+        """Committed (manifest-bearing) version numbers, oldest first.
+        In-progress payload directories without a manifest are invisible."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        return sorted(int(m.group(1)) for n in names
+                      if (m := _VERSION_RE.match(n)))
+
+    def version_dir(self, version: int) -> str:
+        return os.path.join(self.directory, f"v_{version:08d}")
+
+    def _manifest_path(self, version: int) -> str:
+        return os.path.join(self.directory, f"version_{version:08d}.json")
+
+    def manifest(self, version: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._manifest_path(version), encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    # -------------------------------------------------------------- publish
+    def publish(self, files: Optional[Dict[str, bytes]] = None,
+                source_dir: Optional[str] = None, *,
+                golden_body: Optional[bytes] = None,
+                golden_reply_sha256: Optional[str] = None,
+                reply_col: str = "prediction",
+                extra: Optional[Dict[str, Any]] = None,
+                set_current: bool = False) -> int:
+        """Write one new version (payload files, then the manifest that
+        commits them — both through the atomic helper), apply retention,
+        and return the version number.
+
+        ``files`` maps relative paths to bytes; ``source_dir`` copies an
+        existing artifact directory (e.g. an ``AOTStore``) instead. The
+        optional golden probe (one binary rowcodec body + the sha256 of
+        the reply the model must produce) is what the swap warm step
+        replays before any flip."""
+        if (files is None) == (source_dir is None):
+            raise ValueError("publish needs exactly one of files/source_dir")
+        if files is None:
+            files = {}
+            for root, _, names in os.walk(source_dir):
+                for n in names:
+                    p = os.path.join(root, n)
+                    rel = os.path.relpath(p, source_dir)
+                    with open(p, "rb") as fh:
+                        files[rel] = fh.read()
+        if not files:
+            raise ValueError("a version must carry at least one file")
+        versions = self.versions()
+        version = (versions[-1] + 1) if versions else 1
+        vdir = self.version_dir(version)
+        entries: Dict[str, Dict[str, Any]] = {}
+        for rel, data in sorted(files.items()):
+            atomic_write_bytes(os.path.join(vdir, rel), data)
+            entries[rel] = {"sha256": _sha256(data), "bytes": len(data)}
+        golden = None
+        if golden_body is not None:
+            golden = {"body_b64": base64.b64encode(golden_body).decode(),
+                      "reply_sha256": golden_reply_sha256,
+                      "reply_col": reply_col}
+        manifest = {
+            "schema_version": REGISTRY_SCHEMA_VERSION,
+            "version": version,
+            "files": entries,
+            "golden": golden,
+            "extra": dict(extra or {}),
+        }
+        atomic_write_text(self._manifest_path(version),
+                          json.dumps(manifest, indent=1, sort_keys=True))
+        try:
+            from ..observability import get_registry
+            get_registry().counter(
+                "model_registry_publish_total",
+                "model versions published").inc()
+        except Exception:  # noqa: BLE001
+            pass
+        if set_current:
+            self.set_current(version)
+        self._gc()
+        return version
+
+    def _gc(self) -> None:
+        """Keep-last-K retention, never evicting a pointer-pinned version."""
+        pinned = {v for v in (self.current(), self.canary()) if v is not None}
+        vs = self.versions()
+        for v in vs[:-self.keep_last] if self.keep_last else []:
+            if v in pinned:
+                continue
+            try:
+                os.remove(self._manifest_path(v))
+            except OSError:
+                pass
+            shutil.rmtree(self.version_dir(v), ignore_errors=True)
+
+    # ------------------------------------------------------------- pointers
+    def _read_pointer(self, name: str) -> Optional[int]:
+        try:
+            with open(os.path.join(self.directory, name),
+                      encoding="utf-8") as fh:
+                v = json.load(fh).get("version")
+            return int(v) if v is not None else None
+        except (OSError, ValueError, AttributeError, TypeError):
+            return None
+
+    def _write_pointer(self, name: str, version: Optional[int]) -> None:
+        atomic_write_text(os.path.join(self.directory, name),
+                          json.dumps({"version": version}))
+
+    def current(self) -> Optional[int]:
+        return self._read_pointer(CURRENT_POINTER)
+
+    def set_current(self, version: Optional[int]) -> None:
+        if version is not None and self.manifest(version) is None:
+            raise RegistryError(f"cannot pin CURRENT to unknown "
+                                f"version {version}")
+        self._write_pointer(CURRENT_POINTER, version)
+
+    def canary(self) -> Optional[int]:
+        return self._read_pointer(CANARY_POINTER)
+
+    def set_canary(self, version: Optional[int]) -> None:
+        if version is not None and self.manifest(version) is None:
+            raise RegistryError(f"cannot pin CANARY to unknown "
+                                f"version {version}")
+        self._write_pointer(CANARY_POINTER, version)
+
+    # -------------------------------------------------------------- resolve
+    def verify(self, version: int) -> Tuple[bool, str]:
+        """Digest-check every payload file against the manifest. Returns
+        (ok, reason) without raising — ``resolve`` is the raising form."""
+        man = self.manifest(version)
+        if man is None:
+            return False, "missing_manifest"
+        if int(man.get("schema_version", -1)) > REGISTRY_SCHEMA_VERSION:
+            return False, "schema_newer_than_reader"
+        vdir = self.version_dir(version)
+        for rel, ent in man.get("files", {}).items():
+            try:
+                with open(os.path.join(vdir, rel), "rb") as fh:
+                    data = fh.read()
+            except OSError:
+                return False, "payload_missing"
+            if _sha256(data) != ent.get("sha256"):
+                return False, "digest_mismatch"
+        return True, "ok"
+
+    def resolve(self, version: int) -> Tuple[str, Dict[str, Any]]:
+        """Verified (payload_dir, manifest) for one version, or
+        ``RegistryError`` with a counted
+        ``model_registry_verify_failures_total{reason}``. Workers call
+        this inside the swap load step, so a corrupt artifact becomes a
+        counted rollback, never a crash or a silently-wrong model."""
+        ok, reason = self.verify(version)
+        if not ok:
+            _count_verify_failure(reason, version)
+            raise RegistryError(
+                f"model version {version} failed verification: {reason}")
+        return self.version_dir(version), self.manifest(version)
+
+    def golden(self, version: int
+               ) -> Tuple[Optional[bytes], Optional[str], str]:
+        """(golden_body, expected_reply_sha256, reply_col) for one version
+        (Nones when the publisher attached no probe)."""
+        man = self.manifest(version) or {}
+        g = man.get("golden") or {}
+        body = (base64.b64decode(g["body_b64"])
+                if g.get("body_b64") else None)
+        return body, g.get("reply_sha256"), g.get("reply_col", "prediction")
+
+
+def load_aot_callable(version_dir: str, name: str, args,
+                      expect_nr_devices: int = 1):
+    """Resolve an AOT-backed version's entry to the fastest usable
+    callable — the version directory is an ``AOTStore``, and this is the
+    PR 11 compiled -> exported -> fresh-JIT resolver applied to it
+    (``compile/aot.load_serving_callable``; returns None on a counted
+    fallback, in which case the caller's loader supplies the fresh JIT)."""
+    from ..compile.aot import AOTStore, load_serving_callable
+    return load_serving_callable(AOTStore(version_dir), name, args,
+                                 expect_nr_devices=expect_nr_devices)
+
+
+class RegistryModelSource:
+    """Worker-side bridge from a registry to the hot-swap machinery.
+
+    ``loader(version_dir, manifest) -> handler`` builds the serving
+    callable (an AOT-backed loader routes through ``load_aot_callable``).
+    ``describe(version)`` returns the ``(load_fn, golden_body,
+    expected_reply_sha256)`` triple ``ServingServer.hot_swap`` consumes:
+    ``load_fn`` performs digest verification + loading ON THE SWAP
+    THREAD, so every failure lands in the counted-rollback funnel while
+    the old handler keeps serving."""
+
+    def __init__(self, directory: str,
+                 loader: Callable[[str, Dict[str, Any]], Callable],
+                 keep_last: int = 4):
+        self.registry = ModelRegistry(directory, keep_last=keep_last)
+        self.loader = loader
+
+    def current_version(self) -> Optional[int]:
+        return self.registry.current()
+
+    def describe(self, version: int):
+        golden_body, expected, _reply_col = self.registry.golden(version)
+
+        def load_fn():
+            vdir, manifest = self.registry.resolve(version)
+            return self.loader(vdir, manifest)
+
+        return load_fn, golden_body, expected
+
+    def load_current(self):
+        """(handler, version) for the CURRENT pointer — the worker's
+        start-of-life model. Raises when there is no usable current
+        version (a worker with nothing to serve must not start)."""
+        version = self.registry.current()
+        if version is None:
+            raise RegistryError("registry has no CURRENT version")
+        vdir, manifest = self.registry.resolve(version)
+        return self.loader(vdir, manifest), version

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import struct
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -159,31 +160,70 @@ class BufferPool:
     batch's buffer can still be live while the next assembles — distinct
     buffers from the freelist make that safe). `hits`/`misses` are plain
     ints surfaced through the serving metrics, not a stats dict.
+
+    Bounded in BOTH dimensions (round 13): `max_per_key` caps buffers per
+    (dtype, shape) key, and `max_keys` is an LRU bound on DISTINCT keys —
+    without it, a hot swap to a model with different batch buckets
+    strands every old-shape buffer forever (the old keys are never
+    acquired again, so per-key caps alone never free them). `clear()` is
+    the swap hook (io/serving.py empties the pool at handler install);
+    `pooled_bytes` backs the `serving_pool_bytes` gauge.
     """
 
-    def __init__(self, max_per_key: int = 4):
+    def __init__(self, max_per_key: int = 4, max_keys: int = 16):
         self.max_per_key = max_per_key
-        self._free: Dict[Tuple[str, Tuple[int, ...]], List[np.ndarray]] = {}
+        self.max_keys = max_keys
+        # insertion/touch order IS the LRU order (oldest first)
+        self._free: "OrderedDict[Tuple[str, Tuple[int, ...]], " \
+                    "List[np.ndarray]]" = OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.key_evictions = 0
 
     def acquire(self, dtype, shape: Tuple[int, ...]) -> np.ndarray:
         key = (np.dtype(dtype).str, tuple(int(d) for d in shape))
         with self._lock:
             lst = self._free.get(key)
-            if lst:
-                self.hits += 1
-                return lst.pop()
+            if lst is not None:
+                self._free.move_to_end(key)
+                if lst:
+                    self.hits += 1
+                    return lst.pop()
             self.misses += 1
         return np.empty(key[1], dtype=np.dtype(dtype))
 
     def release(self, arr: np.ndarray) -> None:
         key = (arr.dtype.str, arr.shape)
         with self._lock:
-            lst = self._free.setdefault(key, [])
+            lst = self._free.get(key)
+            if lst is None:
+                lst = self._free[key] = []
+            self._free.move_to_end(key)
             if len(lst) < self.max_per_key:
                 lst.append(arr)
+            while len(self._free) > self.max_keys:
+                self._free.popitem(last=False)
+                self.key_evictions += 1
+
+    def clear(self) -> None:
+        """Drop every pooled buffer (all keys). The hot-swap install hook:
+        a new model's batch buckets rarely match the old model's, and the
+        stranded-shape buffers would otherwise outlive the swap."""
+        with self._lock:
+            self._free.clear()
+
+    @property
+    def pooled_bytes(self) -> int:
+        """Total bytes currently held in freelists (the
+        `serving_pool_bytes` gauge source)."""
+        with self._lock:
+            return sum(a.nbytes for lst in self._free.values() for a in lst)
+
+    @property
+    def key_count(self) -> int:
+        with self._lock:
+            return len(self._free)
 
 
 def assemble(bodies: Sequence[bytes], headers: Sequence[BinaryHeader],

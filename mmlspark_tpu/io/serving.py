@@ -25,6 +25,17 @@ dispatcher assembles batch k+1 while batch k's replies are still being
 written (no dead time between batches). Request decode is vectorized: the
 binary row format (io/rowcodec.py) assembles a whole batch into a pooled
 device-bound array with ONE host copy; JSON stays as the per-row fallback.
+
+Round 13 (model lifecycle): the handler is no longer fixed at construction.
+`hot_swap()` loads + warms the NEXT model version on a background thread
+(digest-probing a golden row, io/registry.py) while the old handler keeps
+serving, then flips atomically between batches via `_install_handler` —
+the ONE designated mutation point for `self.handler` (AST-linted in
+tests/test_model_lifecycle.py), so no in-flight batch can ever observe a
+torn swap. Any load/warm/digest failure is a counted rollback
+(`serving_swap_events_total{outcome}`) — the old version keeps serving,
+never a crash. `drain()` is the retire discipline's middle step
+(deregister -> drain -> stop) for the autoscaler (io/autoscale.py).
 """
 
 from __future__ import annotations
@@ -596,6 +607,27 @@ class _PackAggregator:
         return cb
 
 
+class SwapResult:
+    """Outcome handle for one `hot_swap` attempt. `done` fires when the
+    attempt resolves; `outcome` is one of "success", "rollback_load",
+    "rollback_warm", "rollback_digest", "rejected" (a swap was already
+    in flight). Rollbacks carry the triggering exception in `error`."""
+
+    __slots__ = ("version", "outcome", "error", "done")
+
+    def __init__(self, version):
+        self.version = version
+        self.outcome: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+    def _resolve(self, outcome: str,
+                 error: Optional[BaseException] = None) -> None:
+        self.outcome = outcome
+        self.error = error
+        self.done.set()
+
+
 class ServingServer:
     """One host's serving endpoint: HTTP listener + dynamic-batch dispatcher.
 
@@ -632,8 +664,8 @@ class ServingServer:
                  batching: str = "continuous",
                  idle_grace_ms: Optional[float] = None,
                  buffer_pool: Optional[rowcodec.BufferPool] = None,
-                 clock: Callable[[], float] = time.perf_counter):
-        self.handler = handler
+                 clock: Callable[[], float] = time.perf_counter,
+                 model_version: Optional[int] = None):
         self.reply_col = reply_col
         self.host, self.port = host, port
         self.max_batch_size = max_batch_size
@@ -728,12 +760,168 @@ class ServingServer:
         self._cb_gauges[1].set_function(
             lambda: 1.0 if (self._disp_thread
                             and self._disp_thread.is_alive()) else 0.0)
+        # ------------------------------------------------ model lifecycle
+        # hot-swap state (round 13): the handler is installed ONLY through
+        # _install_handler (AST-linted); swaps run on a background thread
+        # and roll back counted on any load/warm/digest failure
+        self._lbl = lbl
+        self.model_version: Optional[int] = None
+        self.swap_state: str = "idle"   # idle | loading | warming
+        self.last_swap: Optional[Dict[str, Any]] = None
+        self._swap_lock = threading.Lock()
+        self._m_swaps: Dict[str, Any] = {}
+        self._version_gauge = self.registry.gauge(
+            "serving_model_version",
+            "registry version of the installed handler (-1 = unversioned)",
+            lbl)
+        self._version_gauge.set(-1.0)
+        pool_gauge = self.registry.gauge(
+            "serving_pool_bytes",
+            "bytes held in the staging BufferPool freelists", lbl)
+        pool_gauge.set_function(lambda: float(self.pool.pooled_bytes))
+        self._cb_gauges.append(pool_gauge)
+        # drain bookkeeping: requests the dispatcher currently holds
+        # (collect/inference) and reply jobs not yet fully written — with
+        # the admission queue, these three together account for every
+        # admitted-but-unanswered request (ServingServer.drain)
+        self._work_lock = threading.Lock()
+        self._dispatching = 0
+        self._replying = 0
+        self._install_handler(handler, version=model_version)
 
     @property
     def stats(self) -> Dict[str, int]:
         """Counter view (registry-backed; kept for the pre-observability
         `stats` dict consumers and the /health payload)."""
         return {k: int(c.value) for k, c in self._m.items()}
+
+    # -------------------------------------------------------- model lifecycle
+    def _install_handler(self, handler: Callable[[DataFrame], DataFrame],
+                         version: Optional[int] = None) -> None:
+        """THE designated handler mutation point (construction included).
+
+        The flip is a single attribute rebind: the dispatcher reads
+        `self.handler` exactly once per batch (`_run_batch`), so every
+        batch — and therefore every in-flight request — runs entirely on
+        one version; there is no torn state to observe. The AST lint in
+        tests/test_model_lifecycle.py forbids any other `self.handler`
+        assignment in this module, which is what makes that argument
+        airtight rather than a convention.
+
+        Installing also clears the staging BufferPool: the old model's
+        batch buckets rarely match the new model's, and old-shape buffers
+        would otherwise be stranded until the key-LRU happens to evict
+        them (io/rowcodec.BufferPool)."""
+        self.handler = handler
+        if version is not None:
+            self.model_version = int(version)
+            self._version_gauge.set(float(version))
+        self.pool.clear()
+
+    def _swap_counter(self, outcome: str):
+        c = self._m_swaps.get(outcome)
+        if c is None:
+            c = self.registry.counter(
+                "serving_swap_events_total",
+                "hot-swap attempts by outcome",
+                {**self._lbl, "outcome": outcome})
+            self._m_swaps[outcome] = c
+        return c
+
+    def hot_swap(self, load_fn: Callable[[], Callable],
+                 version: Optional[int],
+                 golden_body: Optional[bytes] = None,
+                 expected_reply_sha256: Optional[str] = None,
+                 wait_s: Optional[float] = None) -> SwapResult:
+        """Zero-downtime handler swap: load + warm the next version on a
+        background thread while the CURRENT handler keeps serving, then
+        flip atomically between batches.
+
+        `load_fn()` builds the new handler (for registry versions this
+        includes digest verification — io/registry.RegistryModelSource);
+        `golden_body` + `expected_reply_sha256` arm the first-batch
+        digest probe: the golden row runs through the new handler (which
+        also warms its compiled program) and the reply digest must match
+        the publish-time digest. ANY failure — load exception, warm
+        exception, digest mismatch — is a counted rollback
+        (`serving_swap_events_total{outcome}`): the old handler keeps
+        serving and the server never crashes.
+
+        Returns a `SwapResult`; pass `wait_s` to block until it resolves
+        (tests and synchronous callers)."""
+        res = SwapResult(version)
+        with self._swap_lock:
+            if self.swap_state != "idle":
+                # one swap at a time: the coordinator's rollout reissues
+                # targets on later beats, so a rejected attempt is retried
+                # naturally once the in-flight one resolves
+                self._swap_counter("rejected").inc()
+                res._resolve("rejected")
+                return res
+            self.swap_state = "loading"
+        t = threading.Thread(
+            target=self._do_swap,
+            args=(res, load_fn, version, golden_body, expected_reply_sha256),
+            daemon=True, name="hot-swap")
+        t.start()
+        if wait_s is not None:
+            res.done.wait(wait_s)
+        return res
+
+    def _do_swap(self, res: SwapResult, load_fn, version,
+                 golden_body, expected_reply_sha256) -> None:
+        t0 = time.perf_counter()
+        outcome, err, handler = "success", None, None
+        try:
+            handler = load_fn()
+        except Exception as e:  # noqa: BLE001 - counted rollback, not crash
+            outcome, err = "rollback_load", e
+        if outcome == "success" and golden_body is not None:
+            with self._swap_lock:
+                self.swap_state = "warming"
+            try:
+                from .registry import golden_reply_digest
+                digest = golden_reply_digest(handler, golden_body,
+                                             self.reply_col)
+            except Exception as e:  # noqa: BLE001
+                outcome, err = "rollback_warm", e
+            else:
+                if (expected_reply_sha256 is not None
+                        and digest != expected_reply_sha256):
+                    outcome = "rollback_digest"
+                    err = ValueError(
+                        f"golden reply digest {digest[:12]}… != published "
+                        f"{expected_reply_sha256[:12]}…")
+        if outcome == "success":
+            self._install_handler(handler, version=version)
+        self._swap_counter(outcome).inc()
+        self.events.append("swap", mint_trace_id(), version=version,
+                           outcome=outcome,
+                           dur_s=time.perf_counter() - t0)
+        with self._swap_lock:
+            self.last_swap = {"version": version, "outcome": outcome,
+                              "error": (f"{type(err).__name__}: {err}"
+                                        if err is not None else None)}
+            self.swap_state = "idle"
+        res._resolve(outcome, err)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait until every admitted request is answered: no queued
+        request (the queue's own `unfinished_tasks` — decremented only
+        AFTER the dispatcher has counted the dequeue into `_dispatching`,
+        so a just-dequeued-not-yet-counted request can never slip between
+        the two checks), the dispatcher holding no batch, and no reply
+        job pending. The retire discipline's middle step (deregister ->
+        DRAIN -> stop, the PR 10 drain order applied to serving) —
+        callers stop routing first, so this converges."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._work_lock:
+                busy = self._dispatching or self._replying
+            if not busy and self._queue.unfinished_tasks == 0:
+                return True
+            time.sleep(0.005)
+        return False
 
     # ------------------------------------------------------------ admission
     def _accept(self, pend: _PendingRequest) -> None:
@@ -814,12 +1002,17 @@ class ServingServer:
                                    b'request queue full"}'})
 
     def health(self) -> Dict[str, Any]:
-        """GET /health payload: queue depth + dispatcher liveness."""
+        """GET /health payload: queue depth + dispatcher liveness + the
+        installed model version and last swap outcome (the rollout
+        operator's per-worker view)."""
         return {"queue_depth": self._queue.qsize(),
                 "max_queue": self.max_queue,
                 "dispatcher_alive": bool(self._disp_thread
                                          and self._disp_thread.is_alive()),
                 "listener": self.listener,
+                "model_version": self.model_version,
+                "swap_state": self.swap_state,
+                "last_swap": dict(self.last_swap) if self.last_swap else None,
                 "stats": dict(self.stats)}
 
     def metrics_text(self) -> str:
@@ -939,22 +1132,40 @@ class ServingServer:
             first = self._try_get(0.05)
             if first is None:
                 continue
-            batch = self.batcher.collect(first, self._try_get,
-                                         should_stop=self._stop.is_set)
-            # a request whose cross-hop budget expired while queued gets its
-            # 504 now — it must not occupy a batch slot a live request could
-            # use (the Deadline threading the gateway forwards shrinks)
-            live, expired = DynamicBatcher.split_expired(batch)
-            for pend in expired:
-                self._m["expired"].inc()
-                self.events.append("expired", pend.trace_id, status=504)
-                pend.complete({"status": 504,
-                               "body": b'{"error": "deadline exceeded"}'})
-            # a batch mixing wire formats (or binary schemas) cannot share
-            # one staging array: run homogeneous sub-batches; uniform
-            # traffic — the only shape the hot path sees — stays one batch
-            for group in self._partition(live):
-                self._run_batch(group)
+            # drain accounting: from here until every group is dispatched
+            # the dispatcher HOLDS requests that are in no queue. The
+            # queue's unfinished_tasks stays >0 until the task_done calls
+            # BELOW this increment, so drain() can never observe the
+            # moment between dequeue and this count (its two checks
+            # overlap by construction)
+            with self._work_lock:
+                self._dispatching += 1
+            try:
+                batch = self.batcher.collect(first, self._try_get,
+                                             should_stop=self._stop.is_set)
+                # every dequeued request (first + collected) is now held
+                # and counted under _dispatching: retire its queue slot
+                for _ in batch:
+                    self._queue.task_done()
+                # a request whose cross-hop budget expired while queued gets
+                # its 504 now — it must not occupy a batch slot a live
+                # request could use (the Deadline threading the gateway
+                # forwards shrinks)
+                live, expired = DynamicBatcher.split_expired(batch)
+                for pend in expired:
+                    self._m["expired"].inc()
+                    self.events.append("expired", pend.trace_id, status=504)
+                    pend.complete({"status": 504,
+                                   "body": b'{"error": "deadline exceeded"}'})
+                # a batch mixing wire formats (or binary schemas) cannot
+                # share one staging array: run homogeneous sub-batches;
+                # uniform traffic — the only shape the hot path sees —
+                # stays one batch
+                for group in self._partition(live):
+                    self._run_batch(group)
+            finally:
+                with self._work_lock:
+                    self._dispatching -= 1
 
     @staticmethod
     def _partition(batch: List[_PendingRequest]
@@ -1026,7 +1237,11 @@ class ServingServer:
                 self._rows_gauge.set(rows / (t_disp - t_asm))
             # serialization + socket writes happen on the reply thread —
             # this dispatcher thread immediately assembles the next batch
-            # (no dead time between device dispatches)
+            # (no dead time between device dispatches). The pending-reply
+            # count is incremented by THIS producer so drain() never sees
+            # a gap between queue handoff and the writer picking it up
+            with self._work_lock:
+                self._replying += 1
             self._reply_q.put((batch, scored, rows, staging,
                                t0, t_asm, t_disp))
         except Exception as e:  # reply 500 to the whole batch
@@ -1069,6 +1284,8 @@ class ServingServer:
             finally:
                 if staging is not None:
                     self.pool.release(staging)
+                with self._work_lock:
+                    self._replying -= 1
 
     def _write_replies(self, batch, scored, rows, t0, t_asm, t_disp):
         vals = scored[self.reply_col]

@@ -24,6 +24,14 @@ lands — exactly a pool preemption's timing), a seeded device-loss
 downshift (resume at fewer devices than the killed fit), and snapshot
 corruption (truncation / bit flips / tmp litter) against which
 `resilience.elastic.CheckpointStore`'s digest fallback is proved.
+
+Round 13 adds SWAP faults against the model lifecycle (io/registry.py +
+ServingServer.hot_swap): `corrupt_version_payload` damages a published
+model version's artifact bytes (the registry digest gate must fail the
+swap LOAD and the worker must keep serving the old version), and
+`slow_load` wraps a swap loader with a delay (the slow-load canary — the
+coordinator's rollout timeout must roll the fleet back while the old
+version keeps serving throughout).
 """
 
 from __future__ import annotations
@@ -180,6 +188,48 @@ class TrainingFaultInjector:
         if not divisors:
             raise ValueError(f"cannot downshift from ndev={ndev}")
         return self._rng.choice(divisors)
+
+    @staticmethod
+    def corrupt_version_payload(model_registry, version: int,
+                                mode: str = "flip") -> str:
+        """Damage one payload file of a published MODEL version (the
+        corrupt-artifact swap fault, mirror of the snapshot corruption
+        above): ``flip`` xors one byte mid-file (bit rot), ``truncate``
+        halves it (torn publish the atomic writer makes impossible, but a
+        disk can still produce). The registry's per-file sha256 gate must
+        turn the next swap of this version into a counted rollback_load —
+        never a crash, never a silently-wrong model. Returns the path of
+        the damaged file."""
+        import os
+        man = model_registry.manifest(version)
+        if not man or not man.get("files"):
+            raise ValueError(f"version {version} has no payload to corrupt")
+        rel = sorted(man["files"])[0]
+        path = os.path.join(model_registry.version_dir(version), rel)
+        with open(path, "r+b") as fh:
+            data = fh.read()
+            fh.seek(0)
+            if mode == "flip":
+                mid = len(data) // 2
+                fh.write(data[:mid] + bytes([data[mid] ^ 0xFF])
+                         + data[mid + 1:])
+            elif mode == "truncate":
+                fh.truncate(0)
+                fh.write(data[:max(1, len(data) // 2)])
+            else:
+                raise ValueError(f"unknown corruption mode {mode!r}")
+        return path
+
+    @staticmethod
+    def slow_load(load_fn: Callable, delay_s: float) -> Callable:
+        """Wrap a swap loader with a straggler delay (the slow-load canary
+        fault): the old handler must keep serving for the whole delay and
+        the coordinator's rollout timeout must fire if the delay outlasts
+        it."""
+        def slow():
+            time.sleep(delay_s)
+            return load_fn()
+        return slow
 
     @staticmethod
     def corrupt_latest_snapshot(store, mode: str = "truncate") -> int:

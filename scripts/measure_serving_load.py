@@ -18,9 +18,26 @@ what the serving data plane actually sustains:
   traffic rebalanced) — the acceptance bar is ZERO accepted (HTTP 200)
   requests with a wrong/missing payload, every reply accounted for.
 
+Round 13 adds two lifecycle scenarios (`--scenario`):
+
+- `swap` — the same sustained mixed-size load, but the workers are
+  REGISTRY-BACKED (io/registry.py) and a version rollout fires mid-run
+  through the coordinator's health-gated state machine (canary ->
+  promote). Acceptance: the swap completes with ZERO lost and ZERO shed
+  accepted requests, every 200 payload exact against {old, new} weights.
+  The chaos variant corrupts the target version's artifact (digest gate
+  must fail the swap), kills a worker mid-rollout, and fails 30% of
+  gateway forwards — the rollout must AUTO-ROLL-BACK with zero
+  accepted-request loss.
+- `autoscale` — a ramped load trace against a 2-worker base fleet with
+  an `Autoscaler` (io/autoscale.py) acting on the heartbeat queue-depth
+  signals: the fleet must grow 2 -> 4 under the ramp and retire back to
+  2 after it, retire = deregister -> drain -> stop, zero lost requests.
+
 Outputs: a markdown row block on stdout (append to docs/SERVING.md) and a
-JSON summary at --out (default docs/SERVING_load.json; bench.py embeds it
-in its emitted record's `extra.serving_load`). Armed in
+JSON summary at --out (defaults: docs/SERVING_load.json /
+docs/SERVING_swap.json / docs/SERVING_autoscale.json; bench.py embeds
+them in its emitted record's `extra`). Armed in
 scripts/tpu_recovery_watch.sh; env knobs for quick runs:
 MEASURE_LOAD_S (per-variant seconds, default 120), MEASURE_LOAD_CLIENTS,
 MEASURE_LOAD_WORKERS, MEASURE_LOAD_SKIP_CHAOS=1.
@@ -51,40 +68,78 @@ def _weights() -> np.ndarray:
     return (np.arange(FEATURES, dtype=np.float32) + 1.0) / FEATURES
 
 
-def _worker_main(coord_url: str, partition: int, ready, stop) -> None:
+def _make_handler(w: np.ndarray, slow_ms: float = 0.0):
+    def handler(df):
+        if slow_ms:
+            # models a heavier per-batch device cost (the autoscale
+            # scenario needs queues to actually build under the ramp)
+            time.sleep(slow_ms / 1000.0)
+        x = np.asarray(df["features"], np.float32)
+        return df.with_column("prediction", (x @ w).astype(np.float32))
+    return handler
+
+
+def _registry_loader(vdir: str, manifest: dict):
+    """Version loader for registry-backed workers: weights.bin -> linear
+    scorer (module-level so spawn-context worker processes can pickle a
+    RegistryModelSource built around it)."""
+    with open(os.path.join(vdir, "weights.bin"), "rb") as fh:
+        w = np.frombuffer(fh.read(), np.float32).copy()
+    slow_ms = float(manifest.get("extra", {}).get("slow_ms", 0.0))
+    return _make_handler(w, slow_ms)
+
+
+def _worker_main(coord_url: str, partition: int, ready, stop,
+                 retire=None, registry_dir: str = None,
+                 slow_ms: float = 0.0, max_batch_size: int = 1024) -> None:
     """One serving worker in its own process (own GIL): numpy linear
     scorer — the host-path cost model; the chip handler swaps in the
-    jitted booster (scripts/measure_serving_tpu.py)."""
+    jitted booster (scripts/measure_serving_tpu.py). With `registry_dir`
+    the worker is registry-backed (serves CURRENT, hot-swaps on rollout
+    targets); with `retire` set it leaves via deregister -> drain -> stop
+    (the autoscaler's zero-loss scale-down)."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     from mmlspark_tpu.io.distributed_serving import DistributedServingServer
 
-    w = _weights()
-
-    def handler(df):
-        x = np.asarray(df["features"], np.float32)
-        return df.with_column("prediction", (x @ w).astype(np.float32))
+    kw = {}
+    if registry_dir is not None:
+        from mmlspark_tpu.io.registry import RegistryModelSource
+        handler = None
+        kw["model_source"] = RegistryModelSource(registry_dir,
+                                                 _registry_loader)
+    else:
+        handler = _make_handler(_weights(), slow_ms)
 
     server = DistributedServingServer(
         handler, coord_url, SERVICE, partition=partition,
         machine=f"load-{partition}", port=0,
-        max_batch_size=1024, max_latency_ms=0.5,
-        heartbeat_interval_s=0.25, max_queue=4096).start()
+        max_batch_size=max_batch_size, max_latency_ms=0.5,
+        heartbeat_interval_s=0.25, max_queue=4096, **kw).start()
     ready.set()
-    stop.wait(3600)
+    while not stop.wait(0.1):
+        if retire is not None and retire.is_set():
+            server.retire(drain_timeout_s=30.0)
+            return
     server.stop()
 
 
 class _Client(threading.Thread):
     """Keep-alive HTTP/1.1 client hammering the gateway with binary
-    bodies of mixed row counts; verifies EVERY 200 payload exactly."""
+    bodies of mixed row counts; verifies EVERY 200 payload exactly.
+    `expected_first` per body may be a tuple of acceptable values — the
+    swap scenario accepts BOTH versions' outputs for the whole run (any
+    other value is a torn/corrupt reply) and tallies which version
+    answered in `value_counts`."""
 
     def __init__(self, host, port, path, bodies, expected, deadline_s,
                  stop_ev):
         super().__init__(daemon=True)
         self.addr = (host, port)
         self.path = path.encode()
-        self.bodies = bodies          # [(nrows, body, expected_first)]
+        # [(nrows, body, expected_first | (v1, v2, ...))] — normalized
+        self.bodies = [(n, b, e if isinstance(e, tuple) else (e,))
+                       for n, b, e in bodies]
         self.deadline_s = deadline_s
         self.stop_ev = stop_ev
         self.expected = expected
@@ -96,6 +151,7 @@ class _Client(threading.Thread):
         self.errors = 0
         self.bad_payload = 0
         self.lost = 0
+        self.value_counts = {}        # matched expected index -> replies
 
     def _connect(self):
         s = socket.create_connection(self.addr, timeout=30.0)
@@ -138,12 +194,19 @@ class _Client(threading.Thread):
                 payload, buf = rest[:length], rest[length:]
                 if status == 200:
                     _, preds = rowcodec.decode(payload)
-                    if (preds.shape[0] != nrows
-                            or abs(float(preds[0]) - exp_first) > 1e-4):
+                    match = None
+                    if preds.shape[0] == nrows:
+                        for k, e in enumerate(exp_first):
+                            if abs(float(preds[0]) - e) <= 1e-4:
+                                match = k
+                                break
+                    if match is None:
                         self.bad_payload += 1
                     else:
                         self.ok_requests += 1
                         self.ok_rows += nrows
+                        self.value_counts[match] = \
+                            self.value_counts.get(match, 0) + 1
                 elif status == 503:
                     self.shed += 1
                 elif status == 504:
@@ -184,24 +247,30 @@ def _prom_value(text: str, name: str) -> float:
     return total
 
 
-def _spawn_workers(ctx, coord_url, n):
-    """Each worker gets its OWN stop event: terminate()-ing a worker that
-    shares an Event can kill it while it holds the event's internal lock,
-    deadlocking the parent's later set() (observed on the chaos path)."""
-    procs, readies, stops = [], [], []
-    for p in range(n):
+def _spawn_workers(ctx, coord_url, n, registry_dir=None, slow_ms=0.0,
+                   max_batch_size=1024, first_partition=0):
+    """Each worker gets its OWN stop/retire events: terminate()-ing a
+    worker that shares an Event can kill it while it holds the event's
+    internal lock, deadlocking the parent's later set() (observed on the
+    chaos path)."""
+    procs, readies, stops, retires = [], [], [], []
+    for p in range(first_partition, first_partition + n):
         ready = ctx.Event()
         stop = ctx.Event()
+        retire = ctx.Event()
         proc = ctx.Process(target=_worker_main,
-                           args=(coord_url, p, ready, stop), daemon=True)
+                           args=(coord_url, p, ready, stop, retire,
+                                 registry_dir, slow_ms, max_batch_size),
+                           daemon=True)
         proc.start()
         procs.append(proc)
         readies.append(ready)
         stops.append(stop)
+        retires.append(retire)
     for r in readies:
         if not r.wait(60):
             raise RuntimeError("worker failed to start/register")
-    return procs, stops
+    return procs, stops, retires
 
 
 def run_variant(chaos: bool, duration_s: float, n_workers: int,
@@ -226,7 +295,7 @@ def run_variant(chaos: bool, duration_s: float, n_workers: int,
         forward_transport=(injector.wrap(transport) if chaos else None),
         coalesce_max=8).start()
     ctx = mp.get_context("spawn")
-    procs, worker_stops = _spawn_workers(ctx, coord.url, n_workers)
+    procs, worker_stops, _ = _spawn_workers(ctx, coord.url, n_workers)
 
     w = _weights()
     rng = np.random.default_rng(5)
@@ -352,9 +421,416 @@ def run_variant(chaos: bool, duration_s: float, n_workers: int,
     return summary
 
 
+# --------------------------------------------------------- swap scenario
+
+def _prom_by_label(text: str, name: str, label: str) -> dict:
+    """Sum a counter family per value of one label."""
+    out = {}
+    for m in re.finditer(rf'^{name}{{([^}}]*)}} ([0-9.e+-]+)$', text, re.M):
+        lm = re.search(rf'{label}="([^"]*)"', m.group(1))
+        if lm:
+            out[lm.group(1)] = out.get(lm.group(1), 0.0) + float(m.group(2))
+    return out
+
+
+def _client_tallies(clients, wall) -> dict:
+    sent = sum(c.sent for c in clients)
+    ok_rows = sum(c.ok_rows for c in clients)
+    values = {}
+    for c in clients:
+        for k, v in c.value_counts.items():
+            values[k] = values.get(k, 0) + v
+    return {
+        "client_requests": sent,
+        "ok_requests": sum(c.ok_requests for c in clients),
+        "ok_rows": ok_rows,
+        "row_requests_per_s": round(ok_rows / wall, 1),
+        "shed": sum(c.shed for c in clients),
+        "expired": sum(c.expired for c in clients),
+        "errors": sum(c.errors for c in clients),
+        "bad_payload_on_200": sum(c.bad_payload for c in clients),
+        "no_reply_lost": sum(c.lost for c in clients),
+        "replies_by_version_index": values,
+    }
+
+
+def run_swap_variant(chaos: bool, duration_s: float, n_workers: int,
+                     n_clients: int) -> dict:
+    """Sustained load with a mid-run version rollout. Baseline: canary ->
+    promote to v2 completes with zero lost/shed accepted requests, every
+    200 payload exact against {v1, v2}. Chaos: the target version's
+    artifact is CORRUPT (digest gate must fail the swap), a worker is
+    killed mid-rollout, and 30% of gateway forwards fail — the rollout
+    must auto-roll-back with zero accepted-request loss."""
+    import tempfile
+    import urllib.parse
+    from mmlspark_tpu.io import rowcodec
+    from mmlspark_tpu.io.distributed_serving import ServingCoordinator
+    from mmlspark_tpu.io.http import KeepAliveTransport
+    from mmlspark_tpu.io.registry import ModelRegistry, golden_reply_digest
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+    from mmlspark_tpu.resilience import FaultInjector
+    from mmlspark_tpu.resilience.chaos import TrainingFaultInjector
+
+    w1 = _weights()
+    w2 = (w1 * 1.5).astype(np.float32)
+    rdir = tempfile.mkdtemp(prefix="model_registry_")
+    registry = ModelRegistry(rdir, keep_last=4)
+    golden = rowcodec.encode("features",
+                             np.ones((1, FEATURES), np.float32))
+    v1 = registry.publish(
+        {"weights.bin": w1.tobytes()}, golden_body=golden,
+        golden_reply_sha256=golden_reply_digest(_make_handler(w1), golden),
+        set_current=True)
+    v2 = registry.publish(
+        {"weights.bin": w2.tobytes()}, golden_body=golden,
+        golden_reply_sha256=golden_reply_digest(_make_handler(w2), golden))
+    target = v2
+    if chaos:
+        # the corrupt-artifact swap fault: the digest gate must fail the
+        # canary's swap and the rollout must roll back automatically
+        v3 = registry.publish({"weights.bin": w2.tobytes()},
+                              golden_body=golden)
+        TrainingFaultInjector.corrupt_version_payload(registry, v3)
+        target = v3
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    injector = None
+    transport = None
+    if chaos:
+        transport = KeepAliveTransport()
+        injector = FaultInjector(seed=12, error_rate=0.3)
+    coord = ServingCoordinator(
+        heartbeat_timeout_s=2.0, registry=reg,
+        forward_transport=(injector.wrap(transport) if chaos else None),
+        coalesce_max=8, canary_beats=2,
+        rollout_timeout_s=max(10.0, duration_s / 3.0)).start()
+    ctx = mp.get_context("spawn")
+    procs, worker_stops, _ = _spawn_workers(ctx, coord.url, n_workers,
+                                            registry_dir=rdir)
+
+    rng = np.random.default_rng(5)
+    bodies = []
+    for nrows in BATCH_MIX:
+        x = rng.normal(size=(nrows, FEATURES)).astype(np.float32)
+        bodies.append((nrows, rowcodec.encode("features", x),
+                       (float(x[0] @ w1), float(x[0] @ w2))))
+
+    stop_clients = threading.Event()
+    parsed = urllib.parse.urlsplit(coord.url)
+    clients = [_Client(parsed.hostname, parsed.port,
+                       f"/gateway/{SERVICE}", bodies, None,
+                       DEADLINE_MS / 1000.0, stop_clients)
+               for _ in range(n_clients)]
+    t0 = time.perf_counter()
+    for c in clients:
+        c.start()
+
+    # phase 1: steady pre-swap traffic (beats deliver model_version
+    # reports, baselines settle)
+    time.sleep(max(duration_s / 3.0, 2.0))
+    # under chaos the routing table can be transiently EMPTY (an injected
+    # forward fault just evicted everyone; heartbeats re-register within
+    # a beat) — retry like an operator would
+    ro = None
+    for _ in range(100):
+        try:
+            ro = coord.start_rollout(SERVICE, target, previous=v1)
+            break
+        except ValueError:
+            time.sleep(0.1)
+    if ro is None:
+        raise RuntimeError("could not start rollout: no workers stayed "
+                           "registered")
+    rollout_started_at = time.perf_counter() - t0
+    print(f"  rollout -> v{target} started at {rollout_started_at:.1f}s "
+          f"(canary {ro['canary'][0]}:{ro['canary'][1]})", flush=True)
+    killed_at = None
+    if chaos:
+        # worker kill mid-swap: terminate a NON-canary worker while the
+        # rollout is in flight; it must be evicted with zero accepted loss
+        time.sleep(0.5)
+        procs[-1].terminate()
+        killed_at = time.perf_counter() - t0
+    # wait for the state machine to resolve, under full load throughout
+    state = None
+    t_resolve = None
+    deadline = time.time() + max(duration_s, 30.0)
+    while time.time() < deadline:
+        state = (coord.rollout_status(SERVICE) or {}).get("state")
+        if state in ("done", "rolled_back"):
+            if t_resolve is None:
+                t_resolve = time.perf_counter() - t0
+            break
+        time.sleep(0.1)
+    # phase 3: steady post-swap traffic (post-flip payloads verified)
+    time.sleep(max(duration_s / 3.0, 2.0))
+    stop_clients.set()
+    for c in clients:
+        c.join(15.0)
+    wall = time.perf_counter() - t0
+
+    # per-worker swap telemetry before teardown
+    worker_swaps = []
+    for s in coord.routes(SERVICE):
+        try:
+            text = _scrape(f"http://{s.host}:{s.port}/metrics")
+            worker_swaps.append({
+                "worker": f"{s.machine}:{s.partition}",
+                "model_version": _prom_value(text, "serving_model_version"),
+                "swap_events": _prom_by_label(
+                    text, "serving_swap_events_total", "outcome"),
+            })
+        except Exception as e:
+            worker_swaps.append({"worker": f"{s.machine}:{s.partition}",
+                                 "scrape_error": str(e)[:100]})
+
+    lbl = {"instance": coord.metrics_label}
+    p50 = reg.quantile("gateway_request_latency_seconds", 0.5, lbl)
+    p99 = reg.quantile("gateway_request_latency_seconds", 0.99, lbl)
+    summary = {
+        "variant": "swap_chaos" if chaos else "swap",
+        "duration_s": round(wall, 1),
+        "workers": n_workers,
+        "clients": n_clients,
+        "batch_mix_rows": list(BATCH_MIX),
+        "versions": {"previous": v1, "target": target,
+                     "target_corrupt": bool(chaos)},
+        "rollout_started_at_s": round(rollout_started_at, 1),
+        "rollout_resolved_at_s": (round(t_resolve, 1)
+                                  if t_resolve else None),
+        "rollout_final_state": state,
+        "rollout": {k: v for k, v in
+                    (coord.rollout_status(SERVICE) or {}).items()
+                    if k != "baseline"},
+        "worker_killed_at_s": (round(killed_at, 1)
+                               if killed_at is not None else None),
+        "gateway_p50_ms": round(p50 * 1e3, 3) if p50 else None,
+        "gateway_p99_ms": round(p99 * 1e3, 3) if p99 else None,
+        "evictions": reg.total("gateway_evictions_total"),
+        "forward_failures": reg.total("gateway_forward_failures_total"),
+        "worker_swaps": worker_swaps,
+        **_client_tallies(clients, wall),
+    }
+    if chaos:
+        summary["injected"] = dict(injector.counts)
+
+    for p, st in zip(procs, worker_stops):
+        if p.is_alive():
+            st.set()
+    for p in procs:
+        p.join(10.0)
+        if p.is_alive():
+            p.terminate()
+    coord.stop()
+    set_registry(prev)
+    return summary
+
+
+# ---------------------------------------------------- autoscale scenario
+
+def run_autoscale_variant(duration_s: float, n_clients: int) -> dict:
+    """Ramped load against a 2-worker base fleet with the Autoscaler
+    acting on heartbeat queue-depth signals: grow 2 -> 4 under the ramp,
+    retire back to 2 after it (deregister -> drain -> stop), zero lost
+    requests throughout."""
+    import urllib.parse
+    from mmlspark_tpu.io import rowcodec
+    from mmlspark_tpu.io.autoscale import Autoscaler
+    from mmlspark_tpu.io.distributed_serving import ServingCoordinator
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    coord = ServingCoordinator(heartbeat_timeout_s=2.0, registry=reg,
+                               coalesce_max=8).start()
+    ctx = mp.get_context("spawn")
+    # deliberately heavier per-batch cost + smaller batches so the ramp
+    # creates a genuine 2-worker capacity DEFICIT (queues grow until the
+    # fleet scales) that 4 workers clear — the autoscaler's signal
+    worker_kw = dict(slow_ms=float(os.environ.get("MEASURE_AS_SLOW_MS",
+                                                  "7")),
+                     max_batch_size=64)
+    base_procs, base_stops, _ = _spawn_workers(ctx, coord.url, 2,
+                                               **worker_kw)
+    next_partition = [2]
+    spawned = []   # (proc, stop, retire) the autoscaler manages
+
+    def spawn():
+        procs, stops, retires = _spawn_workers(
+            ctx, coord.url, 1, first_partition=next_partition[0],
+            **worker_kw)
+        next_partition[0] += 1
+        handle = (procs[0], stops[0], retires[0])
+        spawned.append(handle)
+        return handle
+
+    def retire(handle):
+        proc, stop, retire_ev = handle
+        retire_ev.set()       # worker: deregister -> drain -> stop -> exit
+        proc.join(30.0)
+        if proc.is_alive():
+            proc.terminate()
+
+    scaler = Autoscaler.for_service(
+        coord, SERVICE, spawn, retire,
+        min_workers=2, max_workers=4,
+        high_queue_depth=float(os.environ.get("MEASURE_AS_HIGH", "6")),
+        low_queue_depth=float(os.environ.get("MEASURE_AS_LOW", "1")),
+        up_after=2, down_after=8,
+        cooldown_s=max(3.0, duration_s / 15.0), interval_s=0.25,
+        registry=reg).start()
+
+    w = _weights()
+    rng = np.random.default_rng(5)
+    bodies = []
+    for nrows in BATCH_MIX:
+        x = rng.normal(size=(nrows, FEATURES)).astype(np.float32)
+        bodies.append((nrows, rowcodec.encode("features", x),
+                       float(x[0] @ w)))
+    parsed = urllib.parse.urlsplit(coord.url)
+
+    def mk_clients(n, stop_ev):
+        cs = [_Client(parsed.hostname, parsed.port,
+                      f"/gateway/{SERVICE}", bodies, None,
+                      DEADLINE_MS / 1000.0, stop_ev)
+              for _ in range(n)]
+        for c in cs:
+            c.start()
+        return cs
+
+    # load trace: light -> ramp (all clients) -> light again
+    t0 = time.perf_counter()
+    m0 = time.monotonic()   # the Autoscaler's action clock origin
+    stop_all = threading.Event()
+    stop_ramp = threading.Event()
+    light = mk_clients(max(2, n_clients // 8), stop_all)
+    fleet_series = []
+
+    def sample_fleet():
+        fleet_series.append(
+            {"t": round(time.perf_counter() - t0, 1),
+             "workers": len(coord.routes(SERVICE)),
+             "mean_queue_depth": round(float(np.mean(
+                 [v["queue_depth"] for v in
+                  coord.worker_loads(SERVICE).values()] or [0.0])), 2)})
+
+    phase = max(duration_s / 3.0, 4.0)
+    end1 = time.perf_counter() + phase
+    while time.perf_counter() < end1:
+        sample_fleet()
+        time.sleep(0.5)
+    ramp = mk_clients(n_clients, stop_ramp)
+    peak_workers = 0
+    end2 = time.perf_counter() + phase
+    while time.perf_counter() < end2:
+        sample_fleet()
+        peak_workers = max(peak_workers, len(coord.routes(SERVICE)))
+        time.sleep(0.5)
+    stop_ramp.set()
+    for c in ramp:
+        c.join(15.0)
+    end3 = time.perf_counter() + phase
+    while time.perf_counter() < end3:
+        sample_fleet()
+        time.sleep(0.5)
+    stop_all.set()
+    for c in light:
+        c.join(15.0)
+    wall = time.perf_counter() - t0
+    final_workers = len(coord.routes(SERVICE))
+
+    clients = light + ramp
+    lbl = {"instance": coord.metrics_label}
+    p50 = reg.quantile("gateway_request_latency_seconds", 0.5, lbl)
+    p99 = reg.quantile("gateway_request_latency_seconds", 0.99, lbl)
+    summary = {
+        "variant": "autoscale",
+        "duration_s": round(wall, 1),
+        "base_workers": 2,
+        "clients_light": len(light), "clients_ramp": len(ramp),
+        "batch_mix_rows": list(BATCH_MIX),
+        "peak_workers": peak_workers,
+        "final_workers": final_workers,
+        "actions": [{**a, "t": round(a["t"] - m0, 1)}
+                    for a in scaler.actions],
+        "scale_ups": sum(1 for a in scaler.actions
+                         if a["action"] == "scale_up"),
+        "scale_downs": sum(1 for a in scaler.actions
+                           if a["action"] == "scale_down"),
+        "fleet_series": fleet_series,
+        "gateway_p50_ms": round(p50 * 1e3, 3) if p50 else None,
+        "gateway_p99_ms": round(p99 * 1e3, 3) if p99 else None,
+        "evictions": reg.total("gateway_evictions_total"),
+        **_client_tallies(clients, wall),
+    }
+
+    scaler.stop(retire_spawned=True)
+    for st in base_stops:
+        st.set()
+    for p in base_procs:
+        p.join(10.0)
+        if p.is_alive():
+            p.terminate()
+    coord.stop()
+    set_registry(prev)
+    return summary
+
+
+# ----------------------------------------------------------------- main
+
+def _gate_swap(results) -> int:
+    rc = 0
+    for s in results:
+        chaos = s["variant"] == "swap_chaos"
+        if s["bad_payload_on_200"] or s["no_reply_lost"]:
+            print(f"  !! {s['variant']}: accepted-request loss "
+                  f"(bad={s['bad_payload_on_200']} "
+                  f"lost={s['no_reply_lost']})")
+            rc = 1
+        if not chaos and s["shed"]:
+            print(f"  !! swap: {s['shed']} requests shed during rollout")
+            rc = 1
+        want = "rolled_back" if chaos else "done"
+        if s["rollout_final_state"] != want:
+            print(f"  !! {s['variant']}: rollout ended "
+                  f"{s['rollout_final_state']!r}, wanted {want!r}")
+            rc = 1
+        if not chaos and len(s["replies_by_version_index"]) < 2:
+            print("  !! swap: replies never flipped to the new version")
+            rc = 1
+        if chaos and s["replies_by_version_index"].get(1):
+            print("  !! swap_chaos: corrupt version answered traffic")
+            rc = 1
+    return rc
+
+
+def _gate_autoscale(s) -> int:
+    rc = 0
+    if s["bad_payload_on_200"] or s["no_reply_lost"]:
+        print(f"  !! autoscale: accepted-request loss "
+              f"(bad={s['bad_payload_on_200']} lost={s['no_reply_lost']})")
+        rc = 1
+    # the full acceptance ramp must reach 4 workers; short mini-runs
+    # (tests) gate on growth happening at all (MEASURE_AS_MIN_PEAK=3)
+    min_peak = int(os.environ.get("MEASURE_AS_MIN_PEAK", "4"))
+    if s["peak_workers"] < min_peak:
+        print(f"  !! autoscale: never grew to {min_peak} workers "
+              f"(peak {s['peak_workers']})")
+        rc = 1
+    if s["final_workers"] != 2:
+        print(f"  !! autoscale: did not retire back to 2 "
+              f"(final {s['final_workers']})")
+        rc = 1
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="docs/SERVING_load.json")
+    ap.add_argument("--scenario", default="load",
+                    choices=("load", "swap", "autoscale"))
+    ap.add_argument("--out", default=None)
     ap.add_argument("--duration-s", type=float, default=float(
         os.environ.get("MEASURE_LOAD_S", "120")))
     ap.add_argument("--workers", type=int, default=int(
@@ -363,23 +839,48 @@ def main() -> int:
         os.environ.get("MEASURE_LOAD_CLIENTS", "32")))
     ap.add_argument("--target-rows-s", type=float, default=100_000.0)
     args = ap.parse_args()
+    if args.out is None:
+        args.out = {"load": "docs/SERVING_load.json",
+                    "swap": "docs/SERVING_swap.json",
+                    "autoscale": "docs/SERVING_autoscale.json"}[
+                        args.scenario]
 
-    variants = [False]
-    if os.environ.get("MEASURE_LOAD_SKIP_CHAOS") != "1":
-        variants.append(True)
     results = []
-    for chaos in variants:
-        tag = "chaos" if chaos else "baseline"
-        print(f"== {tag}: {args.duration_s:.0f}s, {args.workers} workers, "
-              f"{args.clients} clients", flush=True)
-        s = run_variant(chaos, args.duration_s, args.workers, args.clients)
-        results.append(s)
+    rc = 0
+    if args.scenario == "load":
+        variants = [False]
+        if os.environ.get("MEASURE_LOAD_SKIP_CHAOS") != "1":
+            variants.append(True)
+        for chaos in variants:
+            tag = "chaos" if chaos else "baseline"
+            print(f"== {tag}: {args.duration_s:.0f}s, {args.workers} "
+                  f"workers, {args.clients} clients", flush=True)
+            results.append(run_variant(chaos, args.duration_s,
+                                       args.workers, args.clients))
+    elif args.scenario == "swap":
+        variants = [False]
+        if os.environ.get("MEASURE_LOAD_SKIP_CHAOS") != "1":
+            variants.append(True)
+        for chaos in variants:
+            tag = "swap_chaos" if chaos else "swap"
+            print(f"== {tag}: {args.duration_s:.0f}s, {args.workers} "
+                  f"workers, {args.clients} clients", flush=True)
+            results.append(run_swap_variant(chaos, args.duration_s,
+                                            args.workers, args.clients))
+    else:
+        print(f"== autoscale: {args.duration_s:.0f}s ramp, "
+              f"{args.clients} ramp clients", flush=True)
+        results.append(run_autoscale_variant(args.duration_s,
+                                             args.clients))
+    for s in results:
         print(json.dumps({k: v for k, v in s.items()
-                          if k not in ("worker_stats", "trace_exemplars")},
+                          if k not in ("worker_stats", "trace_exemplars",
+                                       "fleet_series")},
                          indent=1), flush=True)
 
     record = {
         "host": "cpu",
+        "scenario": args.scenario,
         "date_utc": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
         "target_row_requests_per_s": args.target_rows_s,
         "variants": results,
@@ -389,10 +890,28 @@ def main() -> int:
         json.dump(record, f, indent=1)
     print(f"wrote {args.out}")
 
+    if args.scenario == "swap":
+        print("\n| variant | rows/s | p50 | p99 | rollout | resolved "
+              "| shed | accepted lost |")
+        print("|---|---|---|---|---|---|---|---|")
+        for s in results:
+            print(f"| {s['variant']} | {s['row_requests_per_s']:.0f} | "
+                  f"{s['gateway_p50_ms']} ms | {s['gateway_p99_ms']} ms | "
+                  f"{s['rollout_final_state']} | "
+                  f"{s['rollout_resolved_at_s']}s | {s['shed']} | "
+                  f"{s['bad_payload_on_200'] + s['no_reply_lost']} |")
+        return _gate_swap(results)
+    if args.scenario == "autoscale":
+        s = results[0]
+        print(f"\n| workers 2->{s['peak_workers']}->{s['final_workers']} "
+              f"| rows/s {s['row_requests_per_s']:.0f} | "
+              f"p99 {s['gateway_p99_ms']} ms | shed {s['shed']} | "
+              f"lost {s['no_reply_lost'] + s['bad_payload_on_200']} |")
+        return _gate_autoscale(s)
+
     print("\n| variant | rows/s (row-requests/s) | client req/s | p50 | "
           "p99 | shed rate | mean batch rows | accepted lost |")
     print("|---|---|---|---|---|---|---|---|")
-    rc = 0
     for s in results:
         accepted_lost = s["bad_payload_on_200"]
         print(f"| {s['variant']} | {s['row_requests_per_s']:.0f} | "

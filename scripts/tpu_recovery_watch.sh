@@ -60,6 +60,10 @@ EOF
     run python -u scripts/measure_serving_tpu.py
     echo "== serving sustained load (round-12 tentpole) $(date -u +%FT%TZ)"
     run python -u scripts/measure_serving_load.py --out docs/SERVING_load_chip_host.json
+    echo "== model lifecycle: hot swap under load (round-13 tentpole) $(date -u +%FT%TZ)"
+    run python -u scripts/measure_serving_load.py --scenario swap --out docs/SERVING_swap_chip_host.json
+    echo "== model lifecycle: autoscaler ramp (round-13 tentpole) $(date -u +%FT%TZ)"
+    run python -u scripts/measure_serving_load.py --scenario autoscale --out docs/SERVING_autoscale_chip_host.json
     echo "== cold start: compile cache + AOT (round-11 tentpole) $(date -u +%FT%TZ)"
     run python -u scripts/measure_cold_start.py --out docs/COLD_START_chip.json
     echo "== bench (validates binning fast path on chip) $(date -u +%FT%TZ)"

@@ -43,6 +43,12 @@ SLOW_MODULES = {
 # heavy tests inside otherwise-fast modules (measured >= ~7s on 1 vCPU)
 SLOW_TESTS = {
     ("test_downloader", "TestEndToEndModelDownloader"),
+    # ISSUE-13 budget satellite: these two zoo-anchor fits are ~400 s of
+    # the 780 s tier-1 budget on a slow box (221 s + 175 s measured at
+    # round 13) — the cheap anchor tests in the same classes keep the
+    # tier-1 signal, the full fits ride the slow tier
+    ("test_downloader", "test_featurize_then_train_classifier_beats_random_init"),
+    ("test_downloader", "test_full_bytes_path_transfer_absolute_accuracy"),
     ("test_distributed_serving", "test_two_process_fleet"),
     ("test_lightgbm", "TestVotingParallel"),
     ("test_lightgbm", "test_distributed_matches_serial"),
@@ -152,6 +158,11 @@ def pytest_runtest_makereport(item, call):
         _durations[item.nodeid] = rep.duration
 
 
+def _slowest_lines(n: int = 10):
+    top = sorted(_durations.items(), key=lambda kv: -kv[1])[:n]
+    return [f"  {d:7.2f}s  {nid}" for nid, d in top]
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     if not _durations:
         return
@@ -159,15 +170,17 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     if "not slow" not in marks:
         return  # budget applies to the tier-1 selection only
     total = sum(_durations.values())
-    top = sorted(_durations.items(), key=lambda kv: -kv[1])[:10]
     tw = terminalreporter
     tw.write_line(
         f"[tier-1 audit] summed test time {total:.1f}s "
         f"(budget {TIER1_BUDGET_S:.0f}s, wall cap 870s)")
     if total > TIER1_BUDGET_S:
-        tw.write_line("[tier-1 audit] BUDGET EXCEEDED — slowest tests:")
-        for nid, d in top:
-            tw.write_line(f"  {d:7.2f}s  {nid}")
+        gated = os.environ.get("TIER1_DURATION_GATE") == "1"
+        tw.write_line(f"[tier-1 audit] BUDGET EXCEEDED"
+                      f"{' — GATE ENFORCED, run will FAIL' if gated else ''}"
+                      f" — top-10 slowest tests:")
+        for line in _slowest_lines():
+            tw.write_line(line)
         tw.write_line("[tier-1 audit] mark new heavy tests @pytest.mark."
                       "slow or add them to conftest SLOW_MODULES/SLOW_TESTS")
 
@@ -177,4 +190,16 @@ def pytest_sessionfinish(session, exitstatus):
             and "not slow" in (session.config.option.markexpr or "")
             and sum(_durations.values()) > TIER1_BUDGET_S
             and exitstatus == 0):
+        # self-diagnosing failure (ISSUE-13 satellite): the gate breach
+        # names the top offenders right where the exit status flips, so
+        # an over-budget PR sees WHAT to mark slow without re-running
+        total = sum(_durations.values())
+        print(f"\n[tier-1 audit] FAILING: summed test time {total:.1f}s "
+              f"> budget {TIER1_BUDGET_S:.0f}s "
+              f"(TIER1_DURATION_GATE=1). Top-10 slowest tests:")
+        for line in _slowest_lines():
+            print(line)
+        print("[tier-1 audit] mark heavy tests @pytest.mark.slow or add "
+              "them to conftest SLOW_MODULES/SLOW_TESTS, or raise "
+              "TIER1_TEST_BUDGET_S if the seed itself grew")
         session.exitstatus = 1

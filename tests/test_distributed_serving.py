@@ -20,7 +20,8 @@ import pytest
 from mmlspark_tpu.io.distributed_serving import (DistributedServingServer,
                                                  ServiceInfo,
                                                  ServingCoordinator,
-                                                 fetch_routes)
+                                                 fetch_routes,
+                                                 register_with_retries)
 from mmlspark_tpu.io.serving import ServingServer
 
 
@@ -209,6 +210,125 @@ class TestLatency:
         # socket + dynamic batcher overhead: keep a sane ceiling so
         # regressions (e.g. accidental retrace per request) get caught
         assert p50 < 50.0
+
+
+class TestReRegisterStorm:
+    """ISSUE-13 satellite: `register_with_retries` + the heartbeat-409
+    stand-down under a RE-REGISTER STORM — a worker restarting with the
+    same (machine, partition) identity while its previous incarnation is
+    still beating. Only the single-shot paths were covered before."""
+
+    def test_storm_converges_to_latest_incarnation(self):
+        """20 rapid restarts of one identity, with the ORIGINAL
+        incarnation's beat interleaved after every restart: each beat must
+        answer superseded (never gone — re-registering would collapse the
+        successor), and the table must converge to exactly the newest
+        port."""
+        from mmlspark_tpu.observability import MetricsRegistry
+        coord = ServingCoordinator(registry=MetricsRegistry())
+        old = ServiceInfo("svc", "127.0.0.1", 1000, "m", 0,
+                          heartbeating=True)
+        coord.register(old)
+        assert coord.heartbeat(old) == "ok"
+        last = None
+        for i in range(20):
+            last = ServiceInfo("svc", "127.0.0.1", 2000 + i, "m", 0,
+                               heartbeating=True)
+            coord.register(last)
+            # the displaced incarnation keeps beating mid-storm
+            assert coord.heartbeat(old) == "superseded"
+        routes = coord.routes("svc")
+        assert [s.port for s in routes] == [last.port]
+        # the stood-down incarnation never re-enters; the survivor beats ok
+        assert coord.heartbeat(last) == "ok"
+        assert coord.heartbeat(old) == "superseded"
+
+    def test_live_409_stand_down_then_heal_when_successor_dies(self):
+        """Real workers: B steals A's (machine, partition) identity; A's
+        heartbeat loop must stand down on 409 (routes hold only B, no
+        eviction flap), and when B stops, A's next beat gets 410 and
+        heals by re-registering."""
+        from mmlspark_tpu.observability import MetricsRegistry
+        reg = MetricsRegistry()
+        # a stopped successor is evicted by heartbeat SILENCE: the timeout
+        # must be well inside the heal-wait deadline below
+        coord = ServingCoordinator(registry=reg,
+                                   heartbeat_timeout_s=1.0).start()
+        mk = lambda: DistributedServingServer(  # noqa: E731
+            _double_handler, coord.url, "svc", partition=0, machine="m",
+            port=0, max_latency_ms=1.0, heartbeat_interval_s=0.05,
+            registry=reg).start()
+        a = mk()
+        try:
+            b = mk()
+            try:
+                time.sleep(0.4)   # several beats: A must stand down on 409
+                routes = coord.routes("svc")
+                assert [s.port for s in routes] == [b.port]
+                evictions_mid = coord.stats["evictions"]
+                time.sleep(0.3)   # stability: no A/B eviction flap
+                assert [s.port for s in coord.routes("svc")] == [b.port]
+                assert coord.stats["evictions"] == evictions_mid
+            finally:
+                b.stop()
+            # B gone: A's next beat gets 410 (slot free) and re-registers
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                routes = coord.routes("svc")
+                if [s.port for s in routes] == [a.port]:
+                    break
+                time.sleep(0.05)
+            assert [s.port for s in coord.routes("svc")] == [a.port], \
+                "stood-down worker did not heal after the successor died"
+        finally:
+            a.stop()
+            coord.stop()
+
+    def test_register_with_retries_rides_out_late_coordinator(self):
+        """The registration POST retries through the shared RetryPolicy:
+        a coordinator that comes up ~0.5 s after the worker starts
+        registering must still be reached (bounded retries, backoff)."""
+        import socket as _s
+        import threading as _t
+        sock = _s.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        from mmlspark_tpu.observability import MetricsRegistry
+        holder = {}
+
+        def late_start():
+            time.sleep(0.5)
+            holder["coord"] = ServingCoordinator(
+                port=port, registry=MetricsRegistry()).start()
+
+        t = _t.Thread(target=late_start, daemon=True)
+        t.start()
+        try:
+            register_with_retries(
+                f"http://127.0.0.1:{port}",
+                ServiceInfo("svc", "127.0.0.1", 4321, "m-late", 0),
+                retries=20, delay_s=0.1)
+            t.join(5)
+            assert [s.port for s in holder["coord"].routes("svc")] == [4321]
+        finally:
+            t.join(5)
+            if "coord" in holder:
+                holder["coord"].stop()
+
+    def test_register_with_retries_bounded_failure(self):
+        """No coordinator ever: the retry loop must give up with a
+        ConnectionError after its bounded attempts, not hang."""
+        import socket as _s
+        sock = _s.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        with pytest.raises(ConnectionError, match="could not register"):
+            register_with_retries(
+                f"http://127.0.0.1:{port}",
+                ServiceInfo("svc", "127.0.0.1", 4321, "m", 0),
+                retries=3, delay_s=0.02)
 
 
 class TestFailover:

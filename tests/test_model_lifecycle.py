@@ -1,0 +1,901 @@
+"""Model lifecycle: registry, hot swap, health-gated rollout, autoscaler.
+
+ISSUE-13 coverage:
+- `ModelRegistry` (io/registry.py): digest-verified versioned manifests,
+  atomic publish, keep-last-K retention that never evicts a pinned
+  version, CURRENT/CANARY pointers, golden-reply digests, and the
+  compiled -> exported -> fresh-JIT AOT resolver reused from
+  compile/aot.py on a version directory;
+- `ServingServer.hot_swap`: load/warm/digest-probe on a background thread
+  while the old handler serves, atomic flip between batches, every
+  failure a counted rollback with replies BIT-IDENTICAL to pre-swap
+  (the digest gate, tests/test_serving_dataplane.py style);
+- the AST lint: `self.handler` may only be mutated via the designated
+  `_install_handler` helper in io/serving.py (same posture as the
+  backoff-loop / sync-point / atomic-write / cached-jit lints);
+- BufferPool key eviction (clear-on-swap + LRU bound on distinct keys +
+  pooled-bytes accounting);
+- the coordinator rollout state machine (canary -> promoting -> done,
+  with rollback on swap failure / error-rate breach / canary loss /
+  timeout), driven deterministically through direct heartbeat calls and
+  end-to-end through real workers;
+- `Autoscaler` hysteresis/cooldown/bounds on an injected clock, and the
+  retire discipline (deregister -> drain -> stop) losing zero requests.
+
+The sustained swap-under-load and autoscaler-ramp acceptance runs are
+`@slow` mini-runs of scripts/measure_serving_load.py; full-length
+numbers live in docs/SERVING_swap.json / docs/SERVING_autoscale.json.
+"""
+
+import ast
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.io import rowcodec
+from mmlspark_tpu.io.autoscale import Autoscaler
+from mmlspark_tpu.io.distributed_serving import (DistributedServingServer,
+                                                 ROLLOUT_STATES,
+                                                 ServiceInfo,
+                                                 ServingCoordinator)
+from mmlspark_tpu.io.registry import (ModelRegistry, RegistryError,
+                                      RegistryModelSource,
+                                      golden_reply_digest,
+                                      load_aot_callable)
+from mmlspark_tpu.io.serving import ServingServer
+from mmlspark_tpu.observability import MetricsRegistry
+from mmlspark_tpu.resilience.chaos import TrainingFaultInjector
+
+FEATURES = 4
+
+
+def _weights(scale=1.0):
+    return (np.arange(FEATURES, dtype=np.float32) + 1.0) * scale
+
+
+def _linear_handler(w):
+    def handler(df):
+        x = np.asarray(df["features"], np.float32)
+        return df.with_column("prediction", (x @ w).astype(np.float32))
+    return handler
+
+
+def _loader(vdir, manifest):
+    with open(os.path.join(vdir, "weights.bin"), "rb") as fh:
+        w = np.frombuffer(fh.read(), np.float32).copy()
+    return _linear_handler(w)
+
+
+def _golden():
+    return rowcodec.encode("features", np.ones((1, FEATURES), np.float32))
+
+
+def _publish(reg, w, **kw):
+    return reg.publish(
+        {"weights.bin": np.asarray(w, np.float32).tobytes()},
+        golden_body=_golden(),
+        golden_reply_sha256=golden_reply_digest(_linear_handler(w),
+                                                _golden()), **kw)
+
+
+# ------------------------------------------------------------- registry
+
+class TestModelRegistry:
+    def test_publish_verify_resolve_roundtrip(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path), keep_last=4)
+        v1 = _publish(reg, _weights(), set_current=True)
+        assert reg.versions() == [v1]
+        assert reg.current() == v1
+        ok, reason = reg.verify(v1)
+        assert ok, reason
+        vdir, man = reg.resolve(v1)
+        assert man["version"] == v1
+        assert "weights.bin" in man["files"]
+        handler = _loader(vdir, man)
+        body, expected, col = reg.golden(v1)
+        assert golden_reply_digest(handler, body, col) == expected
+
+    def test_corrupt_payload_fails_digest_and_counts(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        v = _publish(reg, _weights())
+        TrainingFaultInjector.corrupt_version_payload(reg, v, mode="flip")
+        ok, reason = reg.verify(v)
+        assert (ok, reason) == (False, "digest_mismatch")
+        with pytest.raises(RegistryError, match="digest_mismatch"):
+            reg.resolve(v)
+
+    def test_truncated_payload_fails_digest(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        v = _publish(reg, _weights())
+        TrainingFaultInjector.corrupt_version_payload(reg, v,
+                                                      mode="truncate")
+        assert reg.verify(v) == (False, "digest_mismatch")
+
+    def test_retention_never_evicts_pinned(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path), keep_last=2)
+        v1 = _publish(reg, _weights(1), set_current=True)
+        for k in range(2, 6):
+            _publish(reg, _weights(k))
+        vs = reg.versions()
+        # last 2 survive retention; v1 survives because CURRENT pins it
+        assert v1 in vs and vs[-2:] == [4, 5] and len(vs) == 3
+        assert reg.verify(v1)[0]
+
+    def test_pointers(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        v1, v2 = _publish(reg, _weights(1)), _publish(reg, _weights(2))
+        assert reg.current() is None
+        reg.set_current(v1)
+        reg.set_canary(v2)
+        assert (reg.current(), reg.canary()) == (v1, v2)
+        reg.set_canary(None)
+        assert reg.canary() is None
+        with pytest.raises(RegistryError):
+            reg.set_current(99)
+
+    def test_keep_last_must_allow_rollback(self, tmp_path):
+        with pytest.raises(ValueError):
+            ModelRegistry(str(tmp_path), keep_last=1)
+
+    def test_publish_needs_exactly_one_source(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        with pytest.raises(ValueError):
+            reg.publish()
+        with pytest.raises(ValueError):
+            reg.publish({}, source_dir=str(tmp_path))
+
+    def test_model_source_describe_and_current(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        v1 = _publish(reg, _weights(), set_current=True)
+        src = RegistryModelSource(str(tmp_path), _loader)
+        assert src.current_version() == v1
+        handler, v = src.load_current()
+        assert v == v1
+        load_fn, golden, expected = src.describe(v1)
+        assert golden_reply_digest(load_fn(), golden) == expected
+
+
+class TestRegistryAOT:
+    def test_version_dir_is_an_aot_store(self, tmp_path):
+        """An AOT-backed version: the payload directory IS an AOTStore and
+        `load_aot_callable` resolves it through the PR 11 compiled ->
+        exported -> fresh-JIT chain; the resolved callable is digest-
+        identical to the fresh JIT."""
+        import jax
+        import jax.numpy as jnp
+        from jax import export as jax_export
+        from mmlspark_tpu.compile.aot import AOTStore
+
+        w = jnp.asarray(_weights())
+
+        @jax.jit
+        def score(x):
+            return x @ w
+
+        spec = jax.ShapeDtypeStruct((2, FEATURES), jnp.float32)
+        store_dir = str(tmp_path / "aotsrc")
+        AOTStore(store_dir).save("score", jax_export.export(score)(spec))
+        reg = ModelRegistry(str(tmp_path / "registry"))
+        v = reg.publish(source_dir=store_dir, set_current=True)
+        vdir, man = reg.resolve(v)
+        x = np.ones((2, FEATURES), np.float32)
+        fn = load_aot_callable(vdir, "score", (x,))
+        assert fn is not None, "AOT entry did not resolve"
+        np.testing.assert_array_equal(np.asarray(fn(x)),
+                                      np.asarray(score(x)))
+
+
+# ---------------------------------------------------- buffer-pool bounds
+
+class TestBufferPoolKeyEviction:
+    def test_lru_key_bound_and_byte_accounting(self):
+        pool = rowcodec.BufferPool(max_per_key=2, max_keys=2)
+        for i, shape in enumerate([(4, 4), (8, 4), (16, 4)]):
+            pool.release(np.empty(shape, np.float32))
+        # 3 distinct keys released into a 2-key pool: oldest evicted
+        assert pool.key_count == 2
+        assert pool.key_evictions == 1
+        # the evicted key was (4,4): acquiring it misses
+        pool.acquire(np.float32, (4, 4))
+        assert pool.hits == 0 and pool.misses == 1
+        assert pool.pooled_bytes == (8 * 4 + 16 * 4) * 4
+
+    def test_lru_touch_order(self):
+        pool = rowcodec.BufferPool(max_per_key=2, max_keys=2)
+        a = np.empty((4, 4), np.float32)
+        b = np.empty((8, 4), np.float32)
+        pool.release(a)
+        pool.release(b)
+        # touch (4,4) so (8,4) becomes the LRU key
+        pool.release(np.empty((4, 4), np.float32))
+        pool.release(np.empty((2, 2), np.float32))   # evicts (8,4)
+        assert pool.acquire(np.float32, (4, 4)) is not None
+        assert pool.hits == 1
+        pool.acquire(np.float32, (8, 4))
+        assert pool.misses == 1
+
+    def test_clear_empties_everything(self):
+        pool = rowcodec.BufferPool()
+        pool.release(np.empty((4, 4), np.float32))
+        assert pool.pooled_bytes > 0
+        pool.clear()
+        assert pool.pooled_bytes == 0 and pool.key_count == 0
+
+    def test_max_per_key_still_enforced(self):
+        pool = rowcodec.BufferPool(max_per_key=2, max_keys=4)
+        for _ in range(5):
+            pool.release(np.empty((4, 4), np.float32))
+        assert pool.pooled_bytes == 2 * 4 * 4 * 4
+
+
+# ------------------------------------------------------------- hot swap
+
+def _post(url, body):
+    req = urllib.request.Request(url, data=body)
+    with urllib.request.urlopen(req, timeout=10.0) as r:
+        return r.status, r.read()
+
+
+class TestHotSwap:
+    def _server(self, w, registry=None, **kw):
+        return ServingServer(_linear_handler(w), port=0,
+                             max_latency_ms=1.0,
+                             registry=registry or MetricsRegistry(),
+                             model_version=1, **kw).start()
+
+    def test_swap_under_traffic_no_torn_replies(self):
+        """Continuous posting during a swap: every reply is 200 and every
+        payload is exactly v1's or v2's output — nothing in between."""
+        w1, w2 = _weights(1), _weights(2)
+        srv = self._server(w1)
+        body = rowcodec.encode("features",
+                               np.ones((1, FEATURES), np.float32))
+        exp = {float(np.ones(FEATURES, np.float32) @ w1),
+               float(np.ones(FEATURES, np.float32) @ w2)}
+        results = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                status, payload = _post(srv.url, body)
+                results.append((status, payload))
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.1)
+            res = srv.hot_swap(lambda: _linear_handler(w2), 2, wait_s=10)
+            assert res.outcome == "success"
+            time.sleep(0.1)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5)
+            srv.stop()
+        assert len(results) > 10
+        seen = set()
+        for status, payload in results:
+            assert status == 200
+            _, preds = rowcodec.decode(payload)
+            val = float(preds[0])
+            assert any(abs(val - e) < 1e-4 for e in exp), \
+                f"torn reply {val}: not v1's nor v2's output"
+            seen.add(min(exp, key=lambda e: abs(val - e)))
+        assert len(seen) == 2, "swap never flipped the replies"
+        assert srv.model_version == 2
+
+    def test_rollback_on_digest_mismatch_is_bit_identical(self, tmp_path):
+        """The digest gate: a handler whose golden reply does not hash to
+        the published digest must NOT take over, and post-rollback replies
+        are bit-identical to pre-swap replies."""
+        w1 = _weights(1)
+        reg = MetricsRegistry()
+        srv = self._server(w1, registry=reg)
+        try:
+            body = rowcodec.encode(
+                "features", np.ones((2, FEATURES), np.float32))
+            _, before = _post(srv.url, body)
+            golden = _golden()
+            expected = golden_reply_digest(_linear_handler(w1), golden)
+            res = srv.hot_swap(lambda: _linear_handler(_weights(3)), 2,
+                               golden_body=golden,
+                               expected_reply_sha256=expected, wait_s=10)
+            assert res.outcome == "rollback_digest"
+            assert srv.model_version == 1
+            _, after = _post(srv.url, body)
+            assert hashlib.sha256(before).hexdigest() == \
+                hashlib.sha256(after).hexdigest(), \
+                "post-rollback replies differ from pre-swap replies"
+            assert srv.last_swap["outcome"] == "rollback_digest"
+        finally:
+            srv.stop()
+
+    def test_rollback_on_load_and_warm_failure(self):
+        srv = self._server(_weights(1))
+        try:
+            res = srv.hot_swap(
+                lambda: (_ for _ in ()).throw(IOError("artifact gone")),
+                5, wait_s=10)
+            assert res.outcome == "rollback_load"
+
+            def bad_handler(df):
+                raise RuntimeError("model cannot run")
+            res = srv.hot_swap(lambda: bad_handler, 6,
+                               golden_body=_golden(), wait_s=10)
+            assert res.outcome == "rollback_warm"
+            assert srv.model_version == 1
+            # outcomes are counted into the metric family
+            snap = srv.registry.snapshot()["serving_swap_events_total"]
+            outcomes = {dict(s["labels"])["outcome"]: s["value"]
+                        for s in snap["series"]}
+            assert outcomes.get("rollback_load") == 1
+            assert outcomes.get("rollback_warm") == 1
+        finally:
+            srv.stop()
+
+    def test_concurrent_swap_rejected(self):
+        srv = self._server(_weights(1))
+        try:
+            gate = threading.Event()
+
+            def slow_load():
+                gate.wait(5)
+                return _linear_handler(_weights(2))
+
+            first = srv.hot_swap(slow_load, 2)
+            second = srv.hot_swap(lambda: _linear_handler(_weights(3)), 3,
+                                  wait_s=5)
+            assert second.outcome == "rejected"
+            gate.set()
+            first.done.wait(5)
+            assert first.outcome == "success"
+            assert srv.model_version == 2
+        finally:
+            srv.stop()
+
+    def test_swap_clears_buffer_pool(self):
+        srv = self._server(_weights(1))
+        try:
+            srv.pool.release(np.empty((64, FEATURES), np.float32))
+            assert srv.pool.pooled_bytes > 0
+            res = srv.hot_swap(lambda: _linear_handler(_weights(2)), 2,
+                               wait_s=10)
+            assert res.outcome == "success"
+            assert srv.pool.pooled_bytes == 0, \
+                "old-shape staging buffers survived the swap"
+        finally:
+            srv.stop()
+
+    def test_health_reports_lifecycle(self):
+        srv = self._server(_weights(1))
+        try:
+            h = srv.health()
+            assert h["model_version"] == 1
+            assert h["swap_state"] == "idle"
+            srv.hot_swap(lambda: _linear_handler(_weights(2)), 7,
+                         wait_s=10)
+            h = srv.health()
+            assert h["model_version"] == 7
+            assert h["last_swap"]["outcome"] == "success"
+        finally:
+            srv.stop()
+
+
+# ----------------------------------------------------- handler-swap lint
+
+class TestHandlerSwapLint:
+    """`self.handler` may only be mutated inside the designated swap
+    helper (`_install_handler`) in io/serving.py — the structural
+    guarantee behind "no in-flight request ever sees a torn swap". Same
+    CI-enforced posture as the backoff-loop / sync-point / atomic-write /
+    cached-jit lints."""
+
+    ALLOWED = {"_install_handler"}
+
+    @classmethod
+    def _offenders(cls, src: str):
+        tree = ast.parse(src)
+        lines = src.split("\n")
+        excluded = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name in cls.ALLOWED:
+                excluded.update(range(node.lineno, node.end_lineno + 1))
+        out = []
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "handler" \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and node.lineno not in excluded:
+                    out.append(f"{node.lineno}: "
+                               f"{lines[node.lineno - 1].strip()}")
+        return out
+
+    def test_no_handler_mutation_outside_swap_helper(self):
+        import mmlspark_tpu.io.serving as serving
+        src = open(serving.__file__, encoding="utf-8").read()
+        offenders = self._offenders(src)
+        assert not offenders, (
+            "self.handler mutated outside _install_handler (the swap "
+            "helper is the ONE designated mutation point — an in-flight "
+            "batch must never observe a torn swap):\n"
+            + "\n".join(offenders))
+
+    def test_lint_catches_planted_offenders(self):
+        probe = ("class S:\n"
+                 "    def __init__(self, h):\n"
+                 "        self.handler = h\n"
+                 "    def _install_handler(self, h):\n"
+                 "        self.handler = h\n"
+                 "    def sneaky(self, h):\n"
+                 "        self.handler = h\n"
+                 "        self.handler: object = h\n"
+                 "        other.handler = h\n")
+        offenders = self._offenders(probe)
+        assert len(offenders) == 3, offenders
+
+
+# ------------------------------------------------- rollout state machine
+
+def _report(mv=1, requests=0, errors=0, p99=None, swap_version=None,
+            swap_outcome=None):
+    return {"model_version": mv, "requests_total": requests,
+            "errors_total": errors, "p99_ms": p99,
+            "swap_version": swap_version, "swap_outcome": swap_outcome,
+            "swap_state": "idle"}
+
+
+class TestRolloutStateMachine:
+    """Deterministic direct-drive: register ServiceInfos and feed
+    heartbeat reports by hand — no sockets, no sleeps."""
+
+    def _coord(self, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("canary_beats", 2)
+        return ServingCoordinator(**kw)
+
+    def _fleet(self, coord, n=2):
+        infos = [ServiceInfo("svc", "127.0.0.1", 1000 + i, "m", i,
+                             heartbeating=True) for i in range(n)]
+        for info in infos:
+            coord.register(info)
+            coord.heartbeat(info, report=_report(mv=1))
+        return infos
+
+    def test_canary_promote_done(self):
+        coord = self._coord()
+        a, b = self._fleet(coord)
+        ro = coord.start_rollout("svc", 2)
+        assert ro["state"] == "canary"
+        assert ro["previous"] == 1
+        assert ro["canary"] == [a.host, a.port]   # lowest (machine, part)
+        # canary phase: only the canary is targeted; the other worker is
+        # pinned to previous
+        assert coord.heartbeat_target(a) == 2
+        assert coord.heartbeat_target(b) == 1
+        coord.heartbeat(a, report=_report(mv=2, requests=50))
+        assert coord.rollout_status("svc")["state"] == "canary"
+        coord.heartbeat(a, report=_report(mv=2, requests=90))
+        assert coord.rollout_status("svc")["state"] == "promoting"
+        assert coord.heartbeat_target(b) == 2
+        coord.heartbeat(b, report=_report(mv=2))
+        assert coord.rollout_status("svc")["state"] == "done"
+        # terminal state keeps the target pinned for late joiners
+        assert coord.heartbeat_target(a) == 2
+
+    def test_rollback_on_swap_failure(self):
+        coord = self._coord()
+        a, b = self._fleet(coord)
+        coord.start_rollout("svc", 2)
+        coord.heartbeat(a, report=_report(mv=1, swap_version=2,
+                                          swap_outcome="rollback_load"))
+        ro = coord.rollout_status("svc")
+        assert ro["state"] == "rolled_back"
+        assert "rollback_load" in ro["reason"]
+        # both workers re-target the previous version
+        assert coord.heartbeat_target(a) == 1
+        assert coord.heartbeat_target(b) == 1
+
+    def test_rollback_on_error_rate_breach(self):
+        coord = self._coord(canary_max_error_rate=0.05,
+                            canary_min_requests=20)
+        a, b = self._fleet(coord)
+        # baseline: 100 requests, 0 errors
+        coord.heartbeat(a, report=_report(mv=1, requests=100, errors=0))
+        coord.start_rollout("svc", 2)
+        # healthy beat first (below min_requests: not judged yet)
+        coord.heartbeat(a, report=_report(mv=2, requests=110, errors=1))
+        assert coord.rollout_status("svc")["state"] == "canary"
+        # 100 more requests, 50 errors: 50% >> 5% -> rollback
+        coord.heartbeat(a, report=_report(mv=2, requests=200, errors=50))
+        ro = coord.rollout_status("svc")
+        assert ro["state"] == "rolled_back"
+        assert "error_rate" in ro["reason"]
+
+    def test_rollback_on_p99_regression(self):
+        coord = self._coord(canary_max_p99_factor=3.0,
+                            canary_p99_floor_ms=5.0)
+        a, b = self._fleet(coord)
+        coord.heartbeat(a, report=_report(mv=1, p99=4.0))
+        coord.start_rollout("svc", 2)
+        # 4ms -> 40ms (10x, above floor) -> rollback
+        coord.heartbeat(a, report=_report(mv=2, p99=40.0))
+        ro = coord.rollout_status("svc")
+        assert ro["state"] == "rolled_back"
+        assert "p99" in ro["reason"]
+
+    def test_rollback_on_canary_loss_with_hysteresis(self):
+        coord = self._coord()
+        a, b = self._fleet(coord)
+        coord.start_rollout("svc", 2)
+        coord.deregister("svc", a)
+        coord.rollout_tick()
+        coord.rollout_tick()
+        # two ticks of absence: still within the transient-eviction grace
+        assert coord.rollout_status("svc")["state"] == "canary"
+        coord.rollout_tick()
+        ro = coord.rollout_status("svc")
+        assert ro["state"] == "rolled_back"
+        assert "lost" in ro["reason"]
+
+    def test_transient_canary_eviction_heals(self):
+        coord = self._coord()
+        a, b = self._fleet(coord)
+        coord.start_rollout("svc", 2)
+        coord.deregister("svc", a)
+        coord.rollout_tick()
+        coord.register(a)          # the 410-heal re-registration
+        coord.rollout_tick()
+        coord.rollout_tick()
+        assert coord.rollout_status("svc")["state"] == "canary"
+
+    def test_rollback_on_timeout(self):
+        coord = self._coord(rollout_timeout_s=0.0)
+        self._fleet(coord)
+        coord.start_rollout("svc", 2)
+        time.sleep(0.01)
+        coord.rollout_tick()
+        ro = coord.rollout_status("svc")
+        assert ro["state"] == "rolled_back"
+        assert "timeout" in ro["reason"]
+
+    def test_double_rollout_rejected_and_state_gauge(self):
+        coord = self._coord()
+        self._fleet(coord)
+        coord.start_rollout("svc", 2)
+        with pytest.raises(ValueError, match="already active"):
+            coord.start_rollout("svc", 3)
+        g = coord.registry.snapshot()["gateway_rollout_state"]
+        assert g["series"][0]["value"] == ROLLOUT_STATES.index("canary")
+
+    def test_rollout_needs_workers(self):
+        coord = self._coord()
+        with pytest.raises(ValueError, match="no workers"):
+            coord.start_rollout("ghost", 2)
+
+    def test_canary_restart_same_identity_mid_rollout(self):
+        """Satellite: a worker restarting with the SAME (machine,
+        partition) identity mid-rollout. The new incarnation replaces the
+        canary's routing entry (different port), so the canary endpoint
+        is gone — the rollout must roll back cleanly, and the successor
+        must end on the rollback target, never crash or flap."""
+        coord = self._coord()
+        a, b = self._fleet(coord)
+        coord.start_rollout("svc", 2)
+        # restart: same (machine, partition) as the canary, new port
+        a2 = ServiceInfo("svc", "127.0.0.1", 2000, "m", 0,
+                         heartbeating=True)
+        coord.register(a2)
+        # the OLD incarnation's beat must stand down (409), not re-register
+        assert coord.heartbeat(a, report=_report(mv=1)) == "superseded"
+        for _ in range(3):
+            coord.rollout_tick()
+        ro = coord.rollout_status("svc")
+        assert ro["state"] == "rolled_back"
+        # the successor is routable and targeted at the rollback version
+        assert {s.port for s in coord.routes("svc")} == {2000, b.port}
+        assert coord.heartbeat_target(a2) == 1
+
+
+# ----------------------------------------------- end-to-end worker swap
+
+class TestEndToEndRollout:
+    """Real coordinator + two in-process registry-backed workers: the
+    full heartbeat-actuated canary -> promote path, then a corrupt-version
+    rollout that auto-rolls back with bit-identical replies."""
+
+    @pytest.fixture()
+    def fleet(self, tmp_path):
+        reg_dir = str(tmp_path / "registry")
+        registry = ModelRegistry(reg_dir)
+        v1 = _publish(registry, _weights(1), set_current=True)
+        mreg = MetricsRegistry()
+        coord = ServingCoordinator(registry=mreg, canary_beats=2,
+                                   rollout_timeout_s=20.0,
+                                   heartbeat_timeout_s=5.0).start()
+        workers = [DistributedServingServer(
+            None, coord.url, "svc", partition=p, machine=f"m{p}", port=0,
+            max_latency_ms=1.0, heartbeat_interval_s=0.05,
+            model_source=RegistryModelSource(reg_dir, _loader),
+            registry=mreg).start() for p in range(2)]
+        yield registry, coord, workers, v1
+        for w in workers:
+            w.stop()
+        coord.stop()
+
+    def _wait_state(self, coord, want, timeout=15.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            ro = coord.rollout_status("svc") or {}
+            if ro.get("state") in want:
+                return ro
+            time.sleep(0.02)
+        raise AssertionError(
+            f"rollout never reached {want}: {coord.rollout_status('svc')}")
+
+    def test_rollout_then_corrupt_rollback_digest_identical(self, fleet):
+        registry, coord, workers, v1 = fleet
+        body = rowcodec.encode("features",
+                               np.ones((2, FEATURES), np.float32))
+        url = coord.url + "/gateway/svc"
+        assert _post(url, body)[0] == 200
+
+        # --- healthy rollout: v2 promotes fleet-wide
+        v2 = _publish(registry, _weights(2))
+        coord.start_rollout("svc", v2, previous=v1)
+        ro = self._wait_state(coord, ("done", "rolled_back"))
+        assert ro["state"] == "done", ro
+        deadline = time.time() + 5
+        while time.time() < deadline and not all(
+                w.model_version == v2 for w in workers):
+            time.sleep(0.02)
+        assert [w.model_version for w in workers] == [v2, v2]
+        _, v2_reply = _post(url, body)
+        exp2 = float(np.ones(FEATURES, np.float32) @ _weights(2))
+        assert abs(float(rowcodec.decode(v2_reply)[1][0]) - exp2) < 1e-4
+
+        # --- corrupt rollout: digest gate fails the canary swap,
+        # the fleet rolls back, replies stay bit-identical to v2's
+        v3 = _publish(registry, _weights(5))
+        TrainingFaultInjector.corrupt_version_payload(registry, v3)
+        coord.start_rollout("svc", v3, previous=v2)
+        ro = self._wait_state(coord, ("done", "rolled_back"))
+        assert ro["state"] == "rolled_back", ro
+        assert "rollback_load" in ro["reason"]
+        assert all(w.model_version == v2 for w in workers)
+        _, after = _post(url, body)
+        assert hashlib.sha256(after).hexdigest() == \
+            hashlib.sha256(v2_reply).hexdigest(), \
+            "post-rollback replies differ from pre-swap version"
+        # health surfaces the story
+        h = coord.health()
+        assert h["rollouts"]["svc"]["state"] == "rolled_back"
+        assert all(m["model_version"] == v2
+                   for m in h["worker_models"].values())
+
+
+# ------------------------------------------------------------ autoscaler
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestAutoscaler:
+    def _scaler(self, depths, **kw):
+        """Autoscaler over a mutable signal list + recording actuators."""
+        spawned, retired = [], []
+
+        def spawn():
+            handle = f"w{len(spawned)}"
+            spawned.append(handle)
+            depths.append(0.0)
+            return handle
+
+        def retire(handle):
+            retired.append(handle)
+            depths.pop()
+
+        clock = FakeClock()
+        kw.setdefault("min_workers", 2)
+        kw.setdefault("max_workers", 4)
+        kw.setdefault("high_queue_depth", 10.0)
+        kw.setdefault("low_queue_depth", 1.0)
+        kw.setdefault("up_after", 2)
+        kw.setdefault("down_after", 3)
+        kw.setdefault("cooldown_s", 5.0)
+        kw.setdefault("ewma_alpha", 1.0)   # raw signal: deterministic
+        scaler = Autoscaler(lambda: list(depths), spawn, retire,
+                            clock=clock, registry=MetricsRegistry(), **kw)
+        return scaler, clock, spawned, retired
+
+    def test_hysteresis_single_blip_does_not_scale(self):
+        depths = [20.0, 20.0]
+        scaler, clock, spawned, _ = self._scaler(depths)
+        assert scaler.tick() is None            # hot streak 1
+        depths[:] = [5.0, 5.0]                  # blip over: in-band
+        assert scaler.tick() is None            # streak reset
+        depths[:] = [20.0, 20.0]
+        assert scaler.tick() is None
+        assert scaler.tick() == "scale_up"      # 2 consecutive
+        assert spawned == ["w0"]
+
+    def test_cooldown_blocks_second_action(self):
+        depths = [20.0, 20.0]
+        scaler, clock, spawned, _ = self._scaler(depths)
+        scaler.tick()
+        assert scaler.tick() == "scale_up"
+        # still hot, but inside the cooldown window
+        assert scaler.tick() is None
+        assert scaler.tick() is None
+        clock.t = 6.0
+        # cooldown expired and the hot streak persisted: fires immediately
+        assert scaler.tick() == "scale_up"
+        assert len(spawned) == 2
+
+    def test_max_workers_bound(self):
+        depths = [20.0] * 4
+        scaler, clock, spawned, _ = self._scaler(depths)
+        for _ in range(6):
+            scaler.tick()
+            clock.t += 10
+        assert spawned == []   # already at max: never scales past it
+
+    def test_scale_down_only_own_workers_and_min_bound(self):
+        depths = [0.0, 0.0]
+        scaler, clock, spawned, retired = self._scaler(depths)
+        # nothing spawned: scale-down may not touch the base fleet
+        for _ in range(5):
+            assert scaler.tick() is None
+        # spawn one via load, then cool off and drain
+        depths[:] = [20.0, 20.0]
+        scaler.tick()
+        scaler.tick()
+        assert len(spawned) == 1
+        clock.t = 10.0
+        depths[:] = [0.0, 0.0, 0.0]
+        for _ in range(2):
+            assert scaler.tick() is None        # cold streak building
+        assert scaler.tick() == "scale_down"    # down_after=3
+        assert retired == ["w0"]
+        # back at the base fleet: cold forever, but nothing left to retire
+        clock.t = 30.0
+        for _ in range(5):
+            assert scaler.tick() is None
+
+    def test_ewma_smooths_spikes(self):
+        depths = [40.0, 40.0]
+        scaler, clock, _, _ = self._scaler(depths, ewma_alpha=0.5,
+                                           high_queue_depth=30.0)
+        scaler.tick()                        # smoothed = 40? no: first
+        assert scaler.smoothed_depth == 40.0  # first sample seeds
+        depths[:] = [0.0, 0.0]
+        scaler.tick()
+        assert scaler.smoothed_depth == 20.0
+        scaler.tick()
+        assert scaler.smoothed_depth == 10.0
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError):
+            Autoscaler(lambda: [], lambda: None, lambda h: None,
+                       min_workers=0, registry=MetricsRegistry())
+        with pytest.raises(ValueError):
+            Autoscaler(lambda: [], lambda: None, lambda h: None,
+                       low_queue_depth=5, high_queue_depth=5,
+                       registry=MetricsRegistry())
+
+    def test_retire_discipline_loses_no_requests(self):
+        """deregister -> drain -> stop with live traffic: every posted
+        request is answered, the worker leaves the routing table, and the
+        heartbeat does NOT re-register it (no 410-heal on retirement)."""
+        mreg = MetricsRegistry()
+        coord = ServingCoordinator(registry=mreg,
+                                   heartbeat_timeout_s=5.0).start()
+        workers = [DistributedServingServer(
+            _linear_handler(_weights()), coord.url, "svc", partition=p,
+            machine=f"m{p}", port=0, max_latency_ms=1.0,
+            heartbeat_interval_s=0.05, registry=mreg).start()
+            for p in range(2)]
+        body = rowcodec.encode("features",
+                               np.ones((1, FEATURES), np.float32))
+        url = coord.url + "/gateway/svc"
+        statuses = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                statuses.append(_post(url, body)[0])
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.1)
+            assert workers[1].retire(drain_timeout_s=10.0)
+            time.sleep(0.3)   # several beat intervals: no re-register
+            assert [s.partition for s in coord.routes("svc")] == [0]
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5)
+            workers[0].stop()
+            coord.stop()
+        assert len(statuses) > 10
+        assert set(statuses) == {200}, \
+            f"requests lost/failed during retire-drain: {set(statuses)}"
+
+
+# ------------------------------------------------------- slow mini-runs
+
+@pytest.mark.slow
+def test_swap_harness_mini_run(tmp_path):
+    """End-to-end mini run of the swap-under-load harness (baseline +
+    chaos): rollout completes / auto-rolls back with zero accepted-request
+    loss. Full-length numbers: docs/SERVING_swap.json, docs/SERVING.md."""
+    out = tmp_path / "swap.json"
+    env = {**os.environ, "MEASURE_LOAD_S": "9",
+           "MEASURE_LOAD_WORKERS": "2", "MEASURE_LOAD_CLIENTS": "6",
+           "JAX_PLATFORMS": "cpu"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "scripts/measure_serving_load.py",
+         "--scenario", "swap", "--out", str(out)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    variants = {v["variant"]: v for v in rec["variants"]}
+    assert set(variants) == {"swap", "swap_chaos"}
+    assert variants["swap"]["rollout_final_state"] == "done"
+    assert variants["swap"]["shed"] == 0
+    assert variants["swap_chaos"]["rollout_final_state"] == "rolled_back"
+    for v in variants.values():
+        assert v["bad_payload_on_200"] == 0, v
+        assert v["no_reply_lost"] == 0, v
+        assert v["ok_requests"] > 0
+
+
+@pytest.mark.slow
+def test_autoscale_harness_mini_run(tmp_path):
+    """Mini autoscaler ramp: the fleet grows past 2 and retires back with
+    zero lost requests. The full 2->4->2 acceptance trace is recorded in
+    docs/SERVING_autoscale.json."""
+    out = tmp_path / "autoscale.json"
+    # a 24 s mini ramp reliably produces ONE scale-up + retire; the full
+    # 2->4->2 bar needs the 45 s acceptance ramp (MEASURE_AS_MIN_PEAK
+    # keeps the script's own gate on growth-happened for the mini shape)
+    env = {**os.environ, "MEASURE_LOAD_S": "24",
+           "MEASURE_LOAD_CLIENTS": "24", "MEASURE_AS_MIN_PEAK": "3",
+           "JAX_PLATFORMS": "cpu"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "scripts/measure_serving_load.py",
+         "--scenario", "autoscale", "--out", str(out)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    s = rec["variants"][0]
+    assert s["peak_workers"] >= 3, "fleet never grew under the ramp"
+    assert s["final_workers"] == 2, "fleet did not retire back to base"
+    assert s["bad_payload_on_200"] == 0
+    assert s["no_reply_lost"] == 0
