@@ -62,7 +62,14 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
     # serving-load provenance (ISSUE-12): the most recent sustained-load
     # harness summary (scripts/measure_serving_load.py) rides in the bench
     # record, minus the bulky per-trace exemplars — the bench line then
-    # shows both the fit side AND what the serving data plane sustained
+    # shows both the fit side AND what the serving data plane sustained.
+    # Fleet-observability provenance (ISSUE-14) rides with it: the
+    # harness snapshots every /metrics + /health at the end of each run
+    # (scripts/fleet_status.py) and embeds any incident bundles the
+    # flight recorder dumped; those are LIFTED to extra.fleet /
+    # extra.incidents so the armed chip window captures fleet forensics
+    # in the one driver-captured JSON.
+    _incidents = []
     try:
         _lp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "docs", "SERVING_load.json")
@@ -71,6 +78,10 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
                 _load = json.load(_f)
             for _v in _load.get("variants", []):
                 _v.pop("trace_exemplars", None)
+                _fleet = _v.pop("fleet", None)
+                if _fleet is not None:
+                    extra.setdefault("fleet", _fleet)
+                _incidents.extend(_v.pop("incidents", []) or [])
             extra.setdefault("serving_load", _load)
     except Exception as e:  # noqa: BLE001
         extra.setdefault("serving_load_error", str(e)[:200])
@@ -88,9 +99,15 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
                 for _v in _load.get("variants", []):
                     _v.pop("trace_exemplars", None)
                     _v.pop("fleet_series", None)
+                    _fleet = _v.pop("fleet", None)
+                    if _fleet is not None:
+                        extra.setdefault("fleet", _fleet)
+                    _incidents.extend(_v.pop("incidents", []) or [])
                 extra.setdefault(_name, _load)
         except Exception as e:  # noqa: BLE001
             extra.setdefault(_name + "_error", str(e)[:200])
+    if _incidents:
+        extra.setdefault("incidents", _incidents)
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]

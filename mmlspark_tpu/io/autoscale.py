@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ..observability import get_registry
+from ..observability import EventLog, get_registry, mint_trace_id
 
 __all__ = ["Autoscaler"]
 
@@ -65,7 +65,8 @@ class Autoscaler:
                  cooldown_s: float = 10.0, interval_s: float = 0.5,
                  ewma_alpha: float = 0.5,
                  clock: Callable[[], float] = time.monotonic,
-                 registry=None, metrics_label: Optional[str] = None):
+                 registry=None, metrics_label: Optional[str] = None,
+                 event_log: Optional[EventLog] = None):
         if min_workers < 1 or max_workers < min_workers:
             raise ValueError(f"need 1 <= min_workers <= max_workers, got "
                              f"[{min_workers}, {max_workers}]")
@@ -108,6 +109,12 @@ class Autoscaler:
         self._g_depth = reg.gauge(
             "autoscaler_mean_queue_depth",
             "mean per-worker queue depth at the last tick", lbl)
+        # system-event bridge (ISSUE 14): every scale action lands in an
+        # EventLog the trace collector drains, so autoscale actions show
+        # up in incident bundles beside the swaps/evictions they interact
+        # with. Pass the coordinator's log (Autoscaler.for_service does)
+        # to put them on the ring the fleet collector already polls.
+        self.events = event_log if event_log is not None else EventLog(256)
 
     # ------------------------------------------------------------- decisions
     def tick(self) -> Optional[str]:
@@ -164,6 +171,9 @@ class Autoscaler:
         self.actions.append({"t": now, "action": action,
                              "workers_before": n,
                              "mean_queue_depth": round(mean, 2)})
+        self.events.append("autoscale", mint_trace_id(), action=action,
+                           workers_before=n,
+                           mean_queue_depth=round(mean, 2))
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "Autoscaler":
@@ -196,8 +206,10 @@ class Autoscaler:
                     retire: Callable[[Any], None], **kw) -> "Autoscaler":
         """Signals wired to `coordinator.worker_loads(service)` — the same
         heartbeat-piggybacked queue depths the least-loaded router scores
-        on; nothing new is measured."""
+        on; nothing new is measured. Scale actions land in the
+        COORDINATOR's event log (the ring the fleet collector polls)."""
         def signals() -> List[float]:
             return [v["queue_depth"]
                     for v in coordinator.worker_loads(service).values()]
+        kw.setdefault("event_log", coordinator.events)
         return cls(signals, spawn, retire, **kw)

@@ -77,12 +77,13 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from ..observability import (EventLog, TRACE_HEADER, get_registry,
-                             mint_trace_id, trace_id_from_headers)
+from ..observability import (EventLog, SLOMonitor, TRACE_HEADER,
+                             get_registry, mint_trace_id,
+                             trace_id_from_headers)
 from ..resilience import Deadline, RetryError, RetryPolicy
 from . import rowcodec
 from .http import KeepAliveTransport
-from .serving import _INSTANCE_SEQ, ServingServer, SwapResult
+from .serving import _INSTANCE_SEQ, _since_of, ServingServer, SwapResult
 
 #: rollout state machine vocabulary; the index is the
 #: `gateway_rollout_state{service}` gauge value
@@ -242,7 +243,9 @@ class ServingCoordinator:
                  canary_max_error_rate: float = 0.05,
                  canary_min_requests: int = 20,
                  canary_max_p99_factor: float = 3.0,
-                 canary_p99_floor_ms: float = 5.0):
+                 canary_p99_floor_ms: float = 5.0,
+                 slo_monitor: "Optional[SLOMonitor]" = "default",
+                 slo_rollout_gate: bool = False):
         self.host, self.port = host, port
         self.forward_timeout = forward_timeout
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -311,6 +314,22 @@ class ServingCoordinator:
         self.events = event_log if event_log is not None else EventLog()
         self.metrics_label = (metrics_label if metrics_label is not None
                               else f"gateway-{next(_INSTANCE_SEQ)}")
+        # SLO burn-rate monitors (ISSUE 14): dual-window burn over the
+        # gateway's own error/latency families, ticked on the monitor
+        # loop, surfaced in /health and as slo_burn_rate{slo,window}.
+        # Breach events land in THIS coordinator's event log so the
+        # trace collector / flight recorder see them like any other
+        # system event. slo_rollout_gate=True (off by default) also
+        # rolls active rollouts back while an SLO is breached.
+        # slo_monitor: "default" (sentinel) = the stock gateway pair;
+        # None = MONITORING OFF (no per-tick registry sampling).
+        if slo_monitor == "default":
+            self.slo: Optional[SLOMonitor] = SLOMonitor.gateway_defaults(
+                registry=self.registry, event_log=self.events,
+                metrics_label=f"slo-{self.metrics_label}")
+        else:
+            self.slo = slo_monitor
+        self.slo_rollout_gate = bool(slo_rollout_gate)
         lbl = {"instance": self.metrics_label}
         self._m = {
             "forwards": self.registry.counter(
@@ -672,13 +691,23 @@ class ServingCoordinator:
 
     def rollout_tick(self) -> None:
         """Clock-driven rollout checks the beat-driven observer cannot
-        make: overall timeout, and canary loss (killed mid-swap and
-        evicted by the heartbeat monitor). Runs on the monitor loop's
+        make: overall timeout, canary loss (killed mid-swap and evicted
+        by the heartbeat monitor), and — when `slo_rollout_gate` is on —
+        an SLO burning on both windows. Runs on the monitor loop's
         cadence; tests call it directly."""
         now = time.monotonic()
+        slo_breach = (self.slo_rollout_gate and self.slo is not None
+                      and self.slo.breached())
         with self._lock:
             for name, ro in self._rollouts.items():
                 if ro["state"] not in ("canary", "promoting"):
+                    continue
+                if slo_breach:
+                    # the additional (off-by-default) gate: a fleet
+                    # burning its error budget must not keep promoting
+                    self._set_rollout_state_locked(
+                        name, ro, "rolled_back",
+                        "slo burn-rate breach (slo_rollout_gate)")
                     continue
                 if now - ro["started_s"] > self.rollout_timeout_s:
                     self._set_rollout_state_locked(
@@ -791,8 +820,13 @@ class ServingCoordinator:
                             self._reports.pop((name, s.host, s.port), None)
                             self._hb_seen.discard((name, s.host, s.port))
                             self._m["evictions"].inc()
-            # clock-driven rollout checks (timeout, canary eviction) ride
-            # the same monitor cadence
+            # SLO sampling + clock-driven rollout checks (timeout, canary
+            # eviction, optional SLO gate) ride the same monitor cadence
+            if self.slo is not None:
+                try:
+                    self.slo.tick()
+                except Exception:  # noqa: BLE001 - a bad SLO sample must
+                    pass           # not kill eviction monitoring
             self.rollout_tick()
 
     def health(self) -> Dict:
@@ -808,7 +842,9 @@ class ServingCoordinator:
             models = {f"{n}:{h}:{p}": {
                           "model_version": rep.get("model_version"),
                           "swap_state": rep.get("swap_state"),
-                          "swap_outcome": rep.get("swap_outcome")}
+                          "swap_outcome": rep.get("swap_outcome"),
+                          "trace_events_total":
+                              rep.get("trace_events_total")}
                       for (n, h, p), rep in self._reports.items()}
         return {"services": services,
                 "heartbeat_timeout_s": self.heartbeat_timeout_s,
@@ -816,7 +852,25 @@ class ServingCoordinator:
                 "worker_loads": loads,
                 "rollouts": rollouts,
                 "worker_models": models,
+                "slo": (self.slo.status() if self.slo is not None
+                        else None),
                 "stats": dict(self.stats)}
+
+    def trace_payload(self, since: float = 0.0) -> Dict:
+        """GET /trace?since= drain of the gateway's own EventLog (the
+        shared contract — observability.tracing.drain_payload)."""
+        from ..observability.tracing import drain_payload
+        return drain_payload(self.metrics_label, self.events, since)
+
+    def rollouts_status(self) -> Dict[str, Dict]:
+        """Locked snapshot of every rollout record (minus the bulky
+        baselines) — what the flight recorder embeds in bundles; direct
+        iteration of `_rollouts` would race the heartbeat/monitor
+        threads that mutate the records."""
+        with self._lock:
+            return {n: {k: v for k, v in ro.items()
+                        if k not in ("baseline", "swap_base")}
+                    for n, ro in self._rollouts.items()}
 
     # -------------------------------------------------------------- gateway
     def _coalescer(self, name: str) -> "_Coalescer":
@@ -1139,6 +1193,9 @@ class ServingCoordinator:
                                 outer.registry.render_prometheus().encode(),
                                 ctype="text/plain; version=0.0.4; "
                                       "charset=utf-8")
+                elif self.path.startswith("/trace"):
+                    self._reply(200, json.dumps(outer.trace_payload(
+                        _since_of(self.path))).encode())
                 else:
                     self._reply(404, b'{"error": "unknown endpoint"}')
 
@@ -1282,6 +1339,10 @@ class DistributedServingServer(ServingServer):
         d["swap_outcome"] = last.get("outcome")
         d["requests_total"] = int(self._m["requests"].value)
         d["errors_total"] = int(self._m["errors"].value)
+        # span-count piggyback (ISSUE 14): lets the trace collector tell
+        # a quiet ring from one that overflowed between drains, and the
+        # fleet snapshot report per-worker trace volume without a scrape
+        d["trace_events_total"] = self.events.total_appended
         try:
             p99 = self.registry.quantile(
                 "serving_request_latency_seconds", 0.99,
@@ -1338,7 +1399,14 @@ class DistributedServingServer(ServingServer):
         410-heal cannot re-register a retiring worker), DEREGISTER (no
         new routes; in-flight forwards still complete on the live
         sockets), DRAIN every admitted request, then stop — the PR 10
-        deregister -> drain -> stop discipline applied to serving."""
+        deregister -> drain -> stop discipline applied to serving. The
+        retirement is a system event in this worker's ring (drained by
+        the trace collector BEFORE stop() — the collector's poll races
+        the teardown, which is why the event lands first)."""
+        t0 = time.perf_counter()
+        self.events.append("retire", mint_trace_id(),
+                           worker=f"{self.host}:{self.port}",
+                           service=self.service_name, phase="begin")
         self._hb_stop.set()
         try:
             req = urllib.request.Request(
@@ -1350,6 +1418,11 @@ class DistributedServingServer(ServingServer):
         except Exception:  # noqa: BLE001 - coordinator gone: the
             pass           # heartbeat-timeout monitor evicts us anyway
         ok = self.drain(drain_timeout_s)
+        self.events.append("retire", mint_trace_id(),
+                           worker=f"{self.host}:{self.port}",
+                           service=self.service_name, phase="done",
+                           outcome="ok" if ok else "drain_timeout",
+                           dur_s=time.perf_counter() - t0)
         self.stop()
         return ok
 

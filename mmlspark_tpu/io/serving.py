@@ -43,9 +43,11 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
+import math
 import queue
 import threading
 import time
+import urllib.parse
 import uuid as _uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
@@ -56,6 +58,7 @@ from ..core.dataframe import DataFrame
 from ..core.pipeline import Transformer
 from ..observability import (EventLog, TRACE_HEADER, get_registry,
                              mint_trace_id, trace_id_from_headers)
+from ..observability.tracing import drain_payload
 from ..resilience import Deadline
 from . import rowcodec
 
@@ -63,6 +66,22 @@ from . import rowcodec
 #: deterministic per-process instance labels (construction order) so
 #: concurrent servers sharing the global registry never collide
 _INSTANCE_SEQ = itertools.count()
+
+
+def _since_of(path: str) -> float:
+    """`since` cursor of a `GET /trace?since=<ts>` path (0.0 = full ring;
+    a malformed cursor must not 500 the drain — it degrades to a full
+    drain, which the collector dedups by ts anyway). float() parses
+    'nan'/'inf' without raising, and a NaN cursor would make every
+    ts > since comparison False — a PERMANENTLY empty drain masquerading
+    as a quiet ring — so non-finite values degrade like any other
+    malformed cursor."""
+    qs = urllib.parse.urlsplit(path).query
+    try:
+        since = float(urllib.parse.parse_qs(qs).get("since", ["0"])[0])
+    except (TypeError, ValueError):
+        return 0.0
+    return since if math.isfinite(since) else 0.0
 
 
 class _PendingRequest:
@@ -138,14 +157,17 @@ class _PendingRequest:
 def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
                         request_timeout: float, host: str,
                         port: int, health_fn=None,
-                        metrics_fn=None) -> ThreadingHTTPServer:
+                        metrics_fn=None, trace_fn=None
+                        ) -> ThreadingHTTPServer:
     """Shared HTTP front door for ServingServer and HTTPStreamSource: POST
     bodies become _PendingRequests handed to `enqueue`; the socket thread
     blocks on the request's event until a dispatcher/commit sets the reply
     (JVMSharedServer's handler role, DistributedHTTPSource.scala:151-168).
     GET /health serves `health_fn()` as JSON when provided (queue depth +
     dispatcher liveness — the load-balancer probe endpoint); GET /metrics
-    serves `metrics_fn()` as Prometheus text (the scrape endpoint).
+    serves `metrics_fn()` as Prometheus text (the scrape endpoint); GET
+    /trace?since=<ts> serves `trace_fn(since)` as JSON (the EventLog
+    drain the fleet TraceCollector polls — docs/OBSERVABILITY.md).
     Returns the bound (but not yet serving) server; callers start
     `serve_forever` on a daemon thread."""
 
@@ -184,6 +206,9 @@ def _make_http_listener(enqueue: Callable[["_PendingRequest"], None],
             elif self.path == "/metrics" and metrics_fn is not None:
                 body = metrics_fn().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.startswith("/trace") and trace_fn is not None:
+                body = json.dumps(trace_fn(_since_of(self.path))).encode()
+                ctype = "application/json"
             else:
                 self.send_response(404)
                 self.send_header("Content-Length", "0")
@@ -223,11 +248,12 @@ class _AsyncListener:
 
     def __init__(self, enqueue: Callable[["_PendingRequest"], None],
                  request_timeout: float, host: str, port: int,
-                 health_fn=None, metrics_fn=None):
+                 health_fn=None, metrics_fn=None, trace_fn=None):
         self._enqueue = enqueue
         self._timeout = request_timeout
         self._health_fn = health_fn
         self._metrics_fn = metrics_fn
+        self._trace_fn = trace_fn
         self.host, self.port = host, port
         self._loop = None
         self._server = None
@@ -289,13 +315,19 @@ class _AsyncListener:
                 if method == "GET" and (
                         (path == "/health" and self._health_fn is not None)
                         or (path == "/metrics"
-                            and self._metrics_fn is not None)):
+                            and self._metrics_fn is not None)
+                        or (path.startswith("/trace")
+                            and self._trace_fn is not None)):
                     if path == "/health":
                         hb = json.dumps(self._health_fn()).encode()
                         ct = b"application/json"
-                    else:
+                    elif path == "/metrics":
                         hb = self._metrics_fn().encode()
                         ct = b"text/plain; version=0.0.4; charset=utf-8"
+                    else:
+                        hb = json.dumps(
+                            self._trace_fn(_since_of(path))).encode()
+                        ct = b"application/json"
                     writer.write(
                         status_line(200)
                         + b"Content-Type: %s\r\n"
@@ -913,15 +945,23 @@ class ServingServer:
         the two checks), the dispatcher holding no batch, and no reply
         job pending. The retire discipline's middle step (deregister ->
         DRAIN -> stop, the PR 10 drain order applied to serving) —
-        callers stop routing first, so this converges."""
+        callers stop routing first, so this converges. Entry and outcome
+        land as system events in the ring: a drain that TIMED OUT is
+        exactly the kind of fact an incident bundle must carry."""
+        t0 = time.perf_counter()
         deadline = time.monotonic() + timeout_s
+        ok = False
         while time.monotonic() < deadline:
             with self._work_lock:
                 busy = self._dispatching or self._replying
             if not busy and self._queue.unfinished_tasks == 0:
-                return True
+                ok = True
+                break
             time.sleep(0.005)
-        return False
+        self.events.append("drain", mint_trace_id(),
+                           dur_s=time.perf_counter() - t0,
+                           outcome="ok" if ok else "timeout")
+        return ok
 
     # ------------------------------------------------------------ admission
     def _accept(self, pend: _PendingRequest) -> None:
@@ -1019,6 +1059,13 @@ class ServingServer:
         """GET /metrics payload (Prometheus text exposition)."""
         return self.registry.render_prometheus()
 
+    def trace_payload(self, since: float = 0.0) -> Dict[str, Any]:
+        """GET /trace?since= payload: this hop's EventLog drained from
+        the cursor (strictly newer events only) — the one shared drain
+        contract (observability.tracing.drain_payload,
+        docs/OBSERVABILITY.md)."""
+        return drain_payload(self.metrics_label, self.events, since)
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingServer":
         # armed BEFORE the listener accepts: the first reply may land
@@ -1039,14 +1086,16 @@ class ServingServer:
             self._alistener = _AsyncListener(
                 self._accept, self.request_timeout, self.host, self.port,
                 health_fn=self.health,
-                metrics_fn=self.metrics_text).start()
+                metrics_fn=self.metrics_text,
+                trace_fn=self.trace_payload).start()
             self.port = self._alistener.port
         else:
             self._httpd = _make_http_listener(self._accept,
                                               self.request_timeout,
                                               self.host, self.port,
                                               health_fn=self.health,
-                                              metrics_fn=self.metrics_text)
+                                              metrics_fn=self.metrics_text,
+                                              trace_fn=self.trace_payload)
             self.port = self._httpd.server_address[1]  # resolve port 0
             t_http = threading.Thread(target=self._httpd.serve_forever,
                                       daemon=True)

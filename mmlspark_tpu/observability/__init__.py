@@ -15,6 +15,12 @@ production telemetry plane):
 - the profiling bridge — StopWatch / FitTimeline / bring-up probe
   outcomes published into the registry, so fit-side and serving-side
   telemetry land in one scrape.
+- the fleet plane (ISSUE 14) — `TraceCollector` drains every hop's
+  EventLog over `GET /trace?since=` and assembles end-to-end trace
+  trees; `FlightRecorder` dumps atomic incident bundles on anomaly
+  triggers (swap rollback, shed spike, p99/SLO breach); `SLOMonitor`
+  computes fast/slow-window error-budget burn rates surfaced in the
+  coordinator's /health and as `slo_burn_rate{slo,window}` gauges.
 
 Wired into `io/serving.py` (GET /metrics beside /health), the
 `ServingCoordinator` gateway, `DistributedServingServer` workers,
@@ -32,6 +38,9 @@ from .bridge import (classify_probe_outcome, publish_bringup,
                      publish_checkpoint_event, publish_fit_metrics,
                      publish_fit_timeline, publish_multichip_fit,
                      publish_probe_outcome, publish_stopwatch)
+from .collector import REQUEST_SPANS, SYSTEM_SPANS, TraceCollector
+from .flightrecorder import BUNDLE_SCHEMA_VERSION, FlightRecorder
+from .slo import SLODef, SLOMonitor, windowed_quantile
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -40,4 +49,7 @@ __all__ = [
     "classify_probe_outcome", "publish_bringup", "publish_checkpoint_event",
     "publish_fit_metrics", "publish_fit_timeline", "publish_multichip_fit",
     "publish_probe_outcome", "publish_stopwatch",
+    "TraceCollector", "REQUEST_SPANS", "SYSTEM_SPANS",
+    "FlightRecorder", "BUNDLE_SCHEMA_VERSION",
+    "SLODef", "SLOMonitor", "windowed_quantile",
 ]

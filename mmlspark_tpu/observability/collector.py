@@ -1,0 +1,419 @@
+"""Cross-process trace assembly: drain every EventLog, build trace trees.
+
+PR 8 gave every process a bounded EventLog of per-hop spans and PR 12-13
+grew the system into a real fleet — but spans still died inside the
+process that recorded them: "explain this request end to end" meant
+hand-grepping N rings. The reference stack leans on driver-side
+aggregation for exactly this (SURVEY §0 HTTP-on-Spark / Spark Serving:
+the driver owns the routing table AND the aggregate view), and arxiv
+2605.25645's serving-economics argument makes per-request tail-latency
+attribution (host path vs device dispatch) a first-class measurement.
+
+`TraceCollector` is that driver-side aggregator:
+
+- every worker and the gateway expose their ring over `GET
+  /trace?since=<ts>` (io/serving.py, io/distributed_serving.py) — a
+  cursor drain, not a snapshot, so polling is O(new events);
+- the collector pulls all rings (HTTP for remote processes, direct
+  EventLog references in-process) and indexes events by `X-Trace-Id`;
+- `trace(tid)` assembles the end-to-end TREE: gateway `forward_attempt`
+  spans parent the worker's `queue_wait -> batch_assembly ->
+  device_dispatch -> reply` spans for the same trace id (matched by the
+  attempt's `worker` endpoint and time window, with a per-hop
+  clock-skew tolerance since each process stamps its own wall clock);
+- `slowest(k)` / `failed()` answer the two operator questions directly.
+
+Everything is injectable (fetch, clock) so tier-1 tests drive the whole
+assembly against scripted rings with no sockets and no sleeps; the
+polling thread exists for the live fleet (scripts/measure_serving_load,
+scripts/fleet_status).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .metrics import MetricsRegistry, get_registry
+from .tracing import EventLog
+
+__all__ = ["TraceCollector", "REQUEST_SPANS", "SYSTEM_SPANS"]
+
+#: spans that belong to one request's life (worker + gateway hops)
+REQUEST_SPANS = ("queue_wait", "batch_assembly", "device_dispatch",
+                 "reply", "forward_attempt", "shed", "expired")
+
+#: spans recording fleet/system transitions, not requests — the flight
+#: recorder's feed (observability/flightrecorder.py)
+SYSTEM_SPANS = ("swap", "rollout", "retire", "drain", "autoscale", "chaos",
+                "slo")
+
+#: worker span order inside one hop — used when wall clocks tie or skew
+_WORKER_ORDER = {"queue_wait": 0, "batch_assembly": 1,
+                 "device_dispatch": 2, "reply": 3}
+
+
+def _http_fetch(url: str, timeout: float = 5.0) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class _Source:
+    """One ring to drain: either a /trace URL or an in-process EventLog."""
+
+    __slots__ = ("name", "url", "log", "cursor", "role", "endpoint",
+                 "live")
+
+    def __init__(self, name: str, url: Optional[str], log: Optional[EventLog],
+                 role: str, endpoint: Optional[str]):
+        self.name = name
+        self.url = url
+        self.log = log
+        self.role = role            # "gateway" | "worker"
+        #: "host:port" workers are addressed by in gateway forward spans —
+        #: the join key that parents worker spans under the right attempt
+        self.endpoint = endpoint
+        self.cursor = 0.0
+        #: coordinator-managed worker sources are marked dead when they
+        #: leave the routing table (retired/killed): polling a departed
+        #: worker's URL stalls the whole drain loop 5 s per cycle —
+        #: exactly while a shrinking fleet needs the collector most. The
+        #: cursor is KEPT, so a healed re-registration resumes without
+        #: re-ingesting (no duplicate spans)
+        self.live = True
+
+
+class TraceCollector:
+    """Pulls every hop's EventLog and assembles end-to-end trace trees.
+
+    `add_worker` / `add_gateway` register sources by `/trace` URL (remote
+    process) or by EventLog reference (in-process). `poll()` drains each
+    source from its cursor; `trace(tid)` returns the assembled tree;
+    `slowest(k)` / `failed()` / `summaries()` are the query surface.
+    `system_events()` exposes drained SYSTEM_SPANS events (swap, rollout,
+    retire, autoscale, chaos) for the flight recorder.
+
+    Memory is bounded: at most `max_traces` traces are retained (LRU by
+    last-event time) and at most `max_events_per_trace` events per trace.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 skew_tolerance_s: float = 0.25,
+                 max_traces: int = 4096, max_events_per_trace: int = 64,
+                 max_system_events: int = 1024,
+                 fetch: Callable[[str], Dict[str, Any]] = _http_fetch,
+                 registry: Optional[MetricsRegistry] = None,
+                 metrics_label: str = "collector"):
+        self.clock = clock
+        self.skew_tolerance_s = float(skew_tolerance_s)
+        self.max_traces = int(max_traces)
+        self.max_events_per_trace = int(max_events_per_trace)
+        self.fetch = fetch
+        self._sources: List[_Source] = []
+        #: trace_id -> list of (source_name, event); insertion order = LRU
+        self._traces: "OrderedDict[str, List[Tuple[str, Dict]]]" = \
+            OrderedDict()
+        self._system: List[Dict[str, Any]] = []
+        self._max_system = int(max_system_events)
+        self._system_seq = 0    # monotonic cursor for system-event readers
+        self._lock = threading.Lock()
+        self._poll_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = registry if registry is not None else get_registry()
+        lbl = {"instance": metrics_label}
+        self._m_polls = reg.counter(
+            "collector_polls_total", "source drains attempted", lbl)
+        self._m_events = reg.counter(
+            "collector_events_total", "events drained from all sources", lbl)
+        self._m_errors = reg.counter(
+            "collector_poll_errors_total",
+            "source drains that failed (unreachable ring)", lbl)
+        self._g_traces = reg.gauge(
+            "collector_traces", "traces currently retained", lbl)
+        self._g_traces.set_function(lambda: float(len(self._traces)))
+
+    # ------------------------------------------------------------- sources
+    def add_gateway(self, name: str, *, url: Optional[str] = None,
+                    event_log: Optional[EventLog] = None) -> None:
+        self._add(name, url, event_log, "gateway", None)
+
+    def add_worker(self, name: str, *, endpoint: str,
+                   url: Optional[str] = None,
+                   event_log: Optional[EventLog] = None) -> None:
+        """`endpoint` is the "host:port" the gateway forwards to — the key
+        that joins this worker's spans to gateway forward_attempt spans."""
+        self._add(name, url, event_log, "worker", endpoint)
+
+    def _add(self, name, url, log, role, endpoint) -> None:
+        if (url is None) == (log is None):
+            raise ValueError("give exactly one of url= or event_log=")
+        with self._lock:
+            for s in self._sources:
+                if s.name != name:
+                    continue
+                if s.url == url and s.log is log \
+                        and s.endpoint == endpoint:
+                    return  # idempotent re-add (fleet re-discovery)
+                # same identity, new address: a worker RESTARTED on a new
+                # port (the PR 13 re-register storm). Keeping the stale
+                # source would poll a dead URL forever and the new
+                # incarnation's spans would never parent (the gateway's
+                # attempt spans name the NEW endpoint) — replace it and
+                # restart the cursor on the fresh ring
+                s.url, s.log, s.role = url, log, role
+                s.endpoint = endpoint
+                s.cursor = 0.0
+                s.live = True
+                return
+            self._sources.append(_Source(name, url, log, role, endpoint))
+
+    @classmethod
+    def for_coordinator(cls, coordinator, service: str,
+                        **kw) -> "TraceCollector":
+        """Collector over one coordinator's fleet: the gateway's own ring
+        in-process, every routed worker over its `/trace` endpoint. Call
+        `refresh_workers()` (or just `poll()`) after fleet changes —
+        newly registered workers are picked up, departed ones simply stop
+        yielding events."""
+        col = cls(**kw)
+        col.add_gateway(coordinator.metrics_label,
+                        event_log=coordinator.events)
+        col._coordinator = coordinator
+        col._service = service
+        col.refresh_workers()
+        return col
+
+    def refresh_workers(self) -> None:
+        coord = getattr(self, "_coordinator", None)
+        if coord is None:
+            return
+        routed = set()
+        for s in coord.routes(self._service):
+            routed.add(f"{s.host}:{s.port}")
+            self.add_worker(f"{s.machine}:{s.partition}",
+                            endpoint=f"{s.host}:{s.port}",
+                            url=f"http://{s.host}:{s.port}/trace")
+        # evicted/retired workers go dormant (cursor kept for a heal);
+        # a chaos-blip eviction costs at most the polls until re-register
+        with self._lock:
+            for src in self._sources:
+                if src.role == "worker" and src.url is not None:
+                    src.live = src.endpoint in routed
+
+    # --------------------------------------------------------------- drain
+    def poll(self) -> int:
+        """Drain every live source from its cursor. Returns events
+        ingested. A source that fails to answer is counted and skipped —
+        the other rings still drain (a dead worker must not blind the
+        collector). Serialized under `_poll_lock`: two concurrent
+        pollers (the collector's own thread + a flight recorder's tick)
+        would otherwise read the same cursor and ingest every drain
+        twice — duplicated spans in every assembled tree."""
+        with self._poll_lock:
+            return self._poll_locked()
+
+    def _poll_locked(self) -> int:
+        self.refresh_workers()
+        with self._lock:
+            sources = [s for s in self._sources if s.live]
+        n = 0
+        for src in sources:
+            self._m_polls.inc()
+            try:
+                if src.log is not None:
+                    evs, cursor = src.log.drain(src.cursor)
+                else:
+                    payload = self.fetch(f"{src.url}?since={src.cursor}")
+                    evs = payload.get("events", [])
+                    cursor = float(payload.get("now", src.cursor))
+            except Exception:  # noqa: BLE001 - one dead ring must not
+                self._m_errors.inc()   # blind the others
+                continue
+            src.cursor = max([src.cursor, cursor]
+                             + [e["ts"] for e in evs])
+            if evs:
+                self._ingest(src, evs)
+                n += len(evs)
+        if n:
+            self._m_events.inc(n)
+        return n
+
+    def _ingest(self, src: _Source, evs: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            for ev in evs:
+                if ev.get("span") in SYSTEM_SPANS:
+                    self._system_seq += 1
+                    self._system.append({**ev, "source": src.name,
+                                         "_seq": self._system_seq})
+                    if len(self._system) > self._max_system:
+                        del self._system[:len(self._system)
+                                         - self._max_system]
+                    continue
+                tid = ev.get("trace_id")
+                if not tid:
+                    continue
+                lst = self._traces.get(tid)
+                if lst is None:
+                    lst = self._traces[tid] = []
+                else:
+                    self._traces.move_to_end(tid)
+                if len(lst) < self.max_events_per_trace:
+                    lst.append((src.name, ev))
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+
+    # ------------------------------------------------------------ assembly
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def system_events(self, after_seq: int = 0) -> List[Dict[str, Any]]:
+        """Drained system events with `_seq` > after_seq (the flight
+        recorder's cursor read)."""
+        with self._lock:
+            return [dict(e) for e in self._system if e["_seq"] > after_seq]
+
+    def trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Assemble one end-to-end trace tree.
+
+        Shape: {"trace_id", "status", "duration_s", "hops": [...]} where
+        each hop is an event dict plus "source", and a gateway
+        `forward_attempt` hop carries the matched worker spans under
+        "children" (ordered queue_wait -> ... -> reply). Matching is by
+        the attempt's `worker` endpoint and its time window widened by
+        `skew_tolerance_s` — each process stamps its own wall clock, so
+        exact ordering across hops cannot be trusted below the skew
+        bound; within one hop the span pipeline order is authoritative.
+        """
+        with self._lock:
+            tagged = list(self._traces.get(trace_id) or ())
+        if not tagged:
+            return None
+        roles = {s.name: s for s in self._sources}
+        gw: List[Dict] = []
+        by_worker: Dict[str, List[Dict]] = {}
+        loose: List[Dict] = []
+        for name, ev in tagged:
+            src = roles.get(name)
+            e = {**ev, "source": name}
+            if src is not None and src.role == "gateway":
+                gw.append(e)
+            elif src is not None and src.role == "worker":
+                by_worker.setdefault(src.endpoint or name, []).append(e)
+            else:
+                loose.append(e)
+        for evs in by_worker.values():
+            evs.sort(key=lambda e: (e["ts"],
+                                    _WORKER_ORDER.get(e["span"], 9)))
+        gw.sort(key=lambda e: e["ts"])
+        claimed: set = set()
+        hops: List[Dict[str, Any]] = []
+        skew = self.skew_tolerance_s
+        for e in gw:
+            if e["span"] == "forward_attempt" and e.get("worker"):
+                # the attempt's ts is stamped at COMPLETION; its window is
+                # [ts - dur - skew, ts + skew] on the worker's clock
+                t_hi = e["ts"] + skew
+                t_lo = e["ts"] - float(e.get("dur_s") or 0.0) - skew
+                kids = []
+                for w in by_worker.get(e["worker"], ()):
+                    wid = id(w)
+                    if wid in claimed or not t_lo <= w["ts"] <= t_hi:
+                        continue
+                    claimed.add(wid)
+                    kids.append(w)
+                kids.sort(key=lambda w: (_WORKER_ORDER.get(w["span"], 9),
+                                         w["ts"]))
+                hops.append({**e, "children": kids})
+            else:
+                hops.append(e)
+        # direct-hit worker spans (no gateway in the path) and spans whose
+        # attempt window missed (skew larger than tolerated): top level,
+        # never dropped — a lossy assembler would hide exactly the
+        # misbehaving hop an incident needs
+        for endpoint, evs in sorted(by_worker.items()):
+            orphans = [w for w in evs if id(w) not in claimed]
+            if orphans:
+                hops.extend(orphans)
+        hops.extend(loose)
+        status = None
+        duration = None
+        for e in hops:
+            if e["span"] == "reply":
+                status = e.get("status", status)
+                duration = e.get("dur_s", duration)
+            elif e["span"] in ("shed", "expired") and status is None:
+                status = e.get("status")
+        if duration is None and hops:
+            ts = [e["ts"] for e in hops]
+            duration = round(max(ts) - min(ts), 6)
+        return {"trace_id": trace_id, "status": status,
+                "duration_s": duration, "hops": hops}
+
+    # ------------------------------------------------------------- queries
+    def assemble_all(self) -> List[Dict[str, Any]]:
+        """Every retained trace assembled once — pass the result to
+        `slowest`/`failed` when querying both (the flight recorder's
+        dump path): re-assembling 2x per dump would stall ingest exactly
+        while the fleet is degraded."""
+        return [t for t in (self.trace(tid) for tid in self.trace_ids())
+                if t is not None]
+
+    def summaries(self) -> List[Dict[str, Any]]:
+        """One flat row per retained trace (the flight recorder's request
+        ring): {trace_id, status, duration_s, hops}."""
+        return [{"trace_id": t["trace_id"], "status": t["status"],
+                 "duration_s": t["duration_s"], "hops": len(t["hops"])}
+                for t in self.assemble_all()]
+
+    def slowest(self, k: int = 5,
+                trees: Optional[List[Dict[str, Any]]] = None
+                ) -> List[Dict[str, Any]]:
+        done = [t for t in (trees if trees is not None
+                            else self.assemble_all())
+                if t["duration_s"] is not None]
+        done.sort(key=lambda t: -t["duration_s"])
+        return done[:k]
+
+    def failed(self, limit: int = 20,
+               trees: Optional[List[Dict[str, Any]]] = None
+               ) -> List[Dict[str, Any]]:
+        """Traces whose final status is not a 2xx, or that record a
+        failed/no-worker forward attempt anywhere in the tree."""
+        out = []
+        for t in (trees if trees is not None else self.assemble_all()):
+            bad_status = t["status"] is not None and not \
+                (200 <= int(t["status"]) < 300)
+            bad_hop = any(
+                h.get("span") == "forward_attempt"
+                and h.get("outcome") not in ("ok", None)
+                for h in t["hops"])
+            if bad_status or bad_hop:
+                out.append(t)
+            if len(out) >= limit:
+                break
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, interval_s: float = 0.5) -> "TraceCollector":
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.poll()
+                except Exception:  # noqa: BLE001 - one bad poll must not
+                    pass           # kill the drain loop
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="trace-collector")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        self._g_traces.set_function(None)

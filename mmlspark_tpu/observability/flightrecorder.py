@@ -1,0 +1,317 @@
+"""Incident flight recorder: anomaly triggers -> one atomic JSON bundle.
+
+When a rollout rolls back at 91k rows/s the forensics are spread over N
+process rings, the registry, and the coordinator's rollout record — and
+the rings are BOUNDED, so waiting until a human looks means the evidence
+is gone. The flight recorder keeps a short ring of recent request
+summaries and system events (swap, rollback, retire, drain, autoscale,
+chaos, SLO transitions — everything the TraceCollector drains from the
+fleet's EventLogs), watches a small set of anomaly triggers, and on any
+firing dumps an **incident bundle**: one atomic JSON (the PR 10
+atomic-write helper — a crash mid-dump can never leave a torn bundle)
+containing
+
+- the assembled end-to-end trace trees of the slowest and failed
+  requests in the window (gateway attempt spans parenting worker spans),
+- the system-event ring (the rollback/retire/chaos story),
+- the full registry snapshot,
+- the coordinator's rollout state and every worker's `/health`,
+- the SLO burn-rate status when a monitor is attached.
+
+Triggers: swap rollback / rollout rolled_back (incl. canary loss), shed
+spike over the window, windowed p99 breaching the armed baseline, SLO
+breach transition. A per-reason cooldown stops a sustained anomaly from
+flooding the disk. Clock, fetches, and the collector are injectable, so
+tier-1 tests drive every trigger with no sleeps and no subprocess fleet
+(the full fleet run rides the @slow measure_serving_load mini-run).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..resilience.elastic import atomic_write_bytes
+from .collector import TraceCollector, _http_fetch as _http_json
+from .metrics import MetricsRegistry, get_registry
+from .slo import SLOMonitor, _family_buckets, windowed_quantile
+
+__all__ = ["FlightRecorder", "BUNDLE_SCHEMA_VERSION"]
+
+BUNDLE_SCHEMA_VERSION = 1
+
+
+class FlightRecorder:
+    """Bounded recent-history ring + anomaly triggers + bundle dumps.
+
+    `tick()` is the whole control loop: poll the collector, ingest new
+    system events, evaluate triggers, dump bundles. `start(interval_s)`
+    runs ticks on a daemon thread for live fleets; tests call `tick()`
+    directly under an injected clock.
+    """
+
+    def __init__(self, collector: TraceCollector, out_dir: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.time,
+                 window_s: float = 60.0, cooldown_s: float = 30.0,
+                 ring: int = 512, slowest_k: int = 5, failed_k: int = 10,
+                 shed_spike: float = 50.0,
+                 p99_factor: float = 3.0, p99_floor_ms: float = 5.0,
+                 p99_family: str = "gateway_request_latency_seconds",
+                 health_fn: Optional[Callable[[], Dict]] = None,
+                 rollouts_fn: Optional[Callable[[], Dict]] = None,
+                 workers_fn: Optional[Callable[[], List[Tuple[str, str]]]]
+                 = None,
+                 fetch: Callable[[str], Dict[str, Any]] = _http_json,
+                 slo: Optional[SLOMonitor] = None,
+                 metrics_label: str = "flightrecorder"):
+        self.collector = collector
+        self.out_dir = out_dir
+        self.registry = registry if registry is not None else get_registry()
+        self.clock = clock
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self.ring = int(ring)
+        self.slowest_k = int(slowest_k)
+        self.failed_k = int(failed_k)
+        self.shed_spike = float(shed_spike)
+        self.p99_factor = float(p99_factor)
+        self.p99_floor_ms = float(p99_floor_ms)
+        self.p99_family = p99_family
+        self.health_fn = health_fn
+        self.rollouts_fn = rollouts_fn
+        self.workers_fn = workers_fn
+        self.fetch = fetch
+        self.slo = slo
+        self._lbl = {"instance": metrics_label}
+        self._m_bundles: Dict[str, Any] = {}
+        self._system: List[Dict[str, Any]] = []
+        self._sys_seq = 0
+        #: baseline p99 (ms) captured by arm_baseline(); None = the p99
+        #: trigger stays dark (nothing to compare against)
+        self.baseline_p99_ms: Optional[float] = None
+        self._shed_samples: List[Tuple[float, float]] = []
+        self._hist_samples: List[Tuple[float, Tuple[Dict, int]]] = []
+        self._last_dump: Dict[str, float] = {}
+        self._seq = 0
+        self.incidents: List[str] = []     # bundle paths, oldest first
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- conveniences
+    @classmethod
+    def for_coordinator(cls, coordinator, collector: TraceCollector,
+                        out_dir: str, service: str,
+                        **kw) -> "FlightRecorder":
+        """Recorder wired to one coordinator: rollout state, fleet
+        /health, and its registry come along automatically."""
+        def workers():
+            return [(f"{s.host}:{s.port}", f"http://{s.host}:{s.port}")
+                    for s in coordinator.routes(service)]
+        kw.setdefault("registry", coordinator.registry)
+        kw.setdefault("slo", getattr(coordinator, "slo", None))
+        return cls(collector, out_dir,
+                   health_fn=coordinator.health,
+                   rollouts_fn=coordinator.rollouts_status,
+                   workers_fn=workers, **kw)
+
+    def _bundle_counter(self, reason: str):
+        c = self._m_bundles.get(reason)
+        if c is None:
+            c = self.registry.counter(
+                "incident_bundles_total", "incident bundles dumped",
+                {**self._lbl, "reason": reason})
+            self._m_bundles[reason] = c
+        return c
+
+    # -------------------------------------------------------------- baseline
+    def arm_baseline(self) -> None:
+        """Capture the p99 baseline the breach trigger compares against
+        (call once the fleet is warm and serving steady traffic)."""
+        p99 = self.registry.quantile(self.p99_family, 0.99)
+        if p99 is None:
+            # sum across label sets via a two-point window over one snap
+            buckets = _family_buckets(
+                self.registry.snapshot(families=[self.p99_family]),
+                self.p99_family)
+            p99 = windowed_quantile(({}, 0), buckets, 0.99)
+        self.baseline_p99_ms = p99 * 1e3 if p99 is not None else None
+
+    # ------------------------------------------------------------------ tick
+    def tick(self) -> List[str]:
+        """One control cycle. Returns the bundle paths written (if any)."""
+        self.collector.poll()
+        now = self.clock()
+        new_events = self.collector.system_events(after_seq=self._sys_seq)
+        written: List[str] = []
+        with self._lock:
+            for ev in new_events:
+                self._sys_seq = max(self._sys_seq, ev["_seq"])
+                self._system.append(ev)
+            if len(self._system) > self.ring:
+                del self._system[:len(self._system) - self.ring]
+            # windowed samples for the rate triggers
+            shed = (self._family_total("serving_shed_total")
+                    + self._family_total("gateway_shed_total"))
+            self._shed_samples.append((now, shed))
+            self._hist_samples.append(
+                (now, _family_buckets(
+                    self.registry.snapshot(families=[self.p99_family]),
+                    self.p99_family)))
+            cutoff = now - self.window_s * 1.25
+            self._shed_samples = [s for s in self._shed_samples
+                                  if s[0] >= cutoff]
+            self._hist_samples = [s for s in self._hist_samples
+                                  if s[0] >= cutoff]
+        for reason, detail in self._triggers(now, new_events):
+            try:
+                path = self._dump(reason, detail, now)
+            except Exception:  # noqa: BLE001 - one failed dump (disk
+                continue       # full) must not abort the other triggers;
+                               # its cooldown is unconsumed, so it re-fires
+            if path is not None:
+                written.append(path)
+        return written
+
+    def _family_total(self, family: str) -> float:
+        return self.registry.total(family)
+
+    def _triggers(self, now: float, new_events: List[Dict]
+                  ) -> List[Tuple[str, str]]:
+        fired: List[Tuple[str, str]] = []
+        # 1. swap rollback anywhere in the fleet
+        for ev in new_events:
+            if ev.get("span") == "swap" and \
+                    str(ev.get("outcome", "")).startswith("rollback"):
+                fired.append(("swap_rollback",
+                              f"{ev.get('source')}: v{ev.get('version')} "
+                              f"{ev.get('outcome')}"))
+            # 2. rollout rolled back (covers canary loss, error-rate and
+            # p99 breaches, timeout — the reason string says which)
+            elif ev.get("span") == "rollout" and \
+                    ev.get("state") == "rolled_back":
+                fired.append(("rollout_rolled_back",
+                              str(ev.get("reason"))))
+            # 5. SLO breach transition (when a monitor feeds the logs)
+            elif ev.get("span") == "slo" and ev.get("state") == "breach":
+                fired.append(("slo_breach",
+                              f"{ev.get('slo')}: fast "
+                              f"{ev.get('burn_fast')} slow "
+                              f"{ev.get('burn_slow')}"))
+        # 3. shed spike over the window
+        with self._lock:
+            if len(self._shed_samples) >= 2:
+                base = self._window_base(self._shed_samples, now,
+                                         self.window_s)
+                if base is not None and base is not self._shed_samples[-1]:
+                    d = self._shed_samples[-1][1] - base[1]
+                    if d > self.shed_spike:
+                        fired.append(("shed_spike",
+                                      f"{d:.0f} sheds in {self.window_s:.0f}s"
+                                      f" (> {self.shed_spike:.0f})"))
+            # 4. windowed p99 vs armed baseline
+            if self.baseline_p99_ms is not None \
+                    and len(self._hist_samples) >= 2:
+                base = self._window_base(self._hist_samples, now,
+                                         self.window_s)
+                if base is not None:
+                    p99 = windowed_quantile(base[1],
+                                            self._hist_samples[-1][1], 0.99)
+                    if p99 is not None:
+                        bar = max(self.baseline_p99_ms * self.p99_factor,
+                                  self.p99_floor_ms)
+                        if p99 * 1e3 > bar:
+                            fired.append((
+                                "p99_breach",
+                                f"windowed p99 {p99 * 1e3:.1f}ms > "
+                                f"{bar:.1f}ms (baseline "
+                                f"{self.baseline_p99_ms:.1f}ms x "
+                                f"{self.p99_factor})"))
+        return fired
+
+    @staticmethod
+    def _window_base(samples, now, window_s):
+        """Oldest sample actually INSIDE the window (retention keeps a
+        25% margin past it, which must not widen the measured window)."""
+        for s in samples:
+            if now - s[0] <= window_s:
+                return s
+        return None
+
+    # ------------------------------------------------------------------ dump
+    def _workers_health(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, base_url in (self.workers_fn() if self.workers_fn
+                               else ()):
+            try:
+                out[name] = self.fetch(base_url.rstrip("/") + "/health")
+            except Exception as e:  # noqa: BLE001 - a dead worker's
+                out[name] = {"unreachable": str(e)[:200]}  # absence IS data
+        return out
+
+    def _dump(self, reason: str, detail: str, now: float) -> Optional[str]:
+        last = self._last_dump.get(reason)
+        if last is not None and now - last < self.cooldown_s:
+            return None
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            system_events = [
+                {k: v for k, v in e.items() if k != "_seq"}
+                for e in self._system]
+        trees = self.collector.assemble_all()   # ONE assembly pass
+        bundle = {
+            "schema_version": BUNDLE_SCHEMA_VERSION,
+            "reason": reason,
+            "detail": detail,
+            "ts": now,
+            "window_s": self.window_s,
+            "traces": {
+                "slowest": self.collector.slowest(self.slowest_k,
+                                                  trees=trees),
+                "failed": self.collector.failed(self.failed_k,
+                                                trees=trees),
+            },
+            "system_events": system_events,
+            "registry": self.registry.snapshot(),
+            "rollouts": (self.rollouts_fn() if self.rollouts_fn else None),
+            "coordinator_health": (self.health_fn() if self.health_fn
+                                   else None),
+            "workers_health": self._workers_health(),
+            "slo": self.slo.status() if self.slo is not None else None,
+        }
+        path = f"{self.out_dir}/incident_{seq:04d}_{reason}.json"
+        # the PR 10 atomic-write discipline: a crash mid-dump leaves the
+        # previous bundles intact and at worst a stray temp file — never
+        # a torn JSON that breaks the post-mortem tooling
+        atomic_write_bytes(path, json.dumps(bundle, indent=1,
+                                            default=str).encode())
+        # cooldown is consumed only by a SUCCESSFUL write: a dump that
+        # raised (disk full, health fetch blew up) must not suppress the
+        # same reason re-firing on the next tick — that would leave NO
+        # bundle for the incident at all
+        self._last_dump[reason] = now
+        self.incidents.append(path)
+        self._bundle_counter(reason).inc()
+        return path
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, interval_s: float = 1.0) -> "FlightRecorder":
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 - the recorder must
+                    pass           # outlive any one bad tick
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="flight-recorder")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)

@@ -306,11 +306,18 @@ class MetricsRegistry:
             return None
         return s.quantile(q)
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self, families: Optional[Sequence[str]] = None
+                 ) -> Dict[str, Any]:
         """JSON-able view of every series, deterministically ordered
-        (families sorted by name, series by label items)."""
+        (families sorted by name, series by label items). `families`
+        restricts the view — the periodic samplers (SLO monitor, flight
+        recorder) read 1-3 families per tick and must not serialize the
+        whole registry under its lock every time."""
         out: Dict[str, Any] = {}
+        wanted = None if families is None else set(families)
         for fam in self._sorted_families():
+            if wanted is not None and fam.name not in wanted:
+                continue
             with self._lock:
                 items = sorted(fam.series.items())
             rows = []

@@ -1,0 +1,322 @@
+"""SLO burn-rate monitors: fast/slow dual windows over registry families.
+
+The registry (PR 8) already carries every error counter and latency
+histogram; what operators lack is the DERIVATIVE — "are we spending the
+error budget faster than the SLO allows, right now and sustained?". This
+module computes the classic multi-window burn rate (Google SRE workbook
+ch. 5) from periodic registry samples:
+
+    burn = (bad / total over the window) / error_budget        (error SLO)
+    burn = windowed p99 / objective                            (latency SLO)
+
+over a FAST window (seconds-to-minutes: catches a cliff) and a SLOW
+window (minutes-to-hours: filters blips). A breach requires BOTH windows
+burning past `breach_burn_rate` — the fast window alone is one bad batch,
+the slow window alone is stale history. Burn rates surface as
+`slo_burn_rate{slo,window}` gauges, transitions as structured `slo`
+events in the monitor's EventLog (which the TraceCollector drains and
+the flight recorder dumps), and the coordinator exposes the whole status
+block in `/health` and can (off by default) gate rollouts on it.
+
+Counters are CUMULATIVE, so windowed rates come from a ring of (ts,
+value) samples; the latency window comes from diffing the histogram's
+cumulative bucket counts between two samples — an exact windowed
+distribution, not an approximation over the process lifetime. Clock and
+sampling are injectable: tests drive error-rate across the fast window
+threshold with zero sleeps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import MetricsRegistry, get_registry
+from .tracing import EventLog, mint_trace_id
+
+__all__ = ["SLODef", "SLOMonitor", "windowed_quantile"]
+
+
+def _family_totals(snapshot: Dict[str, Any], family: str) -> float:
+    fam = snapshot.get(family)
+    if not fam:
+        return 0.0
+    return float(sum(s.get("value", 0.0) for s in fam["series"]))
+
+
+def _family_buckets(snapshot: Dict[str, Any], family: str
+                    ) -> Tuple[Dict[str, float], int]:
+    """Summed per-bucket counts + total count of one histogram family
+    across all label sets (bucket keys are the bound reprs + '+Inf')."""
+    fam = snapshot.get(family)
+    agg: Dict[str, float] = {}
+    count = 0
+    if fam:
+        for s in fam["series"]:
+            count += int(s.get("count", 0))
+            for k, v in (s.get("buckets") or {}).items():
+                agg[k] = agg.get(k, 0.0) + v
+    return agg, count
+
+
+def windowed_quantile(old: Tuple[Dict[str, float], int],
+                      new: Tuple[Dict[str, float], int],
+                      q: float) -> Optional[float]:
+    """q-quantile of the observations that landed BETWEEN two histogram
+    samples, by diffing cumulative bucket counts. Returns the upper bound
+    of the bucket holding the target rank (None when the window is
+    empty); +Inf-bucket hits report the largest finite bound — a
+    conservative floor, which is the right bias for a breach gate."""
+    ob, oc = old
+    nb, nc = new
+    total = nc - oc
+    if total <= 0:
+        return None
+    deltas = []
+    for key, v in nb.items():
+        d = v - ob.get(key, 0.0)
+        bound = float("inf") if key == "+Inf" else float(key)
+        deltas.append((bound, max(0.0, d)))
+    deltas.sort(key=lambda kv: kv[0])
+    rank = q * total
+    cum = 0.0
+    finite = [b for b, _ in deltas if b != float("inf")]
+    for bound, d in deltas:
+        cum += d
+        if cum >= rank:
+            if bound == float("inf"):
+                return finite[-1] if finite else None
+            return bound
+    return finite[-1] if finite else None
+
+
+class SLODef:
+    """One service-level objective.
+
+    kind "error_rate": `bad` counter families over `total` families (a
+    histogram family's count works as a total), with `budget` = allowed
+    bad fraction (0.01 = 99% objective). Burn 1.0 means spending exactly
+    the budget; >1 is over-spend.
+
+    kind "latency_p99": histogram `family` with `objective_ms`; burn =
+    windowed p99 / objective.
+    """
+
+    KINDS = ("error_rate", "latency_p99")
+
+    def __init__(self, name: str, kind: str,
+                 bad: Sequence[str] = (), total: Sequence[str] = (),
+                 budget: float = 0.01,
+                 family: Optional[str] = None,
+                 objective_ms: Optional[float] = None):
+        if kind not in self.KINDS:
+            raise ValueError(f"kind must be one of {self.KINDS}, "
+                             f"got {kind!r}")
+        if kind == "error_rate" and (not bad or not total):
+            raise ValueError("error_rate SLO needs bad= and total= "
+                             "family lists")
+        if kind == "latency_p99" and (not family or not objective_ms):
+            raise ValueError("latency_p99 SLO needs family= and "
+                             "objective_ms=")
+        self.name = name
+        self.kind = kind
+        self.bad = tuple(bad)
+        self.total = tuple(total)
+        self.budget = float(budget)
+        self.family = family
+        self.objective_ms = objective_ms
+
+
+class _Sample:
+    __slots__ = ("ts", "bad", "total", "hist")
+
+    def __init__(self, ts, bad, total, hist):
+        self.ts = ts
+        self.bad = bad          # {slo_name: cumulative bad}
+        self.total = total      # {slo_name: cumulative total}
+        self.hist = hist        # {slo_name: (buckets, count)}
+
+
+class SLOMonitor:
+    """Samples the registry on `tick()` and maintains fast/slow burn
+    rates per SLO. `status()` is the /health block; `breached()` is the
+    rollout-gate predicate (fast AND slow both past `breach_burn_rate`).
+    """
+
+    WINDOWS = ("fast", "slow")
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 slos: Optional[Sequence[SLODef]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 fast_window_s: float = 60.0, slow_window_s: float = 600.0,
+                 breach_burn_rate: float = 1.0,
+                 event_log: Optional[EventLog] = None,
+                 metrics_label: str = "slo"):
+        if fast_window_s >= slow_window_s:
+            raise ValueError("fast_window_s must be < slow_window_s")
+        self.registry = registry if registry is not None else get_registry()
+        self.slos: List[SLODef] = list(slos or ())
+        self.clock = clock
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.breach_burn_rate = float(breach_burn_rate)
+        self.events = event_log if event_log is not None else EventLog(256)
+        self._lbl = {"instance": metrics_label}
+        self._samples: List[_Sample] = []
+        self._lock = threading.Lock()
+        self._burn: Dict[Tuple[str, str], Optional[float]] = {}
+        self._breached: Dict[str, bool] = {}
+        self._gauges: Dict[Tuple[str, str], Any] = {}
+
+    def _gauge(self, slo: str, window: str):
+        g = self._gauges.get((slo, window))
+        if g is None:
+            g = self.registry.gauge(
+                "slo_burn_rate",
+                "error-budget burn rate per SLO and window (1.0 = "
+                "spending exactly the budget)",
+                {**self._lbl, "slo": slo, "window": window})
+            self._gauges[(slo, window)] = g
+        return g
+
+    # -------------------------------------------------------------- sampling
+    def _needed_families(self) -> List[str]:
+        fams: List[str] = []
+        for slo in self.slos:
+            fams.extend(slo.bad)
+            fams.extend(slo.total)
+            if slo.family:
+                fams.append(slo.family)
+        return fams
+
+    def _take_sample(self) -> _Sample:
+        # per-family snapshot: a periodic sampler must not serialize the
+        # WHOLE registry (every histogram's interpolated quantiles) under
+        # its lock every tick just to read 1-3 families
+        snap = self.registry.snapshot(families=self._needed_families())
+        bad: Dict[str, float] = {}
+        total: Dict[str, float] = {}
+        hist: Dict[str, Tuple[Dict[str, float], int]] = {}
+        for slo in self.slos:
+            if slo.kind == "error_rate":
+                bad[slo.name] = sum(_family_totals(snap, f)
+                                    for f in slo.bad)
+                t = 0.0
+                for f in slo.total:
+                    fam = snap.get(f)
+                    if fam and fam["kind"] == "histogram":
+                        t += sum(s.get("count", 0) for s in fam["series"])
+                    else:
+                        t += _family_totals(snap, f)
+                total[slo.name] = t
+            else:
+                hist[slo.name] = _family_buckets(snap, slo.family)
+        return _Sample(self.clock(), bad, total, hist)
+
+    def _window_base(self, now: float, window_s: float
+                     ) -> Optional[_Sample]:
+        """Oldest retained sample inside the window (None = cannot form a
+        window yet — burn unknown, reported as 0)."""
+        base = None
+        for s in self._samples:
+            if now - s.ts <= window_s:
+                base = s
+                break
+        return base
+
+    def tick(self) -> Dict[str, Dict[str, Any]]:
+        """One sampling + burn computation. Returns `status()`."""
+        sample = self._take_sample()
+        with self._lock:
+            self._samples.append(sample)
+            cutoff = sample.ts - self.slow_window_s * 1.25
+            while self._samples and self._samples[0].ts < cutoff:
+                self._samples.pop(0)
+            now = sample.ts
+            for slo in self.slos:
+                burns = {}
+                for window, wsec in (("fast", self.fast_window_s),
+                                     ("slow", self.slow_window_s)):
+                    base = self._window_base(now, wsec)
+                    burn = None
+                    # warm-up guard: until history actually SPANS (half
+                    # of) a window, its burn is unknown — without it the
+                    # fast and slow burns of a young monitor are computed
+                    # over the same short span, and the slow window
+                    # "filters" nothing: a 1-second blip at 2 s uptime
+                    # would breach both windows and (with the gate on)
+                    # roll a rollout back — exactly the transient the
+                    # dual-window design exists to suppress
+                    if base is not None and base is not sample \
+                            and now - base.ts >= 0.5 * wsec:
+                        if slo.kind == "error_rate":
+                            dt_total = (sample.total[slo.name]
+                                        - base.total.get(slo.name, 0.0))
+                            dt_bad = (sample.bad[slo.name]
+                                      - base.bad.get(slo.name, 0.0))
+                            if dt_total > 0:
+                                burn = (dt_bad / dt_total) / slo.budget
+                        else:
+                            p99 = windowed_quantile(
+                                base.hist.get(slo.name, ({}, 0)),
+                                sample.hist[slo.name], 0.99)
+                            if p99 is not None:
+                                burn = (p99 * 1e3) / slo.objective_ms
+                    burns[window] = burn
+                    self._burn[(slo.name, window)] = burn
+                    self._gauge(slo.name, window).set(burn or 0.0)
+                was = self._breached.get(slo.name, False)
+                is_breached = all(
+                    burns[w] is not None and burns[w] > self.breach_burn_rate
+                    for w in self.WINDOWS)
+                self._breached[slo.name] = is_breached
+                if is_breached != was:
+                    # structured transition event: drained by the
+                    # TraceCollector, dumped by the flight recorder
+                    self.events.append(
+                        "slo", mint_trace_id(), slo=slo.name,
+                        state="breach" if is_breached else "clear",
+                        burn_fast=round(burns["fast"] or 0.0, 3),
+                        burn_slow=round(burns["slow"] or 0.0, 3))
+        return self.status()
+
+    # --------------------------------------------------------------- queries
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            out = {}
+            for slo in self.slos:
+                out[slo.name] = {
+                    "kind": slo.kind,
+                    "burn_fast": self._burn.get((slo.name, "fast")),
+                    "burn_slow": self._burn.get((slo.name, "slow")),
+                    "breached": self._breached.get(slo.name, False),
+                }
+            return out
+
+    def breached(self) -> bool:
+        """True when ANY SLO burns past threshold on BOTH windows — the
+        (off-by-default) rollout-gate predicate."""
+        with self._lock:
+            return any(self._breached.values())
+
+    # ------------------------------------------------------------- defaults
+    @classmethod
+    def gateway_defaults(cls, registry: MetricsRegistry,
+                         availability_budget: float = 0.01,
+                         p99_objective_ms: float = 250.0,
+                         **kw) -> "SLOMonitor":
+        """The coordinator's stock SLO pair: availability (shed + expired
+        over all gateway replies) and latency (gateway p99 vs objective).
+        Families are the ones the gateway already maintains — nothing new
+        is measured."""
+        slos = [
+            SLODef("availability", "error_rate",
+                   bad=("gateway_shed_total", "gateway_expired_total"),
+                   total=("gateway_request_latency_seconds",),
+                   budget=availability_budget),
+            SLODef("latency", "latency_p99",
+                   family="gateway_request_latency_seconds",
+                   objective_ms=p99_objective_ms),
+        ]
+        return cls(registry=registry, slos=slos, **kw)

@@ -22,10 +22,11 @@ import json
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["TRACE_HEADER", "mint_trace_id", "trace_id_from_headers",
-           "EventLog"]
+           "EventLog", "drain_payload"]
 
 TRACE_HEADER = "X-Trace-Id"
 
@@ -48,6 +49,22 @@ def trace_id_from_headers(headers: Optional[Dict[str, str]]
     return None
 
 
+def drain_payload(source: str, log: "EventLog",
+                  since: float) -> Dict[str, Any]:
+    """THE `GET /trace?since=` response body (one definition — the worker
+    and gateway endpoints must never drift apart): the ring drained from
+    the cursor, the source label, the next cursor (`now`), and the
+    monotonic append count. `now` comes from the ATOMIC drain — it is
+    the newest appended ts at the moment the events were read (or the
+    request's own cursor when nothing is newer), so an append racing the
+    drain can never land at ts <= now without being in `events`."""
+    events, cursor = log.drain(since)
+    return {"source": source,
+            "now": cursor,
+            "total_appended": log.total_appended,
+            "events": events}
+
+
 class EventLog:
     """Bounded structured event ring + optional JSONL file sink.
 
@@ -67,24 +84,73 @@ class EventLog:
             collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._sink = open(sink_path, "a", buffering=1) if sink_path else None
+        self._sink_path = sink_path
+        self._appended = 0
+        self._last_ts = 0.0
 
     def append(self, span: str, trace_id: Optional[str] = None,
                dur_s: Optional[float] = None, **extra: Any) -> None:
-        ev: Dict[str, Any] = {"ts": round(time.time(), 6), "span": span}
+        ev: Dict[str, Any] = {"span": span}
         if trace_id is not None:
             ev["trace_id"] = trace_id
         if dur_s is not None:
             ev["dur_s"] = round(dur_s, 6)
         ev.update(extra)
+        sink_err: Optional[Exception] = None
         with self._lock:
+            # per-log STRICTLY increasing ts: two appends inside one
+            # rounded microsecond (or a backward wall-clock step) must
+            # not produce a ts <= an already-drained cursor — the
+            # `/trace?since=` drain is strictly-greater, so a tie would
+            # silently drop the event from every future drain
+            ts = round(time.time(), 6)
+            if ts <= self._last_ts:
+                ts = round(self._last_ts + 1e-6, 6)
+            self._last_ts = ts
+            ev["ts"] = ts
             self._ring.append(ev)
+            self._appended += 1
             if self._sink is not None:
                 try:
                     self._sink.write(json.dumps(ev) + "\n")
-                except (OSError, ValueError):
+                except (OSError, ValueError) as e:
                     # a torn-off sink (disk full, closed fd) must not take
-                    # the dispatcher down; the ring still has the event
+                    # the dispatcher down; the ring still has the event.
+                    # CLOSE the file object (the fd would otherwise leak
+                    # for the process lifetime) and signal below — a
+                    # silently dropped sink is how trace forensics go
+                    # missing exactly when they are needed
+                    sink_err = e
+                    try:
+                        self._sink.close()
+                    except Exception:  # noqa: BLE001 - already broken
+                        pass
                     self._sink = None
+        if sink_err is not None:
+            self._record_sink_error(sink_err)
+
+    def _record_sink_error(self, err: Exception) -> None:
+        """One warning + a counted `tracing_sink_errors_total` so a dead
+        JSONL sink is visible in the scrape, not a silent None."""
+        warnings.warn(
+            f"EventLog JSONL sink {self._sink_path!r} torn off and closed "
+            f"({type(err).__name__}: {err}); ring buffering continues",
+            stacklevel=3)
+        try:
+            from .metrics import get_registry
+            get_registry().counter(
+                "tracing_sink_errors_total",
+                "EventLog JSONL sinks torn off by a write error").inc()
+        except Exception:  # noqa: BLE001 - telemetry must not kill tracing
+            pass
+
+    @property
+    def total_appended(self) -> int:
+        """Monotonic count of events ever appended (ring evictions
+        included) — piggybacked on worker heartbeats so the collector can
+        tell 'quiet ring' from 'ring overflowed between drains'."""
+        with self._lock:
+            return self._appended
 
     def events(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
         """Snapshot of ring events, oldest first; filtered to one trace
@@ -94,6 +160,23 @@ class EventLog:
         if trace_id is None:
             return evs
         return [e for e in evs if e.get("trace_id") == trace_id]
+
+    def events_since(self, since: float) -> List[Dict[str, Any]]:
+        """Ring events with ts STRICTLY greater than `since`, oldest
+        first — the `GET /trace?since=` drain contract: a poller passes
+        the `now` of its previous drain and receives only new events."""
+        with self._lock:
+            return [e for e in self._ring if e["ts"] > since]
+
+    def drain(self, since: float) -> "Tuple[List[Dict[str, Any]], float]":
+        """(events newer than `since`, next cursor) ATOMICALLY: the
+        cursor is the newest appended ts as of the read (ts stamping and
+        this read share the ring lock), so no event can exist with
+        ts <= cursor that the drain did not return — the race a
+        separately-computed wall-clock 'now' would lose."""
+        with self._lock:
+            return ([e for e in self._ring if e["ts"] > since],
+                    max(since, self._last_ts))
 
     def spans(self, trace_id: str) -> List[str]:
         """The span names recorded for one trace, in arrival order."""

@@ -66,7 +66,7 @@ class FaultInjector:
 
     def __init__(self, seed: int = 0, error_rate: float = 0.0,
                  drop_rate: float = 0.0, delay_rate: float = 0.0,
-                 delay_s: float = 0.05):
+                 delay_s: float = 0.05, event_log=None):
         if min(error_rate, drop_rate, delay_rate) < 0 or \
                 error_rate + drop_rate + delay_rate > 1.0:
             raise ValueError("fault rates must be >= 0 and sum to <= 1")
@@ -79,6 +79,11 @@ class FaultInjector:
         self._lock = threading.Lock()
         self.counts: Dict[str, int] = {"calls": 0, "error": 0, "drop": 0,
                                        "delay": 0, "ok": 0}
+        #: optional system-event bridge (ISSUE 14): injected faults (not
+        #: "ok" draws) land as `chaos` events on this EventLog — pass the
+        #: gateway's log so the fleet trace collector sees the injections
+        #: beside the forward failures they caused (incident bundles)
+        self.event_log = event_log
 
     def _classify(self, u: float) -> str:
         if u < self.error_rate:
@@ -108,6 +113,11 @@ class FaultInjector:
                 labels={"kind": kind}).inc()
         except Exception:  # noqa: BLE001 - telemetry must not alter chaos
             pass
+        if kind != "ok" and self.event_log is not None:
+            try:
+                self.event_log.append("chaos", kind=kind, seed=self.seed)
+            except Exception:  # noqa: BLE001 - tracing must not alter chaos
+                pass
         return kind
 
     def schedule(self, n: int) -> List[str]:

@@ -1,0 +1,121 @@
+"""One-shot fleet snapshot: every /metrics + /health in one JSON.
+
+The PR 14 operator tool: given one coordinator URL, walk the fleet (the
+coordinator's /health names the services, /routes/<service> names the
+workers) and scrape every member's /health and /metrics into a single
+JSON document — the "what does the whole fleet look like RIGHT NOW"
+answer that previously took N curl invocations and a text editor.
+
+Metrics are embedded two ways per member: `totals` (each family summed
+across label sets — the compact cross-worker comparison view) and, with
+--full-metrics, the raw Prometheus text. `collect_fleet` is importable:
+scripts/measure_serving_load.py snapshots the fleet at the end of every
+run and bench.py lifts it into the emitted record (`extra.fleet`), so the
+armed chip window captures fleet forensics for free.
+
+Usage:
+    python scripts/fleet_status.py --coordinator http://127.0.0.1:8000 \
+        [--out fleet.json] [--full-metrics]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _get(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _prom_totals(text: str) -> dict:
+    """Prometheus text -> {family: summed value} (histograms contribute
+    their _count/_sum series; buckets are dropped — the compact view)."""
+    out = {}
+    for m in re.finditer(r"^([a-z_][a-z0-9_]*?)(?:{[^}]*})? "
+                         r"([0-9.e+-]+(?:[0-9])?)$", text, re.M):
+        name = m.group(1)
+        if name.endswith("_bucket"):
+            continue
+        try:
+            out[name] = out.get(name, 0.0) + float(m.group(2))
+        except ValueError:
+            continue
+    return out
+
+
+def _member(base_url: str, full_metrics: bool, fetch) -> dict:
+    member = {"url": base_url}
+    try:
+        member["health"] = json.loads(fetch(base_url.rstrip("/")
+                                            + "/health"))
+    except Exception as e:  # noqa: BLE001 - absence IS the finding
+        member["health_error"] = str(e)[:200]
+    try:
+        text = fetch(base_url.rstrip("/") + "/metrics")
+        member["metrics_totals"] = _prom_totals(text)
+        if full_metrics:
+            member["metrics_text"] = text
+    except Exception as e:  # noqa: BLE001
+        member["metrics_error"] = str(e)[:200]
+    return member
+
+
+def collect_fleet(coordinator_url: str, full_metrics: bool = False,
+                  fetch=_get) -> dict:
+    """The whole fleet's /health + /metrics in one dict (the bench/
+    measure-harness embedding entry point; `fetch` injectable for
+    tests)."""
+    snap = {"ts": round(time.time(), 3),
+            "coordinator": _member(coordinator_url, full_metrics, fetch),
+            "workers": {}}
+    services = ((snap["coordinator"].get("health") or {})
+                .get("services") or {})
+    snap["services"] = dict(services)
+    for service in sorted(services):
+        try:
+            routes = json.loads(fetch(coordinator_url.rstrip("/")
+                                      + f"/routes/{service}"))
+        except Exception as e:  # noqa: BLE001
+            snap["workers"][service] = {"routes_error": str(e)[:200]}
+            continue
+        members = {}
+        for r in routes:
+            key = f"{r['machine']}:{r['partition']}"
+            members[key] = _member(f"http://{r['host']}:{r['port']}",
+                                   full_metrics, fetch)
+        snap["workers"][service] = members
+    return snap
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True,
+                    help="coordinator base URL, e.g. http://127.0.0.1:8000")
+    ap.add_argument("--out", default=None,
+                    help="write the snapshot JSON here (default: stdout)")
+    ap.add_argument("--full-metrics", action="store_true",
+                    help="embed raw Prometheus text per member, not just "
+                         "family totals")
+    args = ap.parse_args()
+    snap = collect_fleet(args.coordinator, full_metrics=args.full_metrics)
+    payload = json.dumps(snap, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload)
+        print(f"wrote {args.out}")
+    else:
+        print(payload)
+    # a snapshot that could not even reach the coordinator is a failure;
+    # partial worker scrape errors are data, not failures
+    return 0 if "health" in snap["coordinator"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

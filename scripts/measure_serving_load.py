@@ -34,6 +34,16 @@ Round 13 adds two lifecycle scenarios (`--scenario`):
   signals: the fleet must grow 2 -> 4 under the ramp and retire back to
   2 after it, retire = deregister -> drain -> stop, zero lost requests.
 
+Round 14 (fleet observability): every run arms the TraceCollector +
+FlightRecorder + the coordinator's SLO monitor — worker/gateway rings
+drain over `GET /trace?since=`, anomaly triggers (swap rollback, shed
+spike, p99/SLO breach) dump atomic incident bundles, and the run's JSON
+embeds a whole-fleet /metrics + /health snapshot (`fleet`, via
+scripts/fleet_status.py) plus the first bundle per trigger reason
+(`incidents`); bench.py lifts both into `extra.fleet` /
+`extra.incidents`. `--no-collect` disables the plane (the A/B arm of
+the collector-overhead table in docs/SERVING.md).
+
 Outputs: a markdown row block on stdout (append to docs/SERVING.md) and a
 JSON summary at --out (defaults: docs/SERVING_load.json /
 docs/SERVING_swap.json / docs/SERVING_autoscale.json; bench.py embeds
@@ -239,6 +249,67 @@ def _scrape(url: str) -> str:
         return r.read().decode()
 
 
+# ------------------------------------------- fleet observability (PR 14)
+
+def _arm_observability(coord, reg, injector=None):
+    """TraceCollector + FlightRecorder over one coordinator's fleet: the
+    collector drains every ring (gateway in-process, workers over
+    /trace), the recorder watches the anomaly triggers and dumps atomic
+    incident bundles. The chaos injector's decisions are bridged onto
+    the gateway ring so injections appear in bundles beside the failures
+    they caused."""
+    import tempfile
+    from mmlspark_tpu.observability import FlightRecorder, TraceCollector
+
+    collector = TraceCollector.for_coordinator(coord, SERVICE,
+                                               registry=reg).start(0.5)
+    inc_dir = tempfile.mkdtemp(prefix="mmlspark_incidents_")
+    recorder = FlightRecorder.for_coordinator(
+        coord, collector, inc_dir, SERVICE, registry=reg,
+        window_s=30.0, cooldown_s=10.0, shed_spike=500.0,
+        slowest_k=8, failed_k=20).start(1.0)
+    if injector is not None:
+        injector.event_log = coord.events
+    return collector, recorder
+
+
+def _harvest_observability(summary, coord, collector, recorder):
+    """Final drain + fleet snapshot INTO the summary (workers must still
+    be up: the bundle's /health walk and the fleet snapshot need them)."""
+    if collector is None:
+        return
+    recorder.stop()
+    collector.stop()
+    try:
+        recorder.tick()   # one synchronous final pass
+    except Exception:
+        pass
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from fleet_status import collect_fleet
+        summary["fleet"] = collect_fleet(coord.url)
+    except Exception as e:  # noqa: BLE001 - snapshot must not fail the run
+        summary["fleet_error"] = str(e)[:200]
+    bundles, seen = [], set()
+    for p in recorder.incidents:
+        try:
+            with open(p) as f:
+                b = json.load(f)
+        except Exception:  # noqa: BLE001
+            continue
+        # embed the FIRST bundle of each distinct reason (bundles carry
+        # full registry snapshots — a flat cap could crowd the rollback
+        # bundle out behind repeated SLO/p99 firings)
+        if b["reason"] in seen:
+            continue
+        seen.add(b["reason"])
+        bundles.append(b)
+        if len(bundles) >= 5:
+            break
+    summary["incidents"] = bundles
+    summary["incident_paths"] = list(recorder.incidents)
+
+
 def _prom_value(text: str, name: str) -> float:
     total = 0.0
     for m in re.finditer(rf"^{name}(?:{{[^}}]*}})? ([0-9.e+-]+)$", text,
@@ -274,7 +345,7 @@ def _spawn_workers(ctx, coord_url, n, registry_dir=None, slow_ms=0.0,
 
 
 def run_variant(chaos: bool, duration_s: float, n_workers: int,
-                n_clients: int) -> dict:
+                n_clients: int, collect: bool = True) -> dict:
     from mmlspark_tpu.io import rowcodec
     from mmlspark_tpu.io.distributed_serving import ServingCoordinator
     from mmlspark_tpu.io.http import KeepAliveTransport
@@ -296,6 +367,9 @@ def run_variant(chaos: bool, duration_s: float, n_workers: int,
         coalesce_max=8).start()
     ctx = mp.get_context("spawn")
     procs, worker_stops, _ = _spawn_workers(ctx, coord.url, n_workers)
+    collector = recorder = None
+    if collect:
+        collector, recorder = _arm_observability(coord, reg, injector)
 
     w = _weights()
     rng = np.random.default_rng(5)
@@ -320,6 +394,9 @@ def run_variant(chaos: bool, duration_s: float, n_workers: int,
         # kill one worker a third of the way in: it must be evicted and
         # the fleet rebalanced with zero accepted-request loss
         time.sleep(max(duration_s / 3.0, 1.0))
+        if recorder is not None:
+            # the p99-breach trigger compares against the healthy phase
+            recorder.arm_baseline()
         procs[0].terminate()
         killed_at = time.perf_counter() - t0
         time.sleep(max(duration_s * 2.0 / 3.0, 1.0))
@@ -408,6 +485,8 @@ def run_variant(chaos: bool, duration_s: float, n_workers: int,
     if chaos:
         summary["injected"] = dict(injector.counts)
         summary["worker_killed_at_s"] = round(killed_at, 1)
+    summary["collect"] = bool(collect)
+    _harvest_observability(summary, coord, collector, recorder)
 
     for p, st in zip(procs, worker_stops):
         if p.is_alive():
@@ -455,7 +534,7 @@ def _client_tallies(clients, wall) -> dict:
 
 
 def run_swap_variant(chaos: bool, duration_s: float, n_workers: int,
-                     n_clients: int) -> dict:
+                     n_clients: int, collect: bool = True) -> dict:
     """Sustained load with a mid-run version rollout. Baseline: canary ->
     promote to v2 completes with zero lost/shed accepted requests, every
     200 payload exact against {v1, v2}. Chaos: the target version's
@@ -509,6 +588,9 @@ def run_swap_variant(chaos: bool, duration_s: float, n_workers: int,
     ctx = mp.get_context("spawn")
     procs, worker_stops, _ = _spawn_workers(ctx, coord.url, n_workers,
                                             registry_dir=rdir)
+    collector = recorder = None
+    if collect:
+        collector, recorder = _arm_observability(coord, reg, injector)
 
     rng = np.random.default_rng(5)
     bodies = []
@@ -530,6 +612,8 @@ def run_swap_variant(chaos: bool, duration_s: float, n_workers: int,
     # phase 1: steady pre-swap traffic (beats deliver model_version
     # reports, baselines settle)
     time.sleep(max(duration_s / 3.0, 2.0))
+    if recorder is not None:
+        recorder.arm_baseline()  # p99 judged against pre-swap steady
     # under chaos the routing table can be transiently EMPTY (an injected
     # forward fault just evicted everyone; heartbeats re-register within
     # a beat) — retry like an operator would
@@ -615,6 +699,8 @@ def run_swap_variant(chaos: bool, duration_s: float, n_workers: int,
     }
     if chaos:
         summary["injected"] = dict(injector.counts)
+    summary["collect"] = bool(collect)
+    _harvest_observability(summary, coord, collector, recorder)
 
     for p, st in zip(procs, worker_stops):
         if p.is_alive():
@@ -630,7 +716,8 @@ def run_swap_variant(chaos: bool, duration_s: float, n_workers: int,
 
 # ---------------------------------------------------- autoscale scenario
 
-def run_autoscale_variant(duration_s: float, n_clients: int) -> dict:
+def run_autoscale_variant(duration_s: float, n_clients: int,
+                          collect: bool = True) -> dict:
     """Ramped load against a 2-worker base fleet with the Autoscaler
     acting on heartbeat queue-depth signals: grow 2 -> 4 under the ramp,
     retire back to 2 after it (deregister -> drain -> stop), zero lost
@@ -654,6 +741,9 @@ def run_autoscale_variant(duration_s: float, n_clients: int) -> dict:
                      max_batch_size=64)
     base_procs, base_stops, _ = _spawn_workers(ctx, coord.url, 2,
                                                **worker_kw)
+    collector = recorder = None
+    if collect:
+        collector, recorder = _arm_observability(coord, reg)
     next_partition = [2]
     spawned = []   # (proc, stop, retire) the autoscaler manages
 
@@ -765,6 +855,8 @@ def run_autoscale_variant(duration_s: float, n_clients: int) -> dict:
         "evictions": reg.total("gateway_evictions_total"),
         **_client_tallies(clients, wall),
     }
+    summary["collect"] = bool(collect)
+    _harvest_observability(summary, coord, collector, recorder)
 
     scaler.stop(retire_spawned=True)
     for st in base_stops:
@@ -838,6 +930,10 @@ def main() -> int:
     ap.add_argument("--clients", type=int, default=int(
         os.environ.get("MEASURE_LOAD_CLIENTS", "32")))
     ap.add_argument("--target-rows-s", type=float, default=100_000.0)
+    ap.add_argument("--no-collect", action="store_true",
+                    help="disable the trace collector + flight recorder "
+                         "(the A/B arm of the collector-overhead table in "
+                         "docs/OBSERVABILITY.md)")
     args = ap.parse_args()
     if args.out is None:
         args.out = {"load": "docs/SERVING_load.json",
@@ -856,7 +952,8 @@ def main() -> int:
             print(f"== {tag}: {args.duration_s:.0f}s, {args.workers} "
                   f"workers, {args.clients} clients", flush=True)
             results.append(run_variant(chaos, args.duration_s,
-                                       args.workers, args.clients))
+                                       args.workers, args.clients,
+                                       collect=not args.no_collect))
     elif args.scenario == "swap":
         variants = [False]
         if os.environ.get("MEASURE_LOAD_SKIP_CHAOS") != "1":
@@ -866,17 +963,25 @@ def main() -> int:
             print(f"== {tag}: {args.duration_s:.0f}s, {args.workers} "
                   f"workers, {args.clients} clients", flush=True)
             results.append(run_swap_variant(chaos, args.duration_s,
-                                            args.workers, args.clients))
+                                            args.workers, args.clients,
+                                            collect=not args.no_collect))
     else:
         print(f"== autoscale: {args.duration_s:.0f}s ramp, "
               f"{args.clients} ramp clients", flush=True)
         results.append(run_autoscale_variant(args.duration_s,
-                                             args.clients))
+                                             args.clients,
+                                             collect=not args.no_collect))
     for s in results:
         print(json.dumps({k: v for k, v in s.items()
                           if k not in ("worker_stats", "trace_exemplars",
-                                       "fleet_series")},
+                                       "fleet_series", "fleet",
+                                       "incidents")},
                          indent=1), flush=True)
+        for inc in s.get("incidents", []):
+            print(f"  incident: {inc['reason']} ({inc['detail']}) — "
+                  f"{len(inc['traces']['slowest'])} slowest / "
+                  f"{len(inc['traces']['failed'])} failed traces, "
+                  f"{len(inc['system_events'])} system events", flush=True)
 
     record = {
         "host": "cpu",

@@ -1,0 +1,817 @@
+"""Fleet observability plane (ISSUE 14): trace drain + assembly, incident
+flight recorder, SLO burn-rate monitors, metrics-naming lint.
+
+Tier-1 discipline (ISSUE 14 budget satellite): every collector / flight
+recorder / SLO test here runs with injected clocks and in-process fakes —
+no sleeps, no subprocess fleets. The full-fleet acceptance (chaos
+measure_serving_load run producing an incident bundle) rides the @slow
+mini-run in tests/test_model_lifecycle.py; this file carries its tier-1
+in-process equivalent (TestIncidentEndToEnd).
+"""
+
+import ast
+import json
+import os
+import re
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability import (EventLog, FlightRecorder,
+                                        MetricsRegistry, SLODef, SLOMonitor,
+                                        TraceCollector, set_registry,
+                                        windowed_quantile)
+
+
+def _get_json(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(url, data=body, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10.0) as r:
+        return r.status, r.read()
+
+
+# ------------------------------------------------------------ trace drain
+
+class TestTraceDrain:
+    def test_events_since_strictly_greater(self):
+        log = EventLog(capacity=16)
+        log.append("a", "t1")
+        ts = log.events()[-1]["ts"]
+        assert log.events_since(ts) == []          # strictly greater
+        assert [e["span"] for e in log.events_since(0.0)] == ["a"]
+        assert log.total_appended == 1
+
+    def test_ts_strictly_increases_even_when_clock_does_not(self,
+                                                            monkeypatch):
+        """Two appends inside one rounded microsecond (or a backward
+        wall-clock step) must still get strictly increasing ts — a tie
+        with a drained cursor would drop the event from every future
+        strictly-greater drain."""
+        from mmlspark_tpu.observability import tracing
+        monkeypatch.setattr(tracing.time, "time", lambda: 1000.0)
+        log = EventLog(capacity=16)
+        log.append("a", "t")
+        log.append("b", "t")
+        monkeypatch.setattr(tracing.time, "time", lambda: 999.0)  # step back
+        log.append("c", "t")
+        ts = [e["ts"] for e in log.events()]
+        assert ts == sorted(ts) and len(set(ts)) == 3
+        assert [e["span"] for e in log.events_since(ts[0])] == ["b", "c"]
+
+    @pytest.mark.parametrize("listener", ["asyncio", "thread"])
+    def test_trace_endpoint_drains_with_cursor(self, listener):
+        from mmlspark_tpu.io.serving import ServingServer
+
+        srv = ServingServer(
+            lambda df: df.with_column("prediction", np.ones(len(df))),
+            port=0, listener=listener, max_latency_ms=1.0,
+            registry=MetricsRegistry()).start()
+        try:
+            _post(srv.url, json.dumps({"x": 1.0}).encode(),
+                  {"X-Trace-Id": "tr-drain-1"})
+            base = f"http://{srv.host}:{srv.port}/trace"
+            t = _get_json(base + "?since=0")
+            assert t["source"] == srv.metrics_label
+            assert t["total_appended"] >= 4
+            spans = [e["span"] for e in t["events"]
+                     if e.get("trace_id") == "tr-drain-1"]
+            assert spans == ["queue_wait", "batch_assembly",
+                             "device_dispatch", "reply"]
+            # cursor contract: draining from the returned `now` is empty,
+            # and a malformed cursor degrades to a full drain, not a 500
+            # — including 'nan', which float() parses and which would
+            # otherwise make every ts > since comparison False (a
+            # permanently-empty drain masquerading as a quiet ring)
+            assert _get_json(f"{base}?since={t['now']}")["events"] == []
+            assert len(_get_json(base + "?since=bogus")["events"]) >= 4
+            assert len(_get_json(base + "?since=nan")["events"]) >= 4
+            assert len(_get_json(base + "?since=inf")["events"]) >= 4
+        finally:
+            srv.stop()
+
+    def test_gateway_trace_endpoint(self):
+        from mmlspark_tpu.io.distributed_serving import ServingCoordinator
+
+        coord = ServingCoordinator(registry=MetricsRegistry()).start()
+        try:
+            coord.events.append("rollout", "tid-x", state="canary",
+                                service="svc", target=2, reason=None)
+            t = _get_json(coord.url + "/trace?since=0")
+            assert t["source"] == coord.metrics_label
+            assert any(e["span"] == "rollout" for e in t["events"])
+        finally:
+            coord.stop()
+
+
+# ---------------------------------------------------- JSONL sink satellite
+
+class TestSinkErrors:
+    def test_torn_sink_counts_warns_and_closes(self, tmp_path):
+        """A sink write error must close the fd (no leak), set _sink None,
+        warn once, and land in tracing_sink_errors_total — never take the
+        appending thread down."""
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            p = str(tmp_path / "sink.jsonl")
+            log = EventLog(capacity=4, sink_path=p)
+            fh = log._sink
+            fh.close()   # tear the sink off underneath the log
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                log.append("s", "t1")   # write hits the closed fd
+            assert log._sink is None
+            assert fh.closed
+            assert any("torn off" in str(w.message) for w in caught)
+            assert reg.total("tracing_sink_errors_total") == 1
+            # the ring still has the event and later appends still work
+            log.append("s2", "t2")
+            assert [e["span"] for e in log.events()] == ["s", "s2"]
+            assert reg.total("tracing_sink_errors_total") == 1
+        finally:
+            set_registry(prev)
+
+    def test_close_releases_fd_and_is_idempotent(self, tmp_path):
+        p = str(tmp_path / "sink.jsonl")
+        log = EventLog(capacity=4, sink_path=p)
+        fh = log._sink
+        log.append("s", "t")
+        log.close()
+        assert fh.closed and log._sink is None
+        log.close()   # idempotent
+        assert json.loads(open(p).read().splitlines()[0])["span"] == "s"
+
+
+# -------------------------------------------------------- trace collector
+
+def _mk_gateway_worker_logs(t0=1000.0):
+    """Scripted gateway + worker rings for one failover trace: a dead
+    attempt, then an ok attempt whose window covers the worker spans."""
+    gw, wk = EventLog(64), EventLog(64)
+    tid = "tr-asm-1"
+    # hand-stamp timestamps (events() returns the dicts by reference —
+    # scripting ts this way keeps the test clock-free)
+    gw.append("forward_attempt", tid, dur_s=0.01, attempt=0,
+              worker="10.0.0.9:1", outcome="unreachable")
+    gw.append("forward_attempt", tid, dur_s=0.05, attempt=1,
+              worker="10.0.0.5:2", outcome="ok")
+    gw.append("reply", tid, dur_s=0.08, status=200)
+    for i, ev in enumerate(gw.events()):
+        ev["ts"] = t0 + (0.02, 0.08, 0.081)[i]
+    wk.append("queue_wait", tid, dur_s=0.01)
+    wk.append("batch_assembly", tid, dur_s=0.002)
+    wk.append("device_dispatch", tid, dur_s=0.001)
+    wk.append("reply", tid, dur_s=0.001, status=200)
+    for i, ev in enumerate(wk.events()):
+        # worker clock skewed +0.1s vs the gateway: still inside the
+        # attempt window once widened by the skew tolerance
+        ev["ts"] = t0 + 0.04 + 0.1 + i * 0.001
+    return gw, wk, tid
+
+
+class TestTraceCollector:
+    def _collector(self, gw, wk, **kw):
+        kw.setdefault("skew_tolerance_s", 0.25)
+        col = TraceCollector(registry=MetricsRegistry(), **kw)
+        col.add_gateway("gw", event_log=gw)
+        col.add_worker("wk", endpoint="10.0.0.5:2", event_log=wk)
+        return col
+
+    def test_assembles_failover_tree_with_skew(self):
+        gw, wk, tid = _mk_gateway_worker_logs()
+        col = self._collector(gw, wk)
+        assert col.poll() == 7
+        t = col.trace(tid)
+        attempts = [h for h in t["hops"] if h["span"] == "forward_attempt"]
+        assert [a["outcome"] for a in attempts] == ["unreachable", "ok"]
+        # the dead attempt parents nothing; the ok attempt parents the
+        # worker's whole span pipeline, in pipeline order, same trace id
+        assert attempts[0]["children"] == []
+        kids = attempts[1]["children"]
+        assert [k["span"] for k in kids] == [
+            "queue_wait", "batch_assembly", "device_dispatch", "reply"]
+        assert all(k["trace_id"] == tid for k in kids)
+        assert t["status"] == 200
+        assert t["hops"][-1]["span"] == "reply"
+        assert t["hops"][-1]["source"] == "gw"
+
+    def test_cursor_drains_no_duplicates(self):
+        gw, wk, tid = _mk_gateway_worker_logs()
+        col = self._collector(gw, wk)
+        assert col.poll() == 7
+        assert col.poll() == 0          # nothing new
+        gw.append("reply", "tr-2", dur_s=0.01, status=503)
+        assert col.poll() == 1          # only the new event
+        t = col.trace(tid)              # no double-ingest anywhere:
+        assert len(t["hops"]) == 3      # 2 attempts + gateway reply
+        ok = [h for h in t["hops"] if h.get("outcome") == "ok"][0]
+        assert len(ok["children"]) == 4
+
+    def test_worker_spans_outside_skew_stay_top_level(self):
+        gw, wk, tid = _mk_gateway_worker_logs()
+        col = self._collector(gw, wk, skew_tolerance_s=0.01)
+        col.poll()
+        t = col.trace(tid)
+        ok = [h for h in t["hops"] if h.get("outcome") == "ok"][0]
+        # skew (0.1s) exceeds the tolerance: spans are NOT claimed by the
+        # attempt but are NOT dropped either — they surface top-level
+        assert ok["children"] == []
+        assert sum(1 for h in t["hops"] if h["source"] == "wk") == 4
+
+    def test_slowest_failed_and_lru_bound(self):
+        gw = EventLog(64)
+        col = TraceCollector(registry=MetricsRegistry(), max_traces=3)
+        col.add_gateway("gw", event_log=gw)
+        for i, (dur, status) in enumerate(
+                [(0.5, 200), (0.1, 200), (0.9, 504), (0.2, 200)]):
+            gw.append("reply", f"t{i}", dur_s=dur, status=status)
+        col.poll()
+        assert len(col.trace_ids()) == 3        # LRU evicted the oldest
+        assert col.trace("t0") is None
+        assert [t["trace_id"] for t in col.slowest(2)] == ["t2", "t3"]
+        assert [t["trace_id"] for t in col.failed()] == ["t2"]
+
+    def test_source_replaced_when_identity_moves_endpoint(self):
+        """A worker restarting with the same (machine, partition) name on
+        a NEW port must replace its stale source (fresh cursor, new join
+        endpoint) — not leave the collector polling a dead URL forever."""
+        col = TraceCollector(registry=MetricsRegistry())
+        old = EventLog(16)
+        old.append("reply", "t-old", dur_s=0.01, status=200)
+        col.add_worker("m0", endpoint="127.0.0.1:1", event_log=old)
+        col.poll()
+        new = EventLog(16)
+        new.append("reply", "t-new", dur_s=0.02, status=200)
+        col.add_worker("m0", endpoint="127.0.0.1:2", event_log=new)
+        assert len(col._sources) == 1
+        assert col._sources[0].endpoint == "127.0.0.1:2"
+        assert col.poll() == 1                    # fresh ring drained
+        assert col.trace("t-new") is not None
+        # true idempotent re-add (same endpoint) stays a no-op
+        col.add_worker("m0", endpoint="127.0.0.1:2", event_log=new)
+        assert len(col._sources) == 1 and col.poll() == 0
+
+    def test_departed_worker_goes_dormant_and_heals_without_dupes(self):
+        """A worker evicted from the routing table must not be polled
+        (a dead URL stalls the drain loop 5 s per cycle), but its cursor
+        is kept so a heal resumes WITHOUT re-ingesting old events."""
+        class StubCoord:
+            def __init__(self):
+                self.table = []
+
+            def routes(self, service):
+                return self.table
+
+        class Info:
+            def __init__(self, host, port, machine, partition):
+                self.host, self.port = host, port
+                self.machine, self.partition = machine, partition
+
+        coord = StubCoord()
+        coord.table = [Info("127.0.0.1", 7, "m0", 0)]
+        ring = EventLog(16)
+        ring.append("reply", "t-1", dur_s=0.01, status=200)
+        fetched = []
+
+        def fetch(url):
+            fetched.append(url)
+            since = float(url.split("since=")[1])
+            evs, cursor = ring.drain(since)
+            return {"events": evs, "now": cursor}
+
+        col = TraceCollector(registry=MetricsRegistry(), fetch=fetch)
+        col._coordinator, col._service = coord, "svc"
+        col.add_gateway("gw", event_log=EventLog(4))
+        assert col.poll() == 1 and len(fetched) == 1
+        coord.table = []                      # evicted/retired
+        ring.append("reply", "t-2", dur_s=0.02, status=200)
+        assert col.poll() == 0
+        assert len(fetched) == 1              # dormant: URL not touched
+        coord.table = [Info("127.0.0.1", 7, "m0", 0)]   # healed
+        assert col.poll() == 1                # only the NEW event
+        assert len(col.trace("t-1")["hops"]) == 1       # no duplicates
+
+    def test_system_events_split_from_traces_and_poll_errors(self):
+        gw = EventLog(64)
+        gw.append("swap", "tid-s", version=2, outcome="rollback_load")
+        gw.append("reply", "tid-r", dur_s=0.01, status=200)
+        reg = MetricsRegistry()
+        col = TraceCollector(registry=reg,
+                             fetch=lambda url: (_ for _ in ()).throw(
+                                 IOError("down")))
+        col.add_gateway("gw", event_log=gw)
+        col.add_worker("dead", endpoint="10.0.0.1:1",
+                       url="http://10.0.0.1:1/trace")
+        col.poll()
+        sys_evs = col.system_events()
+        assert [e["span"] for e in sys_evs] == ["swap"]
+        assert col.system_events(after_seq=sys_evs[0]["_seq"]) == []
+        assert col.trace("tid-s") is None       # not a request trace
+        assert col.trace("tid-r") is not None
+        assert reg.total("collector_poll_errors_total") == 1
+
+    def test_http_roundtrip_over_real_fleet(self):
+        """for_coordinator over a live gateway + worker: one request, one
+        poll, a fully parented tree (the tier-1 integration slice of the
+        @slow harness run)."""
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+        from mmlspark_tpu.io.serving import ServingServer
+
+        reg = MetricsRegistry()
+        coord = ServingCoordinator(registry=reg).start()
+        srv = ServingServer(
+            lambda df: df.with_column("prediction", np.ones(len(df))),
+            port=0, max_latency_ms=1.0, registry=reg).start()
+        try:
+            coord.register(ServiceInfo("svc", "127.0.0.1", srv.port,
+                                       "m0", 0))
+            status, _ = _post(coord.url + "/gateway/svc",
+                              json.dumps({"x": 1.0}).encode(),
+                              {"X-Trace-Id": "tr-http-1"})
+            assert status == 200
+            col = TraceCollector.for_coordinator(coord, "svc",
+                                                 registry=reg)
+            assert col.poll() >= 6
+            t = col.trace("tr-http-1")
+            ok = [h for h in t["hops"]
+                  if h["span"] == "forward_attempt"][0]
+            assert ok["outcome"] == "ok"
+            assert [k["span"] for k in ok["children"]] == [
+                "queue_wait", "batch_assembly", "device_dispatch", "reply"]
+        finally:
+            srv.stop()
+            coord.stop()
+
+
+# --------------------------------------------------------- SLO burn rates
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestWindowedQuantile:
+    def test_diff_quantile_over_window(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        from mmlspark_tpu.observability.slo import _family_buckets
+        for _ in range(100):
+            h.observe(0.005)
+        old = _family_buckets(reg.snapshot(), "lat_seconds")
+        for _ in range(100):
+            h.observe(0.5)
+        new = _family_buckets(reg.snapshot(), "lat_seconds")
+        # the WINDOW is 100% slow observations even though the lifetime
+        # distribution is 50/50 — the diff isolates the window
+        assert windowed_quantile(old, new, 0.5) == 1.0
+        assert windowed_quantile(old, new, 0.99) == 1.0
+        assert windowed_quantile(new, new, 0.5) is None   # empty window
+
+
+class TestSLOMonitor:
+    def _monitor(self, reg, clock, **kw):
+        kw.setdefault("fast_window_s", 10.0)
+        kw.setdefault("slow_window_s", 60.0)
+        slos = [SLODef("avail", "error_rate",
+                       bad=("bad_total",), total=("all_total",),
+                       budget=0.01)]
+        return SLOMonitor(registry=reg, slos=slos, clock=clock, **kw)
+
+    def test_error_rate_burn_and_breach_transitions(self):
+        """Drive error-rate across the fast-window threshold with an
+        injected clock: burn gauges update, breach fires when BOTH
+        windows burn, clear event on recovery (the acceptance test)."""
+        reg = MetricsRegistry()
+        clock = FakeClock()
+        mon = self._monitor(reg, clock)
+        bad = reg.counter("bad_total")
+        total = reg.counter("all_total")
+        total.inc(1000)
+        mon.tick()
+        for t in (2.0, 4.0, 6.0):        # clean traffic: burn ~0
+            clock.t = t
+            total.inc(100)
+            mon.tick()
+        st = mon.status()["avail"]
+        assert st["burn_fast"] == 0.0 and not st["breached"]
+        # warm-up guard: the slow window (60s) has no burn until history
+        # spans at least half of it — a young monitor's "slow" window
+        # would otherwise be the fast window in disguise and a blip
+        # would breach both
+        assert st["burn_slow"] is None
+        # 10% errors against a 1% budget, sustained past the slow
+        # window's warm-up (t=30): both windows burn -> breach
+        for t in range(8, 38, 2):
+            clock.t = float(t)
+            total.inc(100)
+            bad.inc(10)
+            mon.tick()
+        st = mon.status()["avail"]
+        assert st["breached"]
+        # deterministic: fast base is the t=26 sample (2300 total, 100
+        # bad) -> burn = (50/500)/0.01 = 10.0; slow base is t=0
+        assert st["burn_fast"] == pytest.approx(10.0)
+        assert st["burn_slow"] == pytest.approx((150 / 1800) / 0.01)
+        # gauges are in the registry under the documented name
+        snap = reg.snapshot()["slo_burn_rate"]["series"]
+        by = {(s["labels"]["slo"], s["labels"]["window"]): s["value"]
+              for s in snap}
+        assert by[("avail", "fast")] == st["burn_fast"]
+        # the transition landed as a structured event
+        evs = [e for e in mon.events.events() if e["span"] == "slo"]
+        assert evs and evs[-1]["state"] == "breach"
+        # recovery: clean traffic pushes the fast window under threshold
+        for t in (38.0, 40.0, 42.0, 44.0, 46.0, 48.0):
+            clock.t = t
+            total.inc(500)
+            mon.tick()
+        assert not mon.status()["avail"]["breached"]
+        assert not mon.breached()
+        evs = [e for e in mon.events.events() if e["span"] == "slo"]
+        assert evs[-1]["state"] == "clear"
+
+    def test_latency_slo_burn(self):
+        reg = MetricsRegistry()
+        clock = FakeClock()
+        h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        mon = SLOMonitor(
+            registry=reg, clock=clock, fast_window_s=10.0,
+            slow_window_s=60.0,
+            slos=[SLODef("lat", "latency_p99", family="lat_seconds",
+                         objective_ms=100.0)])
+        for _ in range(50):
+            h.observe(0.005)
+        mon.tick()
+        clock.t = 5.0
+        for _ in range(50):
+            h.observe(0.5)     # windowed p99 -> 1.0s bucket = 1000 ms
+        mon.tick()
+        st = mon.status()["lat"]
+        assert st["burn_fast"] == pytest.approx(10.0)   # 1000ms / 100ms
+
+    def test_coordinator_health_carries_slo_and_gate_rolls_back(self):
+        """The /health block + the off-by-default rollout gate: with
+        slo_rollout_gate=True and a breached monitor, rollout_tick rolls
+        an active rollout back; with the default (False) it does not."""
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+
+        for gate in (False, True):
+            reg = MetricsRegistry()
+            coord = ServingCoordinator(registry=reg,
+                                       slo_rollout_gate=gate)
+            coord.register(ServiceInfo("svc", "127.0.0.1", 1, "m0", 0))
+            coord.start_rollout("svc", 2, previous=1)
+            assert coord.health()["slo"] is not None
+            # force a breach without waiting out real windows
+            for slo in coord.slo.slos:
+                coord.slo._breached[slo.name] = True
+            assert coord.slo.breached()
+            coord.rollout_tick()
+            ro = coord.rollout_status("svc")
+            if gate:
+                assert ro["state"] == "rolled_back"
+                assert "slo" in ro["reason"]
+            else:
+                assert ro["state"] == "canary"
+
+
+# ------------------------------------------------------- flight recorder
+
+def _recorder(tmp_path, sources, clock, reg=None, **kw):
+    reg = reg or MetricsRegistry()
+    col = TraceCollector(registry=reg)
+    for role, name, log, endpoint in sources:
+        if role == "gateway":
+            col.add_gateway(name, event_log=log)
+        else:
+            col.add_worker(name, endpoint=endpoint, event_log=log)
+    kw.setdefault("cooldown_s", 30.0)
+    rec = FlightRecorder(col, str(tmp_path), registry=reg, clock=clock,
+                         **kw)
+    return rec, col, reg
+
+
+class TestFlightRecorder:
+    def test_swap_rollback_dumps_bundle_with_cooldown(self, tmp_path):
+        gw = EventLog(64)
+        clock = FakeClock(100.0)
+        rec, col, reg = _recorder(tmp_path,
+                                  [("gateway", "gw", gw, None)], clock)
+        assert rec.tick() == []                  # quiet fleet: no bundle
+        gw.append("swap", "tid-1", version=3, outcome="rollback_digest")
+        paths = rec.tick()
+        assert len(paths) == 1
+        b = json.loads(open(paths[0]).read())
+        assert b["schema_version"] == 1
+        assert b["reason"] == "swap_rollback"
+        assert any(e["span"] == "swap"
+                   and e["outcome"] == "rollback_digest"
+                   for e in b["system_events"])
+        assert "registry" in b and "traces" in b
+        assert reg.total("incident_bundles_total") == 1
+        # cooldown: a second rollback inside the window does not dump...
+        clock.t = 110.0
+        gw.append("swap", "tid-2", version=4, outcome="rollback_load")
+        assert rec.tick() == []
+        # ...but one past the cooldown does
+        clock.t = 200.0
+        gw.append("swap", "tid-3", version=5, outcome="rollback_load")
+        assert len(rec.tick()) == 1
+        assert len(rec.incidents) == 2
+
+    def test_shed_spike_trigger(self, tmp_path):
+        clock = FakeClock(0.0)
+        reg = MetricsRegistry()
+        rec, col, _ = _recorder(tmp_path, [], clock, reg=reg,
+                                window_s=30.0, shed_spike=50.0)
+        shed = reg.counter("serving_shed_total")
+        rec.tick()
+        clock.t = 10.0
+        shed.inc(40)             # below the spike bar
+        assert rec.tick() == []
+        clock.t = 20.0
+        shed.inc(60)             # 100 sheds inside the window
+        paths = rec.tick()
+        assert len(paths) == 1
+        assert json.loads(open(paths[0]).read())["reason"] == "shed_spike"
+
+    def test_p99_breach_vs_armed_baseline(self, tmp_path):
+        clock = FakeClock(0.0)
+        reg = MetricsRegistry()
+        rec, col, _ = _recorder(tmp_path, [], clock, reg=reg,
+                                window_s=30.0, p99_factor=3.0,
+                                p99_family="gateway_request_latency_seconds")
+        h = reg.histogram("gateway_request_latency_seconds",
+                          labels={"instance": "g"})
+        for _ in range(100):
+            h.observe(0.005)
+        rec.arm_baseline()
+        assert rec.baseline_p99_ms is not None
+        rec.tick()
+        clock.t = 10.0
+        assert rec.tick() == []          # still healthy
+        for _ in range(100):
+            h.observe(2.0)               # windowed p99 >> baseline*3
+        clock.t = 20.0
+        paths = rec.tick()
+        assert len(paths) == 1
+        b = json.loads(open(paths[0]).read())
+        assert b["reason"] == "p99_breach"
+
+    def test_slo_breach_event_triggers_bundle(self, tmp_path):
+        gw = EventLog(64)
+        clock = FakeClock(0.0)
+        rec, col, _ = _recorder(tmp_path,
+                                [("gateway", "gw", gw, None)], clock)
+        gw.append("slo", "tid-s", slo="availability", state="breach",
+                  burn_fast=14.0, burn_slow=2.1)
+        paths = rec.tick()
+        assert len(paths) == 1
+        assert json.loads(open(paths[0]).read())["reason"] == "slo_breach"
+
+
+# ----------------------------- tier-1 in-process incident acceptance run
+
+class TestIncidentEndToEnd:
+    def test_chaos_swap_rollback_produces_assembled_incident(self, tmp_path):
+        """The tier-1 equivalent of the @slow chaos harness acceptance:
+        in-process gateway + workers, 30% injected forward faults, a
+        corrupt-load hot swap — the recorder must dump a bundle whose
+        trace trees parent worker spans under gateway attempts for the
+        SAME trace id and whose system events carry the rollback."""
+        import threading
+
+        from mmlspark_tpu.io.distributed_serving import (
+            ServiceInfo, ServingCoordinator, _default_transport)
+        from mmlspark_tpu.io.serving import ServingServer
+        from mmlspark_tpu.resilience import Deadline, FaultInjector
+        from mmlspark_tpu.resilience.policy import RetryPolicy
+
+        reg = MetricsRegistry()
+        coord, workers = None, []
+        stop_heal = threading.Event()
+        try:
+            coord = ServingCoordinator(
+                registry=reg,
+                forward_retry=RetryPolicy(attempts=8, backoff_s=0.01,
+                                          multiplier=1.2,
+                                          max_backoff_s=0.05, jitter=0.0),
+                forward_transport=None).start()
+            injector = FaultInjector(seed=7, error_rate=0.3,
+                                     event_log=coord.events)
+            coord._transport = injector.wrap(_default_transport)
+            workers = [ServingServer(
+                lambda df: df.with_column("prediction",
+                                          np.ones(len(df))),
+                port=0, max_latency_ms=0.5, registry=reg).start()
+                for _ in range(2)]
+            infos = [ServiceInfo("svc", "127.0.0.1", w.port, f"m{p}", p)
+                     for p, w in enumerate(workers)]
+            for info in infos:
+                coord.register(info)
+
+            # chaos evicts; a healer thread stands in for the heartbeat
+            # re-registration loop (the TestChaosReconciliation pattern)
+            def heal():
+                while not stop_heal.wait(0.02):
+                    if len(coord.routes("svc")) < len(workers):
+                        for info in infos:
+                            coord.register(info)
+            threading.Thread(target=heal, daemon=True).start()
+            col = TraceCollector(registry=reg)
+            col.add_gateway(coord.metrics_label, event_log=coord.events)
+            for p, w in enumerate(workers):
+                col.add_worker(f"m{p}", endpoint=f"127.0.0.1:{w.port}",
+                               event_log=w.events)
+            clock = FakeClock(0.0)
+            rec = FlightRecorder(col, str(tmp_path), registry=reg,
+                                 clock=clock, cooldown_s=1000.0,
+                                 health_fn=coord.health,
+                                 workers_fn=lambda: [
+                                     (f"127.0.0.1:{w.port}",
+                                      f"http://127.0.0.1:{w.port}")
+                                     for w in workers])
+            for i in range(30):
+                status, _ = _post(
+                    coord.url + "/gateway/svc",
+                    json.dumps({"x": float(i)}).encode(),
+                    {"X-Trace-Id": f"tr-e2e-{i:03d}",
+                     Deadline.HEADER: "8000"})
+                assert status == 200
+            assert injector.counts["error"] > 0
+            # the corrupt-artifact analogue: the swap load fails -> a
+            # counted rollback_load system event on the worker's ring
+            res = workers[0].hot_swap(
+                lambda: (_ for _ in ()).throw(IOError("corrupt")),
+                2, wait_s=10)
+            assert res.outcome == "rollback_load"
+            paths = rec.tick()
+            assert len(paths) == 1
+            b = json.loads(open(paths[0]).read())
+            assert b["reason"] == "swap_rollback"
+            # the rollback system event AND the injected chaos are there
+            assert any(e["span"] == "swap"
+                       and e["outcome"] == "rollback_load"
+                       for e in b["system_events"])
+            assert any(e["span"] == "chaos" and e["kind"] == "error"
+                       for e in b["system_events"])
+            # >= 1 fully assembled end-to-end tree: a gateway attempt
+            # parenting the worker's span pipeline for the SAME trace id
+            assembled = 0
+            for t in b["traces"]["slowest"] + b["traces"]["failed"]:
+                for h in t["hops"]:
+                    if h.get("span") == "forward_attempt" \
+                            and h.get("outcome") == "ok" \
+                            and [k["span"] for k in h.get("children", ())
+                                 ] == ["queue_wait", "batch_assembly",
+                                       "device_dispatch", "reply"] \
+                            and all(k["trace_id"] == t["trace_id"]
+                                    for k in h["children"]):
+                        assembled += 1
+            assert assembled >= 1
+            # every worker's /health made it into the bundle
+            assert len(b["workers_health"]) == 2
+            assert all("queue_depth" in h
+                       for h in b["workers_health"].values())
+            assert b["coordinator_health"]["services"] == {"svc": 2}
+        finally:
+            stop_heal.set()
+            for w in workers:
+                w.stop()
+            if coord is not None:
+                coord.stop()
+
+
+# ------------------------------------------------------------ fleet status
+
+class TestFleetStatus:
+    def test_collect_fleet_with_injected_fetch(self):
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts"))
+        from fleet_status import _prom_totals, collect_fleet
+
+        pages = {
+            "http://c:1/health": json.dumps(
+                {"services": {"svc": 1}, "slo": None}),
+            "http://c:1/metrics": "gateway_forwards_total{i=\"g\"} 5\n",
+            "http://c:1/routes/svc": json.dumps(
+                [{"name": "svc", "host": "w", "port": 2,
+                  "machine": "m0", "partition": 0}]),
+            "http://w:2/health": json.dumps({"queue_depth": 3}),
+            "http://w:2/metrics": (
+                "serving_requests_total{instance=\"s\"} 7\n"
+                "serving_request_latency_seconds_bucket{le=\"0.1\"} 9\n"
+                "serving_request_latency_seconds_count 7\n"),
+        }
+        snap = collect_fleet("http://c:1", fetch=lambda u: pages[u])
+        assert snap["services"] == {"svc": 1}
+        assert snap["coordinator"]["metrics_totals"][
+            "gateway_forwards_total"] == 5
+        worker = snap["workers"]["svc"]["m0:0"]
+        assert worker["health"]["queue_depth"] == 3
+        totals = worker["metrics_totals"]
+        assert totals["serving_requests_total"] == 7
+        assert "serving_request_latency_seconds_bucket" not in totals
+        assert _prom_totals("a_total{x=\"1\"} 2\na_total{x=\"2\"} 3\n") \
+            == {"a_total": 5.0}
+
+
+# ------------------------------------------------- metrics naming lint
+
+class TestMetricsNamingLint:
+    """Every registered family name must follow the documented
+    `<area>_<noun>_<unit|total>` scheme (docs/OBSERVABILITY.md): snake
+    case, a registered area prefix, counters ending `_total`, histograms
+    ending in a unit, gauges never ending `_total`. 10 families were
+    added in PR 13 alone — this is the drift gate."""
+
+    #: documented area vocabulary (first name token). Extending it is a
+    #: deliberate act: add the area HERE and to docs/OBSERVABILITY.md.
+    AREAS = {"serving", "gateway", "autoscaler", "chaos", "bringup",
+             "checkpoint", "compile", "gbdt", "fit", "http", "model",
+             "tracing", "slo", "collector", "incident"}
+    NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+    HIST_UNITS = ("_seconds", "_rows", "_bytes")
+    #: call sites building the family name dynamically (f-strings) —
+    #: pinned so a NEW dynamic name is a conscious decision, not drift
+    MAX_DYNAMIC_SITES = 3
+
+    def _calls(self):
+        root = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "mmlspark_tpu")
+        literal, dynamic = [], []
+        for dirpath, _, names in os.walk(root):
+            for n in names:
+                if not n.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, n)
+                tree = ast.parse(open(path, encoding="utf-8").read())
+                for node in ast.walk(tree):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ("counter", "gauge",
+                                                   "histogram")
+                            and node.args):
+                        continue
+                    arg = node.args[0]
+                    where = f"{path}:{node.lineno}"
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        literal.append((node.func.attr, arg.value, where))
+                    elif isinstance(arg, ast.JoinedStr):
+                        dynamic.append(where)
+        assert literal, "metric call-site scan found nothing — scan broken"
+        return literal, dynamic
+
+    def _offenses(self, calls):
+        out = []
+        for kind, name, where in calls:
+            if not self.NAME_RE.match(name):
+                out.append(f"{where}: {name!r} is not snake_case "
+                           f"<area>_<noun>_<unit|total>")
+                continue
+            area = name.split("_", 1)[0]
+            if area not in self.AREAS:
+                out.append(f"{where}: {name!r} area {area!r} not in the "
+                           f"documented vocabulary {sorted(self.AREAS)}")
+            if kind == "counter" and not name.endswith("_total"):
+                out.append(f"{where}: counter {name!r} must end _total")
+            if kind == "histogram" and not name.endswith(self.HIST_UNITS):
+                out.append(f"{where}: histogram {name!r} must end with a "
+                           f"unit {self.HIST_UNITS}")
+            if kind in ("gauge", "histogram") and name.endswith("_total"):
+                out.append(f"{where}: {kind} {name!r} must not end _total "
+                           f"(that suffix promises a counter)")
+        return out
+
+    def test_every_registered_family_conforms(self):
+        literal, dynamic = self._calls()
+        offenses = self._offenses(literal)
+        assert not offenses, (
+            "metric families violating the documented naming scheme "
+            "(docs/OBSERVABILITY.md):\n" + "\n".join(offenses))
+        assert len(dynamic) <= self.MAX_DYNAMIC_SITES, (
+            f"{len(dynamic)} dynamic (f-string) metric names — new ones "
+            f"dodge the naming lint; prefer literals or bump the pin "
+            f"after review:\n" + "\n".join(dynamic))
+
+    def test_lint_catches_planted_offenders(self):
+        planted = [("counter", "serving_requests", "<p>"),     # no _total
+                   ("gauge", "mystery_depth_total", "<p>"),    # bad area
+                   ("histogram", "serving_lat", "<p>"),        # no unit
+                   ("counter", "ServingRequests_total", "<p>")]
+        assert len(self._offenses(planted)) >= 4

@@ -846,6 +846,100 @@ class TestAutoscaler:
             f"requests lost/failed during retire-drain: {set(statuses)}"
 
 
+# ------------------------------ lifecycle trace continuity (ISSUE 14)
+
+class TestLifecycleTraceContinuity:
+    def test_trace_continuity_through_hot_swap(self):
+        """Requests traced before and after a hot swap both carry the
+        full worker span pipeline in ONE ring, with the swap system
+        event ordered between them — the continuity gap PR 13 left
+        (swaps happened off-trace) is closed."""
+        w1, w2 = _weights(1), _weights(2)
+        srv = ServingServer(_linear_handler(w1), port=0,
+                            max_latency_ms=1.0,
+                            registry=MetricsRegistry(),
+                            model_version=1).start()
+        try:
+            body = rowcodec.encode("features",
+                                   np.ones((1, FEATURES), np.float32))
+            req = urllib.request.Request(
+                srv.url, data=body, headers={"X-Trace-Id": "tr-pre"})
+            with urllib.request.urlopen(req, timeout=10.0):
+                pass
+            res = srv.hot_swap(lambda: _linear_handler(w2), 2, wait_s=10)
+            assert res.outcome == "success"
+            req = urllib.request.Request(
+                srv.url, data=body, headers={"X-Trace-Id": "tr-post"})
+            with urllib.request.urlopen(req, timeout=10.0):
+                pass
+            pipeline = ["queue_wait", "batch_assembly",
+                        "device_dispatch", "reply"]
+            assert srv.events.spans("tr-pre") == pipeline
+            assert srv.events.spans("tr-post") == pipeline
+            ordered = [(e["span"], e.get("outcome"), e.get("version"))
+                       for e in srv.events.events()
+                       if e["span"] in ("reply", "swap")]
+            assert ordered == [("reply", None, None),
+                               ("swap", "success", 2),
+                               ("reply", None, None)]
+        finally:
+            srv.stop()
+
+    def test_retire_emits_system_events(self):
+        """retire() = deregister -> drain -> stop must leave its story in
+        the worker's ring: retire begin, a drain outcome, retire done —
+        what an incident bundle needs to explain a shrinking fleet."""
+        mreg = MetricsRegistry()
+        coord = ServingCoordinator(registry=mreg,
+                                   heartbeat_timeout_s=5.0).start()
+        worker = DistributedServingServer(
+            _linear_handler(_weights()), coord.url, "svc", partition=0,
+            machine="m0", port=0, max_latency_ms=1.0,
+            heartbeat_interval_s=0.1, registry=mreg).start()
+        try:
+            assert worker.retire(drain_timeout_s=10.0)
+            evs = [(e["span"], e.get("phase") or e.get("outcome"))
+                   for e in worker.events.events()
+                   if e["span"] in ("retire", "drain")]
+            assert evs == [("retire", "begin"), ("drain", "ok"),
+                           ("retire", "done")]
+            done = [e for e in worker.events.events()
+                    if e["span"] == "retire" and e.get("phase") == "done"]
+            assert done[0]["outcome"] == "ok"
+            assert coord.routes("svc") == []
+        finally:
+            coord.stop()
+
+    def test_autoscaler_actions_emit_events(self):
+        """Scale actions land in the injected EventLog (for_service wires
+        the coordinator's ring) so the collector sees fleet growth."""
+        from mmlspark_tpu.observability import EventLog
+
+        clock = FakeClock()
+        log = EventLog(32)
+        depths = [100.0, 100.0]
+        scaler = Autoscaler(lambda: depths, lambda: "w", lambda h: None,
+                            min_workers=1, max_workers=8,
+                            high_queue_depth=32.0, low_queue_depth=2.0,
+                            up_after=2, down_after=5, cooldown_s=0.0,
+                            clock=clock, registry=MetricsRegistry(),
+                            event_log=log)
+        assert scaler.tick() is None
+        clock.t = 1.0
+        assert scaler.tick() == "scale_up"
+        evs = [e for e in log.events() if e["span"] == "autoscale"]
+        assert len(evs) == 1
+        assert evs[0]["action"] == "scale_up"
+        assert evs[0]["workers_before"] == 2
+
+    def test_for_service_defaults_to_coordinator_ring(self):
+        coord = ServingCoordinator(registry=MetricsRegistry())
+        scaler = Autoscaler.for_service(
+            coord, "svc", lambda: "w", lambda h: None,
+            registry=MetricsRegistry())
+        assert scaler.events is coord.events
+
+
 # ------------------------------------------------------- slow mini-runs
 
 @pytest.mark.slow
@@ -873,6 +967,38 @@ def test_swap_harness_mini_run(tmp_path):
         assert v["bad_payload_on_200"] == 0, v
         assert v["no_reply_lost"] == 0, v
         assert v["ok_requests"] > 0
+        assert "fleet" in v and v["fleet"]["services"].get("load") is not None
+    # ISSUE-14 acceptance: the chaos run (30% forward faults + worker
+    # kill + corrupt-artifact rollback) produced >= 1 incident bundle
+    # holding a fully assembled end-to-end trace tree (gateway attempt
+    # parenting the worker span pipeline for one X-Trace-Id) AND the
+    # rollback system event
+    bundles = variants["swap_chaos"]["incidents"]
+    assert bundles, variants["swap_chaos"].get("incident_paths")
+    # the rollback STORY must be in a bundle's system events — either the
+    # worker's swap rollback or the coordinator's rolled_back transition
+    # (under 30% faults a mini-run rollout can roll back on TIMEOUT
+    # before the canary's swap ever launches; both are the rollback)
+    assert any(
+        (e["span"] == "swap"
+         and str(e.get("outcome", "")).startswith("rollback"))
+        or (e["span"] == "rollout" and e.get("state") == "rolled_back")
+        for b in bundles for e in b["system_events"])
+    # >= 1 assembled end-to-end tree: a gateway forward attempt
+    # parenting this trace's worker spans, in pipeline order
+    pipeline = ["queue_wait", "batch_assembly", "device_dispatch",
+                "reply"]
+    assembled = [
+        h for b in bundles
+        for t in b["traces"]["slowest"] + b["traces"]["failed"]
+        for h in t["hops"]
+        if h.get("span") == "forward_attempt" and h.get("children")
+        and all(k["trace_id"] == t["trace_id"] for k in h["children"])
+        and [k["span"] for k in h["children"]] == [
+            s for s in pipeline
+            if s in {k["span"] for k in h["children"]}]]
+    assert assembled, "no assembled gateway->worker trace tree in any " \
+                      "chaos incident bundle"
 
 
 @pytest.mark.slow
