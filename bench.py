@@ -477,6 +477,51 @@ def main():
     except Exception as e:  # noqa: BLE001 - extra must not kill bench
         extra["multichip_error"] = str(e)[:300]
 
+    # multihost block (ISSUE 15): the pod-slice fabric. The fleet
+    # topology + hosts-aware comm-model fields are always recorded (zero
+    # cost — this process's view; hosts > 1 only inside a connected
+    # fabric worker). The measured ladder rides in from the most recent
+    # scripts/measure_podslice.py summary the same way serving_load does:
+    # the 2-host CPU-mesh row locally, the on-chip ladder when the armed
+    # watcher window ran it. A fabric candidate is never fit inside bench
+    # itself — a multi-host rung needs peer processes bench cannot spawn
+    # on a chip grant.
+    try:
+        from mmlspark_tpu.parallel import mesh as _meshlib2
+        from mmlspark_tpu.parallel import strategy as _strat2
+        _hosts = _meshlib2.process_count()
+        _dph = _meshlib2.local_device_count()
+        _dec_mh = _strat2.choose_strategy("auto", _meshlib2.device_count(),
+                                          f, bins, leaves, top_k=20,
+                                          hosts=_hosts,
+                                          devices_per_host=_dph)
+        mh_block = {"hosts": _hosts, "devices_per_host": _dph,
+                    "dp_inter_host_bytes_per_split":
+                        _dec_mh.dp_inter_host_bytes_per_split,
+                    "voting_inter_host_bytes_per_split":
+                        _dec_mh.voting_inter_host_bytes_per_split,
+                    "dcn_dominance_hosts_predicted":
+                        _strat2.dcn_dominance_hosts(_dph)}
+        extra["multihost"] = mh_block
+        for _pf in ("PODSLICE_chip.json", "PODSLICE_cpu.json"):
+            _pp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", _pf)
+            if os.path.exists(_pp):
+                with open(_pp) as _f:
+                    mh_block["podslice"] = json.load(_f)
+                mh_block["podslice_source"] = _pf
+                for _r in mh_block["podslice"].get("rungs", []):
+                    if "error" not in _r and _r.get("hosts", 0) > 1:
+                        cands.append({
+                            "mode": f"multihost-{_r['hosts']}x"
+                                    f"{_r['devices_per_host']}",
+                            "n": _r["n"], "iters": _r["iters"],
+                            "rows_iter_per_s": _r["rows_iter_per_s"],
+                            "measured_by": "scripts/measure_podslice.py"})
+                break
+    except Exception as e:  # noqa: BLE001 - extra must not kill bench
+        extra["multihost_error"] = str(e)[:300]
+
     # extra: wall-time decomposition of one instrumented fit of the primary
     # mode (binning / device transfer / boosting / assembly — barriers
     # added between phases, so this fit is NOT one of the timed ones),

@@ -29,6 +29,7 @@ from ...ops.binning import BinMapper
 from ...ops.boosting import (BoostResult, GBDTConfig, HParams, Tree,
                              make_train_fn)
 from ...parallel import mesh as meshlib
+from ...parallel import multihost as mhlib
 from ...parallel import strategy as stratlib
 from ...resilience.elastic import (CheckpointStore, Preempted,
                                    PreemptionDrain)
@@ -550,7 +551,17 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         the SHARDED axis, so every write is shard-local (no collective
         rides the assembly). The final reshape back to [N, F] merges the
         two leading axes shard-contiguously — also communication-free.
-        No host sync anywhere (sync-point lint, tests/test_fit_pipeline)."""
+        No host sync anywhere (sync-point lint, tests/test_fit_pipeline).
+
+        Multi-host fits (jax.process_count() > 1) route to
+        parallel/multihost.binned_to_device: the same double-buffered
+        streaming with each HOST binning and transferring only its own
+        row spans, assembled into one global array via
+        jax.make_array_from_single_device_arrays — a committed-to-
+        global-sharding device_put is not valid across processes."""
+        if meshlib.process_count() > 1:
+            return mhlib.binned_to_device(bm, x, mesh, blk=blk,
+                                          timeline=timeline)
         tl = timeline if timeline is not None else NULL_TIMELINE
         nd = mesh.shape[meshlib.DATA_AXIS]
         x, _ = meshlib.pad_to_multiple(np.ascontiguousarray(x), nd)
@@ -642,10 +653,15 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                     # [N, K] zeros never cross the host link: the margin
                     # is EXCLUDED from the transfer set and replaced by
                     # uncommitted device zeros, resharded free at dispatch
+                    # (multi-host: per-device zeros assembled into a
+                    # global row-sharded array — a single-device
+                    # committed zeros is invalid across processes)
                     y_d, t_d, w_d, _mask = meshlib.shard_rows(
                         mesh, y.astype(np.float64),
                         (~is_valid).astype(np.float32), weights=w)
-                    mg_d = jnp.zeros((n_pad, k), jnp.float32)
+                    mg_d = (mhlib.zeros_row_sharded(mesh, (n_pad, k))
+                            if meshlib.process_count() > 1
+                            else jnp.zeros((n_pad, k), jnp.float32))
         # forced-on fits pipeline at any size (>= 2 blocks whenever the
         # data allows), auto keeps the measured 4M-scale block size
         if mesh is not None:
@@ -1062,7 +1078,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             self.get("numLeaves"), self.get("topK"),
             # a vmapped candidate batch pins data_parallel: per-candidate
             # voting programs would defeat the single compiled batch
-            allow_voting=getattr(self, "_hp_batch", None) is None)
+            allow_voting=getattr(self, "_hp_batch", None) is None,
+            # fleet topology (ISSUE 15): recorded on the decision and
+            # priced by the ICI/DCN comm terms; 1 host everywhere except
+            # a connected multihost fabric
+            hosts=meshlib.process_count(),
+            devices_per_host=meshlib.local_device_count())
         par = decision.strategy
         serial = (par == "serial" or ndev <= 1)
         self._tree_learner_resolved = par
@@ -1073,10 +1094,17 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 f"fitPipeline must be auto, on or off, got {fp!r}")
         # the grouped (lambdarank) sharded layout reorders rows into
         # group-aligned shards — incompatible with the streaming block
-        # buffer, so it keeps the one-shot placement path
+        # buffer, so it keeps the one-shot placement path. A multi-host
+        # sharded fit takes the pipelined path at ANY size: its dataset
+        # construction is where each host bins only its own rows
+        # (multihost.binned_to_device), so routing through it is what
+        # makes host binning cost divide by the host count.
+        _multihost = (not serial) and meshlib.process_count() > 1
         _pipelined = (prebinned is None and (serial or groups is None)
                       and isinstance(x, np.ndarray) and x.ndim == 2
                       and (fp == "on"
+                           or (fp == "auto" and _multihost
+                               and groups is None)
                            or (fp == "auto" and _sw is None
                                and x.dtype == np.float32
                                and n >= 2_000_000)))
@@ -1342,7 +1370,14 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             def save_ck(partial: BoostResult) -> None:
                 """Durable booster-so-far snapshot at a chunk boundary:
                 atomic payload + digest manifest, keep-last-K retention
-                (resilience/elastic.CheckpointStore)."""
+                (resilience/elastic.CheckpointStore). Multi-host fits
+                write from process 0 only: booster state is replicated,
+                so every host would write byte-identical snapshots — on a
+                SHARED checkpointDir (the resumable-pod contract,
+                docs/MULTIHOST.md) concurrent writers would race the
+                sequence numbering for no added durability."""
+                if meshlib.process_count() > 1 and jax.process_index() != 0:
+                    return
                 bst = self._assemble_booster(partial, bm, num_class,
                                              objective, f, None, prev)
                 ck_store.save(
@@ -1399,10 +1434,15 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 # need the per-block barriers this pipeline removes.
                 nb = int(_tl.meta.get("n_blocks", 1))
                 cb = int(_tl.meta.get("blk", n))
-                _t0 = _tm.perf_counter()
-                np.asarray(binned[:cb])
-                _tl.add_span("transfer_estimate", "device",
-                             (_tm.perf_counter() - _t0) * nb)
+                if meshlib.process_count() == 1:
+                    # multi-host: a leading slice of the GLOBAL row-sharded
+                    # array spans non-addressable devices — fetching it
+                    # raises; the estimate is skipped rather than crashing
+                    # an instrumented fabric fit
+                    _t0 = _tm.perf_counter()
+                    np.asarray(binned[:cb])
+                    _tl.add_span("transfer_estimate", "device",
+                                 (_tm.perf_counter() - _t0) * nb)
                 _sw._acc["construction"] = {"total_s": _tl.wall_s,
                                             "count": 1.0}
                 if use_chunked:
@@ -1429,7 +1469,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                         return self._run_chunked(
                             run_chunk, key, n_rows_exec, k, rounds,
                             has_valid, delegate, save_ck=save_ck,
-                            timeline=_chunk_tl)
+                            timeline=_chunk_tl,
+                            mesh=None if serial else m)
                     finally:
                         self._drain = None
             res = jax.tree.map(np.asarray, run_full(key))
@@ -1517,7 +1558,8 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 
     def _run_chunked(self, run_chunk, key, n_rows: int, k: int, rounds: int,
                      has_valid: bool, delegate, save_ck=None,
-                     timeline=None) -> Tuple[BoostResult, Optional[int]]:
+                     timeline=None, mesh=None
+                     ) -> Tuple[BoostResult, Optional[int]]:
         """Host-driven chunked boosting: compiled chunks of iterations with a
         stop-check + delegate hooks between chunks.
 
@@ -1560,13 +1602,30 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         base_lr = (1.0 if self.get("boostingType") == "rf"
                    else self.get("learningRate"))
         cur_lr = base_lr
-        scores = jnp.zeros((n_rows, k), jnp.float32)
+        # the carried raw-score (and dart delta) state is ROW data: on a
+        # multi-host mesh the initial zeros must be a global row-sharded
+        # array assembled from per-device shards — a single-controller
+        # jnp.zeros is not a valid input to a cross-process shard_map
+        # program (multihost.zeros_row_sharded; device-side fill, no
+        # host transfer either way)
+        _mh = mesh is not None and meshlib.process_count() > 1
+        scores = (mhlib.zeros_row_sharded(mesh, (n_rows, k)) if _mh
+                  else jnp.zeros((n_rows, k), jnp.float32))
         dart = self.get("boostingType") == "dart"
         # dart's dropout state rides ON DEVICE between chunks: per-iteration
         # score deltas [T, N, K] + cumulative rescales [T], returned by one
         # chunk and fed to the next (never fetched to host)
-        dart_state = ((jnp.zeros((T, n_rows, k), jnp.float32),
-                       jnp.ones((T,), jnp.float32)) if dart else None)
+        # replicated small inputs (chunk start, per-iteration lr scale,
+        # dart rescales) take place_global on a multi-host mesh for the
+        # same reason: every process holds the identical host value, and
+        # the global program needs it as ONE replicated jax.Array
+        _repl = ((lambda v: meshlib.place_global(mesh, v, P())) if _mh
+                 else (lambda v: v))
+        dart_state = (((mhlib.zeros_row_sharded(mesh, (T, n_rows, k),
+                                                row_axis=1) if _mh
+                        else jnp.zeros((T, n_rows, k), jnp.float32)),
+                       _repl(jnp.ones((T,), jnp.float32)))
+                      if dart else None)
         # running concatenation (not a list of chunks): the checkpoint
         # snapshot and the final result share ONE accumulated copy, so a
         # per-chunk snapshot costs one concat of the so-far model instead
@@ -1674,8 +1733,9 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # bit-identical to the one-program scan for every stochastic
             # mode, dart dropout included
             with tl.span(f"dispatch[{done}]"):
-                out = run_chunk(key, jnp.int32(done), scores,
-                                jnp.asarray(lrs, jnp.float32), dart_state)
+                out = run_chunk(key, _repl(jnp.int32(done)), scores,
+                                _repl(jnp.asarray(lrs, jnp.float32)),
+                                dart_state)
             if dart:
                 (trees_c, tm_c, vm_c, scores, key, d_deltas, d_scale,
                  init_ref) = out

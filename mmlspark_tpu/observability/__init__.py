@@ -37,7 +37,8 @@ from .tracing import (EventLog, TRACE_HEADER, mint_trace_id,
 from .bridge import (classify_probe_outcome, publish_bringup,
                      publish_checkpoint_event, publish_fit_metrics,
                      publish_fit_timeline, publish_multichip_fit,
-                     publish_probe_outcome, publish_stopwatch)
+                     publish_probe_outcome, publish_rendezvous_event,
+                     publish_stopwatch, set_hosts_alive)
 from .collector import REQUEST_SPANS, SYSTEM_SPANS, TraceCollector
 from .flightrecorder import BUNDLE_SCHEMA_VERSION, FlightRecorder
 from .slo import SLODef, SLOMonitor, windowed_quantile
@@ -48,7 +49,8 @@ __all__ = [
     "EventLog", "TRACE_HEADER", "mint_trace_id", "trace_id_from_headers",
     "classify_probe_outcome", "publish_bringup", "publish_checkpoint_event",
     "publish_fit_metrics", "publish_fit_timeline", "publish_multichip_fit",
-    "publish_probe_outcome", "publish_stopwatch",
+    "publish_probe_outcome", "publish_rendezvous_event", "publish_stopwatch",
+    "set_hosts_alive",
     "TraceCollector", "REQUEST_SPANS", "SYSTEM_SPANS",
     "FlightRecorder", "BUNDLE_SCHEMA_VERSION",
     "SLODef", "SLOMonitor", "windowed_quantile",

@@ -22,7 +22,50 @@ from .metrics import MetricsRegistry, get_registry
 __all__ = ["publish_stopwatch", "publish_fit_timeline",
            "publish_fit_metrics", "publish_multichip_fit",
            "classify_probe_outcome", "publish_probe_outcome",
-           "publish_bringup", "publish_checkpoint_event"]
+           "publish_bringup", "publish_checkpoint_event",
+           "publish_rendezvous_event", "set_hosts_alive"]
+
+#: bounded label vocabulary for rendezvous events — the raw error strings
+#: carry addresses/counts that must not become label cardinality
+_RENDEZVOUS_EVENTS = ("bind", "join", "wait", "heartbeat", "leave",
+                      "initialize", "host")
+_RENDEZVOUS_OUTCOMES = ("ok", "rejoin", "duplicate", "roster_full",
+                        "bad_process_id", "timeout", "lost", "heal",
+                        "unknown", "error", "port_in_use",
+                        "no_jax_coordinator")
+
+
+def publish_rendezvous_event(event: str, outcome: str = "ok",
+                             registry: Optional[MetricsRegistry] = None
+                             ) -> None:
+    """One multi-host rendezvous/fabric event (parallel/rendezvous.py,
+    parallel/multihost.py, mesh.distributed_init) -> bounded-label
+    counter. A counted timeout is the contract: a missing host must be a
+    scrapeable event, never a silent hang."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("multihost_rendezvous_events_total",
+                    "multi-host rendezvous/fabric events by kind and "
+                    "outcome",
+                    labels={"event": event if event in _RENDEZVOUS_EVENTS
+                            else "other",
+                            "outcome": outcome if outcome in
+                            _RENDEZVOUS_OUTCOMES else "other"}).inc()
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail rendezvous
+        warnings.warn(f"publish_rendezvous_event failed: {e}", stacklevel=2)
+
+
+def set_hosts_alive(n: int,
+                    registry: Optional[MetricsRegistry] = None) -> None:
+    """Coordinator-side liveness gauge: joined hosts currently beating
+    (or never yet subject to eviction)."""
+    reg = registry or get_registry()
+    try:
+        reg.gauge("multihost_hosts_alive",
+                  "hosts joined to the rendezvous and not heartbeat-lost"
+                  ).set(float(n))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail rendezvous
+        warnings.warn(f"set_hosts_alive failed: {e}", stacklevel=2)
 
 #: checkpoint save/restore durations span ~1 ms (tiny boosters) to tens of
 #: seconds (orbax trees over NFS) — the serving-latency buckets top out
@@ -157,6 +200,26 @@ def publish_multichip_fit(decision, straggler_gap_s: Optional[float] = None,
         reg.gauge("gbdt_fit_voting_threshold",
                   "auto-mode ratio above which voting_parallel is chosen"
                   ).set(float(decision.threshold))
+        # fleet topology + DCN traffic (ISSUE 15): getattr-tolerant so a
+        # pre-multihost decision tuple (older bench JSON replayed through
+        # StrategyDecision) still publishes
+        hosts = int(getattr(decision, "hosts", 1) or 1)
+        reg.gauge("gbdt_fit_hosts",
+                  "hosts (jax processes) in the last fit's mesh"
+                  ).set(float(hosts))
+        reg.gauge("gbdt_fit_devices_per_host",
+                  "local devices per host in the last fit's mesh"
+                  ).set(float(getattr(decision, "devices_per_host", 0) or 0))
+        reg.gauge("gbdt_fit_comm_inter_host_bytes_per_split",
+                  "closed-form DCN (cross-host) allreduce payload bytes "
+                  "per split at the last fit's shape (0 = single host)",
+                  labels={"strategy": "data_parallel"}).set(float(getattr(
+                      decision, "dp_inter_host_bytes_per_split", 0)))
+        reg.gauge("gbdt_fit_comm_inter_host_bytes_per_split",
+                  "closed-form DCN (cross-host) allreduce payload bytes "
+                  "per split at the last fit's shape (0 = single host)",
+                  labels={"strategy": "voting_parallel"}).set(float(getattr(
+                      decision, "voting_inter_host_bytes_per_split", 0)))
         if straggler_gap_s is not None:
             reg.gauge("gbdt_fit_shard_straggler_gap_seconds",
                       "slowest-minus-fastest shard transfer completion of "

@@ -50,14 +50,81 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
                check_rep=check_vma)
 
 
+#: default bound on jax.distributed.initialize (seconds). The runtime's
+#: own default is 300 s of silent blocking; the fabric wants a missing
+#: host to become a NAMED error well before a pool's kill grace.
+DEFAULT_INIT_TIMEOUT_S = 120.0
+
+
 def distributed_init(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     initialization_timeout: Optional[float] = None) -> None:
     """Multi-host bootstrap. Replaces driver rendezvous (LightGBMUtils.scala:116-185):
-    the JAX coordination service plays the driver's ServerSocket role, with retries and
-    timeouts handled inside the runtime instead of hand-rolled socket loops."""
-    if num_processes is not None and num_processes > 1:
-        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    the JAX coordination service plays the driver's ServerSocket role.
+
+    ``initialization_timeout`` bounds the gather: if the coordinator never
+    comes up or a host never arrives, this raises a RuntimeError naming
+    the coordinator address and the expected process count (and counts a
+    ``multihost_rendezvous_events_total{event=initialize,outcome=timeout}``)
+    instead of hanging forever — the ISSUE-15 fix for the unbounded
+    8-line wrapper. Prefer the full rendezvous contract in
+    parallel/multihost.connect, which also gates THIS call behind the
+    coordinator roster barrier."""
+    if not (num_processes is not None and num_processes > 1):
+        return
+    try:
+        # the CPU backend refuses cross-process programs ("Multiprocess
+        # computations aren't implemented on the CPU backend") unless a
+        # collectives implementation is selected BEFORE the backend
+        # initializes; gloo ships in jaxlib and makes the virtual
+        # multi-host CPU mesh (tests, measure_podslice) real. Best-effort:
+        # older/newer jax may not expose the option, TPU pods never
+        # consult it, and an operator's explicit choice (e.g.
+        # 'mpitrampoline' under mpirun) is NEVER overwritten.
+        try:
+            current = jax.config.read("jax_cpu_collectives_implementation")
+        except Exception:  # noqa: BLE001 - no reader: treat as unset
+            current = None
+        if current in (None, "", "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - option absent: accelerator path
+        pass
+    timeout_s = (DEFAULT_INIT_TIMEOUT_S if initialization_timeout is None
+                 else float(initialization_timeout))
+    kw = {"initialization_timeout": max(1, int(round(timeout_s)))}
+    bounded = True
+    try:
+        try:
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id, **kw)
+        except TypeError:
+            # pre-initialization_timeout jax: the knob does not exist —
+            # fall back to the runtime's own (300 s) bound rather than
+            # refusing to initialize at all
+            bounded = False
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id)
+    except Exception as e:
+        # classify for the counted-timeout contract: a gather that ran
+        # out of time vs any other failure (port in use, re-init, ...)
+        msg = str(e).lower()
+        outcome = ("timeout" if ("deadline" in msg or "timeout" in msg
+                                 or "timed out" in msg) else "error")
+        try:
+            from ..observability import publish_rendezvous_event
+            publish_rendezvous_event("initialize", outcome)
+        except Exception:  # noqa: BLE001 - telemetry never hides the error
+            pass
+        bound = (f"within {timeout_s:.0f}s" if bounded else
+                 "within the runtime's default bound (this jax predates "
+                 "initialization_timeout)")
+        raise RuntimeError(
+            f"jax.distributed.initialize failed for process {process_id}: "
+            f"could not gather {num_processes} processes at coordinator "
+            f"{coordinator_address} {bound} — check that "
+            f"every host launched, can reach the coordinator, and agrees "
+            f"on num_processes ({e})") from e
 
 
 def device_count() -> int:
@@ -66,6 +133,12 @@ def device_count() -> int:
 
 def local_device_count() -> int:
     return jax.local_device_count()
+
+
+def process_count() -> int:
+    """Hosts (jax processes) in the mesh — 1 for every single-controller
+    run; >1 only after distributed_init/multihost.connect."""
+    return jax.process_count()
 
 
 def get_mesh(n_devices: Optional[int] = None,
@@ -133,14 +206,16 @@ def place_rows(mesh: Mesh, arr) -> jax.Array:
     size — shard_rows pads). Single-process: one async device_put whose
     per-device pieces ride the host links in parallel (each device
     receives only its shard — the sharded fit paths' transfer plane).
-    Multi-process: a global array assembled from each process's
-    addressable shards, as in place_global."""
+    Multi-process: each process slices out and device_puts ONLY its own
+    shards, assembled into one global array via
+    jax.make_array_from_single_device_arrays (multihost.assemble_row_sharded
+    — the ISSUE-15 process-local data plane)."""
     arr = np.asarray(arr)
     sharding = data_sharding(mesh, arr.ndim)
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
-    return jax.make_array_from_callback(arr.shape, sharding,
-                                        lambda idx: arr[idx])
+    from . import multihost
+    return multihost.assemble_row_sharded(mesh, arr, sharding)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,312 @@
+"""Multi-host training fabric: process-local data plane + elastic membership.
+
+Extends the single-controller mesh (parallel/mesh.py) to a multi-process
+`jax.distributed` fleet with NO estimator-API change:
+`LightGBMClassifier().fit(df)` on a connected fabric shard_maps over the
+GLOBAL device mesh, and each host bins and transfers only ITS OWN rows.
+
+Three layers:
+
+- **Bootstrap** — ``connect()`` drives the full rendezvous contract:
+  join the coordinator with bounded retries (parallel/rendezvous.py),
+  gate on the roster barrier, then ``mesh.distributed_init`` with the
+  distributed jax-coordinator address and an initialization timeout, so
+  a missing host is a counted, named failure at every stage instead of a
+  silent hang.
+- **Data plane** — global row-sharded arrays assembled from PROCESS-LOCAL
+  pieces via ``jax.make_array_from_single_device_arrays``:
+  ``assemble_row_sharded`` (the multi-process route of
+  ``mesh.place_rows``, so `shard_rows` composes unchanged),
+  ``zeros_row_sharded`` (device-side zeros — a [N, K] zero margin never
+  crosses a host link), and ``binned_to_device`` (the multi-host variant
+  of the PR 6/9 double-buffered streaming construction: each host bins
+  ONLY its row spans, block k's per-device async device_put rides under
+  block k+1's host binning, donated per-device dynamic_update_slice
+  writes, no host sync anywhere — the sync-point lint covers this module
+  too, tests/test_fit_pipeline.py).
+- **Elastic membership** — a heartbeat watch whose default host-lost
+  action is the REAPER: SIGTERM (a drainable fit drains) plus a hard-exit
+  watchdog (``os._exit(75)`` after the grace), because a lost host wedges
+  every in-flight collective and a wedged main thread can run neither
+  Python signal handlers nor a drain. Recovery is PR 10's elastic
+  contract: resume from the last durable snapshot at the SURVIVING device
+  count (`shard_rows` re-shards; digest-identical, docs/RESILIENCE.md).
+  The chaos fault that proves it is `TrainingFaultInjector(kill_host=)`.
+
+Multi-host checkpoint discipline: snapshots are written by process 0 only
+(models/lightgbm/base.py save_ck) — point every host at ONE shared
+checkpointDir for resumable pod fits, or accept that only host 0's
+directory holds the durable state (docs/MULTIHOST.md).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import threading
+import time
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import mesh as meshlib
+from .rendezvous import Heartbeater, RendezvousClient, _publish
+
+__all__ = ["MultihostTopology", "topology", "local_row_slices",
+           "assemble_row_sharded", "zeros_row_sharded", "binned_to_device",
+           "connect", "MultihostSession"]
+
+
+class MultihostTopology(NamedTuple):
+    """The fleet shape the comm model prices (parallel/strategy.py
+    hosts/devices_per_host terms) and the bench/podslice rows record."""
+    hosts: int
+    devices_per_host: int
+    devices: int
+    process_id: int
+
+    def as_labels(self) -> dict:
+        return {"hosts": str(self.hosts),
+                "devices_per_host": str(self.devices_per_host)}
+
+
+def topology() -> MultihostTopology:
+    return MultihostTopology(jax.process_count(), jax.local_device_count(),
+                             jax.device_count(), jax.process_index())
+
+
+# ---------------------------------------------------------------- data plane
+
+def local_row_slices(mesh, global_rows: int
+                     ) -> List[Tuple[object, int, int]]:
+    """This process's (device, row_start, row_stop) spans of a
+    row-sharded [global_rows, ...] array — the rows this host (and no
+    other) must bin and transfer. ``global_rows`` must already be a
+    multiple of the data-axis extent (shard_rows/pad_to_multiple pads)."""
+    sharding = meshlib.data_sharding(mesh, 2)
+    spans = []
+    imap = sharding.addressable_devices_indices_map((global_rows, 1))
+    for dev, idx in imap.items():
+        rs = idx[0]
+        start = 0 if rs.start is None else int(rs.start)
+        stop = global_rows if rs.stop is None else int(rs.stop)
+        spans.append((dev, start, stop))
+    spans.sort(key=lambda t: t[1])
+    return spans
+
+
+def assemble_row_sharded(mesh, arr, sharding=None):
+    """Global row-sharded jax.Array from a full host copy, transferring
+    ONLY this process's shards: per addressable device, slice the host
+    rows the device owns, async device_put to that device, then one
+    ``jax.make_array_from_single_device_arrays`` — the multi-process
+    route of ``mesh.place_rows`` (single-process keeps the one-dispatch
+    NamedSharding device_put)."""
+    if sharding is None:
+        sharding = meshlib.data_sharding(mesh, arr.ndim)
+    imap = sharding.addressable_devices_indices_map(arr.shape)
+    pieces = [jax.device_put(arr[idx], dev) for dev, idx in imap.items()]
+    return jax.make_array_from_single_device_arrays(arr.shape, sharding,
+                                                    pieces)
+
+
+def zeros_row_sharded(mesh, shape: Sequence[int], dtype=jnp.float32,
+                      row_axis: int = 0):
+    """Row-sharded global zeros with NO host transfer: per-device
+    ``jnp.zeros`` of the shard shape (device-side fill), assembled like
+    assemble_row_sharded — the multi-process form of the pipelined fit's
+    '[N, K] zeros never cross the host link' contract. ``row_axis``
+    places the data axis (dart's [T, N, K] delta carry shards rows on
+    axis 1)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    shape = tuple(int(s) for s in shape)
+    spec = [None] * len(shape)
+    spec[row_axis] = meshlib.DATA_AXIS
+    sharding = NamedSharding(mesh, P(*spec))
+    imap = sharding.addressable_devices_indices_map(shape)
+    pieces = []
+    for dev, idx in imap.items():
+        shard_shape = tuple(
+            (s.stop or shape[i]) - (s.start or 0) if isinstance(s, slice)
+            else 1 for i, s in enumerate(idx))
+        pieces.append(jax.device_put(jnp.zeros(shard_shape, dtype), dev))
+    return jax.make_array_from_single_device_arrays(shape, sharding, pieces)
+
+
+def binned_to_device(bm, x: np.ndarray, mesh, blk: Optional[int] = None,
+                     timeline=None):
+    """Multi-host streaming dataset construction: the PR 6/9
+    double-buffered bin->device_put pipeline with each host binning only
+    its OWN row spans.
+
+    Per local device d owning global rows [r0, r1): stream blocks of
+    ``blk`` rows — bin block j+1 on the host while block j's async
+    device_put rides d's host link — into a donated per-device
+    dynamic_update_slice buffer, then assemble the per-device [ppd, F]
+    buffers into ONE global row-sharded array via
+    ``jax.make_array_from_single_device_arrays``. Rows another host owns
+    are never binned and never transferred here, so host binning cost
+    divides by the host count. No host sync anywhere (sync-point lint,
+    tests/test_fit_pipeline.py); ``timeline`` records per-block bin/put
+    spans without adding barriers."""
+    from ..compile import cache as compilecache
+    from ..utils.profiling import NULL_TIMELINE
+
+    tl = timeline if timeline is not None else NULL_TIMELINE
+    nd = mesh.shape[meshlib.DATA_AXIS]
+    x, _ = meshlib.pad_to_multiple(np.ascontiguousarray(x), nd)
+    n, fdim = x.shape
+    ppd = n // nd
+    spans = local_row_slices(mesh, n)
+    if blk is None:
+        blk = max(1_000_000 // nd, -(-ppd // 8))
+    blk = max(1, min(int(blk), ppd))
+    tl.meta["blk"] = int(blk * len(spans))
+    tl.meta["n_blocks"] = 1 + len(range(blk, ppd, blk))
+    tl.meta["ndev"] = int(nd)
+    tl.meta["local_devices"] = len(spans)
+    sharding = meshlib.data_sharding(mesh, 2)
+
+    if blk >= ppd:
+        pieces = []
+        for dev, r0, r1 in spans:
+            with tl.span(f"bin[{r0}]"):
+                bk = bm.transform(x[r0:r1])
+            with tl.span(f"put[{r0}]"):
+                pieces.append(jax.device_put(bk, dev))
+        return jax.make_array_from_single_device_arrays((n, fdim), sharding,
+                                                        pieces)
+
+    write = compilecache.cached_jit(
+        lambda buf, block, i0: jax.lax.dynamic_update_slice(
+            buf, block, (i0, 0)),
+        key="binned_write2d", name="gbdt_binned_write", donate_argnums=0)
+    bufs = [None] * len(spans)
+    first_dtype = None
+    for j0 in range(0, ppd, blk):
+        # the final window shifts back to stay full-size (ONE compiled
+        # write shape); its overlap rows re-bin to identical values
+        k0 = min(j0, ppd - blk)
+        for di, (dev, r0, _r1) in enumerate(spans):
+            with tl.span(f"bin[{r0 + k0}]"):
+                bk = bm.transform(x[r0 + k0:r0 + k0 + blk])
+            with tl.span(f"put[{r0 + k0}]"):
+                piece = jax.device_put(bk, dev)
+                if bufs[di] is None:
+                    first_dtype = piece.dtype
+                    bufs[di] = jax.device_put(
+                        jnp.zeros((ppd, fdim), first_dtype), dev)
+                bufs[di] = write(bufs[di], piece, jnp.int32(k0))
+    return jax.make_array_from_single_device_arrays((n, fdim), sharding,
+                                                    bufs)
+
+
+# ----------------------------------------------------------------- bootstrap
+
+def _default_reaper(grace_s: float) -> Callable[[List[int]], None]:
+    """The host-lost action: a dead peer wedges every in-flight
+    collective, and a main thread stuck inside XLA can run neither
+    Python signal handlers nor a drain — so SIGTERM first (a fit that
+    CAN drain, drains: PreemptionDrain finishes the chunk and
+    snapshots), then a watchdog hard-exit with status 75 (EX_TEMPFAIL,
+    the PreemptionDrain convention: retryable — resume from the last
+    durable snapshot at the surviving device count)."""
+    def reap(lost: List[int]) -> None:
+        _publish("host", "lost")
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+        except OSError:
+            pass
+        t = threading.Timer(max(0.1, grace_s), lambda: os._exit(75))
+        t.daemon = True
+        t.start()
+    return reap
+
+
+class MultihostSession:
+    """A connected fabric membership: identity, topology, liveness."""
+
+    def __init__(self, process_id: int, num_hosts: int,
+                 client: RendezvousClient,
+                 heartbeater: Optional[Heartbeater]):
+        self.process_id = int(process_id)
+        self.num_hosts = int(num_hosts)
+        self.client = client
+        self.heartbeater = heartbeater
+        self.topology = topology()
+
+    def close(self) -> None:
+        """Clean departure: stop the watch, then tell the coordinator we
+        LEFT — a finished host must never surface in peers' lost lists
+        (finishing first is not dying; rendezvous.leave)."""
+        if self.heartbeater is not None:
+            self.heartbeater.stop()
+        try:
+            self.client.leave(self.process_id)
+        except Exception:  # noqa: BLE001 - a dead coordinator cannot
+            pass           # distinguish leave from silence anyway
+
+
+def connect(coordinator_address: str, num_hosts: int,
+            name: Optional[str] = None, *, host_addr: str = "127.0.0.1",
+            jax_port: Optional[int] = None, deadline_s: float = 120.0,
+            heartbeat_interval_s: float = 2.0,
+            initialization_timeout_s: Optional[float] = None,
+            on_host_lost="exit",
+            reap_grace_s: Optional[float] = None) -> MultihostSession:
+    """Bring this process into the multi-host mesh, end to end:
+
+    1. join the rendezvous coordinator (RetryPolicy-backed, bounded by
+       ``deadline_s``) and receive this process's id;
+    2. gate on the roster barrier — a late/missing host is a counted
+       ``RendezvousTimeout`` naming the coordinator and the missing count;
+    3. ``mesh.distributed_init`` against the distributed jax-coordinator
+       address with the REMAINING deadline as initialization timeout;
+    4. start the heartbeat watch. ``on_host_lost='exit'`` installs the
+       reaper (SIGTERM + hard-exit after ``reap_grace_s``, default the
+       MMLSPARK_TPU_DRAIN_GRACE_S drain grace); pass a callable for a
+       custom action or None to disable the watch.
+
+    ``jax_port``: a port this host reserved for the jax coordination
+    service — the coordinator uses process 0's (addr, jax_port) unless an
+    explicit jax_coordinator was pinned at coordinator construction.
+    """
+    deadline = time.monotonic() + float(deadline_s)
+    client = RendezvousClient(coordinator_address)
+    if name is None:
+        name = f"{socket.gethostname()}-{os.getpid()}"
+    joined = client.join(name, addr=host_addr, jax_port=jax_port,
+                         deadline_s=deadline_s)
+    pid = int(joined["process_id"])
+    remaining = max(1.0, deadline - time.monotonic())
+    roster = client.wait(deadline_s=remaining)
+    jax_coordinator = roster.get("jax_coordinator")
+    if num_hosts > 1 and not jax_coordinator:
+        _publish("initialize", "no_jax_coordinator")
+        raise RuntimeError(
+            "rendezvous produced no jax coordinator address: pass jax_port "
+            "at join time (process 0's is used) or pin jax_coordinator on "
+            "the RendezvousCoordinator")
+    remaining = max(1.0, deadline - time.monotonic())
+    if initialization_timeout_s is None:
+        initialization_timeout_s = remaining
+    # a failed initialize is counted (timeout vs error) by
+    # distributed_init itself — no second count here
+    meshlib.distributed_init(
+        jax_coordinator, num_processes=num_hosts, process_id=pid,
+        initialization_timeout=initialization_timeout_s)
+    _publish("initialize")
+    hb = None
+    if heartbeat_interval_s and on_host_lost is not None:
+        if on_host_lost == "exit":
+            if reap_grace_s is None:
+                from ..resilience.elastic import DRAIN_GRACE_ENV
+                reap_grace_s = float(os.environ.get(DRAIN_GRACE_ENV, "30"))
+            on_host_lost = _default_reaper(reap_grace_s)
+        hb = Heartbeater(client, pid, interval_s=heartbeat_interval_s,
+                         on_host_lost=on_host_lost)
+        hb.start()
+    return MultihostSession(pid, num_hosts, client, hb)

@@ -1,0 +1,468 @@
+"""Coordinator-based multi-host rendezvous for the training fabric.
+
+The reference's distribution story is the Spark DRIVER acting as rendezvous
+server: LightGBMUtils `NetworkInit` opens a driver ServerSocket, every
+executor connects, the driver assigns ring positions and broadcasts the
+topology before a single byte of training traffic flows
+(LightGBMUtils.scala:108-185, TrainUtils.scala:410-512). This module plays
+that role for a multi-process `jax.distributed` mesh:
+
+- ``RendezvousCoordinator`` — a small threaded TCP registration service
+  (one JSON line per request/response). It assigns process ids, records
+  each host's address, distributes the jax coordination-service address
+  (process 0's ``host:jax_port`` unless pinned at construction), and gates
+  the barrier: ``wait`` releases only when every expected host has joined,
+  and a missing/late host is a COUNTED timeout naming the coordinator
+  address and the missing count — never a silent hang.
+- ``RendezvousClient`` — join with bounded retries (the ONE
+  `resilience.policy.RetryPolicy` implementation; a not-yet-listening
+  coordinator is a retryable condition, a duplicate process id is not),
+  server-side ``wait`` barrier, heartbeats.
+- ``Heartbeater`` — a daemon thread beating every ``interval_s``; the
+  coordinator piggybacks the currently-lost process ids on every beat
+  reply (the `distributed_serving` heartbeat-piggyback pattern), and the
+  first non-empty set fires ``on_host_lost`` exactly once. A lost host
+  wedges in-flight collectives, so the fabric's default reaction
+  (parallel/multihost.py) is SIGTERM + a hard-exit watchdog, not a drain
+  that would itself hang.
+
+Telemetry (PR 8 registry, guarded — a broken observability import must
+never fail a rendezvous): ``multihost_rendezvous_events_total{event,
+outcome}`` and the ``multihost_hosts_alive`` gauge.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..resilience.policy import Deadline, RetryPolicy
+
+__all__ = ["RendezvousError", "RendezvousTimeout", "RendezvousCoordinator",
+           "RendezvousClient", "Heartbeater"]
+
+
+class RendezvousError(RuntimeError):
+    """The coordinator rejected a request (duplicate process id, roster
+    full, unknown process) or could not start (port in use)."""
+
+
+class RendezvousTimeout(RendezvousError):
+    """A rendezvous deadline expired: the coordinator never came up, or
+    the roster never filled (a late/missing host)."""
+
+
+def _publish(event: str, outcome: str = "ok") -> None:
+    try:
+        from ..observability import publish_rendezvous_event
+        publish_rendezvous_event(event, outcome)
+    except Exception:  # noqa: BLE001 - telemetry never fails a rendezvous
+        pass
+
+
+def _set_alive(n: int) -> None:
+    try:
+        from ..observability import set_hosts_alive
+        set_hosts_alive(n)
+    except Exception:  # noqa: BLE001 - telemetry never fails a rendezvous
+        pass
+
+
+class _Host:
+    __slots__ = ("name", "process_id", "addr", "jax_port", "joined_at",
+                 "last_beat", "lost", "left")
+
+    def __init__(self, name: str, process_id: int, addr: str,
+                 jax_port: Optional[int]):
+        self.name = name
+        self.process_id = process_id
+        self.addr = addr
+        self.jax_port = jax_port
+        self.joined_at = time.monotonic()
+        self.last_beat: Optional[float] = None
+        self.lost = False
+        #: a clean departure (``leave``): exempt from silence eviction
+        #: and NEVER reported in the lost lists — a host that finished
+        #: its work must not trigger its peers' reapers
+        self.left = False
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):  # one JSON line in, one JSON line out
+        try:
+            line = self.rfile.readline(1 << 16)
+            req = json.loads(line.decode("utf-8"))
+            resp = self.server.coordinator._dispatch(req)
+        except Exception as e:  # noqa: BLE001 - a bad request must not kill the server
+            resp = {"ok": False, "error": f"bad request: {e}"}
+        try:
+            self.wfile.write((json.dumps(resp) + "\n").encode("utf-8"))
+        except OSError:
+            pass  # client gone; its retry policy owns the recovery
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = False  # a bound port must FAIL loudly, not share
+
+
+class RendezvousCoordinator:
+    """The driver-rendezvous replacement: assign ids, gate the barrier,
+    watch liveness. Run it on the launcher (or host 0) before starting
+    the per-host workers."""
+
+    def __init__(self, num_hosts: int, port: int = 0,
+                 bind_host: str = "127.0.0.1",
+                 jax_coordinator: Optional[str] = None,
+                 heartbeat_timeout_s: float = 10.0):
+        if num_hosts < 1:
+            raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+        self.num_hosts = int(num_hosts)
+        self._port = int(port)
+        self._bind_host = bind_host
+        #: explicit jax coordination-service address; None = derived from
+        #: process 0's (addr, jax_port) join payload at wait time
+        self._jax_coordinator = jax_coordinator
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self._cond = threading.Condition()
+        self._hosts: Dict[str, _Host] = {}
+        self._by_pid: Dict[int, _Host] = {}
+        self._server: Optional[_Server] = None
+        self._threads: List[threading.Thread] = []
+        self._stopping = threading.Event()
+
+    # ---------------------------------------------------------------- server
+    def start(self) -> "RendezvousCoordinator":
+        try:
+            self._server = _Server((self._bind_host, self._port), _Handler)
+        except OSError as e:
+            _publish("bind", "port_in_use")
+            raise RendezvousError(
+                f"rendezvous coordinator could not bind "
+                f"{self._bind_host}:{self._port}: {e} — the port is in use "
+                f"(inject a free port, or let port=0 pick one)") from e
+        self._server.coordinator = self
+        t = threading.Thread(target=self._server.serve_forever,
+                             name="rendezvous-server", daemon=True)
+        t.start()
+        m = threading.Thread(target=self._monitor,
+                             name="rendezvous-monitor", daemon=True)
+        m.start()
+        self._threads = [t, m]
+        _publish("bind")
+        _set_alive(0)
+        return self
+
+    @property
+    def address(self) -> str:
+        if self._server is None:
+            return f"{self._bind_host}:{self._port}"
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # ------------------------------------------------------------- liveness
+    def _alive_count(self) -> int:
+        return sum(1 for h in self._hosts.values()
+                   if not h.lost and not h.left)
+
+    def _lost_pids(self) -> List[int]:
+        return sorted(h.process_id for h in self._hosts.values()
+                      if h.lost and not h.left)
+
+    def _monitor(self) -> None:
+        poll = max(0.05, min(1.0, self.heartbeat_timeout_s / 4.0))
+        while not self._stopping.wait(poll):
+            now = time.monotonic()
+            with self._cond:
+                for h in self._hosts.values():
+                    # only hosts that have ever beaten are subject to
+                    # silence-based eviction (the distributed_serving
+                    # _hb_seen discipline: a join without a heartbeat
+                    # loop must not be reaped for not having one); a
+                    # cleanly-departed host is exempt
+                    if (not h.lost and not h.left
+                            and h.last_beat is not None
+                            and now - h.last_beat > self.heartbeat_timeout_s):
+                        h.lost = True
+                        _publish("heartbeat", "lost")
+                _set_alive(self._alive_count())
+
+    # ------------------------------------------------------------- commands
+    def _dispatch(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        if cmd == "join":
+            return self.join(str(req.get("host", "")),
+                             addr=str(req.get("addr", "127.0.0.1")),
+                             jax_port=req.get("jax_port"),
+                             process_id=req.get("process_id"))
+        if cmd == "wait":
+            return self.wait(float(req.get("timeout_s", 60.0)))
+        if cmd == "heartbeat":
+            return self.heartbeat(int(req.get("process_id", -1)))
+        if cmd == "leave":
+            return self.leave(int(req.get("process_id", -1)))
+        if cmd == "status":
+            return self.status()
+        return {"ok": False, "error": f"unknown command {cmd!r}"}
+
+    def join(self, name: str, addr: str = "127.0.0.1",
+             jax_port: Optional[int] = None,
+             process_id: Optional[int] = None) -> dict:
+        """Register one host; assigns the smallest free process id unless
+        an explicit one is requested. Re-joining under the same name is
+        idempotent (a restarted join retry must not burn a second id)."""
+        if not name:
+            return {"ok": False, "error": "join requires a host name"}
+        with self._cond:
+            if name in self._hosts:
+                h = self._hosts[name]
+                _publish("join", "rejoin")
+                return {"ok": True, "process_id": h.process_id,
+                        "num_hosts": self.num_hosts, "rejoined": True}
+            if process_id is not None and int(process_id) in self._by_pid:
+                other = self._by_pid[int(process_id)]
+                _publish("join", "duplicate")
+                return {"ok": False,
+                        "error": f"duplicate process id {process_id}: "
+                                 f"already held by host {other.name!r}"}
+            if len(self._hosts) >= self.num_hosts:
+                _publish("join", "roster_full")
+                return {"ok": False,
+                        "error": f"rendezvous roster full "
+                                 f"({self.num_hosts}/{self.num_hosts} joined)"}
+            if process_id is None:
+                pid = next(i for i in range(self.num_hosts)
+                           if i not in self._by_pid)
+            else:
+                pid = int(process_id)
+                if not 0 <= pid < self.num_hosts:
+                    _publish("join", "bad_process_id")
+                    return {"ok": False,
+                            "error": f"process_id {pid} outside "
+                                     f"[0, {self.num_hosts})"}
+            h = _Host(name, pid, addr,
+                      int(jax_port) if jax_port is not None else None)
+            self._hosts[name] = h
+            self._by_pid[pid] = h
+            _publish("join")
+            _set_alive(self._alive_count())
+            if len(self._hosts) == self.num_hosts:
+                self._cond.notify_all()
+            return {"ok": True, "process_id": pid,
+                    "num_hosts": self.num_hosts}
+
+    def _resolve_jax_coordinator(self) -> Optional[str]:
+        if self._jax_coordinator:
+            return self._jax_coordinator
+        p0 = self._by_pid.get(0)
+        if p0 is not None and p0.jax_port is not None:
+            return f"{p0.addr}:{p0.jax_port}"
+        return None
+
+    def wait(self, timeout_s: float = 60.0) -> dict:
+        """The barrier: block until every expected host joined. A miss is
+        a counted timeout naming this coordinator and the missing count —
+        the failure the 8-line `distributed_init` could only express as a
+        hang."""
+        with self._cond:
+            full = self._cond.wait_for(
+                lambda: len(self._hosts) == self.num_hosts,
+                timeout=max(0.0, timeout_s))
+            joined = len(self._hosts)
+            if not full:
+                _publish("wait", "timeout")
+                missing = self.num_hosts - joined
+                return {"ok": False, "timeout": True, "joined": joined,
+                        "expected": self.num_hosts,
+                        "error": f"rendezvous timeout at {self.address}: "
+                                 f"{joined}/{self.num_hosts} hosts joined "
+                                 f"({missing} missing) after {timeout_s:.1f}s"}
+            _publish("wait")
+            return {"ok": True, "num_hosts": self.num_hosts,
+                    "jax_coordinator": self._resolve_jax_coordinator(),
+                    "roster": [{"host": h.name, "process_id": h.process_id,
+                                "addr": h.addr}
+                               for h in sorted(self._hosts.values(),
+                                               key=lambda h: h.process_id)]}
+
+    def heartbeat(self, process_id: int) -> dict:
+        """Record one beat; the reply piggybacks the currently-lost pids
+        so every host learns about a dead peer without a separate poll."""
+        with self._cond:
+            h = self._by_pid.get(int(process_id))
+            if h is None:
+                _publish("heartbeat", "unknown")
+                return {"ok": False,
+                        "error": f"unknown process id {process_id}"}
+            healed = h.lost
+            h.last_beat = time.monotonic()
+            h.lost = False
+            _publish("heartbeat", "heal" if healed else "ok")
+            _set_alive(self._alive_count())
+            return {"ok": True, "lost": self._lost_pids()}
+
+    def leave(self, process_id: int) -> dict:
+        """A CLEAN departure (MultihostSession.close): the host stops
+        beating but must never appear in the lost lists — finishing
+        first is not dying, and peers still measuring/draining must not
+        be reaped over it."""
+        with self._cond:
+            h = self._by_pid.get(int(process_id))
+            if h is None:
+                _publish("leave", "unknown")
+                return {"ok": False,
+                        "error": f"unknown process id {process_id}"}
+            h.left = True
+            h.lost = False
+            _publish("leave")
+            _set_alive(self._alive_count())
+            return {"ok": True}
+
+    def status(self) -> dict:
+        with self._cond:
+            return {"ok": True, "joined": len(self._hosts),
+                    "expected": self.num_hosts,
+                    "hosts_alive": self._alive_count(),
+                    "lost": self._lost_pids(),
+                    "left": sorted(h.process_id
+                                   for h in self._hosts.values() if h.left),
+                    "jax_coordinator": self._resolve_jax_coordinator()}
+
+
+# -------------------------------------------------------------------- client
+
+#: join retry shape: a coordinator that is still starting refuses
+#: connections — retry with short jittered backoff until the caller's
+#: deadline (unbounded attempts REQUIRE a deadline, policy.py contract)
+_JOIN_POLICY = RetryPolicy(attempts=None, backoff_s=0.2, multiplier=1.6,
+                           max_backoff_s=2.0, jitter=0.1)
+
+
+class RendezvousClient:
+    """One host's view of the coordinator. Every RPC is one short-lived
+    connection (no pooled socket to go stale across a host's lifetime)."""
+
+    def __init__(self, address: str, rpc_timeout_s: float = 10.0):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.address = f"{self.host}:{self.port}"
+        self.rpc_timeout_s = float(rpc_timeout_s)
+
+    def _rpc(self, payload: dict,
+             timeout_s: Optional[float] = None) -> dict:
+        t = self.rpc_timeout_s if timeout_s is None else timeout_s
+        with socket.create_connection((self.host, self.port),
+                                      timeout=t) as s:
+            s.sendall((json.dumps(payload) + "\n").encode("utf-8"))
+            with s.makefile("rb") as fh:
+                line = fh.readline(1 << 16)
+        if not line:
+            raise ConnectionError(
+                f"rendezvous coordinator {self.address} closed the "
+                f"connection without a reply")
+        resp = json.loads(line.decode("utf-8"))
+        if not resp.get("ok"):
+            if resp.get("timeout"):
+                raise RendezvousTimeout(resp.get("error", "timeout"))
+            raise RendezvousError(resp.get("error", "rejected"))
+        return resp
+
+    def join(self, name: str, addr: str = "127.0.0.1",
+             jax_port: Optional[int] = None,
+             process_id: Optional[int] = None,
+             deadline_s: float = 60.0,
+             retry: Optional[RetryPolicy] = None) -> dict:
+        """Join with retries: connection failures (coordinator not up yet)
+        retry under the deadline; a COORDINATOR REJECTION (duplicate id,
+        roster full) raises immediately — retrying it cannot succeed."""
+        policy = retry or _JOIN_POLICY
+        deadline = Deadline.after(deadline_s)
+        last: Optional[BaseException] = None
+        for _a in policy.attempts_iter(deadline=deadline):
+            try:
+                return self._rpc({"cmd": "join", "host": name, "addr": addr,
+                                  "jax_port": jax_port,
+                                  "process_id": process_id})
+            except RendezvousError:
+                raise
+            except (OSError, ValueError) as e:
+                last = e
+        _publish("join", "timeout")
+        raise RendezvousTimeout(
+            f"could not join rendezvous coordinator {self.address} within "
+            f"{deadline_s:.1f}s (last error: {last})")
+
+    def wait(self, deadline_s: float = 60.0) -> dict:
+        """Block until the roster fills or the deadline passes. The wait
+        runs SERVER-side; the socket timeout pads it so a coordinator
+        that dies mid-wait surfaces as a connection error, not a hang."""
+        return self._rpc({"cmd": "wait", "timeout_s": deadline_s},
+                         timeout_s=deadline_s + 5.0)
+
+    def heartbeat(self, process_id: int) -> dict:
+        return self._rpc({"cmd": "heartbeat", "process_id": process_id})
+
+    def leave(self, process_id: int) -> dict:
+        return self._rpc({"cmd": "leave", "process_id": process_id})
+
+    def status(self) -> dict:
+        return self._rpc({"cmd": "status"})
+
+
+class Heartbeater(threading.Thread):
+    """Daemon beat loop + host-loss watch. ``on_host_lost(lost_pids)``
+    fires at most once, from this thread — the callback must not assume
+    the main thread is responsive (a lost host usually means the main
+    thread is wedged inside a collective).
+
+    Hysteresis: the callback fires only after ``confirm_beats``
+    CONSECUTIVE beat replies report a loss — a single reply reflecting a
+    transient scheduler stall (the coordinator heals a returning host)
+    must not trigger the irreversible reaper. Cost: one extra
+    ``interval_s`` of detection latency."""
+
+    def __init__(self, client: RendezvousClient, process_id: int,
+                 interval_s: float = 2.0,
+                 on_host_lost: Optional[Callable[[List[int]], None]] = None,
+                 confirm_beats: int = 2):
+        super().__init__(name=f"rendezvous-heartbeat-{process_id}",
+                         daemon=True)
+        self.client = client
+        self.process_id = int(process_id)
+        self.interval_s = float(interval_s)
+        self.on_host_lost = on_host_lost
+        self.confirm_beats = max(1, int(confirm_beats))
+        self._lost_streak = 0
+        self._stop = threading.Event()
+        self.fired = False
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                resp = self.client.heartbeat(self.process_id)
+            except Exception:  # noqa: BLE001 - a missed beat is not fatal;
+                continue       # the coordinator's timeout owns liveness
+            lost = [p for p in resp.get("lost", ())
+                    if p != self.process_id]
+            self._lost_streak = self._lost_streak + 1 if lost else 0
+            if (lost and self._lost_streak >= self.confirm_beats
+                    and not self.fired and self.on_host_lost is not None):
+                self.fired = True
+                try:
+                    self.on_host_lost(lost)
+                except Exception:  # noqa: BLE001 - the watch must keep beating
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()

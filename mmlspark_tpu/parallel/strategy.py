@@ -27,6 +27,22 @@ trip-count-weighted byte walk):
 The ratio dp/voting is independent of the device count (the ring factor
 2*(ndev-1)/ndev multiplies both sides), so `ndev` only gates serial vs
 sharded and scales the absolute byte gauges.
+
+Multi-host extension (ISSUE 15): on a pod slice the per-split allreduce
+crosses TWO link classes — ICI inside a host, DCN between hosts — and
+the hierarchical form prices them separately: an intra-host
+reduce-scatter/all-gather moves ``2*(ld-1)/ld`` payloads per device over
+ICI, then a ring over the per-host leaders moves ``2*(H-1)/H`` payloads
+per host over DCN (``inter_host_bytes_per_split``). The dp/voting ratio
+STILL cancels (both strategies cross the same links), so the chooser's
+learner decision is unchanged — what the hosts term adds is the absolute
+inter-host traffic and the predicted wall (`allreduce_wall_model_s`),
+plus the `dcn_dominance_hosts` breakeven: the host count at which the
+DCN phase overtakes the ICI phase. With realistic dcn << ici that
+breakeven is H=2 — crossing hosts at all makes DCN the bottleneck —
+which is exactly the comm-dominance regime arxiv 1612.01437 measures.
+`scripts/measure_podslice.py` grounds the model on a measured 2-host
+CPU-mesh allreduce.
 """
 
 from __future__ import annotations
@@ -62,6 +78,14 @@ PARALLELISM_ALIASES = {
     "off": "serial", "serial": "serial",
 }
 
+#: calibration defaults for the two link classes (bytes/s). Order-of-
+#: magnitude v5e-class figures — effective per-device ICI vs per-host
+#: DCN NIC — used only where no measured bandwidth is available;
+#: scripts/measure_podslice.py derives the measured effective values
+#: from the 2-host allreduce wall and logs both next to these.
+ICI_BYTES_PER_S_DEFAULT = 4.8e10
+DCN_BYTES_PER_S_DEFAULT = 3.125e9
+
 
 def normalize_parallelism(value: str) -> str:
     """Canonical learner name ('auto'|'serial'|'data_parallel'|
@@ -88,6 +112,53 @@ def comm_bytes_per_split(n_features: int, bins: int, num_leaves: int,
     raise ValueError(f"no comm model for strategy {strategy!r}")
 
 
+def inter_host_bytes_per_split(n_features: int, bins: int, num_leaves: int,
+                               top_k: int, strategy: str, hosts: int) -> int:
+    """Closed-form DCN (cross-host) payload bytes per split: the
+    hierarchical allreduce's leader ring moves ``2*(H-1)/H`` payloads per
+    host across the host boundary. 0 on a single host — intra-host ICI
+    traffic never touches the DCN."""
+    if hosts <= 1:
+        return 0
+    payload = comm_bytes_per_split(n_features, bins, num_leaves, top_k,
+                                   strategy)
+    return int(round(payload * 2.0 * (hosts - 1) / hosts))
+
+
+def allreduce_wall_model_s(payload_bytes: float, ndev: int, hosts: int = 1,
+                           ici_bytes_per_s: float = ICI_BYTES_PER_S_DEFAULT,
+                           dcn_bytes_per_s: float = DCN_BYTES_PER_S_DEFAULT
+                           ) -> float:
+    """Predicted wall of one payload allreduce over a (hosts x
+    devices_per_host) mesh: intra-host reduce-scatter/all-gather over ICI
+    plus the leader ring over DCN, serialized (the hierarchical schedule
+    runs the phases back to back)."""
+    hosts = max(1, int(hosts))
+    ld = max(1, int(ndev) // hosts)
+    intra = 2.0 * (ld - 1) / ld * payload_bytes / float(ici_bytes_per_s)
+    inter = (2.0 * (hosts - 1) / hosts * payload_bytes
+             / float(dcn_bytes_per_s)) if hosts > 1 else 0.0
+    return intra + inter
+
+
+def dcn_dominance_hosts(devices_per_host: int,
+                        ici_bytes_per_s: float = ICI_BYTES_PER_S_DEFAULT,
+                        dcn_bytes_per_s: float = DCN_BYTES_PER_S_DEFAULT
+                        ) -> Optional[int]:
+    """The multi-host breakeven: the smallest host count H >= 2 at which
+    the DCN phase of the hierarchical allreduce takes at least as long as
+    the ICI phase — 2*(H-1)/H / dcn >= 2*(ld-1)/ld / ici, i.e.
+    (H-1)/H >= r with r = (dcn/ici) * (ld-1)/ld. None when DCN never
+    dominates at this bandwidth pair (r >= 1). With realistic dcn << ici
+    this returns 2: any cross-host hop makes DCN the bottleneck."""
+    import math
+    ld = max(1, int(devices_per_host))
+    r = (float(dcn_bytes_per_s) / float(ici_bytes_per_s)) * (ld - 1) / ld
+    if r >= 1.0:
+        return None
+    return max(2, math.ceil(1.0 / (1.0 - r)))
+
+
 def voting_advantage(n_features: int, bins: int, num_leaves: int,
                      top_k: int) -> float:
     """Predicted dp/voting traffic ratio (>1 = voting saves bytes);
@@ -100,7 +171,10 @@ def voting_advantage(n_features: int, bins: int, num_leaves: int,
 
 class StrategyDecision(NamedTuple):
     """The auditable record of one strategy choice (published to the
-    metrics registry and embedded in bench JSON)."""
+    metrics registry and embedded in bench JSON). The hosts fields
+    (ISSUE 15) record the fleet topology the fit ran on and the
+    closed-form DCN traffic it implies — 0 inter-host bytes on a single
+    host."""
     strategy: str          # resolved learner: serial|data_parallel|voting_parallel
     requested: str         # normalized user request (may be 'auto')
     ndev: int              # data-axis extent the fit will use (1 = serial)
@@ -109,14 +183,22 @@ class StrategyDecision(NamedTuple):
     voting_bytes_per_split: int
     threshold: float
     reason: str
+    hosts: int = 1                       # jax processes in the fit mesh
+    devices_per_host: int = 0            # local devices per host (0 = n/a)
+    dp_inter_host_bytes_per_split: int = 0
+    voting_inter_host_bytes_per_split: int = 0
 
     def as_labels(self) -> dict:
-        return {"strategy": self.strategy, "requested": self.requested}
+        return {"strategy": self.strategy, "requested": self.requested,
+                "hosts": str(self.hosts),
+                "devices_per_host": str(self.devices_per_host)}
 
 
 def choose_strategy(requested: str, ndev: int, n_features: int, bins: int,
                     num_leaves: int, top_k: int,
-                    allow_voting: bool = True) -> StrategyDecision:
+                    allow_voting: bool = True, hosts: int = 1,
+                    devices_per_host: Optional[int] = None
+                    ) -> StrategyDecision:
     """Resolve the user's `parallelism` request against the comm model.
 
     - explicit 'serial'/'data_parallel'/'voting_parallel' (or their short
@@ -127,6 +209,11 @@ def choose_strategy(requested: str, ndev: int, n_features: int, bins: int,
       (allow_voting=False pins data_parallel — the vmapped sweep path,
       where per-candidate voting programs would defeat the single
       compiled batch).
+
+    ``hosts``/``devices_per_host`` describe the fleet (multihost.topology):
+    they do not change the learner choice (the dp/voting ratio crosses
+    identical links, so bandwidth cancels) but land in the decision as
+    the closed-form inter-host byte prediction and the topology labels.
     """
     req = normalize_parallelism(requested)
     adv = voting_advantage(n_features, bins, num_leaves, top_k)
@@ -134,15 +221,26 @@ def choose_strategy(requested: str, ndev: int, n_features: int, bins: int,
                                 "data_parallel")
     vt_b = comm_bytes_per_split(n_features, bins, num_leaves, top_k,
                                 "voting_parallel")
+    hosts = max(1, int(hosts))
+    if devices_per_host is None:
+        devices_per_host = max(1, int(ndev) // hosts)
 
     def dec(strategy, reason):
         # ndev records the extent the fit WILL use: a serial resolution
         # runs on one device no matter how many are visible, and the
-        # gbdt_fit_ndev gauge documents 1 = serial
-        return StrategyDecision(strategy, req,
-                                1 if strategy == "serial" else ndev,
-                                adv, dp_b, vt_b,
-                                VOTING_ADVANTAGE_THRESHOLD, reason)
+        # gbdt_fit_ndev gauge documents 1 = serial (one device is also
+        # one host — a serial fit never crosses the DCN)
+        h = 1 if strategy == "serial" else hosts
+        return StrategyDecision(
+            strategy, req, 1 if strategy == "serial" else ndev,
+            adv, dp_b, vt_b, VOTING_ADVANTAGE_THRESHOLD, reason,
+            hosts=h,
+            devices_per_host=(1 if strategy == "serial"
+                              else int(devices_per_host)),
+            dp_inter_host_bytes_per_split=inter_host_bytes_per_split(
+                n_features, bins, num_leaves, top_k, "data_parallel", h),
+            voting_inter_host_bytes_per_split=inter_host_bytes_per_split(
+                n_features, bins, num_leaves, top_k, "voting_parallel", h))
 
     if req != "auto":
         return dec(req, "explicit parallelism param")

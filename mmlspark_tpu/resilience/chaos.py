@@ -153,15 +153,43 @@ class TrainingFaultInjector:
     ``self.counts`` stays an INDEPENDENT tally (boundaries seen, kills
     fired) so tests can reconcile registry counters against ground truth
     that does not share the registry's code path.
+
+    ``kill_host`` (ISSUE 15) turns the kill into a HOST fault on a
+    multi-process mesh: armed identically on every host (same seed, same
+    boundary — SPMD discipline), it fires only on the process whose
+    `jax.process_index()` matches, modelling exactly one host of the
+    fleet dying mid-fit. The surviving hosts' next collective wedges;
+    the fabric's heartbeat reaper (parallel/multihost.py) hard-exits
+    them, and recovery is PR 10's elastic resume at the surviving device
+    count from the last durable snapshot — proved digest-identical in
+    tests/test_multihost_fabric.py.
     """
 
     def __init__(self, seed: int = 0, kill_at_chunk: Optional[int] = None,
-                 max_chunk: int = 4):
+                 max_chunk: int = 4, kill_host: Optional[int] = None,
+                 process_index_fn: Optional[Callable[[], int]] = None):
         self.seed = seed
         self._rng = random.Random(seed)
         self.kill_at_chunk = (self._rng.randrange(max_chunk)
                               if kill_at_chunk is None else int(kill_at_chunk))
+        #: None = kill wherever armed; int = only the host (jax process)
+        #: with that index dies — the others count a 'spared' boundary
+        self.kill_host = kill_host
+        self._process_index_fn = process_index_fn
+        # 'spared' appears only for host faults: plain train-kill tests
+        # reconcile this dict EXACTLY against {boundaries, kills}
         self.counts: Dict[str, int] = {"boundaries": 0, "kills": 0}
+        if kill_host is not None:
+            self.counts["spared"] = 0
+
+    def _process_index(self) -> int:
+        if self._process_index_fn is not None:
+            return int(self._process_index_fn())
+        try:
+            import jax
+            return int(jax.process_index())
+        except Exception:  # noqa: BLE001 - no jax/distributed = host 0
+            return 0
 
     def chunk_boundary(self, chunk_index: int, start_iter: int) -> None:
         """The fit loop's per-chunk callback; raises `InjectedKill` at the
@@ -173,6 +201,12 @@ class TrainingFaultInjector:
         self.counts["boundaries"] += 1
         if idx != self.kill_at_chunk:
             return
+        if self.kill_host is not None \
+                and self._process_index() != self.kill_host:
+            # this host survives its peer's death — the wedge + reap is
+            # the fabric's job, not the injector's
+            self.counts["spared"] += 1
+            return
         self.counts["kills"] += 1
         try:
             from ..observability import get_registry
@@ -183,7 +217,9 @@ class TrainingFaultInjector:
             pass
         raise InjectedKill(
             f"injected kill at chunk boundary {chunk_index} "
-            f"(iteration {start_iter}: snapshot already durable)")
+            f"(iteration {start_iter}: snapshot already durable"
+            + (f"; host {self.kill_host} of the mesh dies"
+               if self.kill_host is not None else "") + ")")
 
     def arm(self, estimator):
         """Install on a LightGBM-style estimator; returns it for chaining."""

@@ -1,0 +1,260 @@
+"""Measure the multi-host training fabric: the pod-slice scaling ladder
+(ISSUE 15, ROADMAP item 4).
+
+Armed in scripts/tpu_recovery_watch.sh. Behavior:
+
+- Locally (CPU, the default): a VIRTUAL pod slice — H subprocess hosts,
+  each a separate OS process with its own
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=D`` backend, joined
+  through the real rendezvous contract (parallel/rendezvous.py
+  coordinator -> roster barrier -> gated jax.distributed/gloo init,
+  exactly the path `multihost.connect` drives on a pod). The 1-host rung
+  is the same worker at H=1 (single-controller mesh fit), so the scaling
+  ratio compares like against like. CPU-mesh numbers validate scaling
+  STRUCTURE (digest parity across host counts, chooser topology fields,
+  measured cross-host allreduce vs the ICI/DCN wall model), not absolute
+  throughput.
+- On a pod slice (each host launched by the pool runner with
+  MEASURE_PODSLICE_WORKER=1 + a shared coordinator address): the same
+  worker body runs on real ICI/DCN — the 1->2->4-host ladder the watcher
+  arms for the next multi-host window.
+
+Per rung: warm + timed fits of ``LightGBMClassifier(numTasks=H*D)``
+(process-local binning/transfer via multihost.binned_to_device), the
+strategy decision's hosts/devices_per_host/inter-host-bytes fields, the
+structural fit digest (must be identical across EVERY rung and host), and
+a measured global-mesh child-slice allreduce wall beside the closed-form
+``allreduce_wall_model_s`` prediction. Rows append to
+docs/PERF_podslice.log; the launcher writes one summary JSON (--out).
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+# the ONE reap-safe subprocess-host launcher (try/finally kill + hard
+# per-worker timeout) is shared with the multi-host tests — this script
+# runs from a repo checkout, where tests/ is always present
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+from multihost_harness import free_port, launch_hosts  # noqa: E402
+
+LOG = os.path.join(os.path.dirname(__file__), "..", "docs",
+                   "PERF_podslice.log")
+
+#: CPU-mesh problem shape: bounded (~15 s/rung on a 24-core box) but
+#: non-trivial — NaN-bearing, weighted, row count not a multiple of any
+#: rung's device count, scatter hist (the CPU-mesh discipline of
+#: measure_multichip_fit.py)
+N_ROWS, N_FEATURES, ITERS, BINS, LEAVES = 60_003, 16, 10, 32, 15
+
+
+def _log(row):
+    line = json.dumps(row)
+    print(line, flush=True)
+    try:
+        with open(LOG, "a") as fh:
+            fh.write(line + "\n")
+    except OSError:
+        pass
+
+
+def _data():
+    import numpy as np
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    x[rng.random((N_ROWS, N_FEATURES)) < 0.05] = np.nan
+    y = (np.nansum(x[:, :4], axis=1) > 0).astype(np.float64)
+    w = rng.uniform(0.5, 2.0, size=N_ROWS).astype(np.float32)
+    return x, y, w
+
+
+def _struct_digest(model_string: str) -> str:
+    """Structural digest of a STRAIGHT fit's model_string (split records
+    only — leaf values carry cross-process reduction-order fp noise).
+    The canonical definition: tests/test_multihost_fabric.py imports it
+    from here. NOT valid for a RESUMED booster, whose model_string
+    renumbers nodes from the BFS slot layout (parse_model_string first —
+    test_elastic)."""
+    struct = "\n".join(l for l in model_string.splitlines()
+                       if l.split("=")[0] in
+                       ("split_feature", "threshold", "decision_type",
+                        "left_child", "right_child", "num_leaves"))
+    return hashlib.sha256(struct.encode()).hexdigest()
+
+
+# ----------------------------------------------------------------- worker
+
+def worker(args) -> int:
+    """One host of the rung: rendezvous -> fit -> rows on stdout (the
+    launcher keeps process 0's). Runs identically on the virtual CPU
+    fabric and on a real pod-slice host."""
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.parallel import mesh as meshlib
+    from mmlspark_tpu.parallel import multihost as mh
+    from mmlspark_tpu.parallel import strategy as stratlib
+
+    sess = mh.connect(args.coordinator, args.hosts, name=args.name,
+                      jax_port=args.jax_port or None, deadline_s=120.0,
+                      heartbeat_interval_s=1.0)
+    topo = sess.topology
+    ndev = topo.devices
+    x, y, w = _data()
+    df = DataFrame({"features": x, "label": y, "w": w})
+    clf = LightGBMClassifier(numIterations=ITERS, numLeaves=LEAVES,
+                             maxBin=BINS, numTasks=ndev, weightCol="w",
+                             histMethod="scatter")
+    t0 = time.time()
+    mdl = clf.fit(df)                                   # compile + warm
+    warm = time.time() - t0
+    walls = []
+    for _ in range(2):
+        t0 = time.time()
+        mdl = clf.fit(df)
+        walls.append(time.time() - t0)
+    dec = mdl.booster.fit_strategy
+    row = {"row": "rung", "hosts": topo.hosts,
+           "devices_per_host": topo.devices_per_host, "ndev": ndev,
+           "process_id": topo.process_id,
+           "n": N_ROWS, "iters": ITERS,
+           "strategy": dec["strategy"],
+           "decision_hosts": dec.get("hosts"),
+           "decision_devices_per_host": dec.get("devices_per_host"),
+           "dp_inter_host_bytes_per_split":
+               dec.get("dp_inter_host_bytes_per_split"),
+           "voting_inter_host_bytes_per_split":
+               dec.get("voting_inter_host_bytes_per_split"),
+           "warm_wall_s": round(warm, 2),
+           "wall_s": [round(w_, 2) for w_ in walls],
+           "rows_iter_per_s": round(N_ROWS * ITERS / min(walls), 1),
+           "pipelined": bool(clf._last_fit_pipelined),
+           "digest": _struct_digest(mdl.booster.model_string())}
+    # measured cross-host allreduce on the GLOBAL mesh vs the hierarchical
+    # ICI/DCN wall model — the grounding the chooser's hosts term rests on
+    arw = stratlib.measure_allreduce_wall_s(meshlib.get_mesh(ndev),
+                                            N_FEATURES, BINS, reps=3)
+    payload = stratlib.comm_bytes_per_split(N_FEATURES, BINS, LEAVES, 20,
+                                            "data_parallel")
+    row["allreduce_wall_child_slice_ms"] = round(arw * 1e3, 3)
+    row["allreduce_wall_model_ms"] = round(
+        stratlib.allreduce_wall_model_s(payload, ndev, topo.hosts) * 1e3, 4)
+    row["allreduce_effective_bytes_per_s"] = round(
+        2.0 * (ndev - 1) / ndev * payload / arw, 1) if ndev > 1 else None
+    print("ROW " + json.dumps(row), flush=True)
+    sess.close()
+    return 0
+
+
+# ---------------------------------------------------------------- launcher
+
+def _launch_rung(hosts: int, dph: int, timeout_s: float):
+    """One virtual rung: coordinator here, H subprocess hosts, each on
+    its own D-device CPU backend, launched through the shared reap-safe
+    harness (tests/multihost_harness.launch_hosts). Returns process 0's
+    rows after cross-checking every host's digest."""
+    from mmlspark_tpu.parallel.rendezvous import RendezvousCoordinator
+    coord = RendezvousCoordinator(hosts, heartbeat_timeout_s=15.0).start()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={dph}").strip()
+    try:
+        outs = launch_hosts(
+            [[sys.executable, "-u", os.path.abspath(__file__),
+              "--worker", "--coordinator", coord.address,
+              "--hosts", str(hosts), "--jax-port", str(free_port()),
+              "--name", f"vhost{i}"] for i in range(hosts)],
+            env, timeout_s=timeout_s, per_worker_timeout_s=timeout_s)
+    finally:
+        coord.stop()
+    rows, digests = [], []
+    for rc, out, err in outs:
+        if rc != 0:
+            raise RuntimeError(f"rung {hosts}x{dph} worker failed rc={rc}: "
+                               f"{err[-1500:]}")
+        for line in out.splitlines():
+            if line.startswith("ROW "):
+                r = json.loads(line[4:])
+                digests.append(r["digest"])
+                if r["process_id"] == 0:
+                    rows.append(r)
+    if len(digests) != hosts or not rows:
+        raise RuntimeError(f"rung {hosts}x{dph}: expected {hosts} worker "
+                           f"rows, got {len(digests)}")
+    if len(set(digests)) != 1:
+        raise RuntimeError(f"rung {hosts}x{dph}: hosts disagree on the "
+                           f"fit digest: {digests}")
+    return rows[0]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--coordinator", default="")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--jax-port", type=int, default=0)
+    ap.add_argument("--name", default="")
+    ap.add_argument("--dph", type=int, default=8,
+                    help="devices per host (virtual CPU backend size)")
+    ap.add_argument("--ladder", default="1,2",
+                    help="comma host-count ladder (watcher arms 1,2,4)")
+    ap.add_argument("--rung-timeout-s", type=float, default=600.0)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "docs", "PODSLICE_cpu.json"))
+    args = ap.parse_args()
+    if args.worker:
+        sys.exit(worker(args))
+
+    from mmlspark_tpu.parallel import strategy as stratlib
+    ladder = [int(h) for h in args.ladder.split(",") if h.strip()]
+    _log({"row": "start", "ladder": ladder, "devices_per_host": args.dph,
+          "n": N_ROWS, "iters": ITERS,
+          "start": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())})
+    summary = {"devices_per_host": args.dph, "n": N_ROWS, "iters": ITERS,
+               "rungs": [], "dcn_dominance_hosts_predicted":
+                   stratlib.dcn_dominance_hosts(args.dph)}
+    base_rate, base_digest = None, None
+    for hosts in ladder:
+        try:
+            row = _launch_rung(hosts, args.dph, args.rung_timeout_s)
+        except Exception as e:  # noqa: BLE001 - one rung must not cost the rest
+            _log({"row": "rung", "hosts": hosts, "error": str(e)[:500]})
+            summary["rungs"].append({"hosts": hosts, "error": str(e)[:500]})
+            continue
+        if base_rate is None:
+            base_rate, base_digest = row["rows_iter_per_s"], row["digest"]
+        row["speedup_vs_1host"] = round(row["rows_iter_per_s"] / base_rate, 3)
+        row["scaling_efficiency"] = round(
+            row["rows_iter_per_s"] / (base_rate * hosts), 3)
+        # the acceptance digest: every rung of the ladder must train the
+        # structurally identical model (the cross-host fit changes WHERE
+        # rows are binned, never WHAT is learned)
+        row["digest_matches_1host"] = bool(row["digest"] == base_digest)
+        _log(row)
+        summary["rungs"].append(row)
+        if not row["digest_matches_1host"]:
+            _log({"row": "digest_mismatch", "hosts": hosts,
+                  "digest": row["digest"], "base": base_digest})
+    ok = [r for r in summary["rungs"] if "error" not in r]
+    summary["measured_rungs"] = len(ok)
+    summary["digest_parity_all_rungs"] = bool(
+        ok and all(r["digest_matches_1host"] for r in ok))
+    out = os.path.abspath(args.out)
+    with open(out, "w") as fh:
+        json.dump(summary, fh, indent=1)
+    _log({"row": "summary", "out": out,
+          "measured_rungs": summary["measured_rungs"],
+          "digest_parity_all_rungs": summary["digest_parity_all_rungs"]})
+    sys.exit(0 if summary["digest_parity_all_rungs"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,11 @@ EOF
     run python -u scripts/measure_multichip_fit.py
     echo "== fit pipeline overlap (round-7 tentpole) $(date -u +%FT%TZ)"
     run python -u scripts/measure_fit_pipeline.py
+    echo "== pod-slice multi-host ladder (round-15 tentpole) $(date -u +%FT%TZ)"
+    # 1->2->4-host ladder: on a real pod window the pool runner launches
+    # per-host workers; from one host this measures what the grant allows
+    # and logs fenced per-rung errors for the rest (docs/MULTIHOST.md)
+    run python -u scripts/measure_podslice.py --ladder 1,2,4 --out docs/PODSLICE_chip.json
     if ! run python -u scripts/quick_fit_probe.py; then
       echo "== quick fit probe FAILED $(date -u +%FT%TZ); back to probing"
       sleep 120

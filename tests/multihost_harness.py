@@ -1,0 +1,85 @@
+"""Shared subprocess-host launch harness for the multi-host tests.
+
+ISSUE-15 satellite: the original test_multihost.py launches leaked worker
+subprocesses whenever an assertion (or pytest.fail) fired between Popen
+and communicate() — the sibling worker kept running jax.distributed
+against a dead peer until its own 150 s timeout, eating suite wall and
+occasionally wedging the shared CPU pool. Every multi-host launch now
+routes through `launch_hosts`, which guarantees (try/finally) that every
+worker is killed before control returns, applies a HARD per-worker
+timeout, and never raises from the collection loop itself — callers
+assert on the returned records.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: marker appended to stderr when the harness had to kill a worker — the
+#: caller's `rc == 0` assertion then fails with the reason visible
+KILLED_MARKER = "<<multihost_harness: killed after timeout>>"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_hosts(argvs: Sequence[Sequence[str]], env: Dict[str, str],
+                 timeout_s: float,
+                 per_worker_timeout_s: Optional[float] = None
+                 ) -> List[Tuple[Optional[int], str, str]]:
+    """Launch one subprocess per argv, collect (returncode, stdout,
+    stderr) per worker, and ALWAYS reap every worker before returning —
+    an exception anywhere (launch failure, timeout, a caller's assertion
+    re-raised through us) cannot leak an orphan jax process into the
+    suite.
+
+    ``timeout_s`` bounds the WHOLE launch (shared deadline across
+    workers); ``per_worker_timeout_s`` additionally caps any single
+    communicate() so one wedged worker cannot consume the siblings'
+    budget. A timed-out worker is killed and its record carries
+    KILLED_MARKER in stderr (returncode reflects the kill signal).
+    """
+    procs: List[subprocess.Popen] = []
+    records: List[Tuple[Optional[int], str, str]] = []
+    deadline = time.monotonic() + float(timeout_s)
+    try:
+        for argv in argvs:
+            procs.append(subprocess.Popen(
+                list(argv), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env))
+        for p in procs:
+            budget = max(1.0, deadline - time.monotonic())
+            if per_worker_timeout_s is not None:
+                budget = min(budget, per_worker_timeout_s)
+            try:
+                out, err = p.communicate(timeout=budget)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    out, err = p.communicate(timeout=15)
+                except subprocess.TimeoutExpired:  # unkillable: record, move on
+                    out, err = "", ""
+                err = (err or "") + "\n" + KILLED_MARKER
+            records.append((p.returncode, out or "", err or ""))
+        return records
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.communicate(timeout=15)
+                except Exception:  # noqa: BLE001 - best-effort reap
+                    pass
+
+
+def field(out: str, tag: str) -> str:
+    """Last whitespace-separated field of the first stdout line starting
+    with ``tag`` — the worker-result convention of the multi-host tests."""
+    line = next(l for l in out.splitlines() if l.startswith(tag))
+    return line.split()[-1]

@@ -264,9 +264,16 @@ class TestSyncPointLint:
     _fetch_chunk_host / _finalize_chunks). Same posture as the PR 4
     backoff-loop lint: the concurrency property is enforced by CI."""
 
-    #: functions whose bodies must be sync-free
-    TARGETS = ("_binned_to_device", "_binned_to_device_sharded",
-               "_pipelined_device_data", "_run_chunked")
+    #: (module, functions whose bodies must be sync-free) — the multihost
+    #: data plane (ISSUE 15) carries the same no-sync contract as the
+    #: single-controller pipeline it extends
+    MODULES = (
+        ("mmlspark_tpu.models.lightgbm.base",
+         ("_binned_to_device", "_binned_to_device_sharded",
+          "_pipelined_device_data", "_run_chunked")),
+        ("mmlspark_tpu.parallel.multihost",
+         ("binned_to_device", "assemble_row_sharded", "zeros_row_sharded")),
+    )
     #: nested defs that ARE the designated sync points
     DESIGNATED = {"_fetch_chunk_host", "_finalize_chunks"}
     # np.asarray on a device array is an implicit blocking fetch — both the
@@ -276,31 +283,35 @@ class TestSyncPointLint:
         r"block_until_ready|device_get|(?<!j)np\.asarray\b|\.item\(")
 
     def _offending_lines(self):
-        from mmlspark_tpu.models.lightgbm import base as lgb_base
-        path = lgb_base.__file__
-        src = open(path, encoding="utf-8").read()
-        lines = src.split("\n")
-        tree = ast.parse(src)
-        found = set()
+        import importlib
         offenders = []
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.FunctionDef):
-                continue
-            if node.name not in self.TARGETS:
-                continue
-            found.add(node.name)
-            excluded = set()
-            for sub in ast.walk(node):
-                if (isinstance(sub, ast.FunctionDef)
-                        and sub.name in self.DESIGNATED):
-                    excluded.update(range(sub.lineno, sub.end_lineno + 1))
-            for ln in range(node.lineno, node.end_lineno + 1):
-                if ln in excluded:
+        for modname, targets in self.MODULES:
+            mod = importlib.import_module(modname)
+            path = mod.__file__
+            src = open(path, encoding="utf-8").read()
+            lines = src.split("\n")
+            tree = ast.parse(src)
+            found = set()
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.FunctionDef):
                     continue
-                if self.FORBIDDEN.search(lines[ln - 1]):
-                    offenders.append(f"{path}:{ln}: {lines[ln - 1].strip()}")
-        assert found == set(self.TARGETS), (
-            f"lint targets moved/renamed: found {found}")
+                if node.name not in targets:
+                    continue
+                found.add(node.name)
+                excluded = set()
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.FunctionDef)
+                            and sub.name in self.DESIGNATED):
+                        excluded.update(range(sub.lineno,
+                                              sub.end_lineno + 1))
+                for ln in range(node.lineno, node.end_lineno + 1):
+                    if ln in excluded:
+                        continue
+                    if self.FORBIDDEN.search(lines[ln - 1]):
+                        offenders.append(
+                            f"{path}:{ln}: {lines[ln - 1].strip()}")
+            assert found == set(targets), (
+                f"lint targets moved/renamed in {modname}: found {found}")
         return offenders
 
     def test_no_sync_outside_designated_points(self):

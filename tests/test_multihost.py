@@ -9,12 +9,12 @@ of the DCN story (SURVEY.md §5 distributed communication backend).
 """
 
 import os
-import socket
-import subprocess
 import sys
 import textwrap
 
 import pytest
+
+from multihost_harness import free_port, launch_hosts
 
 WORKER = textwrap.dedent("""
     import os, sys
@@ -50,31 +50,19 @@ WORKER = textwrap.dedent("""
 """).format(repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def test_two_process_distributed_init_and_collectives(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
-    port = _free_port()
+    port = free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # one device per process: no virtual topology
     env["JAX_PLATFORMS"] = "cpu"
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(i), str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=150)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            pytest.fail("distributed worker hung")
-        outs.append((p.returncode, out, err))
+    # launch_hosts (multihost_harness): try/finally-reaped workers + hard
+    # per-worker timeout — an assertion below can no longer leak a live
+    # jax.distributed subprocess into the rest of the suite
+    outs = launch_hosts(
+        [[sys.executable, str(script), str(i), str(port)] for i in range(2)],
+        env, timeout_s=150, per_worker_timeout_s=150)
     for rc, out, err in outs:
         assert rc == 0, f"worker failed:\n{err[-2000:]}"
         assert "psum=3.0" in out and "pmean=1.5" in out
@@ -176,22 +164,14 @@ def test_two_process_2d_mesh_gbdt_and_transformer(tmp_path):
     script = tmp_path / "worker2d.py"
     script.write_text(WORKER_2D)
     model_file = tmp_path / "model_mp.txt"
-    port = _free_port()
+    port = free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    procs = [subprocess.Popen(
-        [sys.executable, str(script), str(i), str(port), str(model_file)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            pytest.fail("2-D mesh worker hung")
-        outs.append((p.returncode, out, err))
+    outs = launch_hosts(
+        [[sys.executable, str(script), str(i), str(port), str(model_file)]
+         for i in range(2)],
+        env, timeout_s=300, per_worker_timeout_s=300)
     for rc, out, err in outs:
         assert rc == 0, f"worker failed:\n{err[-3000:]}"
 
