@@ -108,6 +108,20 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
             extra.setdefault(_name + "_error", str(e)[:200])
     if _incidents:
         extra.setdefault("incidents", _incidents)
+    # VW throughput-ladder provenance (ISSUE-16): the most recent measured
+    # batch-size ladder (scripts/measure_vw_throughput.py) rides in the
+    # record — chip run preferred, CPU-host run otherwise — so the bench
+    # line carries the fusedTables=auto evidence and the best-rung rate.
+    try:
+        for _fn in ("VW_THROUGHPUT_chip.json", "VW_THROUGHPUT.json"):
+            _lp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", _fn)
+            if os.path.exists(_lp):
+                with open(_lp) as _f:
+                    extra.setdefault("vw_throughput", json.load(_f))
+                break
+    except Exception as e:  # noqa: BLE001
+        extra.setdefault("vw_throughput_error", str(e)[:200])
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]

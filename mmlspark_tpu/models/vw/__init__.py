@@ -12,6 +12,7 @@ from .contextual_bandit import (ContextualBanditMetrics,
                                 VowpalWabbitContextualBanditModel)
 from .featurizer import (VectorZipper, VowpalWabbitFeaturizer,
                          VowpalWabbitInteractions)
+from .online import VWOnlineRing
 from .sparse import SparseFeatures
 
 __all__ = [
@@ -19,7 +20,7 @@ __all__ = [
     "VowpalWabbitClassifier", "VowpalWabbitClassificationModel",
     "VowpalWabbitRegressor", "VowpalWabbitRegressionModel",
     "VowpalWabbitContextualBandit", "VowpalWabbitContextualBanditModel",
-    "ContextualBanditMetrics",
+    "ContextualBanditMetrics", "VWOnlineRing",
     "VowpalWabbitFeaturizer", "VowpalWabbitInteractions", "VectorZipper",
     "SparseFeatures",
 ]

@@ -27,8 +27,10 @@ from ...compile import cache as compilecache
 from ...core import params as _p
 from ...core.dataframe import DataFrame
 from ...core.pipeline import Estimator, Model
+from ...observability import bridge as obsbridge
 from ...parallel import mesh as meshlib
-from .sgd import VWConfig, VWState, init_state, make_train_fn, pad_examples
+from .sgd import (VWConfig, VWState, init_state, make_train_fn, pad_examples,
+                  resolve_auto_fused)
 from .sparse import SparseFeatures
 
 
@@ -65,6 +67,20 @@ class VowpalWabbitParamsBase(_p.HasFeaturesCol, _p.HasLabelCol,
     useBarrierExecutionMode = _p.Param(
         "useBarrierExecutionMode", "accepted for API parity; SPMD launch is "
         "inherently gang-scheduled so this is a no-op", False, bool)
+    fusedTables = _p.Param(
+        "fusedTables",
+        "pack the w/g2/scale tables into one [R, 2^b] table so each SGD "
+        "step issues ONE gather and ONE scatter instead of up to three of "
+        "each (auto | on | off). auto packs whenever adaptive or "
+        "normalized needs a second table — the rule pinned by the "
+        "measured ladder (scripts/measure_vw_throughput.py, docs/VW.md)",
+        "auto")
+    metricsEvery = _p.Param(
+        "metricsEvery",
+        "online-ring telemetry cadence: fetch the loss and publish "
+        "vw_examples_per_s / vw_step_seconds every N retired steps — the "
+        "ring's ONLY host syncs outside commit points "
+        "(models/vw/online.py)", 10, int)
 
     interactions = _p.Param(
         "interactions", "namespace interaction terms as VW -q pairs (e.g. "
@@ -182,6 +198,20 @@ class VowpalWabbitParamsBase(_p.HasFeaturesCol, _p.HasLabelCol,
                     "ignored (VowpalWabbitBase.scala:139-169 forwards every "
                     "flag to C++ where it has effect)")
         return out
+
+    def _resolve_fused(self, adaptive: bool, normalized: bool) -> bool:
+        """Resolve fusedTables (auto/on/off) to the concrete step layout
+        and publish the decision (vw_fused_tables_total) so the fleet's
+        resolved layouts are scrapeable."""
+        mode = str(self.get("fusedTables")).lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"fusedTables must be 'auto', 'on' or 'off', got "
+                f"{self.get('fusedTables')!r}")
+        fused = (resolve_auto_fused(adaptive, normalized) if mode == "auto"
+                 else mode == "on")
+        obsbridge.publish_vw_fused_decision(mode, fused)
+        return fused
 
 
 def _masked_features(col: np.ndarray, num_bits: int) -> SparseFeatures:
@@ -340,6 +370,29 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
         return ndev if (ndev > 1 and n_rows >= self.AUTO_SHARD_MIN_ROWS) \
             else 1
 
+    def _initial_state(self, nf: int) -> VWState:
+        """Fresh table, or the initialModel warm start: weights/bias seed
+        training while the adaptive accumulators restart (the reference
+        reloads full VW state from model bytes — here the model's
+        persisted surface is the weight table)."""
+        init_m = self.get("initialModel")
+        if init_m is None:
+            return init_state(nf)
+        if isinstance(init_m, VWState):
+            prev_w = np.asarray(init_m.w)
+            prev_b = float(init_m.bias)
+        else:  # fitted VowpalWabbit model: weights + bias params
+            prev_w = np.asarray(init_m.get("weights"))
+            prev_b = float(init_m.get("biasValue"))
+        if prev_w.shape[0] != nf:
+            raise ValueError(
+                f"initialModel was trained with a {prev_w.shape[0]}-slot "
+                f"weight table but this estimator uses {nf} "
+                f"(numBits mismatch)")
+        return init_state(nf)._replace(
+            w=jnp.asarray(prev_w, jnp.float32),
+            bias=jnp.asarray(prev_b, jnp.float32))
+
     def _train_state(self, feats: SparseFeatures, y: np.ndarray,
                      w: np.ndarray) -> Tuple[VWState, np.ndarray, Dict]:
         eff = self._effective_params()
@@ -363,32 +416,14 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
             num_passes=int(eff["numPasses"]), minibatch=mb,
             use_constant=bool(eff["useConstant"]),
             shared_indices=shared,
-            axis_name=meshlib.DATA_AXIS if ntasks > 1 else None)
+            axis_name=meshlib.DATA_AXIS if ntasks > 1 else None,
+            fused=self._resolve_fused(bool(eff["adaptive"]),
+                                      bool(eff["normalized"])))
         train = make_train_fn(cfg)
         t_ingest = time.perf_counter_ns()
         idx, val, yy, ww = pad_examples(
             feats.indices, feats.values, y, w, mb * max(ntasks, 1))
-        init_m = self.get("initialModel")
-        if init_m is not None:
-            if isinstance(init_m, VWState):
-                prev_w = np.asarray(init_m.w)
-                prev_b = float(init_m.bias)
-            else:  # fitted VowpalWabbit model: weights + bias params
-                prev_w = np.asarray(init_m.get("weights"))
-                prev_b = float(init_m.get("biasValue"))
-            if prev_w.shape[0] != nf:
-                raise ValueError(
-                    f"initialModel was trained with a {prev_w.shape[0]}-slot "
-                    f"weight table but this estimator uses {nf} "
-                    f"(numBits mismatch)")
-            # weights/bias seed training; adaptive accumulators restart
-            # (the reference reloads full VW state from model bytes — here
-            # the model's persisted surface is the weight table)
-            state = init_state(nf)._replace(
-                w=jnp.asarray(prev_w, jnp.float32),
-                bias=jnp.asarray(prev_b, jnp.float32))
-        else:
-            state = init_state(nf)
+        state = self._initial_state(nf)
         t_learn0 = time.perf_counter_ns()
         if ntasks > 1:
             from jax.sharding import PartitionSpec as P
@@ -429,26 +464,91 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
             "rows": np.full(max(ntasks, 1), len(y) // max(ntasks, 1)),
             "passes": np.full(max(ntasks, 1), cfg.num_passes),
         }
+        learn_s = max((t_end - t_learn0) * 1e-9, 1e-9)
+        obsbridge.publish_vw_step_metrics(
+            examples_per_s=len(y) * cfg.num_passes / learn_s)
         return state, np.asarray(losses), stats
 
     def _make_model(self, state: VWState, losses, stats) -> "VowpalWabbitBaseModel":
         raise NotImplementedError
 
-    def _fit(self, df: DataFrame) -> "VowpalWabbitBaseModel":
-        feats, y, w = self._extract(df)
-        state, losses, stats = self._train_state(feats, y, w)
-        model = self._make_model(state, losses, stats)
+    def _decorate_model(self, model: "VowpalWabbitBaseModel"
+                        ) -> "VowpalWabbitBaseModel":
+        """Copy the featurization surface onto the fitted model —
+        transform must expand the same namespaces/interactions as fit.
+        Shared by the offline _fit and finalize_online."""
         for p in ("featuresCol", "labelCol"):
             model.set(p, self.get(p))
         eff = self._effective_params()
         model.set("numBits", eff["numBits"])
-        # transform must expand the same namespaces/interactions as fit
         model.set("interactions", list(eff["interactions"]))
         model.set("additionalFeatures",
                   list(self.get("additionalFeatures") or []))
         model.set("ignoreNamespaces", "".join(eff["ignore"]))
         model.set("link", eff["link"] or "identity")
         return model
+
+    def _fit(self, df: DataFrame) -> "VowpalWabbitBaseModel":
+        feats, y, w = self._extract(df)
+        state, losses, stats = self._train_state(feats, y, w)
+        return self._decorate_model(self._make_model(state, losses, stats))
+
+    # --------------------------------------------------------- online loop
+
+    def _online_label_transform(self):
+        """Label mapping the online ring applies at staging time (the
+        classifier's 0/1 -> ±1 conversion); None = labels pass through."""
+        return None
+
+    def _online_config(self) -> VWConfig:
+        """The streaming step's VWConfig: single pass, no sharding, no
+        shared-index assumption (streamed rows are not known to be
+        row-invariant up front)."""
+        eff = self._effective_params()
+        nf = 1 << int(eff["numBits"])
+        return VWConfig(
+            num_features=nf, loss=eff["loss"] or self._loss,
+            learning_rate=float(eff["learningRate"]),
+            power_t=float(eff["powerT"]), initial_t=float(eff["initialT"]),
+            l1=float(eff["l1"]), l2=float(eff["l2"]),
+            adaptive=bool(eff["adaptive"]), normalized=bool(eff["normalized"]),
+            invariant=bool(eff["invariant"]),
+            num_passes=1, minibatch=self.get("minibatchSize"),
+            use_constant=bool(eff["useConstant"]),
+            shared_indices=False, axis_name=None,
+            fused=self._resolve_fused(bool(eff["adaptive"]),
+                                      bool(eff["normalized"])))
+
+    def online_learner(self, **ring_kw):
+        """Build the ahead-dispatched online ring (models/vw/online.py)
+        for this estimator's engine configuration: submit hashed
+        (indices, values, labels[, weights]) rows as they arrive, then
+        `finalize_online(ring)` for the fitted model. Ring knobs
+        (depth, width, clock, registry, donate) pass through; the
+        telemetry cadence defaults to this estimator's metricsEvery."""
+        from .online import VWOnlineRing
+        cfg = self._online_config()
+        ring_kw.setdefault("metrics_every", int(self.get("metricsEvery")))
+        return VWOnlineRing(cfg, self._initial_state(cfg.num_features),
+                            label_transform=self._online_label_transform(),
+                            **ring_kw)
+
+    def finalize_online(self, ring) -> "VowpalWabbitBaseModel":
+        """Drain the ring and wrap its state as a fitted model (same
+        decoration as the offline _fit). The model's pass_losses carry
+        the ring's metricsEvery-sampled loss trajectory."""
+        state, aux = ring.finalize()
+        ns = int(aux["wall_s"] * 1e9)
+        stats = {
+            "partitionId": np.array([0]),
+            "ingestTimeNs": np.array([0], np.int64),
+            "learnTimeNs": np.array([ns], np.int64),
+            "totalTimeNs": np.array([ns], np.int64),
+            "rows": np.array([aux["examples"]]),
+            "passes": np.array([1]),
+        }
+        return self._decorate_model(
+            self._make_model(state, aux["losses"], stats))
 
 
 class VowpalWabbitBaseModel(Model, _p.HasFeaturesCol, _p.HasLabelCol,

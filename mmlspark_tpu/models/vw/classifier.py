@@ -36,6 +36,20 @@ class VowpalWabbitClassifier(VowpalWabbitBase, _p.HasProbabilityCol,
                     f"found {np.unique(y[bad])[:5]}")
         return feats, y, w
 
+    def _online_label_transform(self):
+        """Same labelConversion contract as _extract, applied per staged
+        chunk by the online ring."""
+        if not self.get("labelConversion"):
+            def _check(y):
+                bad = ~np.isin(y, (-1.0, 1.0))
+                if bad.any():
+                    raise ValueError(
+                        "labelConversion=False requires labels in {-1, +1}; "
+                        f"found {np.unique(y[bad])[:5]}")
+                return y
+            return _check
+        return lambda y: np.where(y > 0.5, 1.0, -1.0).astype(np.float32)
+
     def _make_model(self, state, losses, stats):
         model = VowpalWabbitClassificationModel(state=state, losses=losses,
                                                 stats=stats)

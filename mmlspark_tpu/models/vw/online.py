@@ -1,0 +1,283 @@
+"""VW online-learning ring — ahead-dispatched minibatch steps (ISSUE 16).
+
+The offline fit amortizes dispatch over a whole `lax.scan`; the online
+loop cannot (examples arrive incrementally), and a naive implementation
+syncs host<->device once per step — the per-example-overhead trap of
+arxiv 1612.01437 applied to a step loop. This module applies the PR 6
+ahead-dispatch discipline to the online path instead:
+
+- `submit()` stages incoming rows in a host-side tail buffer and
+  dispatches one device step per full minibatch WITHOUT waiting for the
+  previous step: JAX dispatch is async, so batch i+1's staging (numpy
+  slicing, label transform, width pinning) runs on the host while step
+  i executes on the device.
+- A bounded ring (`depth`) of in-flight steps provides backpressure:
+  when full, the dispatcher blocks ONLY in `_retire_oldest`, the
+  designated sync point under the AST sync-point lint
+  (tests/test_fit_pipeline.py::TestSyncPointLint) — `submit`/`_dispatch`
+  themselves must stay free of host fetches.
+- Telemetry never forces a per-step sync: the loss scalar is fetched to
+  host every `metrics_every` retired steps (the step is already retired
+  — blocked — when fetched, so the fetch itself is free), publishing
+  `vw_examples_per_s` / `vw_step_seconds` via observability/bridge.py.
+- The device step is `make_step_fn(cfg)` routed through `cached_jit`
+  (key `("vw_online_step", cfg, donate)`), with the carry donated on
+  real accelerators so the packed table updates in place.
+
+The carry is the fused packed table when cfg.fused (ONE gather + ONE
+scatter per step — see sgd._fused_minibatch_step) and a plain VWState
+otherwise. With donation active the ring owns the initial state's
+buffers; callers must not reuse a donated VWState after the first step.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...compile import cache as compilecache
+from ...observability import bridge as obsbridge
+from .sgd import (VWConfig, VWState, init_state, make_step_fn, pack_state,
+                  pad_examples, unpack_state)
+
+__all__ = ["VWOnlineRing"]
+
+
+def _coerce_rows(indices, values, labels, weights):
+    """Host-side staging coercion (called from the hot path but pure
+    numpy-on-host: the inputs are caller rows, never device arrays, so
+    nothing here can become an implicit device fetch)."""
+    idx = np.asarray(indices, np.int32)
+    val = np.asarray(values, np.float32)
+    y = np.asarray(labels, np.float32)
+    if idx.ndim != 2 or val.shape != idx.shape:
+        raise ValueError(
+            f"expected row-major [n, k] indices/values, got {idx.shape} / "
+            f"{val.shape}")
+    if y.shape != (idx.shape[0],):
+        raise ValueError(
+            f"labels must be [n]={idx.shape[0]}, got {y.shape}")
+    w = (np.ones(len(y), np.float32) if weights is None
+         else np.asarray(weights, np.float32))
+    if w.shape != y.shape:
+        raise ValueError(f"weights must be [n]={y.shape}, got {w.shape}")
+    return idx, val, y, w
+
+
+def _repin_width(idx, val, k_pinned: int):
+    """Pad a narrower chunk up to the ring's pinned row width (index 0 /
+    value 0 slots are inert). A WIDER chunk would change the jitted step's
+    shape and retrace mid-stream — stalling the very overlap the ring
+    exists to create — so it is rejected loudly instead."""
+    k = idx.shape[1]
+    if k > k_pinned:
+        raise ValueError(
+            f"row width {k} exceeds the ring's pinned width {k_pinned}; "
+            f"a wider batch would retrace the jitted step mid-stream. "
+            f"Create the ring with width={k} (or submit the widest batch "
+            f"first)")
+    if k == k_pinned:
+        return idx, val
+    pad = ((0, 0), (0, k_pinned - k))
+    return (np.pad(idx, pad), np.pad(val, pad))
+
+
+class VWOnlineRing:
+    """Bounded ahead-dispatch ring over the VW minibatch step.
+
+    Usage::
+
+        ring = estimator.online_learner()
+        for chunk in stream:
+            ring.submit(chunk.indices, chunk.values, chunk.labels)
+        model = estimator.finalize_online(ring)
+
+    Rows below one minibatch accumulate in the tail buffer until enough
+    arrive; `flush()` pads the tail with zero-weight rows (inert through
+    the step) and drains every in-flight step.
+    """
+
+    def __init__(self, cfg: VWConfig, state: Optional[VWState] = None, *,
+                 depth: int = 2, metrics_every: int = 10,
+                 label_transform: Optional[Callable] = None,
+                 width: Optional[int] = None,
+                 registry=None, clock: Callable[[], float] = time.perf_counter,
+                 donate: Optional[bool] = None):
+        if depth < 1:
+            raise ValueError(f"ring depth must be >= 1, got {depth}")
+        if metrics_every < 1:
+            raise ValueError(
+                f"metricsEvery must be >= 1, got {metrics_every}")
+        self.cfg = cfg
+        self._template = (state if state is not None
+                          else init_state(cfg.num_features))
+        self._carry = (pack_state(cfg, self._template) if cfg.fused
+                       else self._template)
+        if donate is None:
+            # donation is a no-op warning on CPU; only arm it on real chips
+            donate = jax.default_backend() != "cpu"
+        dn = (0,) if donate else ()
+        self._step = compilecache.cached_jit(
+            make_step_fn(cfg), key=("vw_online_step", cfg, dn),
+            name="vw_online_step", donate_argnums=dn)
+        self._depth = depth
+        self._metrics_every = metrics_every
+        self._label_transform = label_transform
+        self._registry = registry
+        self._clock = clock
+        self._k: Optional[int] = width
+        self._tail: Optional[List[np.ndarray]] = None
+        self._inflight: deque = deque()  # (loss_dev, n_examples, t_dispatch)
+        self._loss_history: List[Tuple[int, float]] = []
+        self._steps = 0
+        self._retired = 0
+        self._examples = 0
+        self._examples_retired = 0
+        self._t_start: Optional[float] = None
+        self._last_loss: Optional[float] = None
+
+    # ------------------------------------------------------------ hot path
+
+    def submit(self, indices, values, labels, weights=None) -> int:
+        """Stage rows and ahead-dispatch every full minibatch. Returns the
+        number of device steps dispatched. HOT PATH: no host fetch happens
+        here — backpressure blocking lives in _retire_oldest (the
+        designated sync point)."""
+        idx, val, y, w = _coerce_rows(indices, values, labels, weights)
+        if self._label_transform is not None:
+            y = self._label_transform(y)
+        if self._k is None:
+            self._k = idx.shape[1]
+        elif idx.shape[1] != self._k:
+            idx, val = _repin_width(idx, val, self._k)
+        if self._tail is None:
+            self._tail = [idx, val, y, w]
+        else:
+            self._tail = [np.concatenate([a, b]) for a, b in
+                          zip(self._tail, (idx, val, y, w))]
+        ti, tv, ty, tw = self._tail
+        b = self.cfg.minibatch
+        n_full = len(ty) // b
+        for i in range(n_full):
+            sl = slice(i * b, (i + 1) * b)
+            self._dispatch(ti[sl], tv[sl], ty[sl], tw[sl])
+        rem = len(ty) - n_full * b
+        self._tail = (None if rem == 0
+                      else [a[n_full * b:] for a in (ti, tv, ty, tw)])
+        return n_full
+
+    def _dispatch(self, idx, val, y, w, n_real: int = -1) -> None:
+        """Launch one device step ahead of retirement. HOT PATH: the
+        jnp.asarray staging and the step call are async dispatches; the
+        only blocking is the ring-full backpressure, which is delegated
+        to the designated _retire_oldest sync point. `n_real` is the
+        non-padding row count (flush's padded tail carries zero-weight
+        filler that must not inflate the throughput gauge)."""
+        if n_real < 0:
+            n_real = len(y)
+        while len(self._inflight) >= self._depth:
+            self._retire_oldest()
+        if self._t_start is None:
+            self._t_start = self._clock()
+        batch = (jnp.asarray(idx), jnp.asarray(val),
+                 jnp.asarray(y), jnp.asarray(w))
+        t0 = self._clock()
+        self._carry, loss = self._step(self._carry, batch)
+        self._inflight.append((loss, n_real, t0))
+        self._steps += 1
+        self._examples += n_real
+
+    # --------------------------------------------------- designated syncs
+
+    def _retire_oldest(self) -> None:
+        """DESIGNATED SYNC POINT: block until the oldest in-flight step
+        completes, freeing one ring slot. Loss fetch + metrics publication
+        happen here at the metricsEvery cadence — after the block, so the
+        fetch costs nothing extra."""
+        loss, n, t0 = self._inflight.popleft()
+        jax.block_until_ready(loss)
+        self._retired += 1
+        self._examples_retired += n
+        if self._retired % self._metrics_every == 0:
+            self._fetch_metrics_host(loss, self._clock() - t0)
+
+    def _fetch_metrics_host(self, loss, step_seconds: float) -> None:
+        """DESIGNATED SYNC POINT: the metricsEvery-cadence host fetch.
+        `loss` is already retired, so float() is a free host copy."""
+        lv = float(loss)
+        self._last_loss = lv
+        self._loss_history.append((self._retired, lv))
+        elapsed = max(self._clock() - (self._t_start or 0.0), 1e-9)
+        obsbridge.publish_vw_step_metrics(
+            step_seconds=step_seconds,
+            examples_per_s=self._examples_retired / elapsed,
+            registry=self._registry)
+
+    def flush(self) -> None:
+        """COMMIT POINT: dispatch the sub-minibatch tail (padded with
+        zero-weight rows, inert through the step) and drain the ring."""
+        if self._tail is not None and len(self._tail[2]):
+            ti, tv, ty, tw = self._tail
+            self._tail = None
+            n_real = len(ty)
+            ti, tv, ty, tw = pad_examples(ti, tv, ty, tw, self.cfg.minibatch)
+            self._dispatch(ti, tv, ty, tw, n_real=n_real)
+        while self._inflight:
+            self._retire_oldest()
+
+    def state(self) -> VWState:
+        """COMMIT POINT: block on the carry and return it as a VWState
+        (unpacking the fused table when cfg.fused). Does not drain the
+        tail — call flush() first for exactly-submitted semantics."""
+        carry = self._carry
+        jax.block_until_ready(jax.tree_util.tree_leaves(carry)[0])
+        return (unpack_state(self.cfg, carry, self._template)
+                if self.cfg.fused else carry)
+
+    def finalize(self) -> Tuple[VWState, Dict]:
+        """Flush + drain, then return (state, aux). aux carries the
+        sampled loss trajectory (metricsEvery cadence), example/step
+        counts, and wall-clock throughput."""
+        self.flush()
+        state = self.state()
+        wall = (max(self._clock() - self._t_start, 1e-9)
+                if self._t_start is not None else 0.0)
+        eps = self._examples / wall if wall else 0.0
+        if self._steps:
+            obsbridge.publish_vw_step_metrics(examples_per_s=eps,
+                                              registry=self._registry)
+        aux = {
+            "steps": self._steps,
+            "examples": self._examples,
+            "wall_s": wall,
+            "examples_per_s": eps,
+            "losses": np.asarray([v for _, v in self._loss_history],
+                                 np.float32),
+            "loss_steps": np.asarray([s for s, _ in self._loss_history],
+                                     np.int64),
+            "last_loss": self._last_loss,
+        }
+        return state, aux
+
+    # ------------------------------------------------------------- introspection
+
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+    @property
+    def examples(self) -> int:
+        return self._examples
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    @property
+    def pending_rows(self) -> int:
+        return 0 if self._tail is None else len(self._tail[2])

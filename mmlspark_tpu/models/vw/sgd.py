@@ -49,6 +49,16 @@ class VWConfig(NamedTuple):
     # estimator after checking the actual arrays; measured ~4 ms -> sub-ms
     # per minibatch step on chip at 2^18 features.
     shared_indices: bool = False
+    # fused packed tables (ISSUE 16): pack w/g2/scale into ONE
+    # [R, 2^b] table so a step issues ONE gather and ONE scatter instead
+    # of up to three of each. The scale table's max-reduction is fused
+    # into the single scatter-add as a first-occurrence delta (see
+    # _fused_minibatch_step); the per-step table rate reads are emulated
+    # locally with duplicate-index segment reductions, so a fused step
+    # never re-gathers a table it just scattered. Resolved from the
+    # estimator's fusedTables param (auto/on/off, auto = ladder rule
+    # resolve_auto_fused).
+    fused: bool = False
 
 
 class VWState(NamedTuple):
@@ -117,6 +127,57 @@ def _invariant_delta(loss: str, pred, y, xbar, h):
     return y * dm
 
 
+def _step_updates(cfg: VWConfig, pred, y, wt, values, gx, g_raw, g,
+                  g2_view, scale_view, bias_g2, t):
+    """Per-weight learning rates + the update step, given the POST-update
+    gathered views of the adaptive/normalization tables. The unpacked and
+    fused paths produce identical views (up to float reassociation in the
+    duplicate-index sums), so this math is shared verbatim between them.
+
+    Returns (step[B,k], bias_step)."""
+    if cfg.adaptive:
+        rate = cfg.learning_rate / (jnp.sqrt(g2_view) + 1e-6)
+        bias_rate = cfg.learning_rate / (jnp.sqrt(bias_g2) + 1e-6)
+    else:
+        # decayed global rate: eta * (t0+1 / (t0+t))^power_t
+        r = cfg.learning_rate * jnp.power(
+            (cfg.initial_t + 1.0) / (cfg.initial_t + t + 1.0), cfg.power_t)
+        rate = jnp.broadcast_to(r, values.shape)
+        bias_rate = r
+    if cfg.normalized:
+        rate = rate / jnp.maximum(scale_view, 1e-6)
+
+    if cfg.invariant:
+        # exact importance-weight-aware update: compute the closed-form
+        # prediction change dp and distribute it over the weights so the
+        # example's prediction moves by exactly dp (never past the label).
+        # The shared bias moves by the minibatch MEAN of per-example bias
+        # steps, so its contribution to each example's xbar is bias_rate/B —
+        # batch-total prediction change then matches batch-total dp exactly.
+        xbar = (rate * values * values).sum(axis=-1)  # [B]
+        if cfg.use_constant:
+            xbar = xbar + bias_rate / values.shape[0]
+        dp = _invariant_delta(cfg.loss, pred, y, xbar, wt)
+        # dp/xbar is the per-unit step; as xbar->0 it limits to -g*h
+        unit = jnp.where(xbar > 1e-12, dp / xbar, -g_raw * wt)
+        step = -(rate * values) * unit[:, None]
+        bias_step = -(bias_rate * unit).mean()
+    else:
+        step = rate * gx
+        bias_step = bias_rate * g.mean()
+    return step, bias_step
+
+
+def _regularize(cfg: VWConfig, w):
+    """L2 shrink + L1 truncated gradient over the whole weight table."""
+    if cfg.l2 > 0.0:
+        w = w * (1.0 - cfg.learning_rate * cfg.l2)
+    if cfg.l1 > 0.0:
+        thresh = cfg.learning_rate * cfg.l1
+        w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - thresh, 0.0)
+    return w
+
+
 def _minibatch_step(cfg: VWConfig, state: VWState, batch):
     indices, values, y, wt = batch   # [B,k], [B,k], [B], [B]
     # shared-index mode (cfg.shared_indices): every real row carries the
@@ -155,51 +216,201 @@ def _minibatch_step(cfg: VWConfig, state: VWState, batch):
              if cfg.normalized else state.scale)
 
     t = state.t + wt.sum()
-    if cfg.adaptive:
-        rate = cfg.learning_rate / (jnp.sqrt(gather(g2)) + 1e-6)
-        bias_rate = cfg.learning_rate / (jnp.sqrt(bias_g2) + 1e-6)
-    else:
-        # decayed global rate: eta * (t0+1 / (t0+t))^power_t
-        r = cfg.learning_rate * jnp.power(
-            (cfg.initial_t + 1.0) / (cfg.initial_t + t + 1.0), cfg.power_t)
-        rate = jnp.broadcast_to(r, values.shape)
-        bias_rate = r
-    if cfg.normalized:
-        rate = rate / jnp.maximum(gather(scale), 1e-6)
+    step, bias_step = _step_updates(
+        cfg, pred, y, wt, values, gx, g_raw, g,
+        gather(g2) if cfg.adaptive else None,
+        gather(scale) if cfg.normalized else None, bias_g2, t)
 
-    if cfg.invariant:
-        # exact importance-weight-aware update: compute the closed-form
-        # prediction change dp and distribute it over the weights so the
-        # example's prediction moves by exactly dp (never past the label).
-        # The shared bias moves by the minibatch MEAN of per-example bias
-        # steps, so its contribution to each example's xbar is bias_rate/B —
-        # batch-total prediction change then matches batch-total dp exactly.
-        xbar = (rate * values * values).sum(axis=-1)  # [B]
-        if cfg.use_constant:
-            xbar = xbar + bias_rate / values.shape[0]
-        dp = _invariant_delta(cfg.loss, pred, y, xbar, wt)
-        # dp/xbar is the per-unit step; as xbar->0 it limits to -g*h
-        unit = jnp.where(xbar > 1e-12, dp / xbar, -g_raw * wt)
-        step = -(rate * values) * unit[:, None]
-        bias_step = -(bias_rate * unit).mean()
-    else:
-        step = rate * gx
-        bias_step = bias_rate * g.mean()
-
-    w = scatter(state.w, -step, "add")
+    w = _regularize(cfg, scatter(state.w, -step, "add"))
     bias = state.bias - bias_step if cfg.use_constant else state.bias
-
-    # L2 shrink + L1 truncated gradient, vectorized over the whole weight table
-    if cfg.l2 > 0.0:
-        w = w * (1.0 - cfg.learning_rate * cfg.l2)
-    if cfg.l1 > 0.0:
-        thresh = cfg.learning_rate * cfg.l1
-        w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - thresh, 0.0)
 
     new_state = VWState(w=w, g2=g2, scale=scale, bias=bias,
                         bias_g2=bias_g2, t=t)
     denom = jnp.maximum(wt.sum(), 1e-9)
     return new_state, (lv * wt).sum() / denom
+
+
+# -------------------------------------------------------- fused packed path
+
+def _packed_layout(cfg: VWConfig):
+    """Row layout of the fused [R, 2^b] table: w is always row 0; g2 and
+    scale are packed only when their mode is on (R = 3 with
+    adaptive+normalized, 2 with one of them, 1 for plain SGD).
+
+    Returns (row_g2, row_scale, nrows) with None for absent rows."""
+    row_g2 = 1 if cfg.adaptive else None
+    row_scale = ((2 if cfg.adaptive else 1) if cfg.normalized else None)
+    nrows = 1 + (row_g2 is not None) + (row_scale is not None)
+    return row_g2, row_scale, nrows
+
+
+def pack_state(cfg: VWConfig, state: VWState):
+    """VWState -> the fused step's carry (packed[R,F], bias, bias_g2, t)."""
+    row_g2, row_scale, _ = _packed_layout(cfg)
+    parts = [state.w]
+    if row_g2 is not None:
+        parts.append(state.g2)
+    if row_scale is not None:
+        parts.append(state.scale)
+    return (jnp.stack(parts, axis=0), state.bias, state.bias_g2, state.t)
+
+
+def unpack_state(cfg: VWConfig, carry, template: VWState) -> VWState:
+    """Fused carry -> VWState. Tables the fused layout does not carry
+    (g2 with adaptive off, scale with normalized off) pass through from
+    `template` untouched — exactly what the unpacked step does to them."""
+    packed, bias, bias_g2, t = carry
+    row_g2, row_scale, _ = _packed_layout(cfg)
+    return VWState(
+        w=packed[0],
+        g2=packed[row_g2] if row_g2 is not None else template.g2,
+        scale=packed[row_scale] if row_scale is not None else template.scale,
+        bias=bias, bias_g2=bias_g2, t=t)
+
+
+def _fused_minibatch_step(cfg: VWConfig, carry, batch):
+    """One SGD minibatch against the packed [R, 2^b] table: ONE gather,
+    ONE scatter, regardless of how many of (w, g2, scale) are live.
+
+    The unpacked step re-gathers g2/scale right after scattering them (the
+    per-weight rates want post-update values). Here those reads are
+    emulated locally: one argsort of the step's indices yields
+    duplicate-index runs, and segment reductions over the runs reproduce
+    gather-after-scatter exactly — `add` runs for g2 (same totals as the
+    scatter, reassociated), `max` runs for scale (bit-exact; max is
+    insensitive to order). The scale table's max-update is then fused into
+    the single scatter-ADD as a first-occurrence delta per distinct index:
+    table + max(batch_max - table, 0) == max(table, batch_max) up to one
+    subtract/add rounding (<= 1 ulp; both operands are >= 0).
+
+    Composes with the shared-index pre-reduction: in shared mode the batch
+    axis is pre-reduced per op (sum for w/g2, max for scale) BEFORE the
+    duplicate-run pass, so the scatter stays [k]-wide."""
+    packed, bias0, bias_g2, t = carry
+    indices, values, y, wt = batch           # [B,k], [B,k], [B], [B]
+    row_g2, row_scale, _ = _packed_layout(cfg)
+    bsz, k = values.shape
+
+    if cfg.shared_indices:
+        fi = indices[0]                      # [k] — every real row identical
+        pg = packed[:, fi]                   # [R, k]   THE one gather
+        red_add = lambda u: u.sum(axis=0)    # batch pre-reduction per op
+        red_max = lambda u: u.max(axis=0)
+        view = lambda v: v[None, :]          # flat [k] -> broadcast [1, k]
+        flat_row = lambda r: pg[r]
+    else:
+        fi = indices.reshape(-1)             # [B*k]
+        pg = packed[:, indices]              # [R, B, k] THE one gather
+        red_add = lambda u: u.reshape(-1)
+        red_max = red_add
+        view = lambda v: v.reshape(bsz, k)
+        flat_row = lambda r: pg[r].reshape(-1)
+
+    n_flat = fi.shape[0]
+    order = jnp.argsort(fi)
+    fs = fi[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), fs[1:] != fs[:-1]])   # run starts
+    seg = jnp.cumsum(first) - 1                      # sorted run ids
+    inv = jnp.zeros((n_flat,), order.dtype).at[order].set(
+        jnp.arange(n_flat, dtype=order.dtype))       # unsort permutation
+
+    pred = (view(flat_row(0)) * values).sum(axis=-1) + bias0
+    lv, g_raw = _loss_and_grad(cfg.loss, pred, y)
+    g = g_raw * wt
+    gx = g[:, None] * values
+
+    upd = [None] * len(pg)                           # the one scatter's rows
+    g2_view = scale_view = None
+    if cfg.adaptive:
+        u2 = red_add(gx * gx)                        # [n_flat]
+        tot = jax.ops.segment_sum(u2[order], seg, num_segments=n_flat,
+                                  indices_are_sorted=True)
+        # gather-after-scatter emulation: old value + total update landing
+        # on the same index anywhere in the batch
+        g2_view = view(flat_row(row_g2) + tot[seg][inv])
+        bias_g2 = bias_g2 + (g * g).sum()
+        upd[row_g2] = u2                             # scatter-add sums dups
+    if cfg.normalized:
+        m = red_max(jnp.abs(values))                 # [n_flat]
+        sg = flat_row(row_scale)
+        mx = jax.ops.segment_max(m[order], seg, num_segments=n_flat,
+                                 indices_are_sorted=True)
+        run_max = mx[seg]                            # sorted order
+        scale_view = view(jnp.maximum(sg, run_max[inv]))
+        # max fused into the add-scatter: the positive delta lands ONCE per
+        # distinct index (at its sorted run's first slot); every other
+        # duplicate contributes 0, so the sum reproduces the max
+        delta = jnp.where(first, jnp.maximum(run_max - sg[order], 0.0), 0.0)
+        upd[row_scale] = delta[inv]
+
+    t = t + wt.sum()
+    step, bias_step = _step_updates(cfg, pred, y, wt, values, gx, g_raw, g,
+                                    g2_view, scale_view, bias_g2, t)
+    upd[0] = red_add(-step)
+    packed = packed.at[:, fi].add(jnp.stack(upd, axis=0))  # THE one scatter
+    if cfg.l1 > 0.0 or cfg.l2 > 0.0:
+        packed = packed.at[0].set(_regularize(cfg, packed[0]))
+    bias = bias0 - bias_step if cfg.use_constant else bias0
+
+    denom = jnp.maximum(wt.sum(), 1e-9)
+    return (packed, bias, bias_g2, t), (lv * wt).sum() / denom
+
+
+def make_step_fn(cfg: VWConfig):
+    """The single-minibatch step for cfg's table layout, as
+    step(carry, (indices, values, labels, weights)) -> (carry, loss).
+    The carry is pack_state's tuple when cfg.fused, a VWState otherwise
+    (pair with pack_state/unpack_state)."""
+    return partial(_fused_minibatch_step if cfg.fused else _minibatch_step,
+                   cfg)
+
+
+def resolve_auto_fused(adaptive: bool, normalized: bool,
+                       backend: Optional[str] = None) -> bool:
+    """fusedTables='auto' rule, pinned by the measured batch-size ladder
+    (scripts/measure_vw_throughput.py, docs/PERF.md, docs/VW.md).
+
+    Packing only pays where per-kernel scatter dispatch dominates the
+    step — the accelerator backends. On CPU the measured ladder shows the
+    OPPOSITE: XLA lowers each scatter to a cheap serial loop while the
+    fused path's duplicate-run sort is real work, so unpacked runs
+    1.4-4x faster across every rung (2026-08 CPU ladder). Hence:
+
+    - cpu backend: never pack (auto == off);
+    - other backends: pack whenever the step updates >= 2 tables
+      (adaptive or normalized on). Plain SGD runs one table either way,
+      so packing would only add stack/slice overhead.
+    """
+    if backend is None:
+        backend = jax.default_backend()
+    return (bool(adaptive) or bool(normalized)) and backend != "cpu"
+
+
+def _cross_shard_reduce(cfg: VWConfig, carry):
+    """Per-pass allreduce over cfg.axis_name — the spanning-tree
+    equivalent (vw/VowpalWabbitBase.scala:401-429). Handles both carry
+    layouts: VWState (unpacked) and the fused (packed, bias, bias_g2, t)
+    tuple, where every packed row pmean-averages EXCEPT the scale row,
+    which is a running max and must pmax like the unpacked path."""
+    ax = cfg.axis_name
+    if cfg.fused:
+        packed, bias, bias_g2, t = carry
+        _, row_scale, _ = _packed_layout(cfg)
+        mean = jax.lax.pmean(packed, ax)
+        if row_scale is not None:
+            mean = mean.at[row_scale].set(
+                jax.lax.pmax(packed[row_scale], ax))
+        return (mean, jax.lax.pmean(bias, ax),
+                jax.lax.pmean(bias_g2, ax), jax.lax.psum(t, ax))
+    return VWState(
+        w=jax.lax.pmean(carry.w, ax),
+        g2=jax.lax.pmean(carry.g2, ax),
+        scale=jax.lax.pmax(carry.scale, ax),
+        bias=jax.lax.pmean(carry.bias, ax),
+        bias_g2=jax.lax.pmean(carry.bias_g2, ax),
+        t=jax.lax.psum(carry.t, ax),
+    )
 
 
 def make_train_fn(cfg: VWConfig):
@@ -210,22 +421,17 @@ def make_train_fn(cfg: VWConfig):
     (pad rows with weight 0). When cfg.axis_name is set the function is meant
     to run inside shard_map; weights are pmean-averaged across shards after
     every pass — the spanning-tree allreduce equivalent
-    (vw/VowpalWabbitBase.scala:401-429)."""
+    (vw/VowpalWabbitBase.scala:401-429). With cfg.fused the scan carries the
+    packed [R, 2^b] table (pack once before the first pass, unpack once at
+    the end) so every minibatch runs the one-gather/one-scatter step."""
+    step = make_step_fn(cfg)
 
-    def one_pass(state, batches):
-        state, losses = jax.lax.scan(
-            partial(_minibatch_step, cfg), state, batches)
+    def one_pass(carry, batches):
+        carry, losses = jax.lax.scan(step, carry, batches)
         if cfg.axis_name is not None:
-            state = VWState(
-                w=jax.lax.pmean(state.w, cfg.axis_name),
-                g2=jax.lax.pmean(state.g2, cfg.axis_name),
-                scale=jax.lax.pmax(state.scale, cfg.axis_name),
-                bias=jax.lax.pmean(state.bias, cfg.axis_name),
-                bias_g2=jax.lax.pmean(state.bias_g2, cfg.axis_name),
-                t=jax.lax.psum(state.t, cfg.axis_name),
-            )
+            carry = _cross_shard_reduce(cfg, carry)
             losses = jax.lax.pmean(losses, cfg.axis_name)
-        return state, losses.mean()
+        return carry, losses.mean()
 
     def train(indices, values, labels, weights, state):
         n, k = indices.shape
@@ -237,11 +443,14 @@ def make_train_fn(cfg: VWConfig):
             labels.reshape(nb, b),
             weights.reshape(nb, b),
         )
+        carry = pack_state(cfg, state) if cfg.fused else state
         pass_losses = []
         for _ in range(cfg.num_passes):
-            state, mean_loss = one_pass(state, batches)
+            carry, mean_loss = one_pass(carry, batches)
             pass_losses.append(mean_loss)
-        return state, jnp.stack(pass_losses)
+        if cfg.fused:
+            carry = unpack_state(cfg, carry, state)
+        return carry, jnp.stack(pass_losses)
 
     return train
 

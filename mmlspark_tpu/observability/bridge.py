@@ -23,7 +23,8 @@ __all__ = ["publish_stopwatch", "publish_fit_timeline",
            "publish_fit_metrics", "publish_multichip_fit",
            "classify_probe_outcome", "publish_probe_outcome",
            "publish_bringup", "publish_checkpoint_event",
-           "publish_rendezvous_event", "set_hosts_alive"]
+           "publish_rendezvous_event", "set_hosts_alive",
+           "publish_vw_fused_decision", "publish_vw_step_metrics"]
 
 #: bounded label vocabulary for rendezvous events — the raw error strings
 #: carry addresses/counts that must not become label cardinality
@@ -232,6 +233,61 @@ def publish_multichip_fit(decision, straggler_gap_s: Optional[float] = None,
                       ).set(float(allreduce_wall_s))
     except Exception as e:  # noqa: BLE001 - telemetry must not fail the fit
         warnings.warn(f"publish_multichip_fit failed: {e}", stacklevel=2)
+
+
+#: VW online steps span ~50 us (small minibatch, CPU dispatch-bound) to
+#: seconds (first-step compile); the serving-latency buckets start too
+#: high to resolve the hot band
+_VW_STEP_SECONDS_BUCKETS = (1e-5, 5e-5, 2e-4, 1e-3, 5e-3, 0.02, 0.1,
+                            0.5, 2.0, 10.0)
+#: fusedTables modes — bounded label vocabulary
+_VW_FUSED_MODES = ("auto", "on", "off")
+
+
+def publish_vw_fused_decision(mode: str, fused: bool,
+                              registry: Optional[MetricsRegistry] = None
+                              ) -> None:
+    """One fusedTables resolution (models/vw/base.py) -> bounded-label
+    counter: WHICH mode was requested and WHAT the step actually ran
+    (packed [R, 2^b] table vs per-table gather/scatter). The auto rule
+    lives in sgd.resolve_auto_fused; this makes its decisions scrapeable
+    so a fleet running the slow layout is visible, not folklore."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("vw_fused_tables_total",
+                    "VW step-layout decisions by fusedTables mode and "
+                    "resolved layout",
+                    labels={"mode": mode if mode in _VW_FUSED_MODES
+                            else "other",
+                            "decision": "fused" if fused else "unpacked"}
+                    ).inc()
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the fit
+        warnings.warn(f"publish_vw_fused_decision failed: {e}", stacklevel=2)
+
+
+def publish_vw_step_metrics(step_seconds: Optional[float] = None,
+                            examples_per_s: Optional[float] = None,
+                            registry: Optional[MetricsRegistry] = None
+                            ) -> None:
+    """VW online-ring telemetry at the metricsEvery cadence
+    (models/vw/online.py): per-step dispatch->retire latency histogram +
+    the headline throughput gauge. Called ONLY from designated sync
+    points — publication must never add a host sync of its own."""
+    reg = registry or get_registry()
+    try:
+        if step_seconds is not None:
+            reg.histogram("vw_step_seconds",
+                          "VW online-ring step latency "
+                          "(dispatch to retirement)",
+                          buckets=_VW_STEP_SECONDS_BUCKETS
+                          ).observe(float(step_seconds))
+        if examples_per_s is not None:
+            reg.gauge("vw_examples_per_s",
+                      "VW online-ring training throughput "
+                      "(retired examples / wall second)"
+                      ).set(float(examples_per_s))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail training
+        warnings.warn(f"publish_vw_step_metrics failed: {e}", stacklevel=2)
 
 
 #: bounded label set for bring-up probe outcomes — the raw outcome

@@ -1,0 +1,256 @@
+"""VW hot-path batch-size ladder: the measurement that decides fusedTables=auto
+and fills the VW row in docs/PERF.md (ISSUE 16).
+
+Grid: minibatch B in {256..16384} x {dense row-invariant, sparse hashed}
+features x {fused packed table, unpacked} x {ahead-dispatched ring,
+per-step sync baseline}. Each rung streams the same examples through the
+online ring (models/vw/online.py) and reports retired examples per wall
+second; the sync baseline blocks after every step — the per-example
+overhead the ring exists to remove. A digest gate asserts ring and sync
+runs of the same configuration land bit-identical weight tables (they
+execute the same step sequence; the ring only changes WHEN the host
+waits).
+
+Runs on CPU today (the numbers feed the CPU column of docs/PERF.md and
+the fusedTables=auto backend rule); the same script is armed on chip via
+scripts/tpu_recovery_watch.sh with --out docs/VW_THROUGHPUT_chip.json.
+`run_ladder` is importable with an injectable clock so the tier-1 suite
+runs a seeded mini-ladder without timing flakiness
+(tests/test_vw_fused.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the pre-overhaul chip measurement this ladder is graded against
+#: (docs/PERF.md "VW training throughput", 2026-08-01 TPU v5e run)
+BASELINE_EXAMPLES_PER_S = 0.18e6
+
+
+def make_dataset(rows: int, features: int, num_bits: int, layout: str,
+                 seed: int = 0):
+    """A VW-shaped stream: [rows, features] values with either
+    row-invariant indices (the dense-column fast path: every row hits the
+    same slots, shared_indices applies) or per-row hashed indices (the
+    sparse path: collisions everywhere, general scatter)."""
+    rng = np.random.default_rng(seed)
+    nf = 1 << num_bits
+    val = rng.normal(size=(rows, features)).astype(np.float32)
+    y = np.sign(val @ rng.normal(size=features).astype(np.float32)
+                ).astype(np.float32)
+    if layout == "dense":
+        idx = np.broadcast_to(
+            np.arange(features, dtype=np.int32), (rows, features)).copy()
+    elif layout == "sparse":
+        idx = rng.integers(0, nf, size=(rows, features)).astype(np.int32)
+    else:
+        raise ValueError(f"layout must be dense|sparse, got {layout!r}")
+    w = np.ones(rows, np.float32)
+    return idx, val, y, w
+
+
+def _build_config(num_bits: int, batch: int, fused: bool, layout: str):
+    from mmlspark_tpu.models.vw.sgd import VWConfig
+
+    return VWConfig(num_features=1 << num_bits, loss="logistic",
+                    minibatch=batch, fused=fused,
+                    shared_indices=(layout == "dense"))
+
+
+def _run_ring(cfg, idx, val, y, w, depth, clock):
+    """One warm ring pass over the whole stream; returns (wall_s, state)."""
+    import jax
+
+    from mmlspark_tpu.models.vw.online import VWOnlineRing
+    from mmlspark_tpu.models.vw.sgd import init_state
+
+    nb = len(y) // cfg.minibatch
+    # compile warm-up on a throwaway ring (shared cached_jit executable),
+    # so the measured ring starts from a fresh state with a hot cache
+    warm = VWOnlineRing(cfg, init_state(cfg.num_features), depth=depth,
+                        metrics_every=max(nb, 1), clock=clock)
+    b = cfg.minibatch
+    warm.submit(idx[:b], val[:b], y[:b], w[:b])
+    warm.flush()
+    ring = VWOnlineRing(cfg, init_state(cfg.num_features), depth=depth,
+                        metrics_every=max(nb, 1), clock=clock)
+    t0 = clock()
+    ring.submit(idx, val, y, w)
+    ring.flush()
+    wall = max(clock() - t0, 1e-9)
+    state = ring.state()
+    jax.block_until_ready(state.w)
+    return wall, state
+
+
+def _run_sync(cfg, idx, val, y, w, clock):
+    """The per-step host-sync baseline: identical step sequence, but the
+    host blocks after every dispatch (the pre-ring online loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.compile import cache as compilecache
+    from mmlspark_tpu.models.vw.sgd import (init_state, make_step_fn,
+                                            pack_state, unpack_state)
+
+    b = cfg.minibatch
+    nb = len(y) // b
+    step = compilecache.cached_jit(make_step_fn(cfg),
+                                   key=("vw_online_step", cfg, ()),
+                                   name="vw_online_step")
+    template = init_state(cfg.num_features)
+    carry = pack_state(cfg, template) if cfg.fused else template
+    carry, loss = step(carry, (jnp.asarray(idx[:b]), jnp.asarray(val[:b]),
+                               jnp.asarray(y[:b]), jnp.asarray(w[:b])))
+    jax.block_until_ready(loss)  # compile warm-up
+    carry = pack_state(cfg, template) if cfg.fused else template
+    t0 = clock()
+    for i in range(nb):
+        sl = slice(i * b, (i + 1) * b)
+        batch = (jnp.asarray(idx[sl]), jnp.asarray(val[sl]),
+                 jnp.asarray(y[sl]), jnp.asarray(w[sl]))
+        carry, loss = step(carry, batch)
+        jax.block_until_ready(loss)   # the per-step sync the ring removes
+    wall = max(clock() - t0, 1e-9)
+    state = unpack_state(cfg, carry, template) if cfg.fused else carry
+    return wall, state
+
+
+def run_ladder(batch_sizes=(256, 1024, 4096, 16384), rows=1 << 19,
+               features=30, num_bits=18, layouts=("dense", "sparse"),
+               fused_modes=(False, True), ring_depth=2, seed=0,
+               clock=time.perf_counter, include_sync=True,
+               max_steps_per_rung=128):
+    """Measure every rung; returns the summary dict (JSON-serializable).
+
+    Each rung streams min(rows, batch * max_steps_per_rung) examples —
+    enough steps to amortize dispatch, bounded so the sparse/fused slow
+    rungs do not dominate the wall clock. The digest gate compares ring
+    vs sync final weights per configuration at the largest batch."""
+    import jax
+
+    rungs = []
+    digest_parity = {}
+    for layout in layouts:
+        idx, val, y, w = make_dataset(rows, features, num_bits, layout, seed)
+        for fused in fused_modes:
+            for b in batch_sizes:
+                n_use = min(rows, b * max_steps_per_rung)
+                n_use -= n_use % b
+                if n_use < b:
+                    continue
+                cfg = _build_config(num_bits, b, fused, layout)
+                cut = (idx[:n_use], val[:n_use], y[:n_use], w[:n_use])
+                wall, state = _run_ring(cfg, *cut, depth=ring_depth,
+                                        clock=clock)
+                rungs.append({
+                    "layout": layout, "fused": fused, "batch": b,
+                    "mode": "ring", "rows": n_use, "steps": n_use // b,
+                    "wall_s": wall, "examples_per_s": n_use / wall,
+                })
+                if include_sync:
+                    wall_s, state_s = _run_sync(cfg, *cut, clock=clock)
+                    rungs.append({
+                        "layout": layout, "fused": fused, "batch": b,
+                        "mode": "sync", "rows": n_use, "steps": n_use // b,
+                        "wall_s": wall_s, "examples_per_s": n_use / wall_s,
+                    })
+                    if b == max(batch_sizes):
+                        # digest gate: same steps => identical tables
+                        digest_parity[f"{layout}_fused={fused}"] = bool(
+                            np.allclose(np.asarray(state.w),
+                                        np.asarray(state_s.w),
+                                        rtol=1e-6, atol=1e-7))
+    ring_rungs = [r for r in rungs if r["mode"] == "ring"]
+    best = max(ring_rungs, key=lambda r: r["examples_per_s"])
+    backend = jax.default_backend()
+    # what the ladder says about the auto rule on THIS backend: does the
+    # fused layout win its unpacked twin, rung by rung?
+    fused_wins = []
+    for r in ring_rungs:
+        if not r["fused"]:
+            continue
+        twin = [u for u in ring_rungs
+                if not u["fused"] and u["layout"] == r["layout"]
+                and u["batch"] == r["batch"]]
+        if twin:
+            fused_wins.append(
+                r["examples_per_s"] > twin[0]["examples_per_s"])
+    from mmlspark_tpu.models.vw.sgd import resolve_auto_fused
+    return {
+        "platform": backend,
+        "device": str(jax.devices()[0]),
+        "rows": rows, "features": features, "num_bits": num_bits,
+        "ring_depth": ring_depth,
+        "rungs": rungs,
+        "best": dict(best),
+        "baseline_examples_per_s": BASELINE_EXAMPLES_PER_S,
+        "speedup_vs_baseline":
+            best["examples_per_s"] / BASELINE_EXAMPLES_PER_S,
+        "auto_decision": {
+            "backend": backend,
+            "fused_rungs_won": int(sum(fused_wins)),
+            "fused_rungs_total": len(fused_wins),
+            "auto_resolves_fused": resolve_auto_fused(True, True, backend),
+            "rule": "pack on non-cpu backends when adaptive or normalized "
+                    "adds a second table; never on cpu (sgd."
+                    "resolve_auto_fused)",
+        },
+        "digest_parity": digest_parity,
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="write the summary JSON here (e.g. "
+                         "docs/VW_THROUGHPUT.json)")
+    ap.add_argument("--rows", type=int, default=1 << 19)
+    ap.add_argument("--features", type=int, default=30)
+    ap.add_argument("--bits", type=int, default=18)
+    ap.add_argument("--batches", default="256,1024,4096,16384")
+    ap.add_argument("--layouts", default="dense,sparse")
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--no-sync", action="store_true",
+                    help="skip the per-step sync baselines")
+    args = ap.parse_args()
+
+    batches = tuple(int(b) for b in args.batches.split(","))
+    layouts = tuple(args.layouts.split(","))
+    summary = run_ladder(batch_sizes=batches, rows=args.rows,
+                         features=args.features, num_bits=args.bits,
+                         layouts=layouts, ring_depth=args.depth,
+                         include_sync=not args.no_sync)
+    for r in summary["rungs"]:
+        print(f"{r['layout']:>6} fused={str(r['fused']):>5} "
+              f"b={r['batch']:>5} {r['mode']:>4}: "
+              f"{r['examples_per_s'] / 1e6:6.2f}M ex/s "
+              f"({r['steps']} steps)", flush=True)
+    best = summary["best"]
+    print(f"best: {best['layout']} fused={best['fused']} b={best['batch']} "
+          f"{best['examples_per_s'] / 1e6:.2f}M ex/s = "
+          f"{summary['speedup_vs_baseline']:.1f}x the "
+          f"{BASELINE_EXAMPLES_PER_S / 1e6:.2f}M ex/s chip baseline "
+          f"[{summary['platform']}]")
+    print(f"digest parity: {summary['digest_parity']}")
+    bad = [k for k, v in summary["digest_parity"].items() if not v]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"wrote {args.out}")
+    if bad:
+        print(f"DIGEST MISMATCH in {bad}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

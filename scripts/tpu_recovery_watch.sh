@@ -75,6 +75,8 @@ EOF
     run python -u bench.py
     echo "== vw throughput (validates shared-index fast path) $(date -u +%FT%TZ)"
     run python -u scripts/measure_vw_tpu.py
+    echo "== vw hot-path ladder: fused tables + ahead-dispatch ring, targets >=1M ex/s (round-16 tentpole) $(date -u +%FT%TZ)"
+    run python -u scripts/measure_vw_throughput.py --out docs/VW_THROUGHPUT_chip.json
     echo "== image featurizer ladder $(date -u +%FT%TZ)"
     run python -u scripts/measure_image_featurizer.py
     echo "== watcher done $(date -u +%FT%TZ)"

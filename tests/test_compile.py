@@ -323,6 +323,8 @@ LINT_MODULES = {
                                    "make_sp_train_step"},
     "models/vw/base.py": set(),
     "models/vw/classifier.py": set(),
+    "models/vw/online.py": set(),
+    "models/vw/contextual_bandit.py": set(),
     "io/serving.py": set(),
     "io/distributed_serving.py": set(),
 }

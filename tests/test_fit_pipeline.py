@@ -273,6 +273,11 @@ class TestSyncPointLint:
           "_pipelined_device_data", "_run_chunked")),
         ("mmlspark_tpu.parallel.multihost",
          ("binned_to_device", "assemble_row_sharded", "zeros_row_sharded")),
+        # the VW online ring (ISSUE 16): submit/_dispatch are the hot
+        # path — host syncs live ONLY in _retire_oldest /
+        # _fetch_metrics_host / flush / state (the designated commit and
+        # metrics points, deliberately NOT listed here)
+        ("mmlspark_tpu.models.vw.online", ("submit", "_dispatch")),
     )
     #: nested defs that ARE the designated sync points
     DESIGNATED = {"_fetch_chunk_host", "_finalize_chunks"}
