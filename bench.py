@@ -122,6 +122,20 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
                 break
     except Exception as e:  # noqa: BLE001
         extra.setdefault("vw_throughput_error", str(e)[:200])
+    # Out-of-core ingest provenance (ISSUE-18): the most recent measured
+    # shard-size x ring-depth x ndev ladder + bounded-RSS big-fit rows
+    # (scripts/measure_ingest.py) ride in the record — chip run
+    # preferred, CPU-host run otherwise.
+    try:
+        for _fn in ("INGEST_chip.json", "INGEST_cpu.json"):
+            _lp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", _fn)
+            if os.path.exists(_lp):
+                with open(_lp) as _f:
+                    extra.setdefault("ingest", json.load(_f))
+                break
+    except Exception as e:  # noqa: BLE001
+        extra.setdefault("ingest_error", str(e)[:200])
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]

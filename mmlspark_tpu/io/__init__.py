@@ -8,7 +8,11 @@ from .http import (AsyncClient, CustomInputParser, CustomOutputParser,
                    JSONInputParser, JSONOutputParser, KeepAliveTransport,
                    SimpleHTTPTransformer, StringOutputParser,
                    send_with_retries)
-from .rowcodec import BufferPool
+from .rowcodec import BufferPool, ShardReader
+from .shardstore import (ShardStore, ShardStoreError, ShardStoreWriter,
+                         ShardVerifyError, as_store, fit_bin_mapper,
+                         host_rss_bytes, is_store_path, read_column,
+                         stream_fit_arrays, write_store)
 from .registry import (ModelRegistry, RegistryError, RegistryModelSource,
                        golden_reply_digest, load_aot_callable)
 from .serving import (DynamicBatcher, HTTPStreamSource, ServingServer,
@@ -29,6 +33,9 @@ __all__ = [
     "AsyncClient", "send_with_retries", "KeepAliveTransport",
     "ServingServer", "ServingUDFs", "HTTPStreamSource", "parse_request",
     "make_reply", "DynamicBatcher", "BufferPool", "SwapResult",
+    "ShardReader", "ShardStore", "ShardStoreError", "ShardStoreWriter",
+    "ShardVerifyError", "as_store", "fit_bin_mapper", "host_rss_bytes",
+    "is_store_path", "read_column", "stream_fit_arrays", "write_store",
     "ModelRegistry", "RegistryError", "RegistryModelSource",
     "golden_reply_digest", "load_aot_callable", "Autoscaler",
     "SharedSingleton", "SharedVariable", "PartitionConsolidator",

@@ -40,10 +40,11 @@ Reply bodies reuse the same format (name = reply column). Packs:
 
 from __future__ import annotations
 
+import mmap
 import struct
 import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -147,6 +148,184 @@ def rows_view(body: bytes, h: BinaryHeader) -> np.ndarray:
     """[nrows, ncols] zero-copy view of one request's payload."""
     view = np.frombuffer(body, dtype=h.dtype, offset=h.offset)
     return view.reshape(h.nrows, h.ncols)
+
+
+# ------------------------------------------------- shard files (storage)
+
+def encode_header(name: str, dtype, shape: Tuple[int, ...]) -> bytes:
+    """Header bytes alone, no payload. Streaming shard writers
+    (io/shardstore.py) emit one column header and then append the payload
+    in pieces as ingest blocks arrive — never concatenating the blocks on
+    the host — so the header must be constructible before the payload
+    bytes exist."""
+    dt = np.dtype(dtype).newbyteorder("<")
+    code = _DTYPE_CODES.get(dt)
+    if code is None:
+        raise BinaryFormatError(f"unsupported dtype {dtype}")
+    if len(shape) not in (1, 2):
+        raise BinaryFormatError(f"ndim must be 1 or 2, got {len(shape)}")
+    nb = name.encode("utf-8")
+    head = _HEAD.pack(MAGIC, code, len(shape), len(nb))
+    dims = struct.pack("<%dI" % len(shape), *[int(d) for d in shape])
+    return head + dims + nb
+
+
+def peek_at(buf, offset: int = 0) -> Tuple[BinaryHeader, int]:
+    """Header parse at an offset inside a larger buffer.
+
+    Shard files concatenate many bodies back to back, so unlike `peek`
+    this tolerates trailing data: it validates that the payload FITS and
+    returns (header, end_offset) with `header.offset` absolute into
+    `buf`. Only the fixed header + dims + name bytes are touched — the
+    payload is never read, which is what keeps a shard-directory scan
+    O(header bytes) even when `buf` is an mmap of a multi-GB file."""
+    total = len(buf)
+    if offset + _HEAD.size > total:
+        raise BinaryFormatError("truncated header")
+    magic, code, ndim, name_len = _HEAD.unpack_from(buf, offset)
+    if magic != MAGIC:
+        raise BinaryFormatError(f"bad magic at offset {offset}")
+    dtype = _DTYPES.get(code)
+    if dtype is None:
+        raise BinaryFormatError(f"unknown dtype code {code}")
+    if ndim not in (1, 2):
+        raise BinaryFormatError(f"bad ndim {ndim}")
+    dims_off = offset + _HEAD.size
+    payload_off = dims_off + 4 * ndim + name_len
+    if payload_off > total:
+        raise BinaryFormatError("truncated dims/name")
+    shape = struct.unpack_from("<%dI" % ndim, buf, dims_off)
+    name = bytes(buf[dims_off + 4 * ndim:payload_off]).decode("utf-8")
+    expected = int(np.prod(shape)) * dtype.itemsize
+    end = payload_off + expected
+    if end > total:
+        raise BinaryFormatError(
+            f"truncated payload: need {expected} bytes at {payload_off}, "
+            f"have {total - payload_off}")
+    h = BinaryHeader(name, dtype, tuple(int(d) for d in shape), payload_off)
+    return h, end
+
+
+class ShardReader:
+    """Zero-copy mmap reader over one shard file.
+
+    A shard is a concatenation of rowcodec bodies, one per column, every
+    column agreeing on shape[0] (the shard's row count). Construction
+    scans ONLY header bytes through bounded `read(size)` calls —
+    `header_bytes_read` is the regression-pinned proof that opening a
+    shard costs O(columns), not O(file). Payload access goes through a
+    single lazily created mmap whose row-range slices are zero-copy
+    views; `iter_blocks` yields those views per block so the ingest hot
+    path touches `rows_per_block` rows of pages at a time and never
+    materializes the shard.
+
+    Callers must drop every view before `close()` (an mmap with live
+    exports cannot be unmapped); the ingest ring copies views into its
+    reusable staging buffers and releases them immediately, which is how
+    consumed shards actually leave RSS.
+    """
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._f = open(self.path, "rb")
+        self._mm: Optional[mmap.mmap] = None
+        self.header_bytes_read = 0
+        self.block_bytes_viewed = 0
+        self.headers: "OrderedDict[str, BinaryHeader]" = OrderedDict()
+        self._col_rows: Dict[str, int] = {}
+        self._f.seek(0, 2)
+        total = self._f.tell()
+        off = 0
+        while off < total:
+            head = self._read_at(off, _HEAD.size)
+            magic, code, ndim, name_len = _HEAD.unpack_from(head, 0)
+            if magic != MAGIC:
+                raise BinaryFormatError(
+                    f"{self.path}: bad magic at offset {off}")
+            dtype = _DTYPES.get(code)
+            if dtype is None:
+                raise BinaryFormatError(
+                    f"{self.path}: unknown dtype code {code}")
+            if ndim not in (1, 2):
+                raise BinaryFormatError(f"{self.path}: bad ndim {ndim}")
+            rest = self._read_at(off + _HEAD.size, 4 * ndim + name_len)
+            shape = struct.unpack_from("<%dI" % ndim, rest, 0)
+            name = rest[4 * ndim:].decode("utf-8")
+            payload_off = off + _HEAD.size + 4 * ndim + name_len
+            expected = int(np.prod(shape)) * dtype.itemsize
+            if payload_off + expected > total:
+                raise BinaryFormatError(
+                    f"{self.path}: truncated payload for column {name!r}")
+            h = BinaryHeader(name, dtype,
+                             tuple(int(d) for d in shape), payload_off)
+            self.headers[name] = h
+            self._col_rows[name] = int(shape[0])
+            off = payload_off + expected
+        rows = {r for r in self._col_rows.values()}
+        if len(rows) > 1:
+            raise BinaryFormatError(
+                f"{self.path}: columns disagree on row count {self._col_rows}")
+        self.rows = rows.pop() if rows else 0
+
+    def _read_at(self, off: int, size: int) -> bytes:
+        """Bounded positioned read during the header scan (never the
+        payload — `size` is always a handful of header bytes)."""
+        self._f.seek(off)
+        data = self._f.read(size)
+        if len(data) != size:
+            raise BinaryFormatError(
+                f"{self.path}: truncated at offset {off}")
+        self.header_bytes_read += len(data)
+        return data
+
+    def _mmap(self) -> mmap.mmap:
+        if self._mm is None:
+            self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        return self._mm
+
+    def column_rows(self, name: str, start: int, stop: int) -> np.ndarray:
+        """Zero-copy [stop-start, ...] view of one column's row range."""
+        h = self.headers[name]
+        count = int(np.prod(h.shape))
+        full = np.frombuffer(self._mmap(), dtype=h.dtype, count=count,
+                             offset=h.offset).reshape(h.shape)
+        view = full[start:stop]
+        self.block_bytes_viewed += view.nbytes
+        return view
+
+    def iter_blocks(self, rows_per_block: int,
+                    columns: Optional[Sequence[str]] = None,
+                    start: int = 0, stop: Optional[int] = None
+                    ) -> Iterator[Tuple[int, Dict[str, np.ndarray]]]:
+        """Yield (row_offset, {column: zero-copy view}) in bounded blocks.
+
+        Each yield's views cover at most `rows_per_block` rows — the
+        per-block bytes touched are bounded by rows_per_block * row_bytes
+        regardless of shard size (regression-pinned via
+        `block_bytes_viewed` in tests/test_shardstore.py)."""
+        if rows_per_block <= 0:
+            raise ValueError("rows_per_block must be positive")
+        names = list(columns) if columns is not None else list(self.headers)
+        hi = self.rows if stop is None else min(int(stop), self.rows)
+        b0 = max(0, int(start))
+        while b0 < hi:
+            b1 = min(b0 + rows_per_block, hi)
+            yield b0, {nm: self.column_rows(nm, b0, b1) for nm in names}
+            b0 = b1
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._mm.close()  # raises BufferError if views are still live
+            self._mm = None
+        if self._f is not None:
+            self._f.close()
+            self._f = None  # type: ignore[assignment]
+
+    def __enter__(self) -> "ShardReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 # ------------------------------------------------------------- buffer pool

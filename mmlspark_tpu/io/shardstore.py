@@ -1,0 +1,1037 @@
+"""Out-of-core training data plane: rowcodec shards on disk + streaming
+bounded-RAM ingest into the device-resident binned dataset.
+
+HIGGS-11M fits in host RAM; production traffic logs don't. A shard store
+is a directory of binary rowcodec shard files (io/rowcodec.py wire
+format promoted to a storage format: one self-describing body per column
+per shard) plus an atomic ``MANIFEST.json`` carrying per-shard
+sha256/row-count, the column schema, and the exact full-pass feature
+stats the streaming BinMapper fit needs. Everything the in-memory fit
+computes from the raw matrix is either recomputed from a bounded sample
+(quantile edges) or read from the manifest (min/max/missing — combined
+per append block at WRITE time, so no extra full pass at fit time).
+
+The ingest hot path is the PR 6 ahead-dispatch discipline applied to
+disk I/O:
+
+- shards are mmapped and read through zero-copy ``ShardReader`` views,
+  copied once into a bounded ring of reusable staging buffers by a
+  producer thread (page-in + memcpy release the GIL) while the consumer
+  bins block k and dispatches its async ``device_put`` — read, bin, and
+  transfer overlap;
+- blocks land in donated ``dynamic_update_slice`` device buffers exactly
+  like the in-memory pipelined fit (`models/lightgbm/base.py`
+  _binned_to_device and the sharded/multi-host variants), so the hot
+  path has NO host sync (sync-point lint, tests/test_fit_pipeline.py)
+  and peak HBM stays ~1x the binned matrix + one block;
+- peak host RSS is bounded by the ring: ``ring_depth`` staging block
+  sets plus the shards currently mapped (readers are closed — munmapped
+  — as soon as no later block needs them), regardless of dataset size
+  (bounded-memory lint + RSS-asserted harness, docs/DATA.md).
+
+Digest parity with the in-memory fit is a hard contract, pinned by
+tests/test_shardstore.py: same bin edges (ops/binning.BinMapper
+.fit_sampled — same rng sample, exact stats), same device values (same
+casts, same padding/masking as mesh.shard_rows), bit-identical boosters.
+
+Multi-host fits give each host ownership of only its shards: the rows a
+host's devices own (parallel/multihost.local_row_slices) are mapped back
+to shard row ranges, and rows another host owns are never read, binned,
+or transferred here — host ingest cost divides by the host count.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import rowcodec
+
+MANIFEST_NAME = "MANIFEST.json"
+STORE_FORMAT = "mmlspark-tpu-shardstore"
+STORE_SCHEMA_VERSION = 1
+
+#: canonical column names (fixed vocabulary — the fit route keys on them)
+FEATURES = "features"
+LABEL = "label"
+WEIGHT = "weight"
+GROUP = "group"
+
+
+class ShardStoreError(ValueError):
+    """Store directory/manifest/shard is malformed or inconsistent."""
+
+
+class ShardVerifyError(ShardStoreError):
+    """A shard's bytes do not match the manifest sha256/row count."""
+
+
+def _publish_verify_failure() -> None:
+    try:
+        from ..observability.bridge import publish_ingest_verify_failure
+        publish_ingest_verify_failure()
+    except Exception:  # noqa: BLE001 - metrics must never mask the error
+        pass
+
+
+def host_rss_bytes(peak: bool = False) -> Optional[int]:
+    """Current (VmRSS) or peak (VmHWM) resident set of this process in
+    bytes, from /proc/self/status; None where that interface is absent.
+    The `ingest_rss_bytes` gauge source and the measure_ingest harness's
+    bound probe."""
+    key = "VmHWM:" if peak else "VmRSS:"
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith(key):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        return None
+    return None
+
+
+# ---------------------------------------------------------------- writer
+
+class ShardStoreWriter:
+    """Streaming shard-store writer: bounded by the append block size.
+
+    ``append`` buffers row blocks (views are fine — they are consumed at
+    the next flush) and cuts a shard file every ``rows_per_shard`` rows;
+    the shard is written column by column — header first
+    (rowcodec.encode_header), then each buffered block's payload bytes —
+    so no block concatenation ever materializes a whole shard in RAM.
+    sha256 is folded in while writing. ``close`` writes ``MANIFEST.json``
+    through the atomic-write helper (resilience/elastic.py): the manifest
+    commit IS the store's existence — shard files without a manifest are
+    invisible garbage, never a torn dataset.
+
+    Exact full-pass stats are accumulated per block at write time
+    (np.fmin/np.fmax of per-block nanmin/nanmax == whole-matrix
+    nanmin/nanmax; OR of per-block isnan-any) — the inputs
+    ops/binning.BinMapper.fit_sampled needs for bit-parity with the
+    in-memory fit, paid here where the rows are already in hand.
+    """
+
+    def __init__(self, path: str, rows_per_shard: int = 1_000_000):
+        if rows_per_shard <= 0:
+            raise ValueError("rows_per_shard must be positive")
+        self.path = str(path)
+        self.rows_per_shard = int(rows_per_shard)
+        os.makedirs(self.path, exist_ok=True)
+        self._buf: List[Dict[str, np.ndarray]] = []
+        self._buf_rows = 0
+        self._shards: List[Dict[str, Any]] = []
+        self._rows = 0
+        self._columns: Optional[List[str]] = None
+        self._dtypes: Dict[str, np.dtype] = {}
+        self._ncols = 0
+        self._fmin: Optional[np.ndarray] = None
+        self._fmax: Optional[np.ndarray] = None
+        self._missing: Optional[np.ndarray] = None
+        self._any_nan = False
+        self._label_min = np.inf
+        self._label_max = -np.inf
+        self._closed = False
+
+    def append(self, features: np.ndarray, label: np.ndarray,
+               weight: Optional[np.ndarray] = None,
+               group: Optional[np.ndarray] = None) -> None:
+        if self._closed:
+            raise ShardStoreError("writer already closed")
+        features = np.ascontiguousarray(features)
+        label = np.ascontiguousarray(label)
+        if features.ndim != 2:
+            raise ShardStoreError("features must be 2-D [rows, F]")
+        r = features.shape[0]
+        if label.shape != (r,):
+            raise ShardStoreError(
+                f"label shape {label.shape} != ({r},)")
+        block = {FEATURES: features, LABEL: label}
+        if weight is not None:
+            weight = np.ascontiguousarray(weight, np.float32)
+            if weight.shape != (r,):
+                raise ShardStoreError(
+                    f"weight shape {weight.shape} != ({r},)")
+            block[WEIGHT] = weight
+        if group is not None:
+            group = np.ascontiguousarray(group)
+            if group.shape != (r,):
+                raise ShardStoreError(
+                    f"group shape {group.shape} != ({r},)")
+            block[GROUP] = group
+        if self._columns is None:
+            self._columns = list(block)
+            self._dtypes = {nm: a.dtype for nm, a in block.items()}
+            self._ncols = features.shape[1]
+            for nm, dt in self._dtypes.items():
+                if dt.newbyteorder("<") not in rowcodec._DTYPE_CODES:
+                    raise ShardStoreError(
+                        f"column {nm!r}: unsupported dtype {dt}")
+        else:
+            if list(block) != self._columns:
+                raise ShardStoreError(
+                    f"append columns {list(block)} != first append's "
+                    f"{self._columns}")
+            if features.shape[1] != self._ncols:
+                raise ShardStoreError(
+                    f"features has {features.shape[1]} cols, store has "
+                    f"{self._ncols}")
+            for nm, a in block.items():
+                if a.dtype != self._dtypes[nm]:
+                    raise ShardStoreError(
+                        f"column {nm!r} dtype {a.dtype} != {self._dtypes[nm]}")
+        if r == 0:
+            return
+        self._update_stats(features, label)
+        self._buf.append(block)
+        self._buf_rows += r
+        self._rows += r
+        while self._buf_rows >= self.rows_per_shard:
+            self._flush(self.rows_per_shard)
+
+    def _update_stats(self, features: np.ndarray, label: np.ndarray) -> None:
+        # np.fmin/np.fmax ignore the NaN side of a pair, so the per-block
+        # reduce chain equals whole-matrix nanmin/nanmax — and equals
+        # plain min/max when NaN-free — matching BinMapper.fit's stats in
+        # every case (and never emitting the all-NaN-slice warning).
+        bmin = np.fmin.reduce(features, axis=0)
+        bmax = np.fmax.reduce(features, axis=0)
+        if self._fmin is None:
+            self._fmin, self._fmax = bmin, bmax
+        else:
+            self._fmin = np.fmin(self._fmin, bmin)
+            self._fmax = np.fmax(self._fmax, bmax)
+        if features.dtype.kind == "f":
+            nanmask = np.isnan(features)
+            if self._missing is None:
+                self._missing = nanmask.any(axis=0)
+            else:
+                self._missing |= nanmask.any(axis=0)
+            self._any_nan = bool(self._any_nan or nanmask.any())
+        elif self._missing is None:
+            self._missing = np.zeros(features.shape[1], bool)
+        self._label_min = float(np.fmin(self._label_min,
+                                        np.fmin.reduce(label)))
+        self._label_max = float(np.fmax(self._label_max,
+                                        np.fmax.reduce(label)))
+
+    def _flush(self, rows: int) -> None:
+        """Cut one shard of exactly ``rows`` rows from the buffer head."""
+        rows = int(min(rows, self._buf_rows))
+        if rows <= 0:
+            return
+        head: List[Dict[str, np.ndarray]] = []
+        taken = 0
+        while taken < rows:
+            block = self._buf[0]
+            r = block[FEATURES].shape[0]
+            if taken + r <= rows:
+                head.append(self._buf.pop(0))
+                taken += r
+            else:
+                cut = rows - taken
+                head.append({nm: a[:cut] for nm, a in block.items()})
+                self._buf[0] = {nm: a[cut:] for nm, a in block.items()}
+                taken = rows
+        self._buf_rows -= rows
+        fname = f"shard-{len(self._shards):05d}.mt"
+        fpath = os.path.join(self.path, fname)
+        digest = hashlib.sha256()
+        nbytes = 0
+        with open(fpath, "wb") as f:
+            for nm in self._columns or []:
+                dt = self._dtypes[nm].newbyteorder("<")
+                shape = ((rows, self._ncols) if nm == FEATURES else (rows,))
+                hb = rowcodec.encode_header(nm, dt, shape)
+                f.write(hb)
+                digest.update(hb)
+                nbytes += len(hb)
+                for block in head:
+                    payload = np.ascontiguousarray(
+                        block[nm]).astype(dt, copy=False).tobytes()
+                    f.write(payload)
+                    digest.update(payload)
+                    nbytes += len(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        self._shards.append({"file": fname, "rows": rows,
+                             "bytes": nbytes,
+                             "sha256": digest.hexdigest()})
+
+    def close(self) -> "ShardStore":
+        if self._closed:
+            return ShardStore(self.path)
+        if self._buf_rows:
+            self._flush(self._buf_rows)
+        self._closed = True
+        col_stats: Optional[Dict[str, Any]] = None
+        if self._rows:
+            col_stats = {
+                "feature_min": [float(v) for v in self._fmin],
+                "feature_max": [float(v) for v in self._fmax],
+                "missing": [bool(v) for v in (
+                    self._missing if self._missing is not None
+                    else np.zeros(self._ncols, bool))],
+                "any_nan": bool(self._any_nan),
+                "label_min": float(self._label_min),
+                "label_max": float(self._label_max),
+            }
+        manifest = {
+            "format": STORE_FORMAT,
+            "schema_version": STORE_SCHEMA_VERSION,
+            "rows": int(self._rows),
+            "num_features": int(self._ncols),
+            "columns": {nm: {"dtype": self._dtypes[nm].newbyteorder("<").str,
+                             **({"cols": int(self._ncols)}
+                                if nm == FEATURES else {})}
+                        for nm in (self._columns or [])},
+            "shards": self._shards,
+            "stats": col_stats,
+        }
+        from ..resilience.elastic import atomic_write_text
+        atomic_write_text(os.path.join(self.path, MANIFEST_NAME),
+                          json.dumps(manifest, indent=2, sort_keys=True))
+        return ShardStore(self.path)
+
+    def __enter__(self) -> "ShardStoreWriter":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is None:
+            self.close()
+
+
+def write_store(path: str, features: np.ndarray, label: np.ndarray,
+                weight: Optional[np.ndarray] = None,
+                group: Optional[np.ndarray] = None,
+                rows_per_shard: int = 1_000_000,
+                block_rows: int = 262_144) -> "ShardStore":
+    """In-RAM arrays -> shard store (tests/small datasets; the real
+    out-of-core route streams ShardStoreWriter.append from a generator)."""
+    with ShardStoreWriter(path, rows_per_shard) as w:
+        n = features.shape[0]
+        for i0 in range(0, n, block_rows):
+            i1 = min(i0 + block_rows, n)
+            w.append(features[i0:i1], label[i0:i1],
+                     None if weight is None else weight[i0:i1],
+                     None if group is None else group[i0:i1])
+    return ShardStore(path)
+
+
+# ----------------------------------------------------------------- store
+
+class ShardStore:
+    """An opened shard-store directory: manifest + shard access.
+
+    ``shape`` mirrors a 2-D matrix ((rows, num_features)) so fit-path
+    bookkeeping (`n, f = x.shape`) reads the same for both routes;
+    everything row-payload goes through per-shard ``ShardReader``s.
+    ``manifest_digest`` is the dataset identity the checkpoint
+    shard-cursor records (resilience/elastic.py schema v2) — resume
+    against a different/rewritten store is a counted refusal, not a
+    silent wrong-data continuation.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        mpath = os.path.join(self.path, MANIFEST_NAME)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except OSError as e:
+            raise ShardStoreError(f"cannot read {mpath}: {e}") from e
+        except ValueError as e:
+            raise ShardStoreError(f"malformed manifest {mpath}: {e}") from e
+        if manifest.get("format") != STORE_FORMAT:
+            raise ShardStoreError(
+                f"{mpath}: format {manifest.get('format')!r} is not "
+                f"{STORE_FORMAT!r}")
+        ver = int(manifest.get("schema_version", -1))
+        if ver > STORE_SCHEMA_VERSION:
+            raise ShardStoreError(
+                f"{mpath}: schema_version {ver} is newer than this reader "
+                f"({STORE_SCHEMA_VERSION})")
+        self.manifest = manifest
+        self.rows = int(manifest["rows"])
+        self.num_features = int(manifest["num_features"])
+        self.columns: Dict[str, Dict[str, Any]] = manifest["columns"]
+        self.shards: List[Dict[str, Any]] = list(manifest["shards"])
+        self.stats: Optional[Dict[str, Any]] = manifest.get("stats")
+        self.manifest_digest = hashlib.sha256(
+            json.dumps(manifest, sort_keys=True).encode()).hexdigest()
+        if sum(int(s["rows"]) for s in self.shards) != self.rows:
+            raise ShardStoreError(
+                f"{mpath}: shard row counts do not sum to rows={self.rows}")
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.rows, self.num_features)
+
+    def __len__(self) -> int:
+        return self.rows
+
+    def column_dtype(self, name: str) -> np.dtype:
+        return np.dtype(self.columns[name]["dtype"])
+
+    def shard_path(self, i: int) -> str:
+        return os.path.join(self.path, self.shards[i]["file"])
+
+    def open_shard(self, i: int) -> rowcodec.ShardReader:
+        return rowcodec.ShardReader(self.shard_path(i))
+
+    def shard_row_ranges(self) -> List[Tuple[int, int]]:
+        """Global [start, stop) row range of each shard — global row
+        order IS shard concatenation order."""
+        out, base = [], 0
+        for s in self.shards:
+            out.append((base, base + int(s["rows"])))
+            base += int(s["rows"])
+        return out
+
+    def cursor(self) -> Dict[str, Any]:
+        """The shard-cursor fields a checkpoint manifest records
+        (resilience/elastic.py schema v2): enough to validate at resume
+        time that the store on disk is byte-for-byte the dataset the
+        snapshot was trained on."""
+        return {"store": self.path,
+                "manifest_digest": self.manifest_digest,
+                "shards": len(self.shards),
+                "rows": int(self.rows)}
+
+    def verify(self, shard: Optional[int] = None,
+               chunk_bytes: int = 1 << 20) -> int:
+        """Recompute shard sha256s in bounded chunks against the
+        manifest. Returns the number of shards verified; a mismatch
+        counts `ingest_verify_failures_total` and raises
+        ShardVerifyError naming the shard."""
+        idxs = range(len(self.shards)) if shard is None else [int(shard)]
+        for i in idxs:
+            entry = self.shards[i]
+            digest = hashlib.sha256()
+            with open(self.shard_path(i), "rb") as f:
+                while True:
+                    chunk = f.read(chunk_bytes)
+                    if not chunk:
+                        break
+                    digest.update(chunk)
+            if digest.hexdigest() != entry["sha256"]:
+                _publish_verify_failure()
+                raise ShardVerifyError(
+                    f"{self.shard_path(i)}: sha256 mismatch (manifest "
+                    f"{entry['sha256'][:12]}…, file "
+                    f"{digest.hexdigest()[:12]}…)")
+        return len(list(idxs))
+
+
+def is_store_path(obj: Any) -> bool:
+    """True when ``obj`` names a shard-store directory on disk."""
+    if not isinstance(obj, (str, os.PathLike)):
+        return False
+    return os.path.isfile(os.path.join(str(obj), MANIFEST_NAME))
+
+
+def as_store(obj: Any) -> Optional[ShardStore]:
+    """ShardStore | store-directory path -> ShardStore; anything else ->
+    None (the fit-entry routing probe in models/lightgbm/base.py)."""
+    if isinstance(obj, ShardStore):
+        return obj
+    if is_store_path(obj):
+        return ShardStore(str(obj))
+    return None
+
+
+# -------------------------------------------------- streamed BinMapper fit
+
+def _gather_sample(store: ShardStore,
+                   idx: Optional[np.ndarray]) -> np.ndarray:
+    """DESIGNATED block-assembly point (bounded-memory lint,
+    tests/test_shardstore.py): the ONE place a multi-shard feature gather
+    materializes, and it is bounded by the bin sample count (or the full
+    store when the store is smaller), never the dataset."""
+    total = store.rows if idx is None else int(len(idx))
+    out = np.empty((total, store.num_features), np.float64)
+    pos = 0
+    for i, (g0, g1) in enumerate(store.shard_row_ranges()):
+        if idx is None:
+            local = None
+            take = g1 - g0
+        else:
+            lo = int(np.searchsorted(idx, g0))
+            hi = int(np.searchsorted(idx, g1))
+            if hi == lo:
+                continue
+            local = idx[lo:hi] - g0
+            take = hi - lo
+        rd = store.open_shard(i)
+        try:
+            view = rd.column_rows(FEATURES, 0, rd.rows)
+            out[pos:pos + take] = view if local is None else view[local]
+            del view
+        finally:
+            rd.close()
+        pos += take
+    return out
+
+
+def fit_bin_mapper(store: ShardStore, max_bins: int = 255,
+                   sample_count: int = 200_000, seed: int = 0,
+                   categorical: Optional[Tuple[int, ...]] = None,
+                   max_bins_by_feature: Optional[np.ndarray] = None,
+                   use_missing: bool = True):
+    """BinMapper from a shard store with BIT-PARITY to the in-memory
+    ``BinMapper.fit(X)``: the same rng draw picks the sample rows (drawn
+    against the same n with the same seed; row order is irrelevant —
+    compute_bin_edges sorts per column), which are gathered from the
+    shards, and the full-pass min/max/missing stats come from the
+    manifest (accumulated exactly at write time). Cost: O(sample) reads
+    + O(columns) manifest, never a full-data pass."""
+    from ..ops.binning import BinMapper
+    n = store.rows
+    if n == 0:
+        raise ShardStoreError("cannot fit a BinMapper on an empty store")
+    if store.stats is None:
+        raise ShardStoreError("store manifest carries no stats")
+    if n > sample_count:
+        rng = np.random.default_rng(seed)
+        idx = np.sort(rng.choice(n, sample_count, replace=False))
+    else:
+        idx = None
+    sample = _gather_sample(store, idx)
+    st = store.stats
+    return BinMapper.fit_sampled(
+        sample, n,
+        feature_min=np.asarray(st["feature_min"], np.float64),
+        feature_max=np.asarray(st["feature_max"], np.float64),
+        missing_any=np.asarray(st["missing"], bool),
+        float_data=store.column_dtype(FEATURES).kind == "f",
+        max_bins=max_bins, sample_count=sample_count, seed=seed,
+        categorical=categorical, max_bins_by_feature=max_bins_by_feature,
+        use_missing=use_missing)
+
+
+def read_column(store: ShardStore, name: str) -> np.ndarray:
+    """DESIGNATED block-assembly point (bounded-memory lint): full
+    materialization of ONE auxiliary 1-D column. The lambdarank group-id
+    column rides this — a single int column is the documented exception
+    to the RSS bound (docs/DATA.md), ~1/(4·F) of the feature payload."""
+    if name not in store.columns:
+        raise ShardStoreError(f"store has no column {name!r}")
+    parts = []
+    for i in range(len(store.shards)):
+        rd = store.open_shard(i)
+        try:
+            view = rd.column_rows(name, 0, rd.rows)
+            parts.append(np.array(view))
+            del view
+        finally:
+            rd.close()
+    return (np.concatenate(parts) if parts
+            else np.empty(0, store.column_dtype(name)))
+
+
+# ------------------------------------------------------- prefetch ring
+
+#: column source spec: ("store", column_name) reads shard payloads,
+#: ("const", value) fills real rows with value — pad rows are always 0
+_DONE = object()
+
+
+class _PrefetchRing:
+    """Bounded ring of reusable staging buffer sets filled ahead by a
+    producer thread.
+
+    ``requests`` is the exact consumption order: (tag, segments) where
+    each segment (dest_row, g0, g1) copies padded-global rows [g0, g1)
+    of every column into the buffer at dest_row. Rows at/after the
+    store's real row count are PADDING and fill as 0. The producer walks
+    shard mmaps through zero-copy views (page-in + memcpy release the
+    GIL under the consumer's binning), recycles at most ``depth`` buffer
+    sets, and closes each shard reader after its last-use request — so
+    resident staging is depth block sets and resident file pages are the
+    handful of shards the in-flight requests span. That is the RSS bound
+    (docs/DATA.md); nothing here scales with dataset size."""
+
+    def __init__(self, store: ShardStore,
+                 columns: Dict[str, Tuple],
+                 requests: Sequence[Tuple[Any, List[Tuple[int, int, int]]]],
+                 rows_cap: int, depth: int = 2):
+        self._store = store
+        self._columns = columns
+        self._requests = list(requests)
+        self._ranges = store.shard_row_ranges()
+        self._free: "queue.Queue" = queue.Queue()
+        self._ready: "queue.Queue" = queue.Queue(
+            maxsize=max(2, int(depth)) + 1)
+        self._abort = False
+        self._err: Optional[BaseException] = None
+        self.bytes_filled = 0
+        fdim = store.num_features
+        for _ in range(max(2, int(depth))):
+            bufset = {}
+            for nm, spec in columns.items():
+                dt = spec[2]
+                shape = ((rows_cap, fdim) if nm == FEATURES
+                         else (rows_cap,))
+                bufset[nm] = np.zeros(shape, dt)
+            self._free.put(bufset)
+        # last request index touching each shard -> close (munmap) there
+        self._last_use: Dict[int, int] = {}
+        for ri, (_tag, segs) in enumerate(self._requests):
+            for _dst, g0, g1 in segs:
+                for si, (s0, s1) in enumerate(self._ranges):
+                    if g0 < min(s1, store.rows) and s0 < g1:
+                        self._last_use[si] = ri
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="shardstore-prefetch")
+        self._thread.start()
+
+    def _fill(self, bufset: Dict[str, np.ndarray], dst: int, g0: int,
+              g1: int, readers: Dict[int, rowcodec.ShardReader]) -> None:
+        rows = self._store.rows
+        real1 = min(g1, rows)
+        for nm, spec in self._columns.items():
+            buf = bufset[nm]
+            if spec[0] == "const":
+                if real1 > g0:
+                    buf[dst:dst + (real1 - g0)] = spec[1]
+            if g1 > real1:  # padding rows (beyond the store) are zero
+                buf[dst + max(0, real1 - g0):dst + (g1 - g0)] = 0
+        if real1 <= g0:
+            return
+        for si, (s0, s1) in enumerate(self._ranges):
+            a = max(g0, s0)
+            b = min(real1, s1)
+            if b <= a:
+                continue
+            rd = readers.get(si)
+            if rd is None:
+                rd = readers[si] = self._store.open_shard(si)
+            for nm, spec in self._columns.items():
+                if spec[0] != "store":
+                    continue
+                view = rd.column_rows(spec[1], a - s0, b - s0)
+                np.copyto(bufset[nm][dst + (a - g0):dst + (b - g0)], view,
+                          casting="same_kind")
+                self.bytes_filled += view.nbytes
+                del view
+
+    def _produce(self) -> None:
+        readers: Dict[int, rowcodec.ShardReader] = {}
+        try:
+            for ri, (tag, segs) in enumerate(self._requests):
+                bufset = None
+                while bufset is None:
+                    if self._abort:
+                        return
+                    try:
+                        bufset = self._free.get(timeout=0.2)
+                    except queue.Empty:
+                        continue
+                for dst, g0, g1 in segs:
+                    self._fill(bufset, dst, g0, g1, readers)
+                # munmap shards no later request touches — this is what
+                # actually returns their file-backed pages
+                for si in [s for s, last in self._last_use.items()
+                           if last == ri and s in readers]:
+                    readers.pop(si).close()
+                self._ready.put((tag, bufset))
+            self._ready.put((_DONE, None))
+        except BaseException as e:  # noqa: BLE001 - surfaced to consumer
+            self._err = e
+            try:
+                self._ready.put_nowait((_DONE, None))
+            except queue.Full:
+                pass
+        finally:
+            for rd in readers.values():
+                try:
+                    rd.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def __iter__(self):
+        while True:
+            tag, bufset = self._ready.get()
+            if tag is _DONE:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield tag, bufset
+
+    def recycle(self, bufset: Dict[str, np.ndarray]) -> None:
+        self._free.put(bufset)
+
+    def close(self) -> None:
+        self._abort = True
+        try:
+            while True:
+                self._ready.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+
+
+# ------------------------------------------------------ streaming ingest
+
+def _block_plan(extent: int, blk: int) -> List[int]:
+    """Shift-back block starts: every window is full-size (ONE compiled
+    write shape); the final window's overlap rows rewrite identical
+    values — same discipline as the in-memory pipelined fit."""
+    starts = [0]
+    for i0 in range(blk, extent, blk):
+        starts.append(min(i0, extent - blk))
+    return starts
+
+
+def _zero_pad_rows(arr: np.ndarray, segs: List[Tuple[int, int, int]],
+                   n_real: int) -> None:
+    """Zero computed values (margins) on padding rows so the streamed
+    arrays match shard_rows' zero-padded in-memory layout bit for bit."""
+    for dst, g0, g1 in segs:
+        if g1 > n_real:
+            arr[dst + max(0, n_real - g0):dst + (g1 - g0)] = 0
+
+
+def _publish_stream_metrics(rows: int, seconds: float) -> None:
+    try:
+        from ..observability.bridge import publish_ingest_metrics
+        publish_ingest_metrics(rows=rows, seconds=seconds,
+                               rss_bytes=host_rss_bytes())
+    except Exception:  # noqa: BLE001 - metrics must never fail ingest
+        pass
+
+
+def _observe_block_seconds(seconds: float) -> None:
+    """Per-block hot-path sample, observed straight into the registry's
+    `ingest_block_seconds` histogram — the telemetry lint
+    (tests/test_observability.py) forbids latency-sample LISTS in io/,
+    and a histogram is the right home anyway."""
+    try:
+        from ..observability.bridge import publish_ingest_metrics
+        publish_ingest_metrics(rows=0, seconds=0.0,
+                               block_seconds=[seconds])
+    except Exception:  # noqa: BLE001 - metrics must never fail ingest
+        pass
+
+
+def stream_fit_arrays(bm, store: ShardStore, *, k: int = 1, mesh=None,
+                      margin_fn: Optional[Callable] = None,
+                      blk: Optional[int] = None, ring_depth: int = 2,
+                      timeline=None):
+    """The out-of-core twin of base._pipelined_device_data: shards ->
+    (binned_device, (y_d, w_d, t_d, mg_d, gidx)) with gidx always None
+    (group ids ride read_column, serial fits only).
+
+    Routing mirrors the in-memory fit exactly: serial (mesh None),
+    sharded single-process ([ndev, rows_per_dev, F] super-blocks,
+    donated writes at (0, j0, 0), communication-free flatten), and
+    multi-host (per-device buffers on LOCAL devices only, assembled via
+    jax.make_array_from_single_device_arrays — each host reads only the
+    shards its rows live in). No host sync anywhere (sync-point lint,
+    tests/test_fit_pipeline.py); ``margin_fn`` (resume/init-score
+    streaming: raw features block -> [rows, k] float32 margin) is the
+    one documented stall, confined to warm-start fits.
+
+    Value parity with the in-memory route (pinned bit-identical by the
+    digest tests): y casts through the same dtype chain (float64 ->
+    canonical on sharded paths, stored-dtype -> canonical serial), pad
+    rows are zero everywhere shard_rows zero-pads, absent weights are
+    ones on real rows / zero on padding, and the binned matrix bins the
+    same raw values blockwise (BinMapper.transform is blockwise-exact).
+    """
+    from ..utils.profiling import NULL_TIMELINE
+    tl = timeline if timeline is not None else NULL_TIMELINE
+    n, fdim = store.shape
+    if n == 0:
+        raise ShardStoreError("cannot stream an empty store")
+    if mesh is None:
+        return _stream_serial(bm, store, k, margin_fn, blk, ring_depth, tl)
+    from ..parallel import mesh as meshlib
+    if meshlib.process_count() > 1:
+        return _stream_multihost(bm, store, k, margin_fn, blk, ring_depth,
+                                 tl, mesh)
+    return _stream_sharded(bm, store, k, margin_fn, blk, ring_depth, tl,
+                           mesh)
+
+
+def _ring_columns(store: ShardStore, need_weight_stream: bool,
+                  y_staging_dtype) -> Dict[str, Tuple]:
+    cols: Dict[str, Tuple] = {
+        FEATURES: ("store", FEATURES, store.column_dtype(FEATURES)),
+        LABEL: ("store", LABEL, y_staging_dtype),
+    }
+    if need_weight_stream:
+        if WEIGHT in store.columns:
+            cols[WEIGHT] = ("store", WEIGHT, np.float32)
+        else:
+            # absent weights are ones on real rows, zero on padding —
+            # exactly shard_rows' weights*mask fold
+            cols[WEIGHT] = ("const", np.float32(1.0), np.float32)
+    return cols
+
+
+def _stream_serial(bm, store, k, margin_fn, blk, ring_depth, tl):
+    import jax
+    import jax.numpy as jnp
+    from ..compile import cache as compilecache
+    n, fdim = store.shape
+    if blk is None:
+        blk = max(1_000_000, -(-n // 8))
+    blk = max(1, min(int(blk), n))
+    starts = _block_plan(n, blk)
+    tl.meta["blk"] = int(blk)
+    tl.meta["n_blocks"] = len(starts)
+    y_dt = jax.dtypes.canonicalize_dtype(store.column_dtype(LABEL))
+    has_w = WEIGHT in store.columns
+    cols = _ring_columns(store, has_w, store.column_dtype(LABEL))
+    requests = [(j0, [(0, j0, j0 + blk)]) for j0 in starts]
+    bdt = jnp.uint8 if bm.max_bins <= 256 else jnp.int32
+    write2 = compilecache.cached_jit(
+        lambda buf, block, i0: jax.lax.dynamic_update_slice(
+            buf, block, (i0, 0)),
+        key="binned_write2d", name="gbdt_binned_write", donate_argnums=0)
+    write1 = compilecache.cached_jit(
+        lambda buf, block, i0: jax.lax.dynamic_update_slice(
+            buf, block, (i0,)),
+        key="ingest_write1d", name="ingest_aux_write", donate_argnums=0)
+    binned = jnp.zeros((n, fdim), bdt)
+    y_d = jnp.zeros((n,), y_dt)
+    w_d = jnp.zeros((n,), jnp.float32) if has_w else jnp.ones(
+        (n,), jnp.float32)
+    mg_d = (jnp.zeros((n, k), jnp.float32) if margin_fn is not None
+            else None)
+    ring = _PrefetchRing(store, cols, requests, blk, ring_depth)
+    t_start = time.perf_counter()
+    try:
+        for j0, bufset in ring:
+            t0 = time.perf_counter()
+            feats = bufset[FEATURES]
+            i0 = jnp.int32(j0)
+            if margin_fn is not None:
+                with tl.span(f"margin[{j0}]"):
+                    mg = margin_fn(feats).astype(
+                        np.float32, copy=False).reshape(blk, k)
+                mg_d = write2(mg_d, jax.device_put(mg), i0)
+            with tl.span(f"bin[{j0}]"):
+                bk = bm.transform(feats)
+            with tl.span(f"put[{j0}]"):
+                binned = write2(binned, jax.device_put(bk), i0)
+                y_d = write1(y_d, jax.device_put(
+                    bufset[LABEL].astype(y_dt)), i0)
+                if has_w:
+                    w_d = write1(w_d, jax.device_put(
+                        bufset[WEIGHT].astype(np.float32)), i0)
+            ring.recycle(bufset)
+            _observe_block_seconds(time.perf_counter() - t0)
+    finally:
+        ring.close()
+    t_d = jnp.ones((n,), jnp.float32)
+    if mg_d is None:
+        mg_d = jnp.zeros((n, k), jnp.float32)
+    _publish_stream_metrics(n, time.perf_counter() - t_start)
+    return binned, (y_d, w_d, t_d, mg_d, None)
+
+
+def _stream_sharded(bm, store, k, margin_fn, blk, ring_depth, tl, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..compile import cache as compilecache
+    from ..parallel import mesh as meshlib
+    n, fdim = store.shape
+    nd = mesh.shape[meshlib.DATA_AXIS]
+    n_pad = n + ((-n) % nd)
+    ppd = n_pad // nd
+    if blk is None:
+        blk = max(1_000_000 // nd, -(-ppd // 8))
+    blk = max(1, min(int(blk), ppd))
+    starts = _block_plan(ppd, blk)
+    tl.meta["blk"] = int(blk * nd)
+    tl.meta["n_blocks"] = len(starts)
+    tl.meta["ndev"] = int(nd)
+    # sharded fits cast y through float64 (the serial-path parity cast)
+    cols = _ring_columns(store, True, np.float64)
+    requests = [(j0, [(d * blk, d * ppd + j0, d * ppd + j0 + blk)
+                      for d in range(nd)]) for j0 in starts]
+    sh3 = NamedSharding(mesh, P(meshlib.DATA_AXIS, None, None))
+    sh2 = NamedSharding(mesh, P(meshlib.DATA_AXIS, None))
+    bdt = jnp.uint8 if bm.max_bins <= 256 else jnp.int32
+    write3 = compilecache.cached_jit(
+        lambda buf, block, j0: jax.lax.dynamic_update_slice(
+            buf, block, (0, j0, 0)),
+        key="binned_write3d", name="gbdt_binned_write", donate_argnums=0)
+    write2 = compilecache.cached_jit(
+        lambda buf, block, j0: jax.lax.dynamic_update_slice(
+            buf, block, (0, j0)),
+        key="ingest_write2d", name="ingest_aux_write", donate_argnums=0)
+    binned = jnp.zeros((nd, ppd, fdim), bdt, device=sh3)
+    y_d = jnp.zeros((nd, ppd), jnp.float32, device=sh2)
+    w_d = jnp.zeros((nd, ppd), jnp.float32, device=sh2)
+    t_d = jnp.zeros((nd, ppd), jnp.float32, device=sh2)
+    mg_d = (jnp.zeros((nd, ppd, k), jnp.float32, device=sh3)
+            if margin_fn is not None else None)
+    ring = _PrefetchRing(store, cols, requests, nd * blk, ring_depth)
+    t_start = time.perf_counter()
+    try:
+        for j0, bufset in ring:
+            t0 = time.perf_counter()
+            feats = bufset[FEATURES]
+            segs = [(d * blk, d * ppd + j0, d * ppd + j0 + blk)
+                    for d in range(nd)]
+            i0 = jnp.int32(j0)
+            if margin_fn is not None:
+                with tl.span(f"margin[{j0}]"):
+                    mg = margin_fn(feats).astype(
+                        np.float32, copy=False).reshape(nd * blk, k)
+                    _zero_pad_rows(mg, segs, n)
+                mg_d = write3(mg_d, jax.device_put(
+                    mg.reshape(nd, blk, k), sh3), i0)
+            with tl.span(f"bin[{j0}]"):
+                bk = bm.transform(feats).reshape(nd, blk, fdim)
+            with tl.span(f"put[{j0}]"):
+                binned = write3(binned, jax.device_put(bk, sh3), i0)
+                y_d = write2(y_d, jax.device_put(
+                    bufset[LABEL].astype(np.float32).reshape(nd, blk),
+                    sh2), i0)
+                w_d = write2(w_d, jax.device_put(
+                    bufset[WEIGHT].astype(np.float32).reshape(nd, blk),
+                    sh2), i0)
+                # is_train is 1 on real rows, 0 on padding — exactly
+                # shard_rows' padded (~is_valid) mask
+                t_d = write2(t_d, jax.device_put(
+                    _train_mask(segs, n, nd, blk), sh2), i0)
+            ring.recycle(bufset)
+            _observe_block_seconds(time.perf_counter() - t0)
+    finally:
+        ring.close()
+    flat2 = compilecache.cached_jit(
+        lambda b: b.reshape(b.shape[0] * b.shape[1], b.shape[2]),
+        key=("binned_flat", nd), name="gbdt_binned_flat",
+        out_shardings=meshlib.data_sharding(mesh, 2))
+    flat1 = compilecache.cached_jit(
+        lambda b: b.reshape(b.shape[0] * b.shape[1]),
+        key=("ingest_flat1", nd), name="ingest_aux_flat",
+        out_shardings=meshlib.data_sharding(mesh, 1))
+    out_mg = (flat2(mg_d) if mg_d is not None
+              else jnp.zeros((n_pad, k), jnp.float32))
+    _publish_stream_metrics(n, time.perf_counter() - t_start)
+    return flat2(binned), (flat1(y_d), flat1(w_d), flat1(t_d), out_mg,
+                           None)
+
+
+def _train_mask(segs: List[Tuple[int, int, int]], n_real: int, nd: int,
+                blk: int) -> np.ndarray:
+    """Host [nd, blk] is_train block: 1.0 real rows, 0.0 padding — what
+    shard_rows produces for (~is_valid) when no validation column rides
+    the store."""
+    out = np.ones((nd * blk,), np.float32)
+    _zero_pad_rows(out, segs, n_real)
+    return out.reshape(nd, blk)
+
+
+def _stream_multihost(bm, store, k, margin_fn, blk, ring_depth, tl, mesh):
+    import jax
+    import jax.numpy as jnp
+    from ..compile import cache as compilecache
+    from ..parallel import mesh as meshlib
+    from ..parallel import multihost as mhlib
+    n, fdim = store.shape
+    nd = mesh.shape[meshlib.DATA_AXIS]
+    n_pad = n + ((-n) % nd)
+    ppd = n_pad // nd
+    spans = mhlib.local_row_slices(mesh, n_pad)
+    if blk is None:
+        blk = max(1_000_000 // nd, -(-ppd // 8))
+    blk = max(1, min(int(blk), ppd))
+    starts = _block_plan(ppd, blk)
+    tl.meta["blk"] = int(blk * len(spans))
+    tl.meta["n_blocks"] = len(starts)
+    tl.meta["ndev"] = int(nd)
+    tl.meta["local_devices"] = len(spans)
+    cols = _ring_columns(store, True, np.float64)
+    # per-host shard ownership: requests touch ONLY this host's spans,
+    # so the ring opens only the shards this host's rows live in
+    requests = [((di, j0), [(0, r0 + j0, r0 + j0 + blk)])
+                for j0 in starts
+                for di, (_dev, r0, _r1) in enumerate(spans)]
+    bdt = jnp.uint8 if bm.max_bins <= 256 else jnp.int32
+    write2 = compilecache.cached_jit(
+        lambda buf, block, i0: jax.lax.dynamic_update_slice(
+            buf, block, (i0, 0)),
+        key="binned_write2d", name="gbdt_binned_write", donate_argnums=0)
+    write1 = compilecache.cached_jit(
+        lambda buf, block, i0: jax.lax.dynamic_update_slice(
+            buf, block, (i0,)),
+        key="ingest_write1d", name="ingest_aux_write", donate_argnums=0)
+    b_bufs = [jax.device_put(jnp.zeros((ppd, fdim), bdt), dev)
+              for dev, _r0, _r1 in spans]
+    y_bufs = [jax.device_put(jnp.zeros((ppd,), jnp.float32), dev)
+              for dev, _r0, _r1 in spans]
+    w_bufs = [jax.device_put(jnp.zeros((ppd,), jnp.float32), dev)
+              for dev, _r0, _r1 in spans]
+    t_bufs = [jax.device_put(jnp.zeros((ppd,), jnp.float32), dev)
+              for dev, _r0, _r1 in spans]
+    mg_bufs = ([jax.device_put(jnp.zeros((ppd, k), jnp.float32), dev)
+                for dev, _r0, _r1 in spans]
+               if margin_fn is not None else None)
+    ring = _PrefetchRing(store, cols, requests, blk, ring_depth)
+    t_start = time.perf_counter()
+    rows_local = 0
+    try:
+        for (di, j0), bufset in ring:
+            t0 = time.perf_counter()
+            dev, r0, _r1 = spans[di]
+            segs = [(0, r0 + j0, r0 + j0 + blk)]
+            rows_local += blk
+            feats = bufset[FEATURES]
+            i0 = jnp.int32(j0)
+            if margin_fn is not None:
+                with tl.span(f"margin[{r0 + j0}]"):
+                    mg = margin_fn(feats).astype(
+                        np.float32, copy=False).reshape(blk, k)
+                    _zero_pad_rows(mg, segs, n)
+                mg_bufs[di] = write2(mg_bufs[di],
+                                     jax.device_put(mg, dev), i0)
+            with tl.span(f"bin[{r0 + j0}]"):
+                bk = bm.transform(feats)
+            with tl.span(f"put[{r0 + j0}]"):
+                b_bufs[di] = write2(b_bufs[di], jax.device_put(bk, dev), i0)
+                y_bufs[di] = write1(y_bufs[di], jax.device_put(
+                    bufset[LABEL].astype(np.float32), dev), i0)
+                w_bufs[di] = write1(w_bufs[di], jax.device_put(
+                    bufset[WEIGHT].astype(np.float32), dev), i0)
+                t_bufs[di] = write1(t_bufs[di], jax.device_put(
+                    _train_mask(segs, n, 1, blk).reshape(blk), dev), i0)
+            ring.recycle(bufset)
+            _observe_block_seconds(time.perf_counter() - t0)
+    finally:
+        ring.close()
+    sh2 = meshlib.data_sharding(mesh, 2)
+    sh1 = meshlib.data_sharding(mesh, 1)
+    binned = jax.make_array_from_single_device_arrays((n_pad, fdim), sh2,
+                                                      b_bufs)
+    y_d = jax.make_array_from_single_device_arrays((n_pad,), sh1, y_bufs)
+    w_d = jax.make_array_from_single_device_arrays((n_pad,), sh1, w_bufs)
+    t_d = jax.make_array_from_single_device_arrays((n_pad,), sh1, t_bufs)
+    mg_d = (jax.make_array_from_single_device_arrays(
+                (n_pad, k), sh2, mg_bufs) if mg_bufs is not None
+            else mhlib.zeros_row_sharded(mesh, (n_pad, k)))
+    _publish_stream_metrics(rows_local, time.perf_counter() - t_start)
+    return binned, (y_d, w_d, t_d, mg_d, None)
+
+
+__all__ = [
+    "MANIFEST_NAME", "STORE_FORMAT", "STORE_SCHEMA_VERSION",
+    "FEATURES", "LABEL", "WEIGHT", "GROUP",
+    "ShardStoreError", "ShardVerifyError", "ShardStore",
+    "ShardStoreWriter", "write_store", "is_store_path", "as_store",
+    "fit_bin_mapper", "read_column", "stream_fit_arrays",
+    "host_rss_bytes",
+]

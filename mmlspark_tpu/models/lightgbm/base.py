@@ -475,6 +475,20 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                                  else None),
                              use_missing=use_missing)
 
+    def _fit_bin_mapper_store(self, store) -> BinMapper:
+        """`_fit_bin_mapper` for an on-disk shard store: edges from a
+        bounded gathered row sample + the manifest's exact whole-pass
+        stats — same `_bin_config` source, bit-identical mapper to
+        BinMapper.fit on the materialized matrix (digest parity)."""
+        from ...io import shardstore as sstore
+        max_bin, sample_count, seed, cat, mbbf, use_missing = \
+            self._bin_config()
+        return sstore.fit_bin_mapper(
+            store, max_bin, sample_count, seed, categorical=cat,
+            max_bins_by_feature=(np.asarray(mbbf, np.int64) if mbbf
+                                 else None),
+            use_missing=use_missing)
+
     @staticmethod
     def _missing_idx_of(bm: BinMapper):
         # features with a reserved missing bin get both-direction split scans
@@ -751,8 +765,23 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
         overridden fit) or a LIST of param maps, returning one model per map
         (Estimator.fit(dataset, paramMaps) — the surface TuneHyperparameters
         sweeps, automl/TuneHyperparameters.scala:37-203). Maps touching only
-        continuous hyperparameters train in ONE vmapped XLA program."""
+        continuous hyperparameters train in ONE vmapped XLA program.
+
+        `df` may also be a shard-store directory path (or an opened
+        `io.shardstore.ShardStore`): the fit then streams the dataset
+        from disk with bounded host memory instead of materializing it
+        (the out-of-core route, docs/DATA.md)."""
         try:
+            from ...io.shardstore import as_store
+            store = as_store(df)
+            if store is not None:
+                if isinstance(params, (list, tuple)):
+                    raise ValueError(
+                        "fit(store, paramMaps) is not supported for "
+                        "shard-store input (the vmapped sweep batches "
+                        "in-memory candidates); run one fit per map")
+                est = self.copy(params) if params else self
+                return est._fit_from_store(store)
             if isinstance(params, (list, tuple)):
                 return self.fit_param_maps(df, list(params))
             return super().fit(df, params)
@@ -761,6 +790,54 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
             # param-validation ValueError) must not leave the estimator
             # pinning a LightGBMDataset's feature/binned matrices
             self._prebinned = None
+
+    # ------------------------------------------------- out-of-core fit
+    def _store_fit_spec(self, store):
+        """(objective, num_class, groups) for a shard-store fit — the
+        per-estimator decisions the in-memory `_fit` derives from full
+        label/group arrays, re-derived here from the store manifest's
+        exact whole-pass stats (classifier/ranker override)."""
+        return self._objective_name(), 1, None
+
+    def _make_store_model(self, booster: Booster):
+        """Wrap the trained booster in this estimator's model class
+        (the tail of the subclass `_fit`)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support shard-store input")
+
+    def _fit_from_store(self, store) -> "LightGBMModelBase":
+        """Out-of-core fit: the dataset never exists in host memory —
+        binning samples bounded rows, training arrays stream from disk
+        shards through a bounded prefetch ring (io/shardstore.py), and
+        checkpoints record a shard cursor so a resume can refuse a
+        rewritten store. Digest parity with the in-memory fit is a
+        tier-1 contract (tests/test_shardstore.py)."""
+        from ...io import shardstore as sstore
+        if self.get("numBatches"):
+            raise ValueError(
+                "numBatches is not supported when fitting from a shard "
+                "store (the batch split permutes full row indices); "
+                "write per-batch stores instead")
+        if self.get("initScoreCol"):
+            raise ValueError(
+                "initScoreCol is not supported when fitting from a shard "
+                "store; warm-start via modelString streams its margin "
+                "per block instead")
+        if self.get("validationIndicatorCol"):
+            raise ValueError(
+                "validationIndicatorCol is not supported when fitting "
+                "from a shard store (no per-row indicator column on "
+                "disk); hold out a separate store for evaluation")
+        if self.get("weightCol") and sstore.WEIGHT not in store.columns:
+            raise ValueError(
+                f"weightCol={self.get('weightCol')!r} is set but the "
+                f"shard store at {store.path} has no weight column "
+                "(write_store(..., weight=...))")
+        objective, num_class, groups = self._store_fit_spec(store)
+        booster = self._train_booster(store, None, None,
+                                      np.zeros(1, bool), num_class,
+                                      objective=objective, groups=groups)
+        return self._make_store_model(booster)
 
     def fit_param_maps(self, df: DataFrame, maps):
         def sequential():
@@ -957,6 +1034,23 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                 else:
                     start_trees = base_trees
                 self._ck_resume_trees = ck_trees - start_trees
+                cur_ck = man.get("shard_cursor") if man is not None else None
+                if cur_ck is not None and hasattr(x, "manifest_digest"):
+                    # shard-cursor resume contract (schema v2): the
+                    # snapshot names the exact store it trained on — a
+                    # rewritten/substituted store is a counted refusal,
+                    # never a silent continuation on wrong data
+                    if cur_ck.get("manifest_digest") != x.manifest_digest:
+                        from ...resilience.elastic import publish_event
+                        publish_event("resume", outcome="store_mismatch")
+                        raise ValueError(
+                            f"checkpoint at {ckdir} was written against "
+                            f"shard store digest "
+                            f"{cur_ck.get('manifest_digest', '')[:12]}… "
+                            f"but the store at {x.path} has digest "
+                            f"{x.manifest_digest[:12]}…; refusing to "
+                            "resume on different data (clear the "
+                            "checkpointDir to train fresh)")
                 if num_batches and num_batches > 1 \
                         and self._ck_resume_trees >= \
                         self.get("numIterations"):
@@ -1047,7 +1141,12 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                             prev: Optional[Booster],
                             groups: Optional[np.ndarray] = None,
                             prebinned=None) -> Booster:
-        n, f = x.shape
+        _store = None
+        if not isinstance(x, np.ndarray):
+            from ...io.shardstore import ShardStore
+            if isinstance(x, ShardStore):
+                _store = x
+        n, f = x.shape  # ShardStore mirrors the 2-D .shape surface
         k = num_class if num_class > 1 else 1
         _sw = None
         if self.get("collectFitTimings"):
@@ -1112,20 +1211,62 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
 
         # margin assembly hoisted ABOVE dataset construction (it only needs
         # raw features): the pipelined path dispatches its device copy
-        # before the block loop, hiding the transfer under host binning
-        margin = np.zeros((n, k), np.float32)
+        # before the block loop, hiding the transfer under host binning.
+        # A shard-store fit never materializes an [n, k] host margin —
+        # warm-start margins stream per block inside the ingest ring.
+        margin = None if _store is not None else np.zeros((n, k), np.float32)
         has_init = False
         if init_score is not None:
             margin += init_score.reshape(n, -1).astype(np.float32)
             has_init = True
         if prev is not None:
-            pm = prev.raw_predict(x)
-            margin += pm.reshape(n, -1).astype(np.float32)
+            if _store is None:
+                pm = prev.raw_predict(x)
+                margin += pm.reshape(n, -1).astype(np.float32)
             has_init = True
 
         _tl = None
         _aux = None
-        if _sw is not None and not _pipelined:
+        if _store is not None:
+            # out-of-core dataset construction (io/shardstore.py): the
+            # binned matrix and every aux array stream from disk shards
+            # through a bounded prefetch ring — the full feature matrix
+            # never exists in host memory, and the streamed arrays are
+            # bit-identical to the in-memory route (digest parity,
+            # tests/test_shardstore.py)
+            if prebinned is not None:
+                raise ValueError("LightGBMDataset prebinning does not "
+                                 "compose with shard-store input")
+            if groups is not None and not serial:
+                raise ValueError(
+                    "lambdarank from a shard store is serial-only: the "
+                    "sharded grouped layout reorders rows into group-"
+                    "aligned shards, which defeats streaming ingest — "
+                    "set numTasks=1 or parallelism='serial'")
+            from ...io import shardstore as sstore
+            _tl = FitTimeline() if _sw is not None else NULL_TIMELINE
+            with _tl.span("edges_fit"):
+                bm = self._fit_bin_mapper_store(x)
+            self._missing_idx = self._missing_idx_of(bm)
+            margin_fn = None
+            if prev is not None:
+                margin_fn = (lambda feats: prev.raw_predict(feats)
+                             .reshape(feats.shape[0], -1)
+                             .astype(np.float32))
+            binned, _aux = sstore.stream_fit_arrays(
+                bm, x, k=k,
+                mesh=None if serial else meshlib.get_mesh(ndev),
+                margin_fn=margin_fn, timeline=_tl)
+            if groups is not None:
+                # serial lambdarank: group ids are small (one int per
+                # row) — the layout rides beside the streamed arrays
+                from ...ops.ranking import make_group_layout
+                _aux = _aux[:4] + (jnp.asarray(
+                    make_group_layout(groups).group_idx),)
+            if _sw is None:
+                _tl = None
+            self._last_fit_pipelined = True
+        elif _sw is not None and not _pipelined:
             with _sw.measure("binning", barrier=False):
                 if prebinned is not None:
                     bm, binned, self._missing_idx = prebinned
@@ -1386,7 +1527,9 @@ class LightGBMParamsBase(Estimator, _p.HasFeaturesCol, _p.HasLabelCol,
                         bst.trees)[0].shape[0]),
                     ndev=ck_ndev,
                     batch_index=getattr(self, "_batch_index", 0),
-                    extra={"batch_start_trees": _batch_start_trees})
+                    extra={"batch_start_trees": _batch_start_trees},
+                    shard_cursor=(x.cursor() if _store is not None
+                                  else None))
 
         _chunk_tl = None
         _straggler_gap_s = None

@@ -67,6 +67,37 @@ class LightGBMClassifier(LightGBMParamsBase, _p.HasProbabilityCol,
             model.set(p, self.get(p))
         return self._propagate_model_params(model)
 
+    def _store_fit_spec(self, store):
+        """Out-of-core numClass inference: the in-memory path unique()s
+        the full label array; the store manifest's exact whole-pass
+        label_max stat gives the same answer without a label pass
+        (labels are dense class ids 0..C-1, the upstream contract)."""
+        if self.get("isUnbalance"):
+            raise ValueError(
+                "isUnbalance is not supported when fitting from a shard "
+                "store (it needs a full-label pass for class weight "
+                "sums); pre-weight rows in the store's weight column")
+        stats = store.stats or {}
+        lmax = stats.get("label_max")
+        if lmax is None:
+            raise ValueError(
+                f"shard store at {store.path} has no label stats in its "
+                "manifest; rewrite it with ShardStoreWriter")
+        num_class = int(lmax) + 1
+        if num_class <= 2:
+            return "binary", 1, None
+        if self.get("objective") in ("multiclassova", "multiclass_ova",
+                                     "ova", "ovr"):
+            return "multiclassova", num_class, None
+        return "multiclass", num_class, None
+
+    def _make_store_model(self, booster):
+        k = booster.num_class if booster.multiclass else 2
+        model = LightGBMClassificationModel(booster=booster, num_class=k)
+        for p in ("probabilityCol", "rawPredictionCol"):
+            model.set(p, self.get(p))
+        return self._propagate_model_params(model)
+
 
 class LightGBMClassificationModel(LightGBMModelBase, _p.HasProbabilityCol,
                                   _p.HasRawPredictionCol):

@@ -49,6 +49,25 @@ class LightGBMRanker(LightGBMParamsBase):
                                       "lambdarank", init_score, groups)
         return self._propagate_model_params(LightGBMRankerModel(booster))
 
+    def _store_fit_spec(self, store):
+        """Out-of-core lambdarank: the group-id column streams from the
+        store (one int per row — read_column is a designated assembly
+        point); label non-negativity checks the manifest's exact
+        label_min stat instead of a label pass."""
+        from ...io import shardstore as sstore
+        if sstore.GROUP not in store.columns:
+            raise ValueError(
+                f"LightGBMRanker needs a group column in the shard store "
+                f"at {store.path} (write_store(..., group=...))")
+        stats = store.stats or {}
+        lmin = stats.get("label_min")
+        if lmin is not None and lmin < 0:
+            raise ValueError("ranking labels must be non-negative integers")
+        return "lambdarank", 1, sstore.read_column(store, sstore.GROUP)
+
+    def _make_store_model(self, booster):
+        return self._propagate_model_params(LightGBMRankerModel(booster))
+
     def _make_config(self, num_class, axis_name, objective=None,
                      has_init_score=False):
         cfg = super()._make_config(num_class, axis_name, objective,

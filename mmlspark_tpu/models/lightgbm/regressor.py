@@ -29,6 +29,9 @@ class LightGBMRegressor(LightGBMParamsBase):
                                       is_valid, 1, init_score=init_score)
         return self._propagate_model_params(LightGBMRegressionModel(booster))
 
+    def _make_store_model(self, booster):
+        return self._propagate_model_params(LightGBMRegressionModel(booster))
+
 
 class LightGBMRegressionModel(LightGBMModelBase):
 

@@ -36,7 +36,8 @@ from .tracing import (EventLog, TRACE_HEADER, mint_trace_id,
                       trace_id_from_headers)
 from .bridge import (classify_probe_outcome, publish_bringup,
                      publish_checkpoint_event, publish_fit_metrics,
-                     publish_fit_timeline, publish_multichip_fit,
+                     publish_fit_timeline, publish_ingest_metrics,
+                     publish_ingest_verify_failure, publish_multichip_fit,
                      publish_probe_outcome, publish_rendezvous_event,
                      publish_stopwatch, set_hosts_alive)
 from .collector import REQUEST_SPANS, SYSTEM_SPANS, TraceCollector
@@ -48,7 +49,8 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
     "EventLog", "TRACE_HEADER", "mint_trace_id", "trace_id_from_headers",
     "classify_probe_outcome", "publish_bringup", "publish_checkpoint_event",
-    "publish_fit_metrics", "publish_fit_timeline", "publish_multichip_fit",
+    "publish_fit_metrics", "publish_fit_timeline", "publish_ingest_metrics",
+    "publish_ingest_verify_failure", "publish_multichip_fit",
     "publish_probe_outcome", "publish_rendezvous_event", "publish_stopwatch",
     "set_hosts_alive",
     "TraceCollector", "REQUEST_SPANS", "SYSTEM_SPANS",

@@ -24,7 +24,8 @@ __all__ = ["publish_stopwatch", "publish_fit_timeline",
            "classify_probe_outcome", "publish_probe_outcome",
            "publish_bringup", "publish_checkpoint_event",
            "publish_rendezvous_event", "set_hosts_alive",
-           "publish_vw_fused_decision", "publish_vw_step_metrics"]
+           "publish_vw_fused_decision", "publish_vw_step_metrics",
+           "publish_ingest_metrics", "publish_ingest_verify_failure"]
 
 #: bounded label vocabulary for rendezvous events — the raw error strings
 #: carry addresses/counts that must not become label cardinality
@@ -132,6 +133,57 @@ def publish_fit_timeline(summary: Dict[str, Any],
                           ).set(float(summary[src]))
     except Exception as e:  # noqa: BLE001 - telemetry must not fail the fit
         warnings.warn(f"publish_fit_timeline failed: {e}", stacklevel=2)
+
+
+#: per-block read->bin->dispatch spans: ~5 ms (small cached shards) to
+#: tens of seconds (cold NFS reads of multi-GB blocks)
+_INGEST_BLOCK_SECONDS_BUCKETS = (0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 30.0)
+
+
+def publish_ingest_metrics(rows: int, seconds: float,
+                           rss_bytes: Optional[int] = None,
+                           block_seconds: Optional[list] = None,
+                           registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    """One completed out-of-core ingest pass (io/shardstore
+    stream_fit_arrays): headline rows/s gauge, per-block duration
+    histogram, and the post-pass host RSS the bounded-memory contract
+    (docs/DATA.md) is judged by."""
+    reg = registry or get_registry()
+    try:
+        if seconds > 0:
+            reg.gauge("ingest_rows_per_s",
+                      "last out-of-core ingest throughput (rows/s, "
+                      "read->bin->device_put)").set(rows / seconds)
+        if rss_bytes is not None:
+            reg.gauge("ingest_rss_bytes",
+                      "host RSS sampled at the end of the last ingest "
+                      "pass (the docs/DATA.md bounded-memory contract)"
+                      ).set(float(rss_bytes))
+        if block_seconds:
+            h = reg.histogram("ingest_block_seconds",
+                              "per-block read->bin->dispatch span of the "
+                              "streaming ingest ring",
+                              buckets=_INGEST_BLOCK_SECONDS_BUCKETS)
+            for s in block_seconds:
+                h.observe(float(s))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail ingest
+        warnings.warn(f"publish_ingest_metrics failed: {e}", stacklevel=2)
+
+
+def publish_ingest_verify_failure(
+        registry: Optional[MetricsRegistry] = None) -> None:
+    """One shard sha256 verification failure (ShardStore.verify): silent
+    on-disk corruption must be a scrapeable event, never just a raised
+    exception someone's retry loop swallows."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("ingest_verify_failures_total",
+                    "shard sha256 mismatches found by ShardStore.verify"
+                    ).inc()
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail verify
+        warnings.warn(f"publish_ingest_verify_failure failed: {e}",
+                      stacklevel=2)
 
 
 def publish_fit_metrics(rows: int, iters: int, wall_s: float,

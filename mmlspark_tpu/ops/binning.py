@@ -213,6 +213,68 @@ class BinMapper:
                                            max_bins_by_feature),
                          categorical, fmin, fmax, missing)
 
+    @staticmethod
+    def fit_sampled(sample: np.ndarray, n_total: int, *,
+                    feature_min: Optional[np.ndarray],
+                    feature_max: Optional[np.ndarray],
+                    missing_any: Optional[np.ndarray],
+                    float_data: bool = True,
+                    max_bins: int = 255, sample_count: int = 200_000,
+                    seed: int = 0,
+                    categorical: Optional[Tuple[int, ...]] = None,
+                    max_bins_by_feature: Optional[np.ndarray] = None,
+                    use_missing: bool = True) -> "BinMapper":
+        """`fit` for out-of-core data: a gathered row sample plus exact
+        full-pass stats instead of the in-RAM matrix.
+
+        Bit-parity contract with `fit(X)` (pinned by the shard-store
+        digest tests): `sample` must be the rows `fit` would have drawn —
+        same seed/sample_count `rng.choice` indices (any row order: the
+        per-column sorts in compute_bin_edges erase it) — and the stats
+        must be full-pass exact: `feature_min`/`feature_max` combined per
+        block via np.fmin/np.fmax of nanmin/nanmax (== nanmin/nanmax of
+        the whole matrix, == min/max when NaN-free), `missing_any` the OR
+        of per-block `np.isnan(block).any(axis=0)`. The whole-matrix sum
+        probe `fit` uses is only a fast path around those same exact
+        scans, so feeding the exact values reproduces its output in every
+        case, including the ±inf false-positive one."""
+        sample = np.asarray(sample, dtype=np.float64)
+        if sample.shape[0] > sample_count:
+            # compute_bin_edges would RE-sample with fresh rng state and
+            # silently break parity with the in-memory fit
+            raise ValueError(
+                f"sample has {sample.shape[0]} rows > sample_count "
+                f"{sample_count}; gather at most sample_count rows")
+        f = sample.shape[1]
+        fmin = (np.asarray(feature_min, np.float64)
+                if feature_min is not None and n_total else None)
+        fmax = (np.asarray(feature_max, np.float64)
+                if feature_max is not None and n_total else None)
+        if categorical and fmax is not None:
+            for j in categorical:
+                top = fmax[j]
+                if top >= max_bins:
+                    import warnings
+                    warnings.warn(
+                        f"categorical feature {j} has {int(top) + 1} codes but "
+                        f"maxBin={max_bins}; codes >= {max_bins} are clipped "
+                        f"into one bin (raise maxBin to keep them distinct)")
+        missing = np.zeros(f, bool)
+        if use_missing and n_total and float_data and missing_any is not None:
+            missing = np.asarray(missing_any, bool).copy()
+            if categorical:
+                missing[list(categorical)] = False  # cats bin by code
+        if missing.any():
+            mbbf = (np.asarray(max_bins_by_feature, np.int64).copy()
+                    if max_bins_by_feature is not None
+                    else np.zeros(f, np.int64))
+            cap = np.where(mbbf > 0, np.minimum(mbbf, max_bins), max_bins)
+            max_bins_by_feature = np.where(missing,
+                                           np.maximum(cap - 1, 1), mbbf)
+        return BinMapper(compute_bin_edges(sample, max_bins, sample_count,
+                                           seed, max_bins_by_feature),
+                         categorical, fmin, fmax, missing)
+
     def transform(self, X: np.ndarray) -> np.ndarray:
         out = apply_bins(X, self.edges)
         X = np.asarray(X)

@@ -57,7 +57,7 @@ from .rendezvous import Heartbeater, RendezvousClient, _publish
 
 __all__ = ["MultihostTopology", "topology", "local_row_slices",
            "assemble_row_sharded", "zeros_row_sharded", "binned_to_device",
-           "connect", "MultihostSession"]
+           "store_binned_to_device", "connect", "MultihostSession"]
 
 
 class MultihostTopology(NamedTuple):
@@ -202,6 +202,20 @@ def binned_to_device(bm, x: np.ndarray, mesh, blk: Optional[int] = None,
                 bufs[di] = write(bufs[di], piece, jnp.int32(k0))
     return jax.make_array_from_single_device_arrays((n, fdim), sharding,
                                                     bufs)
+
+
+def store_binned_to_device(bm, store, mesh, blk: Optional[int] = None,
+                           ring_depth: int = 2, timeline=None):
+    """``binned_to_device`` fed from DISK: each host streams only the
+    shard byte ranges its row spans live in (per-host shard ownership —
+    rows another host owns are never read, let alone binned), through
+    the bounded prefetch ring of io/shardstore.py. Returns the same
+    (binned_global, aux) pair as ``shardstore.stream_fit_arrays``; thin
+    delegator (lazy import: parallel/ stays importable without io/)."""
+    from ..io import shardstore as sstore
+    return sstore.stream_fit_arrays(bm, store, mesh=mesh, blk=blk,
+                                    ring_depth=ring_depth,
+                                    timeline=timeline)
 
 
 # ----------------------------------------------------------------- bootstrap

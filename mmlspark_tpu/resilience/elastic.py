@@ -53,11 +53,16 @@ __all__ = [
     "CheckpointStore", "PreemptionDrain", "publish_event",
 ]
 
-#: manifest schema. v1: digest/payload/step/ndev/batch_index/extra. Bump on
-#: any field whose ABSENCE a reader cannot default (dart resume would be
-#: v2: it additionally needs the per-iteration dropout delta history —
-#: device training state the booster payload does not carry).
-SCHEMA_VERSION = 1
+#: manifest schema. v1: digest/payload/step/ndev/batch_index/extra.
+#: v2 (out-of-core data plane): + optional ``shard_cursor`` — the shard
+#: store identity (path/manifest_digest/shards/rows) the snapshot was
+#: trained against, so a resume can refuse a rewritten store. v1
+#: manifests restore fine (the cursor defaults to absent — a counted
+#: ``legacy_schema`` restore, not a failure). Bump again on any field
+#: whose ABSENCE a reader cannot default (dart resume would be v3: it
+#: additionally needs the per-iteration dropout delta history — device
+#: training state the booster payload does not carry).
+SCHEMA_VERSION = 2
 
 _SNAP_RE = re.compile(r"^snapshot_(\d{8})\.json$")
 
@@ -165,9 +170,13 @@ class CheckpointStore:
     # ---------------------------------------------------------------- save
     def save(self, payload: str, *, step: int, ndev: int,
              batch_index: int = 0,
-             extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+             extra: Optional[Dict[str, Any]] = None,
+             shard_cursor: Optional[Dict[str, Any]] = None
+             ) -> Dict[str, Any]:
         """Write one snapshot (payload then manifest, both atomic), then
-        apply keep-last-K retention. Returns the manifest dict."""
+        apply keep-last-K retention. Returns the manifest dict.
+        ``shard_cursor`` (schema v2, out-of-core fits) records the shard
+        store identity the snapshot trained on (ShardStore.cursor())."""
         t0 = time.perf_counter()
         data = payload.encode("utf-8")
         seqs = self.snapshot_seqs()
@@ -183,6 +192,8 @@ class CheckpointStore:
             "batch_index": int(batch_index),
             "extra": dict(extra or {}),
         }
+        if shard_cursor is not None:
+            manifest["shard_cursor"] = dict(shard_cursor)
         try:
             atomic_write_bytes(ppath, data)
             atomic_write_text(mpath, json.dumps(manifest, sort_keys=True))
@@ -237,7 +248,15 @@ class CheckpointStore:
                         if _digest(data) != manifest.get("digest"):
                             reason = "digest_mismatch"
             if reason is None:
-                _publish("restore", seconds=time.perf_counter() - t0)
+                legacy = int(manifest.get("schema_version", -1)) \
+                    < SCHEMA_VERSION
+                # an older-schema manifest restores fine (every v2 field
+                # is optional-with-default) but the downgrade is COUNTED:
+                # fleet telemetry sees how much of the fleet still runs
+                # pre-cursor snapshots
+                _publish("restore",
+                         outcome="legacy_schema" if legacy else "ok",
+                         seconds=time.perf_counter() - t0)
                 return data.decode("utf-8"), manifest
             import warnings
             warnings.warn(

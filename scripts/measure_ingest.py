@@ -1,0 +1,355 @@
+"""Measure the out-of-core training data plane (ISSUE 18, ROADMAP item 4).
+
+Armed in scripts/tpu_recovery_watch.sh. Two measurements:
+
+1. INGEST LADDER (single process): ``stream_fit_arrays`` rows/s over the
+   shard-size x ring-depth x ndev grid on a synthetic store, peak host
+   RSS sampled per cell (/proc VmHWM via shardstore.host_rss_bytes).
+   Rows append to docs/PERF_ingest.log; the run writes one summary JSON
+   (--out) whose table docs/PERF.md quotes.
+2. BIG FIT (--big, the acceptance run): a synthetic store too large to
+   ever materialize (written by a STREAMING generator — no full array
+   exists at any point) is fit on the VIRTUAL 2-host mesh (the
+   measure_podslice.py subprocess fabric: real rendezvous -> gated
+   jax.distributed init, each host streaming ONLY the shards its row
+   span lives in). Each worker asserts the RSS bound inline:
+
+       peak_rss - rss_before_fit
+           <= local_device_bytes                  (binned + y/w/t/mg;
+                                                   host RAM on the CPU
+                                                   backend, HBM on chip)
+            + rows_local * k * TRAIN_WS_BYTES_PER_ROW
+                                                  (boosting working set:
+                                                   scores/grads/hess +
+                                                   XLA per-iter temps —
+                                                   device memory too)
+            + RING_SLACK_FACTOR * ring_depth * shard_bytes
+            + FIXED_SLACK                         (XLA compile buffers)
+
+   i.e. bounded by DEVICE-RESIDENT state (input arrays + the training
+   program's working set, both O(rows_local)) + the prefetch ring —
+   never by the raw dataset bytes on disk or the TOTAL row count
+   (docs/DATA.md pins the contract). The
+   launcher also fits store-vs-in-memory at a size both routes can run
+   and requires bit-identical model strings (digest parity).
+
+CPU-mesh numbers validate the STRUCTURE (bounded RSS, parity, scaling
+shape), not absolute throughput — the chip run is armed in the watcher.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+LOG = os.path.join(os.path.dirname(__file__), "..", "docs",
+                   "PERF_ingest.log")
+
+#: big-fit problem shape: data-plane-bound on purpose (tiny trees, 16
+#: bins) — the measurement is ingest + bounded RSS, not split quality
+BIG_FEATURES, BIG_ITERS, BIG_LEAVES, BIG_BINS = 4, 2, 7, 16
+#: RSS-bound slack terms (the docs/DATA.md contract): ring buffers cycle
+#: through numpy staging + codec views + device_put landing copies, and
+#: XLA keeps compile-time buffers alive
+RING_SLACK_FACTOR = 4
+FIXED_SLACK_BYTES = 768 << 20
+#: boosting working set per LOCAL row per class: the training program's
+#: device memory (scores/grads/hess f32, scatter-hist index temporaries,
+#: XLA per-iteration buffers), which on the CPU backend is host RSS.
+#: Phase-decomposed measurement (20M rows, 8 devices, f=4/k=1): stream
+#: HWM 1192 MB vs fit HWM 3553 MB -> ~137 B/row of fit-phase transients;
+#: the 100M 2-host run lands ~147 B/row all-in. 160 covers both with
+#: margin while staying O(rows_local) — the bound NEVER scales with the
+#: total row count or raw dataset bytes on disk.
+TRAIN_WS_BYTES_PER_ROW = 160
+
+
+def _log(row):
+    line = json.dumps(row)
+    print(line, flush=True)
+    try:
+        with open(LOG, "a") as fh:
+            fh.write(line + "\n")
+    except OSError:
+        pass
+
+
+def write_synthetic(path, rows, features, rows_per_shard, seed=7,
+                    block_rows=1_000_000):
+    """Streaming synthetic writer: generates block_rows at a time into
+    ShardStoreWriter.append — peak RAM is O(block), never O(rows), so
+    the same generator writes the 100M-row store on a 16 GB host."""
+    import numpy as np
+    from mmlspark_tpu.io import shardstore as sstore
+    rng = np.random.default_rng(seed)
+    t0 = time.time()
+    with sstore.ShardStoreWriter(path, rows_per_shard) as w:
+        done = 0
+        while done < rows:
+            r = min(block_rows, rows - done)
+            x = rng.normal(size=(r, features)).astype(np.float32)
+            x[rng.random((r, features)) < 0.02] = np.nan
+            y = np.nan_to_num(x[:, 0] * 0.5 + x[:, -1]).astype(np.float64)
+            wgt = rng.uniform(0.5, 2.0, size=r).astype(np.float32)
+            w.append(x, y, wgt)
+            done += r
+    store = sstore.ShardStore(path)
+    return store, time.time() - t0
+
+
+def _store_row_bytes(store):
+    import numpy as np
+    return sum(np.dtype(c["dtype"]).itemsize
+               * (store.num_features if nm == "features" else 1)
+               for nm, c in store.columns.items())
+
+
+def rss_bound_bytes(store, rows_local, k, ring_depth):
+    """The docs/DATA.md bound for one host's fit-attributed RSS growth."""
+    shard_bytes = (max(int(s["rows"]) for s in store.shards)
+                   * _store_row_bytes(store))
+    device_local = rows_local * (store.num_features + 4 * 4 + 4 * k)
+    train_ws = rows_local * k * TRAIN_WS_BYTES_PER_ROW
+    return (device_local + train_ws
+            + RING_SLACK_FACTOR * ring_depth * shard_bytes
+            + FIXED_SLACK_BYTES)
+
+
+# ------------------------------------------------------------- big worker
+
+def worker(args) -> int:
+    """One host of the 2-host acceptance fit: rendezvous -> fit straight
+    from the store path -> inline RSS-bound assertion -> ROW on stdout."""
+    from mmlspark_tpu.io import shardstore as sstore
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+    from mmlspark_tpu.parallel import multihost as mh
+    from measure_podslice import _struct_digest
+
+    sess = mh.connect(args.coordinator, args.hosts, name=args.name,
+                      jax_port=args.jax_port or None, deadline_s=300.0,
+                      heartbeat_interval_s=1.0)
+    topo = sess.topology
+    store = sstore.ShardStore(args.store)
+    n = store.rows
+    rss0 = sstore.host_rss_bytes() or 0
+    reg = LightGBMRegressor(numIterations=BIG_ITERS, numLeaves=BIG_LEAVES,
+                            maxBin=BIG_BINS, numTasks=topo.devices,
+                            weightCol="w", histMethod="scatter")
+    t0 = time.time()
+    mdl = reg.fit(args.store)
+    wall = time.time() - t0
+    peak = sstore.host_rss_bytes(peak=True) or 0
+    rows_local = -(-n // topo.hosts)
+    bound = rss_bound_bytes(store, rows_local, 1, args.ring_depth)
+    grew = max(0, peak - rss0)
+    row = {"row": "bigfit", "hosts": topo.hosts, "ndev": topo.devices,
+           "process_id": topo.process_id, "n": n,
+           "features": store.num_features, "iters": BIG_ITERS,
+           "wall_s": round(wall, 1),
+           "rows_iter_per_s": round(n * BIG_ITERS / wall, 1),
+           "rss_before_mb": rss0 >> 20, "rss_peak_mb": peak >> 20,
+           "rss_grew_mb": grew >> 20, "rss_bound_mb": bound >> 20,
+           "rss_within_bound": bool(grew <= bound),
+           "digest": _struct_digest(mdl.booster.model_string())}
+    print("ROW " + json.dumps(row), flush=True)
+    sess.close()
+    # the acceptance assertion lives IN the harness: a worker whose RSS
+    # escaped the bound fails its rung, which fails the run
+    assert grew <= bound, (
+        f"host {topo.process_id}: fit-attributed RSS {grew >> 20} MB "
+        f"exceeds the bound {bound >> 20} MB "
+        f"(ring_depth={args.ring_depth})")
+    return 0
+
+
+def _launch_big(args):
+    from multihost_harness import free_port, launch_hosts
+    from mmlspark_tpu.parallel.rendezvous import RendezvousCoordinator
+    hosts = args.hosts
+    coord = RendezvousCoordinator(hosts, heartbeat_timeout_s=60.0).start()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={args.dph}"
+    ).strip()
+    try:
+        outs = launch_hosts(
+            [[sys.executable, "-u", os.path.abspath(__file__),
+              "--worker", "--coordinator", coord.address,
+              "--hosts", str(hosts), "--jax-port", str(free_port()),
+              "--name", f"vhost{i}", "--store", args.store,
+              "--ring-depth", str(args.ring_depth)]
+             for i in range(hosts)],
+            env, timeout_s=args.big_timeout_s,
+            per_worker_timeout_s=args.big_timeout_s)
+    finally:
+        coord.stop()
+    rows, digests = [], []
+    for rc, out, err in outs:
+        if rc != 0:
+            raise RuntimeError(
+                f"big-fit worker failed rc={rc}: {err[-1500:]}")
+        for line in out.splitlines():
+            if line.startswith("ROW "):
+                r = json.loads(line[4:])
+                digests.append(r["digest"])
+                rows.append(r)
+    if len(rows) != hosts:
+        raise RuntimeError(f"expected {hosts} worker rows, got {len(rows)}")
+    if len(set(digests)) != 1:
+        raise RuntimeError(f"hosts disagree on the fit digest: {digests}")
+    if not all(r["rss_within_bound"] for r in rows):
+        raise RuntimeError("a host escaped the RSS bound: "
+                           + json.dumps(rows))
+    return rows
+
+
+def _parity_check(tmp):
+    """Digest parity store-vs-memory at a size BOTH routes can run —
+    raw model_string equality, same gate as tests/test_shardstore.py."""
+    import numpy as np
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.io import shardstore as sstore
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+    rng = np.random.default_rng(3)
+    n = 60_003
+    x = rng.normal(size=(n, BIG_FEATURES)).astype(np.float32)
+    x[rng.random((n, BIG_FEATURES)) < 0.02] = np.nan
+    y = np.nan_to_num(x[:, 0]).astype(np.float64)
+    w = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    d = os.path.join(tmp, "parity")
+    sstore.write_store(d, x, y, weight=w, rows_per_shard=7_000)
+    kw = dict(numIterations=BIG_ITERS, numLeaves=BIG_LEAVES,
+              maxBin=BIG_BINS, numTasks=8, weightCol="w")
+    m_mem = LightGBMRegressor(**kw).fit(
+        DataFrame({"features": x, "label": y, "w": w}))
+    m_st = LightGBMRegressor(**kw).fit(d)
+    return m_mem.booster.model_string() == m_st.booster.model_string()
+
+
+# ---------------------------------------------------------------- ladder
+
+def run_ladder(args, tmp):
+    """stream_fit_arrays rows/s over shard-size x ring-depth x ndev,
+    single process (serial + sharded routes; the multi-host route is the
+    big fit's job)."""
+    import numpy as np  # noqa: F401 - jax init ordering
+    from mmlspark_tpu.io import shardstore as sstore
+    from mmlspark_tpu.parallel import mesh as meshlib
+    cells = []
+    for shard_rows in args.ladder_shard_rows:
+        d = os.path.join(tmp, f"ladder_{shard_rows}")
+        store, t_write = write_synthetic(
+            d, args.ladder_rows, args.ladder_features, shard_rows)
+        _log({"row": "store", "rows": store.rows,
+              "shards": len(store.shards), "rows_per_shard": shard_rows,
+              "write_s": round(t_write, 1),
+              "write_rows_per_s": round(store.rows / t_write, 1)})
+        bm = sstore.fit_bin_mapper(store, BIG_BINS, 200_000, 0)
+        for ndev in args.ladder_ndev:
+            mesh = None if ndev == 1 else meshlib.get_mesh(ndev)
+            for ring_depth in args.ladder_ring:
+                t0 = time.time()
+                binned, _aux = sstore.stream_fit_arrays(
+                    bm, store, mesh=mesh, ring_depth=ring_depth)
+                binned.block_until_ready()
+                wall = time.time() - t0
+                del binned, _aux
+                cell = {"row": "cell", "rows": store.rows,
+                        "rows_per_shard": shard_rows, "ndev": ndev,
+                        "ring_depth": ring_depth,
+                        "wall_s": round(wall, 2),
+                        "rows_per_s": round(store.rows / wall, 1),
+                        "rss_peak_mb":
+                            (sstore.host_rss_bytes(peak=True) or 0) >> 20}
+                _log(cell)
+                cells.append(cell)
+    return cells
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--coordinator", default="")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--jax-port", type=int, default=0)
+    ap.add_argument("--name", default="")
+    ap.add_argument("--store", default="",
+                    help="worker/big: shard-store directory")
+    ap.add_argument("--ring-depth", type=int, default=2)
+    ap.add_argument("--dph", type=int, default=8)
+    ap.add_argument("--big", action="store_true",
+                    help="run the big-fit acceptance rung")
+    ap.add_argument("--big-rows", type=int, default=100_000_000)
+    ap.add_argument("--big-shard-rows", type=int, default=2_000_000)
+    ap.add_argument("--big-timeout-s", type=float, default=3600.0)
+    ap.add_argument("--skip-ladder", action="store_true")
+    ap.add_argument("--ladder-rows", type=int, default=8_000_000)
+    ap.add_argument("--ladder-features", type=int, default=8)
+    ap.add_argument("--ladder-shard-rows", type=int, nargs="+",
+                    default=[500_000, 2_000_000])
+    ap.add_argument("--ladder-ring", type=int, nargs="+", default=[1, 2, 4])
+    ap.add_argument("--ladder-ndev", type=int, nargs="+", default=[1, 8])
+    ap.add_argument("--tmp", default="",
+                    help="scratch dir for synthetic stores (NOT cleaned "
+                         "when given; default: a fresh TemporaryDirectory)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "docs", "INGEST_cpu.json"))
+    args = ap.parse_args()
+    if args.worker:
+        sys.exit(worker(args))
+
+    import tempfile
+    ctx = (tempfile.TemporaryDirectory() if not args.tmp else None)
+    tmp = ctx.name if ctx else args.tmp
+    if args.tmp:
+        os.makedirs(tmp, exist_ok=True)
+    summary = {"dph": args.dph, "cells": [], "bigfit": None,
+               "digest_parity_small": None}
+    _log({"row": "start", "big": bool(args.big),
+          "ladder_rows": args.ladder_rows,
+          "start": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())})
+    try:
+        if not args.skip_ladder:
+            summary["cells"] = run_ladder(args, tmp)
+        summary["digest_parity_small"] = bool(_parity_check(tmp))
+        _log({"row": "parity",
+              "digest_parity_small": summary["digest_parity_small"]})
+        if args.big:
+            big_dir = os.path.join(tmp, "big")
+            store, t_write = write_synthetic(
+                big_dir, args.big_rows, BIG_FEATURES, args.big_shard_rows)
+            _log({"row": "store", "rows": store.rows,
+                  "shards": len(store.shards), "write_s": round(t_write, 1),
+                  "write_rows_per_s": round(store.rows / t_write, 1)})
+            rows = _launch_big(argparse.Namespace(
+                hosts=args.hosts, dph=args.dph, store=big_dir,
+                ring_depth=args.ring_depth,
+                big_timeout_s=args.big_timeout_s))
+            for r in rows:
+                _log(r)
+            summary["bigfit"] = rows
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+    ok = summary["digest_parity_small"] and (
+        not args.big or (summary["bigfit"] is not None
+                         and all(r["rss_within_bound"]
+                                 for r in summary["bigfit"])))
+    out = os.path.abspath(args.out)
+    with open(out, "w") as fh:
+        json.dump(summary, fh, indent=1)
+    _log({"row": "summary", "out": out, "ok": bool(ok)})
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

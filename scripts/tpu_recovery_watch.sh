@@ -56,6 +56,11 @@ EOF
     # per-host workers; from one host this measures what the grant allows
     # and logs fenced per-rung errors for the rest (docs/MULTIHOST.md)
     run python -u scripts/measure_podslice.py --ladder 1,2,4 --out docs/PODSLICE_chip.json
+    echo "== out-of-core ingest ladder + bounded-RSS big fit (round-17 tentpole) $(date -u +%FT%TZ)"
+    # shard-size x ring-depth x ndev rows/s grid, then the 100M-row
+    # streaming fit with the per-host RSS bound asserted in-harness
+    # (docs/DATA.md contract); scratch stores live on local disk
+    run python -u scripts/measure_ingest.py --big --tmp /tmp/ingest_chip --out docs/INGEST_chip.json
     if ! run python -u scripts/quick_fit_probe.py; then
       echo "== quick fit probe FAILED $(date -u +%FT%TZ); back to probing"
       sleep 120

@@ -129,7 +129,7 @@ class TestCheckpointStore:
         store = self._fill(tmp_path)
         payload, man = store.restore()
         assert payload == "payload-2"
-        assert man["schema_version"] == 1
+        assert man["schema_version"] == 2
         assert man["digest"].startswith("sha256:")
         assert man["step"] == 9 and man["ndev"] == 8
         assert man["batch_index"] == 0

@@ -278,6 +278,12 @@ class TestSyncPointLint:
         # _fetch_metrics_host / flush / state (the designated commit and
         # metrics points, deliberately NOT listed here)
         ("mmlspark_tpu.models.vw.online", ("submit", "_dispatch")),
+        # the out-of-core ingest ring (ISSUE 18): disk -> bin ->
+        # device_put streaming carries the same discipline — the hot
+        # path may never block on a device value
+        ("mmlspark_tpu.io.shardstore",
+         ("stream_fit_arrays", "_stream_serial", "_stream_sharded",
+          "_stream_multihost")),
     )
     #: nested defs that ARE the designated sync points
     DESIGNATED = {"_fetch_chunk_host", "_finalize_chunks"}

@@ -742,7 +742,8 @@ class TestMetricsNamingLint:
     #: deliberate act: add the area HERE and to docs/OBSERVABILITY.md.
     AREAS = {"serving", "gateway", "autoscaler", "chaos", "bringup",
              "checkpoint", "compile", "gbdt", "fit", "http", "model",
-             "tracing", "slo", "collector", "incident", "multihost", "vw"}
+             "tracing", "slo", "collector", "incident", "multihost", "vw",
+             "ingest"}
     NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
     HIST_UNITS = ("_seconds", "_rows", "_bytes")
     #: call sites building the family name dynamically (f-strings) —

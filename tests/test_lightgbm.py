@@ -298,7 +298,7 @@ class TestClassifier:
         store = CheckpointStore(ck)
         restored = store.restore()
         assert restored is not None
-        assert restored[1]["schema_version"] == 1
+        assert restored[1]["schema_version"] == 2
         m = LightGBMClassifier(numIterations=12, numLeaves=7, seed=5,
                                numTasks=1, itersPerCall=3,
                                checkpointDir=ck).fit(binary_df)
