@@ -136,6 +136,28 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
                 break
     except Exception as e:  # noqa: BLE001
         extra.setdefault("ingest_error", str(e)[:200])
+    # Train-on-traffic loop provenance (ISSUE-19): the most recent online
+    # loop summaries (scripts/measure_online_loop.py) ride in the record —
+    # chip run preferred, CPU-host run otherwise; the chaos record carries
+    # the zero-loss / digest-parity / exact-reconciliation verdicts and
+    # pointers to the per-fault-class incident bundles.
+    _online = {}
+    try:
+        for _key, _names in (
+                ("loop", ("ONLINE_loop_chip.json", "ONLINE_loop.json")),
+                ("chaos", ("ONLINE_chaos_chip.json", "ONLINE_chaos.json"))):
+            for _fn in _names:
+                _lp = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "docs", _fn)
+                if os.path.exists(_lp):
+                    with open(_lp) as _f:
+                        _online[_key] = json.load(_f)
+                    break
+        if _online:
+            extra.setdefault("online_loop", _online)
+    except Exception as e:  # noqa: BLE001
+        extra.setdefault("online_loop_error", str(e)[:200])
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]

@@ -1,0 +1,141 @@
+"""Train-on-traffic walkthrough — the reference's online VW flow
+(VowpalWabbit.scala incremental passes + the serving sources), closed
+into a loop: served predictions come back as delayed rewards, an
+exactly-once joiner turns the at-least-once event log into training
+examples, and the learner snapshots/publishes at deterministic joined
+ordinals (docs/ONLINE.md).
+
+Setup: a linear environment with hidden weights. Each round logs a
+prediction event (the features the policy served) and, some delay
+later, a reward event with the observed cost. The merged log is the
+ONLY input — the loop must recover the supervised stream from it.
+
+Flow: event log -> RewardJoiner -> OnlineLearnerRunner (snapshot every
+100 joins, publish every 200 through the holdout gate) -> ModelRegistry
+version trail. A fault injector kills the learner at a snapshot
+boundary mid-run; the resumed runner restores {learner, joiner, cursor}
+and must end bit-identical to an uninterrupted offline replay of the
+same log. The registry's version trail doubles as the accuracy
+trajectory: each published model is scored against the hidden weights,
+and the MSE must fall as traffic accumulates.
+"""
+import os
+import random
+import tempfile
+
+import numpy as np
+
+NUM_FEATURES = 32
+ROW_W = 4
+
+
+def simulate(log_path, n_rounds=3000, seed=5):
+    """Write the merged prediction/reward event log. Rewards trail
+    their predictions by 5..100 logical ticks, so the stream the joiner
+    sees is heavily interleaved and out of order relative to the pairs."""
+    from mmlspark_tpu.io.streaming import append_jsonl
+    rng = random.Random(seed)
+    true_w = [rng.uniform(-1.0, 1.0) for _ in range(NUM_FEATURES)]
+    events = []
+    for i in range(n_rounds):
+        ts = i * 0.01
+        indices = sorted(rng.sample(range(NUM_FEATURES), ROW_W))
+        events.append((ts, 0, {
+            "kind": "prediction", "key": f"r{i:06d}", "ts": ts,
+            "indices": indices, "values": [1.0] * ROW_W,
+            "probability": 1.0}))
+        cost = sum(true_w[j] for j in indices) + rng.gauss(0.0, 0.05)
+        rts = ts + rng.uniform(0.05, 1.0)
+        events.append((rts, 1, {"kind": "reward", "key": f"r{i:06d}",
+                                "ts": rts, "cost": cost}))
+    for _, _, ev in sorted(events, key=lambda e: (e[0], e[1])):
+        append_jsonl(log_path, ev)
+    return true_w
+
+
+def eval_mse(state, true_w, n=512, seed=11):
+    """Score a published state against the hidden environment weights
+    on a fresh design — the accuracy the serving fleet would see."""
+    rng = random.Random(seed)
+    w = np.asarray(state.w, np.float32).ravel()[:NUM_FEATURES]
+    b = float(np.asarray(state.bias))
+    err = 0.0
+    for _ in range(n):
+        idx = rng.sample(range(NUM_FEATURES), ROW_W)
+        y = sum(true_w[j] for j in idx)
+        err += (sum(float(w[j]) for j in idx) + b - y) ** 2
+    return err / n
+
+
+def main(n_rounds=3000):
+    from mmlspark_tpu.io.registry import ModelRegistry
+    from mmlspark_tpu.io.streaming import JsonlEventSource
+    from mmlspark_tpu.models.vw import VowpalWabbitRegressor
+    from mmlspark_tpu.models.vw.sgd import state_from_bytes
+    from mmlspark_tpu.resilience import CheckpointStore
+    from mmlspark_tpu.resilience.chaos import (InjectedKill,
+                                               TrainingFaultInjector)
+    from mmlspark_tpu.train.online_loop import (ModelPublisher,
+                                                OnlineLearnerRunner,
+                                                offline_replay)
+
+    with tempfile.TemporaryDirectory() as work:
+        log_path = os.path.join(work, "events.jsonl")
+        true_w = simulate(log_path, n_rounds)
+        registry = ModelRegistry(os.path.join(work, "registry"))
+        store = CheckpointStore(os.path.join(work, "ckpt"), keep_last=4)
+        injector = TrainingFaultInjector(seed=0, kill_at_chunk=4)
+
+        trail = []                         # (version, mse) at publish time
+
+        def score_published(version):      # the publish leg's rollout hook
+            vdir, _ = registry.resolve(version)
+            with open(os.path.join(vdir, "weights.npz"), "rb") as fh:
+                trail.append((version,
+                              eval_mse(state_from_bytes(fh.read()), true_w)))
+
+        def mk_runner():
+            runner = OnlineLearnerRunner(
+                VowpalWabbitRegressor(numBits=5),
+                JsonlEventSource(log_path), row_width=ROW_W,
+                store=store, horizon_s=30.0,
+                snapshot_every=100, publish_every=200, holdout_every=10,
+                publisher=ModelPublisher(registry, set_current=True,
+                                         rollout_fn=score_published))
+            injector.arm(runner)
+            return runner
+
+        runner, kills = mk_runner(), 0
+        while True:
+            try:
+                runner.run(idle_limit=3)
+                break
+            except InjectedKill as exc:   # preemption at a snapshot
+                kills += 1                # boundary: snapshot already
+                print(f"  kill: {exc}")   # durable, resume and re-read
+                runner = mk_runner()      # from the committed cursor
+        final_state, digest = runner.finalize()
+
+        # parity proof: the killed-and-resumed learner must be
+        # bit-identical to an uninterrupted replay of the same log
+        oracle = offline_replay(
+            VowpalWabbitRegressor(numBits=5), JsonlEventSource(log_path),
+            row_width=ROW_W, horizon_s=30.0, snapshot_every=100,
+            holdout_every=10)
+        assert digest == oracle, (digest, oracle)
+
+        counts = runner.counts
+        print(f"{n_rounds} rounds -> joined {counts['joined']} "
+              f"(held out {counts['held_out']}), {kills} injected kill(s), "
+              f"{counts['resumes']} resume(s), digest parity ok")
+        print("published MSE trail: " +
+              " -> ".join(f"v{v} {m:.4f}" for v, m in trail))
+        first, last = trail[0][1], trail[-1][1]
+        print(f"accuracy improved {first:.4f} -> {last:.4f} "
+              f"({first / max(last, 1e-9):.0f}x)")
+        return (digest == oracle and kills >= 1
+                and last < first * 0.1)
+
+
+if __name__ == "__main__":
+    assert main()

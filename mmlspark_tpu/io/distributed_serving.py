@@ -75,7 +75,7 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..observability import (EventLog, SLOMonitor, TRACE_HEADER,
                              get_registry, mint_trace_id,
@@ -330,6 +330,12 @@ class ServingCoordinator:
         else:
             self.slo = slo_monitor
         self.slo_rollout_gate = bool(slo_rollout_gate)
+        # pluggable rollout gates (ISSUE 19): callables consulted each
+        # rollout_tick; a non-None return is a breach reason that rolls
+        # active rollouts back — how the online loop's held-out regret
+        # gate (train/online_loop.py HoldoutGate) vetoes a worse model
+        # the same way a corrupt artifact or SLO burn does
+        self._rollout_monitors: List[Callable[[], Optional[str]]] = []
         lbl = {"instance": self.metrics_label}
         self._m = {
             "forwards": self.registry.counter(
@@ -689,15 +695,40 @@ class ServingCoordinator:
                      ).get("model_version") == target for s in lst):
                 self._set_rollout_state_locked(name, ro, "done", None)
 
+    def add_rollout_monitor(
+            self, fn: "Callable[[], Optional[str]]") -> None:
+        """Register an external rollout gate: ``fn()`` is consulted on
+        every `rollout_tick` (outside the coordinator lock — monitors may
+        hold their own) and a non-None return is a breach reason that
+        rolls every active rollout back. The online loop's held-out
+        regression gate plugs in here."""
+        with self._lock:
+            self._rollout_monitors.append(fn)
+
     def rollout_tick(self) -> None:
         """Clock-driven rollout checks the beat-driven observer cannot
         make: overall timeout, canary loss (killed mid-swap and evicted
-        by the heartbeat monitor), and — when `slo_rollout_gate` is on —
-        an SLO burning on both windows. Runs on the monitor loop's
-        cadence; tests call it directly."""
+        by the heartbeat monitor), an SLO burning on both windows (when
+        `slo_rollout_gate` is on), and any registered rollout monitor
+        reporting a breach. Runs on the monitor loop's cadence; tests
+        call it directly."""
         now = time.monotonic()
         slo_breach = (self.slo_rollout_gate and self.slo is not None
                       and self.slo.breached())
+        monitor_breach: Optional[str] = None
+        with self._lock:
+            monitors = list(self._rollout_monitors)
+            active = any(ro["state"] in ("canary", "promoting")
+                         for ro in self._rollouts.values())
+        if active:
+            for mon in monitors:
+                try:
+                    monitor_breach = mon()
+                except Exception as exc:  # noqa: BLE001 - a crashing gate
+                    # must fail SAFE (veto), never wedge the rollout loop
+                    monitor_breach = f"rollout monitor error: {exc!r}"
+                if monitor_breach:
+                    break
         with self._lock:
             for name, ro in self._rollouts.items():
                 if ro["state"] not in ("canary", "promoting"):
@@ -708,6 +739,10 @@ class ServingCoordinator:
                     self._set_rollout_state_locked(
                         name, ro, "rolled_back",
                         "slo burn-rate breach (slo_rollout_gate)")
+                    continue
+                if monitor_breach:
+                    self._set_rollout_state_locked(
+                        name, ro, "rolled_back", monitor_breach)
                     continue
                 if now - ro["started_s"] > self.rollout_timeout_s:
                     self._set_rollout_state_locked(

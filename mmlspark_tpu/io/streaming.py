@@ -14,6 +14,18 @@ so a crash between sink and commit replays that batch (exactly Spark's
 file-source + checkpoint contract). Batches feed one jitted transform per
 tick, which is the TPU-friendly shape: few large device calls, not per-file
 work.
+
+Round 19 (train-on-traffic loop): replayable sources carry a DURABLE
+cursor — offsets persist through the PR 10 atomic-write helper, so a
+crash can never leave a torn offset file that silently re-delivers (or
+drops) a committed batch at the restart boundary. `JsonlEventSource` is
+the loop's record-granular source: an append-only JSONL event log read
+incrementally with a byte-offset cursor that supports `seek()` — the
+primitive the online loop's preempt-resume proof rewinds (a snapshot
+stores the cursor; resume re-reads exactly the events after it).
+Replay is deterministic: ordering comes from file position (and, for
+FileStreamSource, the (mtime, name) sort), never from the wall clock —
+a seeded harness replays the identical sequence.
 """
 
 from __future__ import annotations
@@ -23,11 +35,12 @@ import json
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..core.dataframe import DataFrame
+from ..resilience.elastic import atomic_write_text
 from .files import decode_image
 
 
@@ -84,15 +97,25 @@ class FileStreamSource:
         """Mark the in-flight batch's files consumed and persist the offset
         watermark (the Spark offset-log commit). Call AFTER the sink has
         consumed the batch => at-least-once delivery: if the sink raises, the
-        files stay un-seen and the next read_batch replays them."""
+        files stay un-seen and the next read_batch replays them.
+
+        Ordering matters at the restart boundary (ISSUE 19): the offsets
+        file is written BEFORE the in-memory promotion, through the PR 10
+        atomic-write helper. The pre-19 code mutated ``_seen`` first and
+        wrote a bare temp+rename; a crash between the two left the disk
+        watermark BEHIND the in-memory one inside the same process run —
+        harmless alone, but combined with an in-process restart
+        (re-instantiating the source over the same checkpoint dir, the
+        elastic-resume shape) the stale disk state re-delivered committed
+        batches. Durable-then-promote makes restart replay exact."""
+        if self.checkpoint_dir:
+            merged = dict(self._seen)
+            merged.update(self._pending)
+            atomic_write_text(
+                self._offsets_file(),
+                json.dumps({"batch_id": self._batch_id, "seen": merged}))
         self._seen.update(self._pending)
         self._pending = {}
-        if not self.checkpoint_dir:
-            return
-        tmp = self._offsets_file() + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump({"batch_id": self._batch_id, "seen": self._seen}, fh)
-        os.replace(tmp, self._offsets_file())  # atomic on POSIX
 
     # ------------------------------------------------------------ discovery
     def _discover(self) -> List[str]:
@@ -262,3 +285,133 @@ class StreamingQuery:
                 return True
             time.sleep(0.02)
         return False
+
+
+# ------------------------------------------------ replayable event source
+
+class JsonlEventSource:
+    """Record-granular replayable source over an append-only JSONL log.
+
+    The train-on-traffic loop's ingest primitive (ISSUE 19): one event
+    per line, read incrementally with an explicit BYTE-OFFSET cursor.
+    Three properties the loop's exactly-once proof rests on:
+
+    - **Replayable**: ``seek(cursor)`` rewinds to any previously returned
+      cursor; re-reading yields the identical record sequence (ordering
+      is file position, never wall clock — deterministic under any
+      seeded harness clock).
+    - **Durable**: ``commit(cursor)`` persists the position through the
+      PR 10 atomic-write helper; a new source over the same
+      ``checkpoint_dir`` resumes exactly there. A torn cursor file is
+      impossible (atomic rename), and an UNREADABLE one degrades to
+      offset 0 — replay, never a drop (at-least-once posture; the
+      consumer's dedup makes it exactly-once).
+    - **Torn-tail safe**: a partially appended last line (no trailing
+      newline yet, or mid-write JSON) is left un-consumed — the cursor
+      never advances past it, so the writer finishing the line makes it
+      readable, and a crashed writer's torn tail is skipped once a later
+      complete line follows (counted ``online_events_total{kind=torn}``
+      via the consumer's refusal vocabulary is NOT used here: a torn
+      line is an ingest artifact, surfaced on ``torn_lines``).
+
+    Writers append whole lines (``append_jsonl`` below or any
+    line-buffered appender); multi-writer interleaving is out of scope —
+    one log per producing process, like a Kafka partition.
+    """
+
+    def __init__(self, path: str, checkpoint_dir: Optional[str] = None):
+        self.path = path
+        self.checkpoint_dir = checkpoint_dir
+        self._offset = 0
+        self.records_read = 0
+        self.torn_lines = 0
+        if checkpoint_dir:
+            self._restore()
+
+    # ------------------------------------------------------------- cursor
+    def _cursor_file(self) -> str:
+        return os.path.join(self.checkpoint_dir, "cursor.json")
+
+    def _restore(self) -> None:
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        try:
+            with open(self._cursor_file(), encoding="utf-8") as fh:
+                self._offset = int(json.load(fh)["offset"])
+        except (OSError, ValueError, KeyError, TypeError):
+            self._offset = 0  # unreadable cursor => replay, never drop
+
+    def cursor(self) -> Dict[str, Any]:
+        """Opaque-but-JSON position token: everything consumed so far."""
+        return {"offset": self._offset}
+
+    def seek(self, cursor: Dict[str, Any]) -> None:
+        """Rewind/advance to a cursor previously returned by `cursor()`
+        (the online loop's resume: its snapshot stores the cursor its
+        learner state corresponds to)."""
+        off = int(cursor["offset"])
+        if off < 0:
+            raise ValueError(f"cursor offset must be >= 0, got {off}")
+        self._offset = off
+
+    def commit(self, cursor: Optional[Dict[str, Any]] = None) -> None:
+        """Persist the cursor (default: current position) durably."""
+        if not self.checkpoint_dir:
+            return
+        off = self._offset if cursor is None else int(cursor["offset"])
+        atomic_write_text(self._cursor_file(),
+                          json.dumps({"offset": off}))
+
+    # --------------------------------------------------------------- read
+    def read(self, max_records: int = 1024) -> List[Dict[str, Any]]:
+        """Up to `max_records` complete records after the cursor; advances
+        the in-memory cursor past exactly the records returned (plus any
+        torn line that a later complete line proves abandoned)."""
+        out: List[Dict[str, Any]] = []
+        try:
+            fh = open(self.path, "rb")
+        except OSError:
+            return out
+        with fh:
+            fh.seek(self._offset)
+            while len(out) < max_records:
+                line_start = fh.tell()
+                line = fh.readline()
+                if not line:
+                    break
+                if not line.endswith(b"\n"):
+                    # torn tail: writer mid-append — do not consume
+                    break
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # a torn line the writer abandoned (crash mid-append,
+                    # then a later append started a fresh line): skip it,
+                    # counted — never silently re-deliver forever
+                    self.torn_lines += 1
+                    self._offset = fh.tell()
+                    continue
+                if not isinstance(rec, dict):
+                    self.torn_lines += 1
+                    self._offset = fh.tell()
+                    continue
+                rec["_offset"] = line_start
+                # the cursor a consumer must store to mark THIS record
+                # consumed: the loop snapshots mid-read-batch, so the
+                # batch-level `cursor()` is too coarse for exactly-once
+                rec["_next_offset"] = fh.tell()
+                out.append(rec)
+                self._offset = fh.tell()
+        self.records_read += len(out)
+        return out
+
+
+def append_jsonl(path: str, record: Dict[str, Any]) -> None:
+    """Append one event as a single line (the producing side of
+    `JsonlEventSource`). O_APPEND single-write keeps lines atomic for
+    same-filesystem readers up to PIPE_BUF-scale records."""
+    line = (json.dumps(record, sort_keys=True) + "\n").encode("utf-8")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line)
+    finally:
+        os.close(fd)

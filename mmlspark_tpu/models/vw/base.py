@@ -525,11 +525,18 @@ class VowpalWabbitBase(VowpalWabbitParamsBase, Estimator):
         (indices, values, labels[, weights]) rows as they arrive, then
         `finalize_online(ring)` for the fitted model. Ring knobs
         (depth, width, clock, registry, donate) pass through; the
-        telemetry cadence defaults to this estimator's metricsEvery."""
+        telemetry cadence defaults to this estimator's metricsEvery.
+        Pass ``state=`` (a restored VWState) to resume a prior learner
+        instead of starting fresh — the online loop's preempt-resume
+        path (train/online_loop.py). Explicit ``is None`` check: VWState
+        is a NamedTuple of arrays, so its truthiness is ambiguous."""
         from .online import VWOnlineRing
         cfg = self._online_config()
+        state = ring_kw.pop("state", None)
+        if state is None:
+            state = self._initial_state(cfg.num_features)
         ring_kw.setdefault("metrics_every", int(self.get("metricsEvery")))
-        return VWOnlineRing(cfg, self._initial_state(cfg.num_features),
+        return VWOnlineRing(cfg, state,
                             label_transform=self._online_label_transform(),
                             **ring_kw)
 

@@ -467,3 +467,43 @@ def pad_examples(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     return (pad(indices, 0), pad(values, 0.0),
             pad(labels, 1.0 if labels.dtype.kind == "f" else 0),
             pad(weights, 0.0))
+
+
+# ------------------------------------------------- durable state (ISSUE 19)
+
+#: VWState fields in canonical digest/serialization order — a NamedTuple's
+#: field order IS this order, pinned here so a reordering refactor cannot
+#: silently change every stored digest
+STATE_FIELDS = ("w", "g2", "scale", "bias", "bias_g2", "t")
+
+
+def state_to_bytes(state: VWState) -> bytes:
+    """Serialize a VWState to portable npz bytes (the online loop's
+    checkpoint payload — host numpy, device-count independent)."""
+    import io
+    buf = io.BytesIO()
+    np.savez(buf, **{f: np.asarray(getattr(state, f))
+                     for f in STATE_FIELDS})
+    return buf.getvalue()
+
+
+def state_from_bytes(data: bytes) -> VWState:
+    """Inverse of `state_to_bytes`; arrays land back on the default
+    device lazily at the first step that consumes them."""
+    import io
+    with np.load(io.BytesIO(data)) as z:
+        return VWState(**{f: jnp.asarray(z[f]) for f in STATE_FIELDS})
+
+
+def state_digest(state: VWState) -> str:
+    """sha256 over the canonical field bytes — the exactly-once proof's
+    currency: two learners that applied the same rewards in the same
+    minibatch grouping have equal digests (bit-identical float32 state)."""
+    import hashlib
+    h = hashlib.sha256()
+    for f in STATE_FIELDS:
+        a = np.ascontiguousarray(np.asarray(getattr(state, f)))
+        h.update(f.encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return "sha256:" + h.hexdigest()

@@ -25,7 +25,9 @@ __all__ = ["publish_stopwatch", "publish_fit_timeline",
            "publish_bringup", "publish_checkpoint_event",
            "publish_rendezvous_event", "set_hosts_alive",
            "publish_vw_fused_decision", "publish_vw_step_metrics",
-           "publish_ingest_metrics", "publish_ingest_verify_failure"]
+           "publish_ingest_metrics", "publish_ingest_verify_failure",
+           "publish_online_event", "publish_online_refusal",
+           "publish_online_apply", "publish_online_publish"]
 
 #: bounded label vocabulary for rendezvous events — the raw error strings
 #: carry addresses/counts that must not become label cardinality
@@ -389,3 +391,109 @@ def publish_bringup(attempts: list, healthy: bool, window_s: float,
                   ).set(len(attempts))
     except Exception as e:  # noqa: BLE001 - telemetry must not fail bring-up
         warnings.warn(f"publish_bringup failed: {e}", stacklevel=2)
+
+
+#: bounded label vocabularies for the train-on-traffic loop (ISSUE 19) —
+#: mirrors resilience/rewardjoin.REFUSAL_REASONS (hardcoded here because
+#: resilience already imports observability; the naming-lint test
+#: asserts the two tuples stay identical)
+_ONLINE_EVENT_KINDS = ("prediction", "reward")
+_ONLINE_REFUSAL_REASONS = ("duplicate", "duplicate_prediction", "expired",
+                           "unknown_key", "reward_timeout", "malformed")
+_ONLINE_PUBLISH_OUTCOMES = ("published", "gate_refused", "error",
+                            "rolled_back")
+#: reward-to-applied lag spans the join horizon (sub-second synthetic
+#: streams to minutes of real conversion delay)
+_ONLINE_LAG_SECONDS_BUCKETS = (0.01, 0.05, 0.2, 1.0, 5.0, 30.0, 120.0,
+                               600.0)
+_ONLINE_SWAP_SECONDS_BUCKETS = (0.01, 0.05, 0.2, 1.0, 5.0, 30.0)
+
+
+def publish_online_event(kind: str,
+                         registry: Optional[MetricsRegistry] = None
+                         ) -> None:
+    """One ingested loop event (resilience/rewardjoin.py) -> bounded
+    counter. Called from the joiner's ingest path, which is host-side
+    dict work — no device sync to add."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("online_events_total",
+                    "train-on-traffic loop events ingested by kind",
+                    labels={"kind": kind if kind in _ONLINE_EVENT_KINDS
+                            else "other"}).inc()
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the loop
+        warnings.warn(f"publish_online_event failed: {e}", stacklevel=2)
+
+
+def publish_online_refusal(reason: str,
+                           registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    """One refused/evicted join (the exactly-once contract's counted
+    refusal vocabulary, docs/ONLINE.md) -> bounded counter."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("online_join_refusals_total",
+                    "reward-join refusals and evictions by reason",
+                    labels={"reason": reason
+                            if reason in _ONLINE_REFUSAL_REASONS
+                            else "other"}).inc()
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the loop
+        warnings.warn(f"publish_online_refusal failed: {e}", stacklevel=2)
+
+
+def publish_online_apply(applied: int,
+                         reward_lag_s=None,
+                         examples_per_s: Optional[float] = None,
+                         pending_keys: Optional[int] = None,
+                         registry: Optional[MetricsRegistry] = None
+                         ) -> None:
+    """Joined-examples-applied telemetry, published from the loop's
+    designated commit points (never per example): the applied counter,
+    per-example reward->applied lag observations, headline loop
+    throughput, and the join-buffer occupancy gauge."""
+    reg = registry or get_registry()
+    try:
+        if applied:
+            reg.counter("online_applied_examples_total",
+                        "joined examples applied to the online learner"
+                        ).inc(int(applied))
+        if reward_lag_s:
+            h = reg.histogram("online_reward_lag_seconds",
+                              "reward event to learner-applied latency",
+                              buckets=_ONLINE_LAG_SECONDS_BUCKETS)
+            for lag in reward_lag_s:
+                h.observe(float(lag))
+        if examples_per_s is not None:
+            reg.gauge("online_examples_per_s",
+                      "train-on-traffic loop applied-example throughput"
+                      ).set(float(examples_per_s))
+        if pending_keys is not None:
+            reg.gauge("online_pending_keys",
+                      "reward-join buffer occupancy (pending predictions"
+                      " + held out-of-order rewards)"
+                      ).set(float(pending_keys))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the loop
+        warnings.warn(f"publish_online_apply failed: {e}", stacklevel=2)
+
+
+def publish_online_publish(outcome: str,
+                           swap_seconds: Optional[float] = None,
+                           registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    """One publish-leg attempt (train/online_loop.py ModelPublisher):
+    outcome counter + the update->publish->swap latency histogram when
+    the publish went out."""
+    reg = registry or get_registry()
+    try:
+        reg.counter("online_publish_total",
+                    "online-loop model publish attempts by outcome",
+                    labels={"outcome": outcome
+                            if outcome in _ONLINE_PUBLISH_OUTCOMES
+                            else "other"}).inc()
+        if swap_seconds is not None:
+            reg.histogram("online_publish_swap_seconds",
+                          "learner finalize to registry-publish latency",
+                          buckets=_ONLINE_SWAP_SECONDS_BUCKETS
+                          ).observe(float(swap_seconds))
+    except Exception as e:  # noqa: BLE001 - telemetry must not fail the loop
+        warnings.warn(f"publish_online_publish failed: {e}", stacklevel=2)

@@ -13,6 +13,7 @@ from .policy import (Attempt, Deadline, DeadlineExceeded, RetryError,
 from .chaos import (FaultInjector, InjectedDrop, InjectedFault, InjectedKill,
                     TrainingFaultInjector)
 from .bringup import backend_bringup
+from .rewardjoin import RewardJoiner, REFUSAL_REASONS
 from .elastic import (CheckpointStore, Preempted, PreemptionDrain,
                       atomic_write_bytes, atomic_write_text)
 
@@ -22,6 +23,7 @@ __all__ = [
     "FaultInjector", "InjectedDrop", "InjectedFault", "InjectedKill",
     "TrainingFaultInjector",
     "backend_bringup",
+    "RewardJoiner", "REFUSAL_REASONS",
     "CheckpointStore", "Preempted", "PreemptionDrain",
     "atomic_write_bytes", "atomic_write_text",
 ]

@@ -32,6 +32,15 @@ swap LOAD and the worker must keep serving the old version), and
 `slow_load` wraps a swap loader with a delay (the slow-load canary — the
 coordinator's rollout timeout must roll the fleet back while the old
 version keeps serving throughout).
+
+Round 19 adds REWARD-PLANE faults (ISSUE 19) for the train-on-traffic
+loop: `RewardFaultInjector` mutates the reward event stream itself —
+duplicate_reward (at-least-once transport re-delivery), delay_reward
+(the reward arrives beyond the join horizon), drop_reward (the reward
+never arrives). Counts are independent ground truth; the loop chaos
+tests reconcile them EXACTLY against the RewardJoiner's refusal/eviction
+tallies (duplicates == `duplicate` refusals, delays == `expired`
+refusals, drops == `reward_timeout` evictions).
 """
 
 from __future__ import annotations
@@ -309,3 +318,100 @@ class TrainingFaultInjector:
             else:
                 raise ValueError(f"unknown corruption mode {mode!r}")
         return seq
+
+
+class RewardFaultInjector:
+    """Seeded reward-STREAM faults for the train-on-traffic loop.
+
+    Where `FaultInjector` breaks transports and `TrainingFaultInjector`
+    breaks fits, this one breaks the reward events themselves — the
+    faults a delayed-feedback pipeline actually delivers. `mutate(event)`
+    passes predictions through untouched and maps each reward event to a
+    LIST of events:
+
+    - duplicate_reward: the event is emitted twice back to back — the
+      at-least-once re-delivery the joiner's seen-ring must refuse.
+    - delay_reward: the event's timestamp is pushed `delay_beyond_s`
+      PAST the join horizon (and behind its prediction), so the joiner
+      must refuse it as `expired` — never apply it, never crash.
+    - drop_reward: the event is removed; the joiner must eventually
+      evict the matching prediction as `reward_timeout`.
+
+    One uniform draw per reward event classifies duplicate -> delay ->
+    drop, so the schedule is a pure function of (seed, rates) —
+    `schedule(n)` previews it without consuming state, the same
+    determinism contract as `FaultInjector`. `self.counts` is the
+    independent ground truth the chaos tests reconcile exactly against
+    the joiner's refusal counters.
+    """
+
+    def __init__(self, seed: int = 0, duplicate_rate: float = 0.0,
+                 delay_rate: float = 0.0, drop_rate: float = 0.0,
+                 horizon_s: float = 300.0, delay_beyond_s: float = 1.0):
+        if min(duplicate_rate, delay_rate, drop_rate) < 0 or \
+                duplicate_rate + delay_rate + drop_rate > 1.0:
+            raise ValueError("fault rates must be >= 0 and sum to <= 1")
+        self.seed = seed
+        self.duplicate_rate = duplicate_rate
+        self.delay_rate = delay_rate
+        self.drop_rate = drop_rate
+        self.horizon_s = float(horizon_s)
+        self.delay_beyond_s = float(delay_beyond_s)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {
+            "rewards": 0, "duplicate_reward": 0, "delay_reward": 0,
+            "drop_reward": 0, "ok": 0}
+
+    def _classify(self, u: float) -> str:
+        if u < self.duplicate_rate:
+            return "duplicate_reward"
+        if u < self.duplicate_rate + self.delay_rate:
+            return "delay_reward"
+        if u < self.duplicate_rate + self.delay_rate + self.drop_rate:
+            return "drop_reward"
+        return "ok"
+
+    def schedule(self, n: int) -> List[str]:
+        """First n decisions a fresh injector with this seed makes (the
+        determinism contract); does not consume this injector's state."""
+        rng = random.Random(self.seed)
+        return [self._classify(rng.random()) for _ in range(n)]
+
+    def mutate(self, event: Dict) -> List[Dict]:
+        """Apply the next seeded fault decision to one event. Predictions
+        and non-events pass through unchanged (the fault plane is the
+        REWARD stream); each reward costs exactly one draw."""
+        if event.get("kind") != "reward":
+            return [event]
+        with self._lock:
+            u = self._rng.random()
+            kind = self._classify(u)
+            self.counts["rewards"] += 1
+            self.counts[kind] += 1
+        if kind != "ok":
+            try:
+                from ..observability import get_registry
+                get_registry().counter(
+                    "chaos_injected_total", "chaos decisions by kind",
+                    labels={"kind": kind}).inc()
+            except Exception:  # noqa: BLE001 - telemetry must not alter chaos
+                pass
+        if kind == "duplicate_reward":
+            return [event, dict(event)]
+        if kind == "delay_reward":
+            late = dict(event)
+            # beyond the horizon measured from the reward's own ts — the
+            # prediction's ts is never later, so the join must expire
+            late["ts"] = float(event["ts"]) + self.horizon_s \
+                + self.delay_beyond_s
+            return [late]
+        if kind == "drop_reward":
+            return []
+        return [event]
+
+    def mutate_stream(self, events) -> List[Dict]:
+        out: List[Dict] = []
+        for ev in events:
+            out.extend(self.mutate(ev))
+        return out

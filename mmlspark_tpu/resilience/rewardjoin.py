@@ -1,0 +1,411 @@
+"""Durable reward-prediction joining for the train-on-traffic loop.
+
+The hard half of ROADMAP item 2 is not moving examples fast (PR 16 did
+that) but surviving what a production reward stream actually delivers:
+duplicate reward events (at-least-once transports re-send), late rewards
+(conversion signals arrive minutes after the prediction), out-of-order
+arrival (a reward can beat its own prediction record through the log),
+and worker death mid-join. ``RewardJoiner`` turns that stream into
+examples that are applied to the learner **exactly once**:
+
+- **Keyed on X-Trace-Id**: the serving plane already mints/propagates a
+  trace id per request (PR 8); the served-prediction event and its
+  delayed reward event share it, so the join key is free.
+- **Bounded, spillable buffer**: pending predictions wait in memory up
+  to ``max_pending_mem`` records; overflow spills payloads to disk
+  (append-only JSONL spill files, a key->(file, offset) index in RAM).
+  All other structures hold only keys + timestamps. RAM is
+  O(max_pending_mem payloads + horizon-window keys), never O(stream).
+- **Idempotent dedup**: applied keys live in a seen ring, evicted only
+  once the event-time watermark passes ``horizon_s`` beyond them — any
+  duplicate inside the horizon is refused, and a duplicate OUTSIDE the
+  horizon is refused by the horizon itself (expired/unknown). Late and
+  out-of-order rewards therefore apply exactly once or are refused with
+  a COUNTED reason, never applied twice and never silently dropped.
+- **Counted refusal vocabulary** (docs/ONLINE.md): ``duplicate`` /
+  ``duplicate_prediction`` (key already applied or in flight),
+  ``expired`` (reward landed after its prediction's horizon),
+  ``unknown_key`` (reward whose prediction never arrived within the
+  horizon), ``reward_timeout`` (prediction evicted with no reward),
+  ``malformed`` (event missing required fields). ``self.counts`` stays
+  an INDEPENDENT tally beside the ``online_join_refusals_total``
+  registry family — chaos tests reconcile the two exactly, like the
+  transport-fault injectors do.
+- **Deterministic**: all expiry decisions run on the EVENT-TIME
+  watermark (max PREDICTION ts ingested — the served-traffic clock,
+  monotone with the stream), never the wall clock, so replaying the
+  same event log yields the identical join/refusal sequence — the
+  property the online loop's preempt-resume digest-parity proof
+  (train/online_loop.py) is built on. A reward timestamp enters only
+  the per-pair lateness decision (reward.ts - prediction.ts > horizon
+  => expired), so a far-future reward ts expires its OWN join without
+  flushing every other in-flight prediction.
+- **Snapshot/restore**: ``snapshot_state()`` captures the full join
+  state (pending payloads incl. spilled, dedup rings, counters,
+  watermark) as one JSON-able dict, persisted by the loop through the
+  PR 10 ``CheckpointStore``; ``restore_state`` rebuilds it. Snapshot
+  size is O(pending-within-horizon), the same bound as RAM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+__all__ = ["RewardJoiner", "REFUSAL_REASONS"]
+
+#: the documented refusal vocabulary (docs/ONLINE.md); every refusal is
+#: counted under exactly one of these reasons
+REFUSAL_REASONS = ("duplicate", "duplicate_prediction", "expired",
+                   "unknown_key", "reward_timeout", "malformed")
+
+#: IPS weight cap — mirrors the offline contextual-bandit fit
+#: (models/vw/contextual_bandit.py: min(1/max(p, 1e-6), 1e3))
+IPS_WEIGHT_CAP = 1e3
+
+
+def _publish_refusal(reason: str) -> None:
+    try:
+        from ..observability import bridge as obsbridge
+        obsbridge.publish_online_refusal(reason)
+    except Exception:  # noqa: BLE001 - telemetry never alters the join
+        pass
+
+
+def _publish_event(kind: str) -> None:
+    try:
+        from ..observability import bridge as obsbridge
+        obsbridge.publish_online_event(kind)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class _SpillStore:
+    """Append-only JSONL spill files for overflow prediction payloads.
+
+    Not independently durable (plain appends): the snapshot — which
+    reads spilled payloads back — is the durability story; the spill
+    exists solely to bound RAM between snapshots. Files rotate every
+    ``rotate`` records and are deleted once every record in them has
+    been joined or evicted."""
+
+    def __init__(self, directory: str, rotate: int = 4096):
+        self.directory = directory
+        self.rotate = int(rotate)
+        self._file_seq = 0
+        self._records_in_current = 0
+        self._live: Dict[int, int] = {}  # file_seq -> live record count
+        self.spilled = 0
+        self.read_back = 0
+
+    def _path(self, seq: int) -> str:
+        return os.path.join(self.directory, f"spill_{seq:06d}.jsonl")
+
+    def append(self, record: Dict[str, Any]):
+        """Returns (file_seq, byte_offset) for the index."""
+        os.makedirs(self.directory, exist_ok=True)
+        if self._records_in_current >= self.rotate:
+            self._file_seq += 1
+            self._records_in_current = 0
+        seq = self._file_seq
+        path = self._path(seq)
+        line = (json.dumps(record, sort_keys=True) + "\n").encode("utf-8")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            offset = os.lseek(fd, 0, os.SEEK_END)
+            os.write(fd, line)
+        finally:
+            os.close(fd)
+        self._records_in_current += 1
+        self._live[seq] = self._live.get(seq, 0) + 1
+        self.spilled += 1
+        return seq, offset
+
+    def read(self, seq: int, offset: int) -> Dict[str, Any]:
+        with open(self._path(seq), "rb") as fh:
+            fh.seek(offset)
+            self.read_back += 1
+            return json.loads(fh.readline())
+
+    def release(self, seq: int) -> None:
+        """One record in file `seq` is dead; delete the file when all are
+        (a file the current writer still appends to is kept)."""
+        n = self._live.get(seq, 0) - 1
+        if n > 0:
+            self._live[seq] = n
+            return
+        self._live.pop(seq, None)
+        if seq != self._file_seq:
+            try:
+                os.remove(self._path(seq))
+            except OSError:
+                pass
+
+
+class RewardJoiner:
+    """Match served predictions to delayed rewards, exactly once.
+
+    Event schema (JSONL records, io/streaming.JsonlEventSource):
+
+    - prediction: ``{"kind": "prediction", "key": <trace id>, "ts": t,
+      "indices": [...], "values": [...], "probability": p?}`` — the
+      hashed (shared ⊕ chosen-action) feature row the serving client
+      logged, plus the logged exploration probability (IPS weight
+      ``min(1/max(p, 1e-6), 1e3)``, the offline bandit fit's cap).
+    - reward: ``{"kind": "reward", "key": <trace id>, "ts": t,
+      "cost": c}`` — lower cost is better (VW CB convention).
+
+    ``ingest(event)`` returns the joined example when this event
+    completed a join, else None. Every non-join outcome is counted.
+    """
+
+    def __init__(self, *, horizon_s: float = 300.0,
+                 max_pending_mem: int = 4096,
+                 spill_dir: Optional[str] = None,
+                 max_tracked_keys: int = 1 << 20):
+        if horizon_s <= 0:
+            raise ValueError(f"horizon_s must be > 0, got {horizon_s}")
+        if max_pending_mem < 1:
+            raise ValueError("max_pending_mem must be >= 1")
+        self.horizon_s = float(horizon_s)
+        self.max_pending_mem = int(max_pending_mem)
+        self.max_tracked_keys = int(max_tracked_keys)
+        self._spill = (_SpillStore(spill_dir) if spill_dir else None)
+        self.spill_dir = spill_dir
+        # key -> full prediction record (insertion = ts order for evict)
+        self._pending_mem: "OrderedDict[str, Dict]" = OrderedDict()
+        # key -> (file_seq, offset, ts) for spilled predictions
+        self._pending_spilled: "OrderedDict[str, tuple]" = OrderedDict()
+        # rewards that arrived before their prediction (out-of-order)
+        self._pending_rewards: "OrderedDict[str, Dict]" = OrderedDict()
+        # applied keys (dedup ring) and evicted-prediction keys (so a late
+        # reward is refused "expired", not "unknown_key") — key -> ts
+        self._seen: "OrderedDict[str, float]" = OrderedDict()
+        self._expired: "OrderedDict[str, float]" = OrderedDict()
+        self.watermark = float("-inf")
+        #: independent ground-truth tally (reconciled against the
+        #: online_* registry families in tests, never derived from them)
+        self.counts: Dict[str, int] = {
+            "predictions": 0, "rewards": 0, "joined": 0,
+            **{r: 0 for r in REFUSAL_REASONS}}
+
+    # ----------------------------------------------------------- pending
+    @property
+    def pending_predictions(self) -> int:
+        return len(self._pending_mem) + len(self._pending_spilled)
+
+    @property
+    def pending_rewards(self) -> int:
+        return len(self._pending_rewards)
+
+    def _refuse(self, reason: str) -> None:
+        self.counts[reason] += 1
+        _publish_refusal(reason)
+
+    # ----------------------------------------------------------- watermark
+    def _advance_watermark(self, ts: float) -> None:
+        if ts <= self.watermark:
+            return
+        self.watermark = ts
+        limit = ts - self.horizon_s
+        # predictions past the horizon: no reward is coming (or it will
+        # be refused as expired) — evict, counted
+        for pend in (self._pending_mem, self._pending_spilled):
+            while pend:
+                key, rec = next(iter(pend.items()))
+                rts = rec["ts"] if isinstance(rec, dict) else rec[2]
+                if rts >= limit:
+                    break
+                pend.popitem(last=False)
+                if pend is self._pending_spilled and self._spill:
+                    self._spill.release(rec[0])
+                # stamped with the EVICTION watermark (not the stale
+                # prediction ts): the expired marker must itself survive
+                # one more horizon so a late reward is refused "expired",
+                # not misfiled as "unknown_key"
+                self._expired[key] = ts
+                self._refuse("reward_timeout")
+        # orphan rewards past the horizon: the prediction never arrived
+        while self._pending_rewards:
+            key, rec = next(iter(self._pending_rewards.items()))
+            if rec["ts"] >= limit:
+                break
+            self._pending_rewards.popitem(last=False)
+            self._refuse("unknown_key")
+        # dedup rings only need to cover the horizon window: any event
+        # for an older key is refused by the horizon itself
+        for ring in (self._seen, self._expired):
+            while ring:
+                key, rts = next(iter(ring.items()))
+                if rts >= limit and len(ring) <= self.max_tracked_keys:
+                    break
+                ring.popitem(last=False)
+
+    def advance(self, ts: float) -> None:
+        """Advance the event-time watermark without an event (an
+        end-of-stream close or idle tick): expiries fire exactly as if
+        an event with this ts had arrived. Chaos reconciliation uses it
+        to flush the tail — a dropped reward's prediction only counts
+        its `reward_timeout` once the watermark passes its horizon."""
+        self._advance_watermark(float(ts))
+
+    # -------------------------------------------------------------- ingest
+    def ingest(self, event: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Process one event; returns the joined training example iff this
+        event completed a join. HOT PATH: pure host-side dict/list work —
+        nothing here may touch a device value (AST sync-point lint)."""
+        kind = event.get("kind")
+        key = event.get("key")
+        ts = event.get("ts")
+        if kind not in ("prediction", "reward") or not key or ts is None:
+            self._refuse("malformed")
+            return None
+        ts = float(ts)
+        if kind == "prediction":
+            # the watermark advances on PREDICTION timestamps only — the
+            # served-traffic clock, monotone with the stream. A reward
+            # timestamp enters only the per-pair lateness decision: a
+            # wildly future reward ts (the delay fault) must expire ITS
+            # join, not flush every other in-flight prediction
+            self._advance_watermark(ts)
+        _publish_event(kind)
+        if kind == "prediction":
+            return self._ingest_prediction(key, ts, event)
+        return self._ingest_reward(key, ts, event)
+
+    def ingest_batch(self, events) -> List[Dict[str, Any]]:
+        out = []
+        for ev in events:
+            j = self.ingest(ev)
+            if j is not None:
+                out.append(j)
+        return out
+
+    def _ingest_prediction(self, key: str, ts: float,
+                           event: Dict[str, Any]) -> Optional[Dict]:
+        self.counts["predictions"] += 1
+        if "indices" not in event or "values" not in event:
+            self._refuse("malformed")
+            return None
+        if key in self._seen or key in self._pending_mem \
+                or key in self._pending_spilled:
+            self._refuse("duplicate_prediction")
+            return None
+        reward = self._pending_rewards.pop(key, None)
+        if reward is not None:
+            # out-of-order arrival: the reward beat its prediction here
+            return self._join(event, reward)
+        self._pending_mem[key] = event
+        if len(self._pending_mem) > self.max_pending_mem:
+            self._spill_oldest()
+        return None
+
+    def _ingest_reward(self, key: str, ts: float,
+                       event: Dict[str, Any]) -> Optional[Dict]:
+        self.counts["rewards"] += 1
+        if "cost" not in event:
+            self._refuse("malformed")
+            return None
+        if key in self._seen:
+            self._refuse("duplicate")
+            return None
+        if key in self._expired:
+            self._refuse("expired")
+            return None
+        pred = self._pending_mem.pop(key, None)
+        if pred is None and key in self._pending_spilled:
+            seq, offset, _rts = self._pending_spilled.pop(key)
+            pred = self._spill.read(seq, offset)
+            self._spill.release(seq)
+        if pred is None:
+            if key in self._pending_rewards:
+                self._refuse("duplicate")
+                return None
+            self._pending_rewards[key] = event
+            return None
+        if ts - float(pred["ts"]) > self.horizon_s:
+            # late beyond the horizon with the prediction still buffered
+            # (watermark had not passed it yet): same contract — refused
+            self._expired[key] = self.watermark
+            self._refuse("expired")
+            return None
+        return self._join(pred, event)
+
+    def _join(self, pred: Dict[str, Any],
+              reward: Dict[str, Any]) -> Dict[str, Any]:
+        key = pred["key"]
+        self._seen[key] = max(float(pred["ts"]), float(reward["ts"]))
+        self.counts["joined"] += 1
+        p = float(pred.get("probability", 1.0))
+        return {
+            "key": key,
+            "indices": pred["indices"],
+            "values": pred["values"],
+            "label": float(reward["cost"]),
+            "weight": min(1.0 / max(p, 1e-6), IPS_WEIGHT_CAP),
+            "pred_ts": float(pred["ts"]),
+            "reward_ts": float(reward["ts"]),
+        }
+
+    def _spill_oldest(self) -> None:
+        """RAM bound: move the oldest in-memory prediction payload to the
+        spill store, keeping only (file, offset, ts) in memory."""
+        key, rec = self._pending_mem.popitem(last=False)
+        if self._spill is None:
+            # no spill dir configured: the bound still holds — the
+            # overflow prediction is evicted as if timed out (counted,
+            # never unbounded memory)
+            self._expired[key] = self.watermark
+            self._refuse("reward_timeout")
+            return
+        seq, offset = self._spill.append(rec)
+        self._pending_spilled[key] = (seq, offset, float(rec["ts"]))
+
+    # ---------------------------------------------------------- snapshot
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Full join state as one JSON-able dict (spilled payloads read
+        back in). Paired with the event-log cursor in the loop snapshot:
+        restore + seek(cursor) + replay == never-interrupted ingest."""
+        spilled = []
+        for key, (seq, offset, _ts) in self._pending_spilled.items():
+            spilled.append(self._spill.read(seq, offset))
+        return {
+            "horizon_s": self.horizon_s,
+            "watermark": (None if self.watermark == float("-inf")
+                          else self.watermark),
+            "pending_predictions": (list(self._pending_mem.values())
+                                    + spilled),
+            "pending_rewards": list(self._pending_rewards.values()),
+            "seen": list(self._seen.items()),
+            "expired": list(self._expired.items()),
+            "counts": dict(self.counts),
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        """Rebuild from `snapshot_state()` output. Pending predictions
+        re-enter through the normal bound (re-spilling overflow), so a
+        restore never exceeds the RAM bound either."""
+        if float(state.get("horizon_s", self.horizon_s)) != self.horizon_s:
+            raise ValueError(
+                f"snapshot horizon {state.get('horizon_s')}s != configured "
+                f"{self.horizon_s}s — the dedup rings' eviction contract "
+                f"depends on the horizon; refusing a silent change")
+        self.watermark = (float("-inf") if state.get("watermark") is None
+                          else float(state["watermark"]))
+        self._pending_mem.clear()
+        self._pending_spilled.clear()
+        self._pending_rewards.clear()
+        for rec in state.get("pending_predictions", []):
+            self._pending_mem[rec["key"]] = rec
+            if len(self._pending_mem) > self.max_pending_mem:
+                self._spill_oldest()
+        for rec in state.get("pending_rewards", []):
+            self._pending_rewards[rec["key"]] = rec
+        self._seen = OrderedDict(
+            (k, float(v)) for k, v in state.get("seen", []))
+        self._expired = OrderedDict(
+            (k, float(v)) for k, v in state.get("expired", []))
+        self.counts.update({k: int(v)
+                            for k, v in state.get("counts", {}).items()})

@@ -4,6 +4,8 @@
 from .compute_statistics import (ComputeModelStatistics,
                                  ComputePerInstanceStatistics)
 from .metrics import MetricConstants
+from .online_loop import (HoldoutGate, ModelPublisher,
+                          OnlineLearnerRunner, offline_replay)
 from .trainers import (TrainClassifier, TrainedClassifierModel,
                        TrainedRegressorModel, TrainRegressor)
 
@@ -12,4 +14,6 @@ __all__ = [
     "TrainRegressor", "TrainedRegressorModel",
     "ComputeModelStatistics", "ComputePerInstanceStatistics",
     "MetricConstants",
+    "OnlineLearnerRunner", "HoldoutGate", "ModelPublisher",
+    "offline_replay",
 ]

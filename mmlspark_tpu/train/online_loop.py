@@ -1,0 +1,575 @@
+"""Fault-tolerant train-on-traffic loop (ROADMAP item 2, ISSUE 19).
+
+Closes the reference's one end-to-end capability we had all the parts
+for but had never wired: served predictions -> delayed rewards ->
+incremental VW updates -> registry publish -> canary rollout, surviving
+the faults a production loop actually sees. The pieces:
+
+- `RewardJoiner` (resilience/rewardjoin.py) turns the at-least-once
+  event stream into exactly-once training examples.
+- `OnlineLearnerRunner` (here) drains joined examples into the PR 16
+  `VWOnlineRing` and snapshots {learner carry, joiner state, event-log
+  cursor} as ONE atomic unit through the PR 10 `CheckpointStore`
+  (schema-v2 sidecar: learner state digest + reward cursor in the
+  manifest `extra`). A SIGTERM/preemption mid-update resumes from the
+  snapshot with zero lost and zero double-applied rewards — the proof
+  is `offline_replay`: an uninterrupted run of the SAME seeded event
+  log lands on a bit-identical learner state digest.
+- `HoldoutGate` + `ModelPublisher` (here) are the publish leg: every
+  k-th joined example is diverted to a sliding held-out window (never
+  trained on), the candidate must not regress against the incumbent on
+  that window to publish, and the same gate plugs into the serving
+  coordinator via `add_rollout_monitor` so a worse model that DOES get
+  out auto-rolls back exactly like a corrupt artifact.
+
+Determinism contract (what makes the digest-parity proof valid): the
+VW minibatch step is BATCHED — every row in a minibatch sees the same
+pre-batch weights — so the grouping of examples into minibatches is
+part of the numerics, and a `ring.flush()` (which closes the current
+partial minibatch with inert zero-weight pad rows) is only
+digest-neutral if it happens at the SAME example ordinals in every run
+being compared. The loop therefore keys every flush-bearing cadence —
+snapshot boundaries, publish points, holdout diversion — on the
+JOINED-EXAMPLE ordinal, never the wall clock or the read batching:
+snapshots fire exactly at multiples of `snapshot_every`, publishes at
+multiples of `publish_every` (constrained to a multiple of
+`snapshot_every`, so a run without a publisher — the replay oracle —
+still flushes at the identical ordinals), and the joiner's expiry runs
+on the event-time watermark. Submit-call granularity does NOT matter:
+the ring buffers submitted rows into fixed minibatches regardless of
+call chunking; only flush points do.
+
+Hot path discipline: `step` / `_ingest_events` / `_apply_staged` carry
+zero host syncs (AST-linted, tests/test_fit_pipeline.py) — host array
+building is delegated to the module-level `_coerce_rows`, and every
+device readback lives in the designated commit points
+(`_commit_snapshot` / `_publish` / `finalize`).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..models.vw.sgd import (state_digest, state_from_bytes,
+                             state_to_bytes)
+from ..resilience.elastic import Preempted
+from ..resilience.rewardjoin import RewardJoiner
+
+__all__ = ["OnlineLearnerRunner", "HoldoutGate", "ModelPublisher",
+           "offline_replay"]
+
+
+def _local_device_count() -> int:
+    try:
+        import jax
+        return int(jax.local_device_count())
+    except Exception:  # noqa: BLE001 - no backend = single-device
+        return 1
+
+
+def _coerce_rows(staged: List[Dict[str, Any]], width: int):
+    """Host-side row packing for one staged chunk: pad each example's
+    hashed (indices, values) to the loop's fixed row width with inert
+    (index 0, value 0.0) entries — a zero-VALUE feature contributes
+    nothing to the margin, the gradient, or the adagrad accumulators,
+    the same inertness argument as the ring's zero-WEIGHT flush pad.
+    Module-level on purpose: keeps the host-array tokens out of the
+    linted hot-path function bodies (the `_coerce_rows` idiom)."""
+    n = len(staged)
+    idx = np.zeros((n, width), np.int32)
+    val = np.zeros((n, width), np.float32)
+    labels = np.zeros(n, np.float32)
+    weights = np.ones(n, np.float32)
+    for r, ex in enumerate(staged):
+        k = len(ex["indices"])
+        if k > width:
+            raise ValueError(
+                f"example has {k} features, loop row_width is {width}")
+        idx[r, :k] = ex["indices"]
+        val[r, :k] = ex["values"]
+        labels[r] = ex["label"]
+        weights[r] = ex["weight"]
+    return idx, val, labels, weights
+
+
+def _eval_holdout(state, examples, width: int) -> Optional[Dict[str, float]]:
+    """IPS-weighted squared error of the linear margin against observed
+    cost on the held-out window (host-side numpy — gate evaluation is a
+    commit point, never the hot path). Lower is better; `policy_value`
+    reports the IPS estimate of the cost the argmin policy would incur,
+    the regret-facing number docs/ONLINE.md tracks."""
+    if not examples:
+        return None
+    idx, val, labels, weights = _coerce_rows(list(examples), width)
+    w = np.asarray(state.w)
+    bias = float(np.asarray(state.bias))
+    margins = (val * w[idx]).sum(axis=1) + bias
+    se = (margins - labels) ** 2
+    wsum = float(weights.sum())
+    return {
+        "examples": len(examples),
+        "weighted_mse": float((se * weights).sum() / max(wsum, 1e-9)),
+        "policy_value": float((labels * weights).sum() / max(wsum, 1e-9)),
+    }
+
+
+class HoldoutGate:
+    """Sliding held-out window + the regression decision on it.
+
+    The runner diverts every `holdout_every`-th joined example here
+    INSTEAD of training on it (deterministic by joined ordinal, so the
+    split survives preempt/resume bit-for-bit). `admit` gates a publish:
+    the candidate must not be worse than the incumbent by more than
+    `tolerance` (relative) on the current window. `rollout_monitor`
+    wraps the same decision for the serving coordinator's
+    `add_rollout_monitor`: while a canary rollout is active, the canary
+    version is re-scored against the incumbent on the LIVE window every
+    tick — a worse model auto-rolls back like a corrupt artifact."""
+
+    def __init__(self, width: int, window: int = 256,
+                 tolerance: float = 0.10, min_delta: float = 1e-4):
+        self.width = int(width)
+        self.window = deque(maxlen=int(window))
+        self.tolerance = float(tolerance)
+        #: absolute regression floor: a near-perfect incumbent (mse ~ 0)
+        #: must not veto an equally-good candidate over float dust
+        self.min_delta = float(min_delta)
+        self.last_eval: Optional[Dict[str, Any]] = None
+
+    def add(self, example: Dict[str, Any]) -> None:
+        self.window.append(example)
+
+    def __len__(self) -> int:
+        return len(self.window)
+
+    def admit(self, candidate_state, incumbent_state) -> Optional[str]:
+        """None = publish may proceed; a string = the counted refusal
+        reason. No incumbent or an empty window always admits (there is
+        nothing to regress against)."""
+        cand = _eval_holdout(candidate_state, self.window, self.width)
+        self.last_eval = {"candidate": cand}
+        if cand is None or incumbent_state is None:
+            return None
+        inc = _eval_holdout(incumbent_state, self.window, self.width)
+        self.last_eval["incumbent"] = inc
+        if cand["weighted_mse"] > inc["weighted_mse"] * (1 + self.tolerance) \
+                + self.min_delta:
+            return (f"holdout regression: candidate mse "
+                    f"{cand['weighted_mse']:.6f} vs incumbent "
+                    f"{inc['weighted_mse']:.6f} (+>{self.tolerance:.0%})")
+        return None
+
+    def rollout_monitor(self, registry) -> Callable[[], Optional[str]]:
+        """A coordinator rollout gate: score CANARY vs CURRENT from the
+        model registry on the live window; a regression is a breach
+        reason (rolls the fleet back). Versions whose payloads are not
+        loop-published weights score as None and pass — this gate only
+        judges models it understands."""
+        def monitor() -> Optional[str]:
+            canary, current = registry.canary(), registry.current()
+            if canary is None or not self.window:
+                return None
+            cand = self._load_state(registry, canary)
+            if cand is None:
+                return None
+            inc = (self._load_state(registry, current)
+                   if current is not None else None)
+            reason = self.admit(cand, inc)
+            return (f"canary v{canary} {reason}" if reason else None)
+        return monitor
+
+    @staticmethod
+    def _load_state(registry, version: int):
+        try:
+            vdir, man = registry.resolve(version)
+            if "weights.npz" not in man.get("files", {}):
+                return None
+            import os
+            with open(os.path.join(vdir, "weights.npz"), "rb") as fh:
+                return state_from_bytes(fh.read())
+        except Exception:  # noqa: BLE001 - unreadable/corrupt = not judged
+            return None
+
+
+class ModelPublisher:
+    """Finalize the learner into the ModelRegistry: weights npz (the
+    `state_to_bytes` codec) + meta.json {digest, joined ordinal, ndev,
+    holdout eval}, optional golden probe, never set_current — promotion
+    is the canary rollout's job (`rollout_fn`, e.g. a closure over
+    `coordinator.start_rollout`). Keeps the last published state in
+    memory as the gate's incumbent until a registry current exists."""
+
+    def __init__(self, registry, *, gate: Optional[HoldoutGate] = None,
+                 rollout_fn: Optional[Callable[[int], Any]] = None,
+                 golden_fn: Optional[Callable] = None,
+                 set_current: bool = False):
+        self.registry = registry
+        self.gate = gate
+        self.rollout_fn = rollout_fn
+        self.golden_fn = golden_fn
+        self.set_current = bool(set_current)
+        self.last_published_state = None
+        self.counts: Dict[str, int] = {"published": 0, "gate_refused": 0,
+                                       "error": 0}
+
+    def _incumbent_state(self):
+        cur = self.registry.current()
+        if cur is not None:
+            state = HoldoutGate._load_state(self.registry, cur)
+            if state is not None:
+                return state
+        return self.last_published_state
+
+    def publish(self, state, meta: Dict[str, Any]) -> Optional[int]:
+        """Gate, then publish; returns the version or None if refused.
+        A failing publish counts `error` and raises — the loop's caller
+        decides whether a broken registry is fatal."""
+        from ..observability.bridge import publish_online_publish
+        if self.gate is not None:
+            reason = self.gate.admit(state, self._incumbent_state())
+            if reason is not None:
+                self.counts["gate_refused"] += 1
+                publish_online_publish("gate_refused")
+                return None
+            meta = dict(meta, holdout=self.gate.last_eval)
+        golden_kw = {}
+        if self.golden_fn is not None:
+            body, reply_sha = self.golden_fn(state)
+            golden_kw = {"golden_body": body,
+                         "golden_reply_sha256": reply_sha}
+        try:
+            version = self.registry.publish(
+                files={
+                    "weights.npz": state_to_bytes(state),
+                    "meta.json": json.dumps(meta, sort_keys=True,
+                                            default=str).encode(),
+                },
+                extra={"kind": "online_loop",
+                       "learner_digest": meta.get("learner_digest")},
+                set_current=self.set_current, **golden_kw)
+        except Exception:
+            self.counts["error"] += 1
+            publish_online_publish("error")
+            raise
+        self.counts["published"] += 1
+        self.last_published_state = state
+        if self.rollout_fn is not None:
+            self.rollout_fn(version)
+        return version
+
+
+class OnlineLearnerRunner:
+    """Drain joined examples into the online ring, snapshot-everything
+    at deterministic boundaries, publish through the gate.
+
+    ``estimator`` is any VowpalWabbit* estimator (its `online_learner`
+    builds the ring; `state=` resumes one). ``source`` is a
+    JsonlEventSource-shaped replayable source (`read` / `cursor` /
+    `seek` / `commit`). All cadences count JOINED examples:
+
+    - every `holdout_every`-th joined example -> the gate's window
+      (never trained on);
+    - `snapshot_every` joined examples -> `_commit_snapshot` (flush
+      ring, persist {learner, joiner, cursor} atomically, fire the
+      post-snapshot `join_boundary_hook` — exactly a preemption's
+      timing, so `TrainingFaultInjector.arm(runner)` injects kills
+      with the same determinism contract as the GBDT chunk kills);
+    - `publish_every` joined examples -> the publish leg.
+
+    `drain` (a PreemptionDrain) turns SIGTERM into a `Preempted` raise
+    at the NEXT snapshot boundary — the snapshot is already durable, so
+    the resumed run re-reads the event log from the committed cursor
+    into the restored joiner: nothing lost, nothing double-applied."""
+
+    SNAPSHOT_SCHEMA = 1
+
+    def __init__(self, estimator, source, *, row_width: int,
+                 store=None, joiner: Optional[RewardJoiner] = None,
+                 horizon_s: float = 300.0,
+                 snapshot_every: int = 2048, publish_every: int = 0,
+                 holdout_every: int = 0, holdout_window: int = 256,
+                 holdout_tolerance: float = 0.10,
+                 publisher: Optional[ModelPublisher] = None,
+                 submit_chunk: int = 256, read_batch: int = 1024,
+                 drain=None, event_log=None, ndev: Optional[int] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        if row_width < 1:
+            raise ValueError("row_width must be >= 1")
+        if snapshot_every < 1:
+            raise ValueError("snapshot_every must be >= 1")
+        if publish_every and publish_every % snapshot_every != 0:
+            # flush points must be identical with and without a
+            # publisher (the replay oracle runs without one) — see the
+            # module docstring's determinism contract
+            raise ValueError(
+                f"publish_every ({publish_every}) must be a multiple of "
+                f"snapshot_every ({snapshot_every})")
+        self.estimator = estimator
+        self.source = source
+        self.store = store
+        self.joiner = joiner or RewardJoiner(horizon_s=horizon_s)
+        self.row_width = int(row_width)
+        self.snapshot_every = int(snapshot_every)
+        self.publish_every = int(publish_every)
+        self.holdout_every = int(holdout_every)
+        self.gate = HoldoutGate(row_width, window=holdout_window,
+                                tolerance=holdout_tolerance) \
+            if holdout_every else None
+        self.publisher = publisher
+        if publisher is not None and publisher.gate is None:
+            publisher.gate = self.gate
+        self.submit_chunk = int(submit_chunk)
+        self.read_batch = int(read_batch)
+        self.drain = drain
+        self.event_log = event_log
+        self.ndev = int(ndev) if ndev is not None else _local_device_count()
+        self.clock = clock if clock is not None else _default_clock
+        #: post-snapshot boundary hook — `TrainingFaultInjector.arm(self)`
+        #: installs its kill here (fired AFTER the snapshot is durable)
+        self._chunk_boundary_hook: Optional[Callable[[int, int], None]] = None
+        self.counts: Dict[str, int] = {
+            "joined": 0, "trained": 0, "held_out": 0, "snapshots": 0,
+            "publishes": 0, "resumes": 0, "reshards": 0}
+        self._staged: List[Dict[str, Any]] = []
+        self._lags: List[float] = []
+        self._snapshot_ordinal = 0
+        self._ingest_cursor: Optional[Dict[str, Any]] = None
+        self._ring = None
+        self._restored_state = None
+        self._resume()
+
+    # ------------------------------------------------------------- wiring
+    @property
+    def ring(self):
+        if self._ring is None:
+            self._ring = self.estimator.online_learner(
+                state=self._restored_state, width=self.row_width)
+            self._restored_state = None
+        return self._ring
+
+    def arm(self, hook: Callable[[int, int], None]) -> "OnlineLearnerRunner":
+        self._chunk_boundary_hook = hook
+        return self
+
+    def _log_event(self, event: str, **fields) -> None:
+        if self.event_log is not None:
+            try:
+                self.event_log.append(event, **fields)
+            except Exception:  # noqa: BLE001 - tracing must not alter the loop
+                pass
+
+    # ----------------------------------------------------------- hot path
+    def step(self) -> int:
+        """One loop iteration: read a batch of events, join, stage, and
+        cross any cadence boundaries reached. Returns the number of
+        events read (0 = source exhausted for now). HOT PATH: no host
+        syncs here or in `_ingest_events`/`_apply_staged` — the syncs
+        live in the designated commit points the boundary checks call
+        into (`_commit_snapshot`/`_publish`), exactly the GBDT chunk
+        loop's structure (AST-linted)."""
+        events = self.source.read(max_records=self.read_batch)
+        if events:
+            self._ingest_events(events)
+        if len(self._staged) >= self.submit_chunk:
+            self._apply_staged()
+        return len(events)
+
+    def _ingest_events(self, events) -> None:
+        """Join one batch of raw events; divert the deterministic
+        holdout split; stage the rest for the ring. Boundary checks run
+        PER JOINED EXAMPLE so snapshots/publishes land at exact
+        ordinals regardless of how the source batched the reads (the
+        determinism contract)."""
+        for ev in events:
+            joined = self.joiner.ingest(ev)
+            if "_next_offset" in ev:
+                # record-granular cursor: the snapshot must mark exactly
+                # the events the joiner has absorbed, not the read batch
+                self._ingest_cursor = {"offset": ev["_next_offset"]}
+            if joined is None:
+                continue
+            self.counts["joined"] += 1
+            if self.holdout_every and \
+                    self.counts["joined"] % self.holdout_every == 0:
+                self.counts["held_out"] += 1
+                self.gate.add(joined)
+            else:
+                self._staged.append(joined)
+            if self.counts["joined"] % self.snapshot_every == 0:
+                self._apply_staged()
+                self._commit_snapshot()
+                if self.publisher is not None and self.publish_every \
+                        and self.counts["joined"] % self.publish_every == 0:
+                    self._publish()
+
+    def _apply_staged(self) -> None:
+        """Submit every staged example to the ring (the ring buffers
+        into minibatches and ahead-dispatches; no sync here)."""
+        if not self._staged:
+            return
+        staged, self._staged = self._staged, []
+        idx, val, labels, weights = _coerce_rows(staged, self.row_width)
+        self.ring.submit(idx, val, labels, weights)
+        self.counts["trained"] += len(staged)
+        now = self.clock()
+        for ex in staged:
+            self._lags.append(max(0.0, now - ex["reward_ts"]))
+
+    # ------------------------------------------------------ commit points
+    def _snapshot_payload(self) -> str:
+        state = self.ring.state()
+        return json.dumps({
+            "schema": self.SNAPSHOT_SCHEMA,
+            "learner_b64": base64.b64encode(
+                state_to_bytes(state)).decode(),
+            "learner_digest": state_digest(state),
+            "joiner": self.joiner.snapshot_state(),
+            "cursor": (self._ingest_cursor if self._ingest_cursor
+                       is not None else self.source.cursor()),
+            "joined": self.counts["joined"],
+            "trained": self.counts["trained"],
+            "held_out": self.counts["held_out"],
+            "holdout_window": list(self.gate.window) if self.gate else [],
+            "snapshot_ordinal": self._snapshot_ordinal,
+        }, sort_keys=True)
+
+    def _commit_snapshot(self) -> None:
+        """DESIGNATED COMMIT POINT: flush the ring (zero-weight pad,
+        bit-identical), read the carry back, persist {learner, joiner,
+        cursor} as one atomic snapshot, then fire the post-snapshot
+        boundary hook (preemption timing) and honor a drain request."""
+        self.ring.flush()
+        if self.store is not None:
+            payload = self._snapshot_payload()
+            rec = json.loads(payload)
+            self.store.save(
+                payload, step=self.counts["joined"], ndev=self.ndev,
+                extra={"learner_digest": rec["learner_digest"],
+                       "reward_cursor": rec["cursor"]})
+            self.source.commit(rec["cursor"])
+        self.counts["snapshots"] += 1
+        ordinal = self._snapshot_ordinal
+        self._snapshot_ordinal += 1
+        self._flush_metrics()
+        self._log_event("online_snapshot", ordinal=ordinal,
+                        joined=self.counts["joined"])
+        if self._chunk_boundary_hook is not None:
+            self._chunk_boundary_hook(ordinal, self.counts["joined"])
+        if self.drain is not None and self.drain.requested:
+            raise Preempted(
+                f"drain requested; snapshot at joined="
+                f"{self.counts['joined']} is durable")
+
+    def _flush_metrics(self) -> None:
+        from ..observability.bridge import publish_online_apply
+        lags, self._lags = self._lags, []
+        publish_online_apply(
+            0, reward_lag_s=lags,
+            pending_keys=(self.joiner.pending_predictions
+                          + self.joiner.pending_rewards))
+
+    def _publish(self) -> None:
+        """DESIGNATED COMMIT POINT: flush, finalize the carry into a
+        candidate, gate it, publish, hand to the rollout."""
+        from ..observability.bridge import publish_online_publish
+        t0 = self.clock()
+        self.ring.flush()
+        state = self.ring.state()
+        meta = {"joined": self.counts["joined"],
+                "trained": self.counts["trained"],
+                "ndev": self.ndev,
+                "learner_digest": state_digest(state)}
+        version = self.publisher.publish(state, meta)
+        if version is not None:
+            self.counts["publishes"] += 1
+            publish_online_publish("published",
+                                   swap_seconds=self.clock() - t0)
+            self._log_event("online_publish", version=version,
+                            joined=self.counts["joined"])
+
+    # --------------------------------------------------------- run / drain
+    def run(self, *, max_steps: Optional[int] = None,
+            idle_limit: int = 1) -> Dict[str, int]:
+        """Drive `step` until the source runs dry `idle_limit` times in
+        a row (or `max_steps`). Returns the counts dict."""
+        idle = 0
+        steps = 0
+        while (max_steps is None or steps < max_steps) \
+                and idle < idle_limit:
+            n = self.step()
+            steps += 1
+            idle = 0 if n else idle + 1
+        return dict(self.counts)
+
+    def finalize(self):
+        """Drain everything staged, flush, and return (state, digest) —
+        the number the parity proof compares."""
+        self._apply_staged()
+        self.ring.flush()
+        state = self.ring.state()
+        return state, state_digest(state)
+
+    # -------------------------------------------------------------- resume
+    def _resume(self) -> None:
+        """Restore {learner, joiner, cursor} from the newest durable
+        snapshot (digest-verified by the store, counted fallback on
+        corruption). A resume at a different device count than the
+        snapshot's is counted as a reshard — the VW carry is unsharded
+        [F] state, so the resumed digest is unchanged (proved at ndev
+        {1,2} in tests)."""
+        if self.store is None:
+            return
+        restored = self.store.restore()
+        if restored is None:
+            return
+        payload, manifest = restored
+        rec = json.loads(payload)
+        if rec.get("schema") != self.SNAPSHOT_SCHEMA:
+            raise ValueError(
+                f"online snapshot schema {rec.get('schema')!r} != "
+                f"{self.SNAPSHOT_SCHEMA}")
+        self._restored_state = state_from_bytes(
+            base64.b64decode(rec["learner_b64"]))
+        if state_digest(self._restored_state) != rec["learner_digest"]:
+            raise ValueError("restored learner digest mismatch "
+                             "(snapshot payload inconsistent)")
+        self.joiner.restore_state(rec["joiner"])
+        self.source.seek(rec["cursor"])
+        self._ingest_cursor = dict(rec["cursor"])
+        self.counts["joined"] = int(rec["joined"])
+        self.counts["trained"] = int(rec["trained"])
+        self.counts["held_out"] = int(rec["held_out"])
+        self.counts["resumes"] += 1
+        if self.gate is not None:
+            for ex in rec.get("holdout_window", []):
+                self.gate.add(ex)
+        self._snapshot_ordinal = int(rec.get("snapshot_ordinal", 0))
+        if int(manifest.get("ndev", self.ndev)) != self.ndev:
+            self.counts["reshards"] += 1
+        self._log_event("online_resume", joined=self.counts["joined"],
+                        ndev=self.ndev)
+
+
+def _default_clock() -> float:
+    import time
+    return time.perf_counter()
+
+
+def offline_replay(estimator, source, *, row_width: int,
+                   **runner_kw) -> str:
+    """The parity oracle: run the SAME event log through a fresh,
+    uninterrupted runner (no store, no publisher — cadences identical
+    because they are joined-ordinal keyed) and return the final learner
+    digest. An interrupted+resumed run over the same log must match it
+    bit for bit."""
+    runner = OnlineLearnerRunner(
+        estimator, source, row_width=row_width, store=None,
+        publisher=None, **runner_kw)
+    runner.run(idle_limit=2)
+    _, digest = runner.finalize()
+    return digest

@@ -1,0 +1,829 @@
+"""Train-on-traffic loop harness (round-19 tentpole, ROADMAP item 2).
+
+Drives the full online-learning data path end to end and records what it
+actually sustains:
+
+- an append-only JSONL event log written by ONE environment thread: every
+  accepted prediction event followed (after a bounded random delay) by
+  its reward event — the delayed-feedback stream a bandit loop sees;
+- the `OnlineLearnerRunner` (train/online_loop.py) tailing the log
+  concurrently: `RewardJoiner` exactly-once joins, `VWOnlineRing`
+  incremental updates, atomic {learner, joiner, cursor} snapshots, and
+  the gated publish leg into a `ModelRegistry`;
+- `--scenario throughput` (default): no faults, no fleet — the loop's
+  headline numbers: applied examples/s, reward-to-applied lag p50/p99,
+  update->publish->swap latency, and the holdout-window MSE trajectory
+  (the regret-facing number docs/ONLINE.md tracks);
+- `--scenario chaos`: the same loop but traffic is REAL — client threads
+  post rows through a ServingCoordinator gateway to registry-backed
+  worker processes serving the loop's own published weights — under four
+  injected fault classes, each of which must heal with zero
+  accepted-request loss and an incident bundle:
+    worker_kill     one serving worker terminated mid-run (evict +
+                    rebalance, clients retry to acceptance);
+    learner_kill    `TrainingFaultInjector` kills the learner at a join
+                    boundary; the resumed learner must land on a digest
+                    BIT-IDENTICAL to an uninterrupted offline replay of
+                    the same event log (zero lost / zero double-applied);
+    reward_storm    `RewardFaultInjector` duplicates/delays/drops reward
+                    events; the joiner's refusal tallies must reconcile
+                    EXACTLY against the injector's independent ground
+                    truth;
+    corrupt_publish a published version is corrupted before its canary
+                    rollout; the digest gate must fail the swap and the
+                    rollout must auto-roll-back.
+
+Outputs: a markdown row block on stdout (append to docs/PERF.md) and a
+JSON summary at --out (defaults docs/ONLINE_loop.json /
+docs/ONLINE_chaos.json; bench.py embeds them in `extra.online_loop`).
+Armed in scripts/tpu_recovery_watch.sh; env knobs for quick runs:
+MEASURE_ONLINE_EVENTS, MEASURE_ONLINE_WORKERS, MEASURE_ONLINE_CLIENTS.
+"""
+
+import argparse
+import heapq
+import json
+import multiprocessing as mp
+import os
+import queue
+import random
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_FEATURES = 64       # numBits=6
+ROW_W = 4
+SERVICE = "online"
+HORIZON_S = 30.0
+SNAPSHOT_EVERY = 128
+PUBLISH_EVERY = 256
+HOLDOUT_EVERY = 10
+DEADLINE_MS = 10_000
+
+
+def _true_weights(seed: int = 3):
+    rng = random.Random(seed)
+    return [rng.uniform(-1.0, 1.0) for _ in range(NUM_FEATURES)]
+
+
+def _estimator():
+    from mmlspark_tpu.models.vw import VowpalWabbitRegressor
+    return VowpalWabbitRegressor(numBits=6)
+
+
+def _joiner(n_events):
+    # The harness is open-loop: the whole stream is enqueued at once, so
+    # in-flight predictions can burst toward n_events before their
+    # rewards come due. Size the joiner's RAM bound to the burst — the
+    # default 4096 would hit the no-spill overflow path and evict live
+    # predictions as reward_timeout on a fault-free run. Production
+    # loops bound memory with spill_dir instead.
+    from mmlspark_tpu.resilience.rewardjoin import RewardJoiner
+    return RewardJoiner(horizon_s=HORIZON_S,
+                        max_pending_mem=max(4096, 2 * n_events))
+
+
+# --------------------------------------------------- environment writer
+
+class EnvWriter(threading.Thread):
+    """The single log writer: accepted predictions in, {prediction,
+    delayed reward} events out. Rewards are the environment's ground
+    truth (linear cost + noise) released when due, each passed through
+    the optional `RewardFaultInjector` — so the log IS the at-least-once
+    stream the joiner must make exactly-once."""
+
+    def __init__(self, log_path, true_w, injector=None, seed=7,
+                 delay_range=(0.05, 1.0)):
+        super().__init__(daemon=True)
+        self.log_path = log_path
+        self.true_w = true_w
+        self.injector = injector
+        self.delay_range = delay_range
+        self._rng = random.Random(seed)
+        self._q = queue.Queue()
+        self._pending = []      # heap of (due, seq, reward_event)
+        self._seq = 0
+        self.predictions = 0
+        self.rewards = 0
+        self.done = threading.Event()
+
+    def submit(self, key, indices):
+        self._q.put((key, list(indices)))
+
+    def close(self):
+        self._q.put(None)
+
+    def _flush_due(self, now):
+        from mmlspark_tpu.io.streaming import append_jsonl
+        while self._pending and self._pending[0][0] <= now:
+            _, _, rew = heapq.heappop(self._pending)
+            events = (self.injector.mutate(rew) if self.injector
+                      else [rew])
+            for ev in events:
+                append_jsonl(self.log_path, ev)
+            self.rewards += 1
+
+    def run(self):
+        from mmlspark_tpu.io.streaming import append_jsonl
+        closed = False
+        while not (closed and not self._pending):
+            self._flush_due(time.perf_counter())
+            try:
+                item = self._q.get(timeout=0.02)
+            except queue.Empty:
+                continue
+            if item is None:
+                closed = True
+                continue
+            key, indices = item
+            ts = time.perf_counter()
+            append_jsonl(self.log_path, {
+                "kind": "prediction", "key": key, "ts": ts,
+                "indices": indices, "values": [1.0] * len(indices),
+                "probability": 1.0})
+            self.predictions += 1
+            cost = sum(self.true_w[j] for j in indices) \
+                + self._rng.gauss(0.0, 0.05)
+            due = ts + self._rng.uniform(*self.delay_range)
+            self._seq += 1
+            heapq.heappush(self._pending, (due, self._seq, {
+                "kind": "reward", "key": key, "ts": due, "cost": cost}))
+        self.done.set()
+
+
+# ------------------------------------------------------ incident bundles
+
+class IncidentWriter:
+    """One atomic JSON bundle per injected fault class: what fired, the
+    loop/joiner/chaos tallies at that instant, and the most recent
+    coordinator system events (the learner's own online_* events land
+    there too via the runner's event_log)."""
+
+    def __init__(self, directory):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.paths = []
+        self.classes = []
+
+    def write(self, reason, detail, **sections):
+        from mmlspark_tpu.resilience.elastic import atomic_write_text
+        bundle = {"reason": reason, "detail": detail,
+                  "wall_utc": time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                                            time.gmtime()),
+                  **sections}
+        path = os.path.join(self.directory,
+                            f"{len(self.paths):02d}_{reason}.json")
+        atomic_write_text(path, json.dumps(bundle, indent=1, default=str))
+        self.paths.append(path)
+        self.classes.append(reason)
+        print(f"  incident bundle: {reason} ({detail})", flush=True)
+        return path
+
+
+def _recent_events(event_log, n=40):
+    try:
+        return list(event_log.events())[-n:]
+    except Exception:  # noqa: BLE001 - bundles must not fail the run
+        return []
+
+
+# ------------------------------------------------------- learner driving
+
+class LearnerDriver:
+    """Owns the runner across kills: drives `step()` until the traffic
+    is done and the source runs dry, rebuilding (= resuming from the
+    snapshot store) whenever an injected kill lands. Counts busy wall
+    time so examples/s reflects the loop, not the idle polls."""
+
+    def __init__(self, mk_runner, traffic_done, incidents=None,
+                 chaos_counts=None):
+        self.mk_runner = mk_runner
+        self.traffic_done = traffic_done
+        self.incidents = incidents
+        self.chaos_counts = chaos_counts if chaos_counts is not None else {}
+        self.runner = mk_runner()
+        self.busy_s = 0.0
+        self.totals = {"snapshots": 0, "publishes": 0, "kills": 0,
+                       "resumes": 0}
+
+    def _absorb(self):
+        self.totals["snapshots"] += self.runner.counts["snapshots"]
+        self.totals["publishes"] += self.runner.counts["publishes"]
+
+    def drain(self):
+        from mmlspark_tpu.resilience import Preempted
+        from mmlspark_tpu.resilience.chaos import InjectedKill
+        idle = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                n = self.runner.step()
+            except (InjectedKill, Preempted) as exc:
+                self.busy_s += time.perf_counter() - t0
+                self._absorb()
+                self.totals["kills"] += 1
+                if self.incidents is not None:
+                    self.incidents.write(
+                        "learner_kill", repr(exc),
+                        loop_counts=dict(self.runner.counts),
+                        joiner_counts=dict(self.runner.joiner.counts),
+                        chaos_counts=dict(self.chaos_counts))
+                self.runner = self.mk_runner()   # resume from the store
+                self.totals["resumes"] += self.runner.counts["resumes"]
+                continue
+            self.busy_s += time.perf_counter() - t0
+            if n:
+                idle = 0
+                continue
+            if self.traffic_done.is_set():
+                idle += 1
+                if idle >= 3:
+                    break
+            time.sleep(0.01)
+        self._absorb()
+        self.totals["resumes"] = max(self.totals["resumes"],
+                                     self.runner.counts["resumes"])
+        return self.runner
+
+
+def _lag_quantiles(reg):
+    def ms(name, q):
+        v = reg.quantile(name, q)
+        return round(v * 1e3, 2) if v is not None else None
+    return {
+        "reward_to_applied_p50_ms": ms("online_reward_lag_seconds", 0.5),
+        "reward_to_applied_p99_ms": ms("online_reward_lag_seconds", 0.99),
+        "publish_swap_p50_ms": ms("online_publish_swap_seconds", 0.5),
+        "publish_swap_p99_ms": ms("online_publish_swap_seconds", 0.99),
+    }
+
+
+def _holdout_trajectory(runner, initial_state, final_state):
+    """MSE of the untrained model vs the final learner on the FINAL
+    held-out window: the accuracy-improves-over-the-run evidence."""
+    from mmlspark_tpu.train.online_loop import _eval_holdout
+    if runner.gate is None or not runner.gate.window:
+        return None
+    first = _eval_holdout(initial_state, runner.gate.window, ROW_W)
+    last = _eval_holdout(final_state, runner.gate.window, ROW_W)
+    return {"initial_mse": round(first["weighted_mse"], 4),
+            "final_mse": round(last["weighted_mse"], 4),
+            "window": first["examples"]}
+
+
+# --------------------------------------------------- throughput scenario
+
+def run_throughput(n_events: int) -> dict:
+    from mmlspark_tpu.io.registry import ModelRegistry
+    from mmlspark_tpu.io.streaming import JsonlEventSource
+    from mmlspark_tpu.models.vw.sgd import init_state
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+    from mmlspark_tpu.resilience import CheckpointStore
+    from mmlspark_tpu.train.online_loop import (ModelPublisher,
+                                                OnlineLearnerRunner)
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    work = tempfile.mkdtemp(prefix="online_loop_")
+    log_path = os.path.join(work, "events.jsonl")
+    registry = ModelRegistry(os.path.join(work, "registry"))
+    store = CheckpointStore(os.path.join(work, "ckpt"), keep_last=4)
+    true_w = _true_weights()
+    env = EnvWriter(log_path, true_w, delay_range=(0.02, 0.5))
+    env.start()
+
+    rng = random.Random(11)
+    for i in range(n_events):
+        env.submit(f"k{i:07d}",
+                   sorted(rng.sample(range(NUM_FEATURES), ROW_W)))
+    env.close()
+
+    publisher = ModelPublisher(registry, set_current=True)
+    runner = OnlineLearnerRunner(
+        _estimator(), JsonlEventSource(log_path), row_width=ROW_W,
+        store=store, joiner=_joiner(n_events), horizon_s=HORIZON_S,
+        snapshot_every=SNAPSHOT_EVERY,
+        publish_every=PUBLISH_EVERY, holdout_every=HOLDOUT_EVERY,
+        publisher=publisher)
+    driver = LearnerDriver(lambda: runner, env.done)
+    t0 = time.perf_counter()
+    runner = driver.drain()
+    runner.joiner.advance(time.perf_counter() + 10 * HORIZON_S)
+    final_state, digest = runner.finalize()
+    trajectory = _holdout_trajectory(runner, init_state(NUM_FEATURES),
+                                     final_state)
+    wall = time.perf_counter() - t0
+
+    from mmlspark_tpu.resilience import REFUSAL_REASONS
+    summary = {
+        "scenario": "throughput",
+        "events": n_events,
+        "duration_s": round(wall, 2),
+        "learner_busy_s": round(driver.busy_s, 2),
+        "examples_per_s": round(
+            runner.counts["trained"] / max(driver.busy_s, 1e-9), 1),
+        "loop_counts": dict(runner.counts),
+        "joiner_counts": dict(runner.joiner.counts),
+        "refusals": sum(runner.joiner.counts[r]
+                        for r in REFUSAL_REASONS),
+        "publisher_counts": dict(publisher.counts),
+        "learner_digest": digest,
+        "holdout": trajectory,
+        **_lag_quantiles(reg),
+    }
+    set_registry(prev)
+    return summary
+
+
+# -------------------------------------------------- chaos serving fleet
+
+def _vw_loader(vdir, manifest):
+    """Registry loader for the serving workers: the loop's published
+    weights.npz -> dense linear scorer (module-level so spawn-context
+    processes can pickle the RegistryModelSource around it)."""
+    from mmlspark_tpu.models.vw.sgd import state_from_bytes
+    with open(os.path.join(vdir, "weights.npz"), "rb") as fh:
+        state = state_from_bytes(fh.read())
+    w = np.asarray(state.w, np.float32)
+    b = float(np.asarray(state.bias))
+
+    def handler(df):
+        x = np.asarray(df["features"], np.float32)
+        return df.with_column("prediction", (x @ w + b).astype(np.float32))
+    return handler
+
+
+def _worker_main(coord_url, partition, registry_dir, ready, stop):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mmlspark_tpu.io.distributed_serving import DistributedServingServer
+    from mmlspark_tpu.io.registry import RegistryModelSource
+
+    server = DistributedServingServer(
+        None, coord_url, SERVICE, partition=partition,
+        machine=f"online-{partition}", port=0,
+        max_batch_size=256, max_latency_ms=0.5,
+        heartbeat_interval_s=0.25, max_queue=4096,
+        model_source=RegistryModelSource(registry_dir, _vw_loader)).start()
+    ready.set()
+    stop.wait()
+    server.stop()
+
+
+class _TrafficClient(threading.Thread):
+    """Posts single-row bodies through the gateway; every eventually-
+    accepted (200, well-formed payload) request becomes a prediction
+    event in the loop. Retryable failures (503/504, connection drops —
+    a worker just died, the gateway is rebalancing) are retried to
+    acceptance; a request that exhausts its retry budget or gets a
+    malformed 200 payload is ACCEPTED-REQUEST LOSS."""
+
+    def __init__(self, cid, gateway_url, n_requests, env, counters,
+                 lock):
+        super().__init__(daemon=True)
+        self.cid = cid
+        self.url = f"{gateway_url}/gateway/{SERVICE}"
+        self.n_requests = n_requests
+        self.env = env
+        self.counters = counters
+        self.lock = lock
+        self._rng = random.Random(100 + cid)
+
+    def _post(self, body):
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/octet-stream",
+                     "X-Deadline-Ms": str(DEADLINE_MS)})
+        with urllib.request.urlopen(req, timeout=30.0) as r:
+            return r.read()
+
+    def run(self):
+        from mmlspark_tpu.io import rowcodec
+        for i in range(self.n_requests):
+            indices = sorted(self._rng.sample(range(NUM_FEATURES), ROW_W))
+            x = np.zeros((1, NUM_FEATURES), np.float32)
+            x[0, indices] = 1.0
+            body = rowcodec.encode("features", x)
+            accepted = False
+            for attempt in range(40):
+                try:
+                    payload = self._post(body)
+                    _, preds = rowcodec.decode(payload)
+                    if preds.shape[0] == 1 and np.isfinite(preds).all():
+                        accepted = True
+                    else:
+                        with self.lock:
+                            self.counters["bad_payload"] += 1
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code in (503, 504):
+                        with self.lock:
+                            self.counters["retries"] += 1
+                        time.sleep(0.05 + 0.05 * min(attempt, 4))
+                        continue
+                    with self.lock:
+                        self.counters["errors"] += 1
+                    break
+                except Exception:  # noqa: BLE001 - connection-level retry
+                    with self.lock:
+                        self.counters["retries"] += 1
+                    time.sleep(0.05 + 0.05 * min(attempt, 4))
+            if accepted:
+                with self.lock:
+                    self.counters["accepted"] += 1
+                self.env.submit(f"c{self.cid}r{i:06d}", indices)
+            else:
+                with self.lock:
+                    self.counters["lost"] += 1
+
+
+def run_chaos(n_events: int, n_workers: int, n_clients: int) -> dict:
+    from mmlspark_tpu.io.distributed_serving import ServingCoordinator
+    from mmlspark_tpu.io.registry import ModelRegistry
+    from mmlspark_tpu.io.streaming import JsonlEventSource
+    from mmlspark_tpu.models.vw.sgd import init_state, state_to_bytes
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+    from mmlspark_tpu.resilience import CheckpointStore
+    from mmlspark_tpu.resilience.chaos import (RewardFaultInjector,
+                                               TrainingFaultInjector)
+    from mmlspark_tpu.train.online_loop import (ModelPublisher,
+                                                OnlineLearnerRunner,
+                                                offline_replay)
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    work = tempfile.mkdtemp(prefix="online_chaos_")
+    log_path = os.path.join(work, "events.jsonl")
+    rdir = os.path.join(work, "registry")
+    registry = ModelRegistry(rdir, keep_last=8)
+    store = CheckpointStore(os.path.join(work, "ckpt"), keep_last=4)
+    incidents = IncidentWriter(os.path.join(work, "incidents"))
+
+    # v1: the untrained model the fleet serves while the loop warms up
+    registry.publish(
+        {"weights.npz": state_to_bytes(init_state(NUM_FEATURES))},
+        extra={"kind": "online_loop"}, set_current=True)
+
+    coord = ServingCoordinator(
+        heartbeat_timeout_s=2.0, registry=reg, coalesce_max=8,
+        canary_beats=2, rollout_timeout_s=8.0).start()
+    ctx = mp.get_context("spawn")
+    procs, stops = [], []
+    for p in range(n_workers):
+        ready, stop = ctx.Event(), ctx.Event()
+        proc = ctx.Process(target=_worker_main,
+                           args=(coord.url, p, rdir, ready, stop),
+                           daemon=True)
+        proc.start()
+        procs.append(proc)
+        stops.append(stop)
+        if not ready.wait(60):
+            raise RuntimeError("serving worker failed to start")
+
+    # reward storm: seeded duplicate/delay/drop faults on the reward
+    # stream — the injector's counts are the independent ground truth
+    reward_inj = RewardFaultInjector(
+        seed=19, duplicate_rate=0.08, delay_rate=0.05, drop_rate=0.05,
+        horizon_s=HORIZON_S)
+    env = EnvWriter(log_path, _true_weights(), injector=reward_inj,
+                    delay_range=(0.05, 1.0))
+    env.start()
+
+    # the publish leg rolls new versions through the coordinator; the
+    # holdout gate doubles as the rollout monitor (a worse canary rolls
+    # back like a corrupt artifact). The monitor reads the CURRENT
+    # runner's live window through `holder` so a learner kill/resume
+    # does not strand it on a dead gate object.
+    holder = {}
+    rollouts = []
+
+    def rollout_fn(version):
+        try:
+            # the canary pointer is the monitor's handle on what is
+            # being judged (the coordinator tracks workers, not the
+            # model registry)
+            registry.set_canary(version)
+            coord.start_rollout(SERVICE, version)
+            rollouts.append({"version": version, "state": "started"})
+        except Exception as exc:  # noqa: BLE001 - a busy rollout is not fatal
+            rollouts.append({"version": version,
+                             "skipped": str(exc)[:120]})
+
+    def monitor():
+        try:
+            runner = holder.get("runner")
+            if runner is None or runner.gate is None:
+                return None
+            return runner.gate.rollout_monitor(registry)()
+        except Exception:  # noqa: BLE001 - a racing window read is not a breach
+            return None
+    coord.add_rollout_monitor(monitor)
+
+    # promote the registry CURRENT pointer when a rollout completes so
+    # the holdout gate's incumbent tracks what the fleet actually serves
+    promoter_stop = threading.Event()
+
+    def promoter():
+        promoted = set()
+        while not promoter_stop.is_set():
+            ro = coord.rollout_status(SERVICE) or {}
+            if ro.get("state") == "done":
+                target = int(ro.get("target", 0))
+                if target and target not in promoted:
+                    registry.set_current(target)
+                    promoted.add(target)
+                    rollouts.append({"version": target,
+                                     "state": "promoted"})
+            promoter_stop.wait(0.2)
+    promoter_thread = threading.Thread(target=promoter, daemon=True)
+    promoter_thread.start()
+
+    train_inj = TrainingFaultInjector(seed=0, kill_at_chunk=2)
+
+    def mk_runner():
+        runner = OnlineLearnerRunner(
+            _estimator(), JsonlEventSource(log_path), row_width=ROW_W,
+            store=store, joiner=_joiner(n_events), horizon_s=HORIZON_S,
+            snapshot_every=SNAPSHOT_EVERY, publish_every=PUBLISH_EVERY,
+            holdout_every=HOLDOUT_EVERY,
+            publisher=ModelPublisher(registry, rollout_fn=rollout_fn),
+            event_log=coord.events)
+        train_inj.arm(runner)
+        holder["runner"] = runner
+        return runner
+
+    lock = threading.Lock()
+    counters = {"accepted": 0, "lost": 0, "bad_payload": 0,
+                "retries": 0, "errors": 0}
+    per_client = n_events // n_clients
+    clients = [_TrafficClient(c, coord.url, per_client, env, counters,
+                              lock) for c in range(n_clients)]
+    t0 = time.perf_counter()
+    for c in clients:
+        c.start()
+
+    # worker kill: terminate one worker a third of the way through the
+    # traffic; the gateway must evict it and clients retry to acceptance
+    worker_kills = [0]
+
+    def killer():
+        target = max(1, (per_client * n_clients) // 3)
+        while True:
+            with lock:
+                if counters["accepted"] >= target:
+                    break
+            time.sleep(0.05)
+        procs[0].terminate()
+        worker_kills[0] += 1
+        with lock:
+            snap = dict(counters)
+        incidents.write("worker_kill",
+                        f"terminated worker 0 of {n_workers} at "
+                        f"{snap['accepted']} accepted requests",
+                        client_counters=snap,
+                        system_events=_recent_events(coord.events))
+    kill_thread = threading.Thread(target=killer, daemon=True)
+    kill_thread.start()
+
+    # the learner drains the log CONCURRENTLY with the traffic; a closer
+    # thread ends the environment once every client has finished
+    def closer():
+        for c in clients:
+            c.join()
+        env.close()
+    closer_thread = threading.Thread(target=closer, daemon=True)
+    closer_thread.start()
+
+    driver = LearnerDriver(mk_runner, env.done, incidents=incidents,
+                           chaos_counts=reward_inj.counts)
+    runner = driver.drain()
+    closer_thread.join(30.0)
+    kill_thread.join(10.0)
+    wall = time.perf_counter() - t0
+
+    # flush the join buffer far past the horizon: every dropped reward's
+    # prediction must surface as a counted reward_timeout
+    runner.joiner.advance(time.perf_counter() + 10 * HORIZON_S)
+    final_state, digest = runner.finalize()
+    trajectory = _holdout_trajectory(runner, init_state(NUM_FEATURES),
+                                     final_state)
+
+    # reward-storm reconciliation: ground truth vs the joiner, EXACT
+    jc = dict(runner.joiner.counts)
+    fc = dict(reward_inj.counts)
+    identities = {
+        "joined == ok + duplicate_reward":
+            jc["joined"] == fc["ok"] + fc["duplicate_reward"],
+        "duplicate == duplicate_reward":
+            jc["duplicate"] == fc["duplicate_reward"],
+        "expired == delay_reward": jc["expired"] == fc["delay_reward"],
+        "reward_timeout == drop_reward":
+            jc["reward_timeout"] == fc["drop_reward"],
+        "no unknown_key": jc["unknown_key"] == 0,
+        "no malformed": jc["malformed"] == 0,
+    }
+    reconciliation = {"exact": all(identities.values()),
+                      "identities": identities,
+                      "joiner": jc, "injected": fc}
+    incidents.write("reward_storm",
+                    f"{fc['rewards']} rewards through seeded "
+                    f"duplicate/delay/drop faults",
+                    reconciliation=reconciliation,
+                    system_events=_recent_events(coord.events))
+
+    # digest parity: the killed-and-resumed learner vs an uninterrupted
+    # offline replay of the exact same event log
+    oracle = offline_replay(
+        _estimator(), JsonlEventSource(log_path), row_width=ROW_W,
+        joiner=_joiner(n_events), horizon_s=HORIZON_S,
+        snapshot_every=SNAPSHOT_EVERY, holdout_every=HOLDOUT_EVERY)
+    parity = digest == oracle
+
+    # corrupt publish: a fresh version, corrupted on disk, rolled out —
+    # the swap's digest gate must fail and the rollout auto-roll-back
+    corrupt_state = {"state": "not_attempted"}
+    vbad = registry.publish(
+        {"weights.npz": state_to_bytes(final_state),
+         "meta.json": json.dumps({"learner_digest": digest}).encode()},
+        extra={"kind": "online_loop"})
+    TrainingFaultInjector.corrupt_version_payload(registry, vbad)
+    registry.set_canary(vbad)
+    started = False
+    for _ in range(100):
+        try:
+            coord.start_rollout(SERVICE, vbad)
+            started = True
+            break
+        except ValueError:
+            time.sleep(0.2)
+    if started:
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            ro = coord.rollout_status(SERVICE) or {}
+            if ro.get("state") in ("done", "rolled_back"):
+                break
+            time.sleep(0.1)
+        ro = coord.rollout_status(SERVICE) or {}
+        corrupt_state = {"version": vbad, "state": ro.get("state"),
+                         "reason": ro.get("reason")}
+    incidents.write("corrupt_publish",
+                    f"v{vbad} corrupted on disk, rollout ended "
+                    f"{corrupt_state.get('state')!r}",
+                    rollout=corrupt_state,
+                    system_events=_recent_events(coord.events))
+
+    with lock:
+        tallies = dict(counters)
+    summary = {
+        "scenario": "chaos",
+        "events": per_client * n_clients,
+        "workers": n_workers,
+        "clients": n_clients,
+        "duration_s": round(wall, 2),
+        "learner_busy_s": round(driver.busy_s, 2),
+        "examples_per_s": round(
+            runner.counts["trained"] / max(driver.busy_s, 1e-9), 1),
+        "loop_counts": dict(runner.counts),
+        "loop_totals": dict(driver.totals),
+        "client_counters": tallies,
+        "rollouts": rollouts,
+        "holdout": trajectory,
+        "learner_digest": digest,
+        **_lag_quantiles(reg),
+        "chaos": {
+            "accepted_lost": tallies["lost"] + tallies["bad_payload"],
+            "worker_kills": worker_kills[0],
+            "learner_kills": driver.totals["kills"],
+            "resumes": driver.totals["resumes"],
+            "digest_parity": parity,
+            "oracle_digest": oracle,
+            "reward_reconciliation": reconciliation,
+            "corrupt_publish": corrupt_state,
+            "incident_classes": list(incidents.classes),
+            "incident_paths": list(incidents.paths),
+        },
+    }
+
+    promoter_stop.set()
+    promoter_thread.join(5.0)
+    for p, st in zip(procs, stops):
+        if p.is_alive():
+            st.set()
+    for p in procs:
+        p.join(10.0)
+        if p.is_alive():
+            p.terminate()
+    coord.stop()
+    set_registry(prev)
+    return summary
+
+
+# ----------------------------------------------------------------- main
+
+def _gate_chaos(s) -> int:
+    rc = 0
+    chaos = s["chaos"]
+    if chaos["accepted_lost"]:
+        print(f"  !! accepted-request loss: {chaos['accepted_lost']}")
+        rc = 1
+    if not (chaos["learner_kills"] >= 1 and chaos["resumes"] >= 1):
+        print("  !! learner kill/resume never fired")
+        rc = 1
+    if not chaos["digest_parity"]:
+        print(f"  !! resumed learner digest {s['learner_digest']} != "
+              f"offline replay {chaos['oracle_digest']}")
+        rc = 1
+    if not chaos["reward_reconciliation"]["exact"]:
+        print(f"  !! reward reconciliation inexact: "
+              f"{chaos['reward_reconciliation']['identities']}")
+        rc = 1
+    if chaos["corrupt_publish"].get("state") != "rolled_back":
+        print(f"  !! corrupt publish ended "
+              f"{chaos['corrupt_publish'].get('state')!r}, wanted "
+              f"'rolled_back'")
+        rc = 1
+    missing = ({"worker_kill", "learner_kill", "reward_storm",
+                "corrupt_publish"} - set(chaos["incident_classes"]))
+    if missing:
+        print(f"  !! missing incident bundles: {sorted(missing)}")
+        rc = 1
+    return rc
+
+
+def _gate_throughput(s) -> int:
+    rc = 0
+    if s["loop_counts"]["joined"] != s["events"]:
+        print(f"  !! joined {s['loop_counts']['joined']} != "
+              f"{s['events']} events (fault-free run must join all)")
+        rc = 1
+    if s["refusals"]:
+        print(f"  !! {s['refusals']} refusals on a fault-free stream")
+        rc = 1
+    if not s["publisher_counts"]["published"]:
+        print("  !! nothing published")
+        rc = 1
+    return rc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenario", default="throughput",
+                    choices=("throughput", "chaos"))
+    ap.add_argument("--events", type=int, default=int(
+        os.environ.get("MEASURE_ONLINE_EVENTS", "0")) or None)
+    ap.add_argument("--workers", type=int, default=int(
+        os.environ.get("MEASURE_ONLINE_WORKERS", "4")))
+    ap.add_argument("--clients", type=int, default=int(
+        os.environ.get("MEASURE_ONLINE_CLIENTS", "4")))
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if args.out is None:
+        args.out = {"throughput": "docs/ONLINE_loop.json",
+                    "chaos": "docs/ONLINE_chaos.json"}[args.scenario]
+    n_events = args.events or \
+        (8000 if args.scenario == "throughput" else 4000)
+
+    print(f"== online loop: {args.scenario}, {n_events} events",
+          flush=True)
+    if args.scenario == "throughput":
+        summary = run_throughput(n_events)
+        rc = _gate_throughput(summary)
+    else:
+        summary = run_chaos(n_events, args.workers, args.clients)
+        rc = _gate_chaos(summary)
+
+    record = {
+        "host": "cpu",
+        "scenario": args.scenario,
+        "date_utc": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        **summary,
+    }
+    print(json.dumps({k: v for k, v in record.items()
+                      if k not in ("chaos", "rollouts")}, indent=1),
+          flush=True)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"wrote {args.out}")
+
+    lc = record["loop_counts"]
+    print(f"\n| scenario | ex/s | reward->applied p50/p99 | "
+          f"publish->swap p50 | joined | publishes |")
+    print("|---|---|---|---|---|---|")
+    print(f"| {record['scenario']} | {record['examples_per_s']:.0f} | "
+          f"{record['reward_to_applied_p50_ms']} / "
+          f"{record['reward_to_applied_p99_ms']} ms | "
+          f"{record['publish_swap_p50_ms']} ms | {lc['joined']} | "
+          f"{lc.get('publishes', 0)} |")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

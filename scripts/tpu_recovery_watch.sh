@@ -74,6 +74,13 @@ EOF
     run python -u scripts/measure_serving_load.py --scenario swap --out docs/SERVING_swap_chip_host.json
     echo "== model lifecycle: autoscaler ramp (round-13 tentpole) $(date -u +%FT%TZ)"
     run python -u scripts/measure_serving_load.py --scenario autoscale --out docs/SERVING_autoscale_chip_host.json
+    echo "== train-on-traffic loop: throughput + chaos (round-19 tentpole) $(date -u +%FT%TZ)"
+    # fault-free loop numbers (ex/s, reward->applied lag, publish->swap),
+    # then the chaos run: worker kill + learner kill + reward storm +
+    # corrupt publish, gated on zero accepted loss, digest parity vs the
+    # offline replay, and exact reward reconciliation (docs/ONLINE.md)
+    run python -u scripts/measure_online_loop.py --out docs/ONLINE_loop_chip.json
+    run python -u scripts/measure_online_loop.py --scenario chaos --out docs/ONLINE_chaos_chip.json
     echo "== cold start: compile cache + AOT (round-11 tentpole) $(date -u +%FT%TZ)"
     run python -u scripts/measure_cold_start.py --out docs/COLD_START_chip.json
     echo "== bench (validates binning fast path on chip) $(date -u +%FT%TZ)"

@@ -82,3 +82,9 @@ def test_long_context_attention():
 
 def test_production_scale_fit():
     assert _run("production_scale_fit.py") > 0.85
+
+
+def test_online_learning_loop():
+    # kill + resume must stay digest-identical to the offline replay and
+    # the published-version MSE trail must improve >10x
+    assert _run("online_learning_loop.py") is True

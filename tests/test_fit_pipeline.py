@@ -284,6 +284,17 @@ class TestSyncPointLint:
         ("mmlspark_tpu.io.shardstore",
          ("stream_fit_arrays", "_stream_serial", "_stream_sharded",
           "_stream_multihost")),
+        # the train-on-traffic loop (ISSUE 19): event read -> join ->
+        # stage -> ring submit is the hot path; host syncs live ONLY in
+        # the designated commit points (_commit_snapshot / _publish /
+        # finalize, deliberately NOT listed) and host array building is
+        # delegated to the module-level _coerce_rows
+        ("mmlspark_tpu.train.online_loop",
+         ("step", "_ingest_events", "_apply_staged")),
+        # the reward joiner's ingest path is pure host dict work — the
+        # lint keeps device reads from ever creeping into it
+        ("mmlspark_tpu.resilience.rewardjoin",
+         ("ingest", "_ingest_prediction", "_ingest_reward", "_join")),
     )
     #: nested defs that ARE the designated sync points
     DESIGNATED = {"_fetch_chunk_host", "_finalize_chunks"}

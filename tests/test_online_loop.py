@@ -1,0 +1,583 @@
+"""Fault-tolerant train-on-traffic loop (ISSUE 19).
+
+Coverage map:
+- `RewardJoiner` exactly-once semantics: join correctness + IPS weights,
+  duplicate/out-of-order/late/expired/unknown refusals (each COUNTED
+  under the documented vocabulary), bounded memory with disk spill,
+  snapshot/restore round-trip, event-time watermark determinism;
+- `RewardFaultInjector` seeded reward-plane faults reconciled EXACTLY
+  against the joiner's refusal tallies (ground truth vs registry, the
+  transport-fault posture);
+- durable cursor + torn-tail semantics of `JsonlEventSource` ride in
+  tests/test_streaming.py (the satellite's restart-boundary regression);
+- `OnlineLearnerRunner`: preempt-resume digest parity against an
+  uninterrupted offline replay of the same seeded event log (injected
+  kill at a join boundary via TrainingFaultInjector.arm, and a SIGTERM
+  drain), at ndev 1 and 2 with the reshard counted;
+- the publish leg: HoldoutGate admit/refuse, ModelPublisher
+  gate_refused counting, and the gate wired as a coordinator rollout
+  monitor auto-rolling back a worse canary (direct-drive, no sockets);
+- the full chaos scenario (worker kill + learner kill + reward storm +
+  corrupt publish) rides ONE @slow mini-run of
+  scripts/measure_online_loop.py.
+
+Everything tier-1 here uses injected clocks and in-process fakes only.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io.registry import ModelRegistry
+from mmlspark_tpu.io.streaming import JsonlEventSource, append_jsonl
+from mmlspark_tpu.models.vw import VowpalWabbitRegressor
+from mmlspark_tpu.models.vw.sgd import (init_state, state_digest,
+                                        state_from_bytes, state_to_bytes)
+from mmlspark_tpu.observability import MetricsRegistry
+from mmlspark_tpu.observability import bridge as obsbridge
+from mmlspark_tpu.resilience import (CheckpointStore, Preempted,
+                                     REFUSAL_REASONS, RewardJoiner)
+from mmlspark_tpu.resilience.chaos import (InjectedKill,
+                                           RewardFaultInjector,
+                                           TrainingFaultInjector)
+from mmlspark_tpu.train.online_loop import (HoldoutGate, ModelPublisher,
+                                            OnlineLearnerRunner,
+                                            offline_replay)
+
+
+def _pred(key, ts, indices=(1, 2), values=(1.0, 1.0), p=1.0):
+    return {"kind": "prediction", "key": key, "ts": ts,
+            "indices": list(indices), "values": list(values),
+            "probability": p}
+
+
+def _rew(key, ts, cost=0.5):
+    return {"kind": "reward", "key": key, "ts": ts, "cost": cost}
+
+
+# ------------------------------------------------------------ RewardJoiner
+
+class TestRewardJoiner:
+    def test_join_carries_features_cost_and_ips_weight(self):
+        j = RewardJoiner(horizon_s=10.0)
+        assert j.ingest(_pred("a", 1.0, p=0.25)) is None
+        ex = j.ingest(_rew("a", 2.0, cost=0.75))
+        assert ex["indices"] == [1, 2] and ex["label"] == 0.75
+        assert ex["weight"] == pytest.approx(4.0)   # 1/p, capped at 1e3
+        assert ex["pred_ts"] == 1.0 and ex["reward_ts"] == 2.0
+        assert j.counts["joined"] == 1
+
+    def test_ips_weight_is_capped(self):
+        j = RewardJoiner(horizon_s=10.0)
+        j.ingest(_pred("a", 1.0, p=1e-9))
+        assert j.ingest(_rew("a", 2.0))["weight"] == pytest.approx(1e3)
+
+    def test_duplicate_reward_refused_exactly_once_applied(self):
+        j = RewardJoiner(horizon_s=10.0)
+        j.ingest(_pred("a", 1.0))
+        assert j.ingest(_rew("a", 2.0)) is not None
+        assert j.ingest(_rew("a", 2.0)) is None
+        assert j.ingest(_rew("a", 3.0)) is None
+        assert j.counts["joined"] == 1 and j.counts["duplicate"] == 2
+
+    def test_duplicate_prediction_refused(self):
+        j = RewardJoiner(horizon_s=10.0)
+        j.ingest(_pred("a", 1.0))
+        assert j.ingest(_pred("a", 1.5)) is None
+        assert j.counts["duplicate_prediction"] == 1
+        # the original prediction still joins
+        assert j.ingest(_rew("a", 2.0)) is not None
+
+    def test_out_of_order_reward_before_prediction_joins(self):
+        j = RewardJoiner(horizon_s=10.0)
+        assert j.ingest(_rew("a", 1.0, cost=0.2)) is None
+        ex = j.ingest(_pred("a", 0.5))
+        assert ex is not None and ex["label"] == 0.2
+        # and a replay of the same reward is now a duplicate
+        assert j.ingest(_rew("a", 1.0, cost=0.2)) is None
+        assert j.counts["duplicate"] == 1
+
+    def test_late_reward_beyond_horizon_expired(self):
+        j = RewardJoiner(horizon_s=5.0)
+        j.ingest(_pred("a", 1.0))
+        # per-pair lateness: the reward is 99s after its prediction —
+        # refused expired, the prediction consumed; and a reward ts
+        # never advances the watermark (the delay fault must not flush
+        # other in-flight predictions)
+        j.ingest(_pred("b", 1.5))
+        assert j.ingest(_rew("a", 100.0)) is None
+        assert j.counts["expired"] == 1
+        assert j.counts["reward_timeout"] == 0
+        assert j.pending_predictions == 1
+        # a replay of the same late reward is still refused expired
+        assert j.ingest(_rew("a", 100.0)) is None
+        assert j.counts["expired"] == 2
+        # the untouched prediction still joins
+        assert j.ingest(_rew("b", 2.0)) is not None
+
+    def test_dropped_reward_prediction_evicted_by_watermark(self):
+        j = RewardJoiner(horizon_s=5.0)
+        j.ingest(_pred("a", 1.0))        # its reward never arrives
+        j.ingest(_pred("b", 100.0))      # traffic moves on
+        assert j.counts["reward_timeout"] == 1
+        assert j.pending_predictions == 1
+        # the too-late reward for the evicted prediction: expired
+        assert j.ingest(_rew("a", 3.0)) is None
+        assert j.counts["expired"] == 1
+
+    def test_unknown_key_reward_times_out(self):
+        j = RewardJoiner(horizon_s=5.0)
+        j.ingest(_rew("ghost", 1.0))
+        assert j.pending_rewards == 1
+        j.advance(100.0)
+        assert j.pending_rewards == 0
+        assert j.counts["unknown_key"] == 1
+
+    def test_malformed_events_counted_never_raise(self):
+        j = RewardJoiner(horizon_s=5.0)
+        for ev in ({}, {"kind": "reward"}, {"kind": "x", "key": "a",
+                                            "ts": 1.0},
+                   {"kind": "prediction", "key": "a", "ts": 1.0},
+                   {"kind": "reward", "key": "b", "ts": 1.0}):
+            assert j.ingest(ev) is None
+        assert j.counts["malformed"] == 5
+
+    def test_spill_bounds_memory_and_joins_exactly(self, tmp_path):
+        j = RewardJoiner(horizon_s=1e6, max_pending_mem=8,
+                         spill_dir=str(tmp_path / "spill"))
+        n = 64
+        for i in range(n):
+            j.ingest(_pred(f"k{i}", float(i)))
+        assert len(j._pending_mem) <= 8
+        assert j.pending_predictions == n
+        assert j._spill.spilled >= n - 8
+        # every reward joins, spilled or not, and carries its features
+        for i in range(n):
+            ex = j.ingest(_rew(f"k{i}", float(n + i), cost=float(i)))
+            assert ex is not None and ex["label"] == float(i)
+        assert j.counts["joined"] == n
+        # spill files for fully-drained rotations are deleted
+        spill_files = list((tmp_path / "spill").glob("*.jsonl"))
+        assert len(spill_files) <= 1
+
+    def test_no_spill_dir_overflow_evicts_counted(self):
+        j = RewardJoiner(horizon_s=1e6, max_pending_mem=4)
+        for i in range(10):
+            j.ingest(_pred(f"k{i}", float(i)))
+        assert j.pending_predictions == 4
+        assert j.counts["reward_timeout"] == 6
+
+    def test_snapshot_restore_roundtrip_with_spill(self, tmp_path):
+        j = RewardJoiner(horizon_s=100.0, max_pending_mem=4,
+                         spill_dir=str(tmp_path / "s1"))
+        for i in range(12):
+            j.ingest(_pred(f"k{i}", float(i)))
+        j.ingest(_rew("k0", 13.0))            # one applied (seen ring)
+        j.ingest(_rew("orphan", 14.0))        # one held out-of-order
+        snap = json.loads(json.dumps(j.snapshot_state()))  # JSON-able
+        j2 = RewardJoiner(horizon_s=100.0, max_pending_mem=4,
+                          spill_dir=str(tmp_path / "s2"))
+        j2.restore_state(snap)
+        assert j2.pending_predictions == j.pending_predictions
+        assert j2.pending_rewards == 1
+        # dedup survives the restore: k0 is still applied-once
+        assert j2.ingest(_rew("k0", 15.0)) is None
+        assert j2.counts["duplicate"] == 1
+        # pending predictions (incl. previously spilled) still join
+        assert j2.ingest(_rew("k5", 16.0)) is not None
+        # the held orphan reward still joins its late prediction
+        assert j2.ingest(_pred("orphan", 13.5)) is not None
+
+    def test_restore_refuses_horizon_change(self):
+        j = RewardJoiner(horizon_s=10.0)
+        snap = j.snapshot_state()
+        with pytest.raises(ValueError, match="horizon"):
+            RewardJoiner(horizon_s=20.0).restore_state(snap)
+
+    def test_refusal_vocabulary_matches_bridge(self):
+        # the bridge hardcodes the reason labels (import-cycle break);
+        # this pin keeps the two vocabularies identical
+        assert tuple(obsbridge._ONLINE_REFUSAL_REASONS) == REFUSAL_REASONS
+
+
+# ----------------------------------------------------- RewardFaultInjector
+
+class TestRewardFaultInjector:
+    def test_schedule_is_deterministic_and_matches_mutation(self):
+        inj = RewardFaultInjector(seed=7, duplicate_rate=0.2,
+                                  delay_rate=0.2, drop_rate=0.2)
+        sched = inj.schedule(50)
+        assert sched == RewardFaultInjector(
+            seed=7, duplicate_rate=0.2, delay_rate=0.2,
+            drop_rate=0.2).schedule(50)
+        for i, expect in enumerate(sched):
+            out = inj.mutate(_rew(f"k{i}", float(i)))
+            if expect == "duplicate_reward":
+                assert len(out) == 2
+            elif expect == "drop_reward":
+                assert out == []
+            elif expect == "delay_reward":
+                assert out[0]["ts"] > float(i) + inj.horizon_s
+            else:
+                assert out == [_rew(f"k{i}", float(i))]
+        assert inj.counts["rewards"] == 50
+
+    def test_predictions_pass_through_without_a_draw(self):
+        inj = RewardFaultInjector(seed=0, drop_rate=1.0)
+        assert inj.mutate(_pred("a", 1.0)) == [_pred("a", 1.0)]
+        assert inj.counts["rewards"] == 0
+
+    def test_faults_reconcile_exactly_against_joiner_counts(self):
+        horizon = 50.0
+        inj = RewardFaultInjector(seed=3, duplicate_rate=0.15,
+                                  delay_rate=0.15, drop_rate=0.15,
+                                  horizon_s=horizon)
+        j = RewardJoiner(horizon_s=horizon)
+        rng = random.Random(11)
+        t = 0.0
+        for i in range(400):
+            t += 1.0
+            key = f"k{i}"
+            j.ingest(_pred(key, t))
+            for ev in inj.mutate(_rew(key, t + rng.uniform(0.1, 5.0))):
+                j.ingest(ev)
+        # flush the tail so every dropped reward's prediction expires
+        j.advance(t + 10 * horizon)
+        c = inj.counts
+        # each duplicate emits the event twice -> second copy refused
+        assert j.counts["duplicate"] == c["duplicate_reward"]
+        # each delayed reward lands past its prediction's horizon ->
+        # expired (and consumes the prediction)
+        assert j.counts["expired"] == c["delay_reward"]
+        # only DROPPED rewards leave a prediction to time out
+        assert j.counts["reward_timeout"] == c["drop_reward"]
+        assert j.counts["joined"] == \
+            c["ok"] + c["duplicate_reward"]
+        assert j.pending_predictions == 0 and j.pending_rewards == 0
+
+
+# ------------------------------------------------------------ publish leg
+
+F_GATE = 8
+
+
+def _gate_examples(w_true, n=32):
+    out = []
+    for i in range(n):
+        k = i % F_GATE
+        out.append({"indices": [k], "values": [1.0],
+                    "label": float(w_true[k]), "weight": 1.0,
+                    "pred_ts": float(i), "reward_ts": float(i)})
+    return out
+
+
+def _state_with_w(w):
+    s = init_state(F_GATE)
+    return s._replace(w=np.asarray(w, np.float32))
+
+
+class TestHoldoutGateAndPublisher:
+    def setup_method(self):
+        self.w_true = np.linspace(-1.0, 1.0, F_GATE).astype(np.float32)
+        self.good = _state_with_w(self.w_true)
+        self.bad = _state_with_w(self.w_true + 5.0)
+
+    def _gate(self):
+        gate = HoldoutGate(width=1, window=64, tolerance=0.10)
+        for ex in _gate_examples(self.w_true):
+            gate.add(ex)
+        return gate
+
+    def test_admit_passes_equal_and_refuses_worse(self):
+        gate = self._gate()
+        assert gate.admit(self.good, self.good) is None
+        reason = gate.admit(self.bad, self.good)
+        assert reason is not None and "holdout regression" in reason
+        # no incumbent or empty window always admits
+        assert gate.admit(self.bad, None) is None
+        assert HoldoutGate(width=1).admit(self.bad, self.good) is None
+
+    def test_publisher_counts_gate_refusal_and_publishes_admitted(
+            self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        rolled = []
+        pub = ModelPublisher(reg, gate=self._gate(),
+                             rollout_fn=rolled.append)
+        v1 = pub.publish(self.good, {"joined": 10})
+        assert v1 == 1 and rolled == [1]
+        assert pub.publish(self.bad, {"joined": 20}) is None
+        assert pub.counts == {"published": 1, "gate_refused": 1,
+                              "error": 0}
+        # the registry holds only the admitted version, loadable back
+        assert reg.versions() == [1]
+        vdir, man = reg.resolve(1)
+        got = state_from_bytes(
+            open(os.path.join(vdir, "weights.npz"), "rb").read())
+        assert state_digest(got) == state_digest(self.good)
+        meta = json.loads(
+            open(os.path.join(vdir, "meta.json")).read())
+        assert meta["joined"] == 10
+
+    def test_rollout_monitor_rolls_back_worse_canary(self, tmp_path):
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        pub = ModelPublisher(reg)
+        v1 = pub.publish(self.good, {})
+        v2 = pub.publish(self.bad, {})   # no gate: the bad model escapes
+        reg.set_current(v1)
+        reg.set_canary(v2)
+        gate = self._gate()
+        coord = ServingCoordinator(registry=MetricsRegistry(),
+                                   canary_beats=2)
+        coord.add_rollout_monitor(gate.rollout_monitor(reg))
+        infos = [ServiceInfo("svc", "127.0.0.1", 1000 + i, "m", i,
+                             heartbeating=True) for i in range(2)]
+        for info in infos:
+            coord.register(info)
+            coord.heartbeat(info, report={"model_version": v1,
+                                          "requests_total": 0,
+                                          "errors_total": 0})
+        coord.start_rollout("svc", v2)
+        coord.rollout_tick()
+        ro = coord.rollout_status("svc")
+        assert ro["state"] == "rolled_back"
+        assert "holdout regression" in ro["reason"]
+        # workers re-target the previous version
+        assert coord.heartbeat_target(infos[0]) == v1
+
+    def test_rollout_monitor_passes_healthy_canary(self, tmp_path):
+        from mmlspark_tpu.io.distributed_serving import (ServiceInfo,
+                                                         ServingCoordinator)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        pub = ModelPublisher(reg)
+        v1 = pub.publish(self.good, {})
+        v2 = pub.publish(_state_with_w(self.w_true + 0.001), {})
+        reg.set_current(v1)
+        reg.set_canary(v2)
+        coord = ServingCoordinator(registry=MetricsRegistry(),
+                                   canary_beats=2)
+        coord.add_rollout_monitor(
+            self._gate().rollout_monitor(reg))
+        info = ServiceInfo("svc", "127.0.0.1", 1000, "m", 0,
+                           heartbeating=True)
+        coord.register(info)
+        coord.heartbeat(info, report={"model_version": v1,
+                                      "requests_total": 0,
+                                      "errors_total": 0})
+        coord.start_rollout("svc", v2)
+        coord.rollout_tick()
+        assert coord.rollout_status("svc")["state"] == "canary"
+
+
+# --------------------------------------------------------------- the loop
+
+ROW_W = 4
+NUM_FEATURES = 64   # numBits=6
+
+
+def _write_event_log(path, n=900, seed=0, max_delay=2.0):
+    """Seeded synthetic traffic: linear true costs, bounded reward
+    delay, rewards interleaved in event-time order."""
+    rng = random.Random(seed)
+    true_w = [rng.uniform(-1, 1) for _ in range(NUM_FEATURES)]
+    t, pending = 0.0, []
+    for i in range(n):
+        t += 0.01
+        idx = sorted(rng.sample(range(NUM_FEATURES), ROW_W))
+        append_jsonl(path, _pred(f"k{i:06d}", t, idx, [1.0] * ROW_W))
+        cost = sum(true_w[j] for j in idx) + rng.gauss(0, 0.05)
+        pending.append((t + rng.uniform(0.05, max_delay),
+                        f"k{i:06d}", cost))
+        pending.sort()
+        while pending and pending[0][0] <= t:
+            rts, k, c = pending.pop(0)
+            append_jsonl(path, _rew(k, rts, c))
+    for rts, k, c in sorted(pending):
+        append_jsonl(path, _rew(k, rts, c))
+    return true_w
+
+
+@pytest.fixture(scope="module")
+def event_log(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("events") / "events.jsonl")
+    _write_event_log(path)
+    return path
+
+
+def _estimator():
+    return VowpalWabbitRegressor(numBits=6)
+
+
+def _runner(event_log, store=None, **kw):
+    kw.setdefault("horizon_s", 10.0)
+    kw.setdefault("snapshot_every", 128)
+    return OnlineLearnerRunner(_estimator(), JsonlEventSource(event_log),
+                               row_width=ROW_W, store=store, **kw)
+
+
+class TestOnlineLearnerRunner:
+    def test_uninterrupted_run_joins_everything(self, event_log):
+        r = _runner(event_log, holdout_every=10)
+        r.run(idle_limit=2)
+        _, digest = r.finalize()
+        assert r.counts["joined"] == 900
+        assert r.counts["held_out"] == 90
+        assert r.counts["trained"] == 810
+        assert r.joiner.counts["joined"] == 900
+        assert digest.startswith("sha256:")
+        assert len(r.gate.window) > 0
+
+    def test_publish_cadence_must_align_with_snapshots(self, event_log):
+        with pytest.raises(ValueError, match="multiple of"):
+            _runner(event_log, snapshot_every=128, publish_every=200)
+
+    @pytest.mark.parametrize("resume_ndev", [1, 2])
+    def test_injected_kill_resume_digest_parity(self, event_log,
+                                                tmp_path, resume_ndev):
+        oracle = offline_replay(_estimator(), JsonlEventSource(event_log),
+                                row_width=ROW_W, horizon_s=10.0,
+                                snapshot_every=128, holdout_every=10)
+        inj = TrainingFaultInjector(seed=0, kill_at_chunk=2)
+        store_dir = str(tmp_path / "ckpt")
+        r1 = _runner(event_log, store=CheckpointStore(store_dir),
+                     holdout_every=10, ndev=1)
+        inj.arm(r1)
+        with pytest.raises(InjectedKill):
+            r1.run(idle_limit=2)
+        assert inj.counts["kills"] == 1
+        # resume — at a different device count for the parametrized leg:
+        # the VW carry is unsharded, so the digest must not move, and
+        # the downshift is a COUNTED outcome, not a silent one
+        r2 = _runner(event_log, store=CheckpointStore(store_dir),
+                     holdout_every=10, ndev=resume_ndev)
+        assert r2.counts["resumes"] == 1
+        assert r2.counts["joined"] == 384    # 3 snapshots * 128
+        assert r2.counts["reshards"] == (0 if resume_ndev == 1 else 1)
+        r2.run(idle_limit=2)
+        _, digest = r2.finalize()
+        assert digest == oracle
+        assert r2.counts["joined"] == 900
+        # zero lost, zero double-applied: the joiner re-absorbed the
+        # replayed window without a single duplicate application
+        assert r2.joiner.counts["joined"] == 900
+
+    def test_sigterm_drain_preempts_at_boundary_then_resumes(
+            self, event_log, tmp_path):
+        class Drain:
+            requested = False
+        oracle = offline_replay(_estimator(), JsonlEventSource(event_log),
+                                row_width=ROW_W, horizon_s=10.0,
+                                snapshot_every=128)
+        drain = Drain()
+        store_dir = str(tmp_path / "ckpt")
+        r1 = _runner(event_log, store=CheckpointStore(store_dir),
+                     drain=drain)
+
+        def trip(ordinal, joined):
+            if ordinal == 1:
+                drain.requested = True
+        r1.arm(trip)
+        with pytest.raises(Preempted):
+            r1.run(idle_limit=2)
+        r2 = _runner(event_log, store=CheckpointStore(store_dir))
+        assert r2.counts["joined"] == 256
+        r2.run(idle_limit=2)
+        _, digest = r2.finalize()
+        assert digest == oracle
+
+    def test_holdout_diversion_survives_resume(self, event_log,
+                                               tmp_path):
+        store_dir = str(tmp_path / "ckpt")
+        r1 = _runner(event_log, store=CheckpointStore(store_dir),
+                     holdout_every=7)
+        inj = TrainingFaultInjector(seed=0, kill_at_chunk=1)
+        inj.arm(r1)
+        with pytest.raises(InjectedKill):
+            r1.run(idle_limit=2)
+        r2 = _runner(event_log, store=CheckpointStore(store_dir),
+                     holdout_every=7)
+        r2.run(idle_limit=2)
+        r2.finalize()
+        # the window was restored, diversion cadence stayed phase-locked
+        assert r2.counts["held_out"] == 900 // 7
+        assert r2.counts["trained"] + r2.counts["held_out"] == 900
+
+    def test_loop_publishes_through_registry(self, event_log, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        rolled = []
+        pub = ModelPublisher(reg, rollout_fn=rolled.append)
+        r = _runner(event_log, holdout_every=10, publish_every=256,
+                    publisher=pub)
+        r.run(idle_limit=2)
+        assert r.counts["publishes"] >= 2
+        assert reg.versions() and rolled
+        vdir, man = reg.resolve(reg.versions()[-1])
+        meta = json.loads(open(os.path.join(vdir, "meta.json")).read())
+        assert meta["learner_digest"].startswith("sha256:")
+        assert man["extra"]["kind"] == "online_loop"
+
+    def test_corrupt_snapshot_falls_back_one_boundary(self, event_log,
+                                                      tmp_path):
+        from mmlspark_tpu.resilience.chaos import TrainingFaultInjector
+        oracle = offline_replay(_estimator(), JsonlEventSource(event_log),
+                                row_width=ROW_W, horizon_s=10.0,
+                                snapshot_every=128)
+        store_dir = str(tmp_path / "ckpt")
+        inj = TrainingFaultInjector(seed=0, kill_at_chunk=3)
+        r1 = _runner(event_log,
+                     store=CheckpointStore(store_dir, keep_last=4))
+        inj.arm(r1)
+        with pytest.raises(InjectedKill):
+            r1.run(idle_limit=2)
+        # corrupt the newest snapshot: restore must fall back to the
+        # previous boundary, replay the difference, and still hit parity
+        TrainingFaultInjector.corrupt_latest_snapshot(
+            CheckpointStore(store_dir, keep_last=4), mode="truncate")
+        r2 = _runner(event_log,
+                     store=CheckpointStore(store_dir, keep_last=4))
+        assert r2.counts["joined"] == 384     # one boundary earlier
+        r2.run(idle_limit=2)
+        _, digest = r2.finalize()
+        assert digest == oracle
+
+
+# ------------------------------------------------------- @slow chaos run
+
+@pytest.mark.slow
+def test_online_loop_chaos_mini_run(tmp_path):
+    """End-to-end mini run of the chaos harness: traffic + environment
+    rewards + learner + publish/canary under worker kill, learner kill,
+    reward storm, and one corrupt publish. Full-length numbers:
+    docs/ONLINE_loop.json, docs/ONLINE.md."""
+    out = tmp_path / "online.json"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "MEASURE_ONLINE_EVENTS": "1200",
+           "MEASURE_ONLINE_WORKERS": "2"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "scripts/measure_online_loop.py",
+         "--scenario", "chaos", "--out", str(out)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=480)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    chaos = rec["chaos"]
+    # zero accepted-request loss under every injected fault class
+    assert chaos["accepted_lost"] == 0
+    assert chaos["learner_kills"] >= 1 and chaos["resumes"] >= 1
+    assert chaos["worker_kills"] >= 1
+    # the resumed learner is digest-identical to the uninterrupted
+    # offline replay of the same event log
+    assert chaos["digest_parity"] is True
+    # the corrupt publish auto-rolled back
+    assert chaos["corrupt_publish"]["state"] == "rolled_back"
+    # reward-storm reconciliation is exact
+    assert chaos["reward_reconciliation"]["exact"] is True
+    # one incident bundle per injected fault class
+    assert set(chaos["incident_classes"]) >= {
+        "worker_kill", "learner_kill", "reward_storm", "corrupt_publish"}

@@ -312,3 +312,154 @@ class TestServingReplay:
             assert statuses == [200, 500], statuses
         finally:
             src.stop()
+
+
+# ----------------------------------------------- durable cursors (ISSUE 19)
+
+from mmlspark_tpu.io.streaming import JsonlEventSource, append_jsonl  # noqa: E402
+
+
+class TestJsonlEventSource:
+    """The train-on-traffic loop's ingest primitive: record-granular
+    byte-offset cursor, durable through the atomic-write helper,
+    torn-tail safe — replay NEVER drops or duplicates at a restart
+    boundary."""
+
+    def _log(self, tmp_path, n=10):
+        path = str(tmp_path / "events.jsonl")
+        for i in range(n):
+            append_jsonl(path, {"kind": "reward", "key": f"k{i}",
+                                "ts": float(i), "cost": 0.0})
+        return path
+
+    def test_read_all_in_order_with_offsets(self, tmp_path):
+        path = self._log(tmp_path)
+        src = JsonlEventSource(path)
+        recs = src.read(max_records=100)
+        assert [r["key"] for r in recs] == [f"k{i}" for i in range(10)]
+        # every record carries its own consume-cursor, strictly increasing
+        offs = [r["_next_offset"] for r in recs]
+        assert offs == sorted(offs)
+        assert src.read() == []
+
+    def test_durable_cursor_survives_restart_exactly(self, tmp_path):
+        path = self._log(tmp_path)
+        ckpt = str(tmp_path / "ckpt")
+        src = JsonlEventSource(path, checkpoint_dir=ckpt)
+        first = src.read(max_records=4)
+        src.commit()
+        # a NEW source over the same checkpoint resumes at exactly k4:
+        # nothing re-delivered, nothing skipped
+        src2 = JsonlEventSource(path, checkpoint_dir=ckpt)
+        rest = src2.read(max_records=100)
+        assert [r["key"] for r in first + rest] == \
+            [f"k{i}" for i in range(10)]
+
+    def test_uncommitted_reads_replay_never_drop(self, tmp_path):
+        path = self._log(tmp_path)
+        ckpt = str(tmp_path / "ckpt")
+        src = JsonlEventSource(path, checkpoint_dir=ckpt)
+        src.read(max_records=4)
+        src.commit()
+        src.read(max_records=3)   # consumed but NOT committed -> replayed
+        src2 = JsonlEventSource(path, checkpoint_dir=ckpt)
+        assert [r["key"] for r in src2.read(max_records=100)] == \
+            [f"k{i}" for i in range(4, 10)]
+
+    def test_seek_to_stored_cursor_is_exact_replay(self, tmp_path):
+        path = self._log(tmp_path)
+        src = JsonlEventSource(path)
+        recs = src.read(max_records=6)
+        cur = {"offset": recs[2]["_next_offset"]}
+        src.seek(cur)
+        assert [r["key"] for r in src.read(max_records=100)] == \
+            [f"k{i}" for i in range(3, 10)]
+
+    def test_torn_tail_not_consumed_until_complete(self, tmp_path):
+        path = self._log(tmp_path, n=2)
+        with open(path, "ab") as fh:
+            fh.write(b'{"kind": "reward", "key": "torn"')  # no newline
+        src = JsonlEventSource(path)
+        assert len(src.read()) == 2
+        before = src.cursor()
+        assert src.read() == []          # tail stays unconsumed
+        assert src.cursor() == before
+        # the writer finishes the line -> it becomes readable
+        with open(path, "ab") as fh:
+            fh.write(b', "ts": 2.0, "cost": 0.0}\n')
+        got = src.read()
+        assert [r["key"] for r in got] == ["torn"]
+
+    def test_abandoned_torn_line_skipped_and_counted(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        append_jsonl(path, {"kind": "reward", "key": "a", "ts": 0.0,
+                            "cost": 0.0})
+        with open(path, "ab") as fh:
+            fh.write(b'{"kind": "half\n')   # crashed writer's torn line
+        append_jsonl(path, {"kind": "reward", "key": "b", "ts": 1.0,
+                            "cost": 0.0})
+        src = JsonlEventSource(path)
+        assert [r["key"] for r in src.read()] == ["a", "b"]
+        assert src.torn_lines == 1
+
+    def test_unreadable_cursor_degrades_to_replay(self, tmp_path):
+        path = self._log(tmp_path, n=3)
+        ckpt = str(tmp_path / "ckpt")
+        src = JsonlEventSource(path, checkpoint_dir=ckpt)
+        src.read()
+        src.commit()
+        with open(os.path.join(ckpt, "cursor.json"), "w") as fh:
+            fh.write("{not json")
+        # at-least-once posture: a damaged cursor replays from 0 (the
+        # consumer's dedup makes it exactly-once), never drops
+        src2 = JsonlEventSource(path, checkpoint_dir=ckpt)
+        assert len(src2.read()) == 3
+
+
+class TestCommitRestartBoundary:
+    """Regression for the pre-19 FileStreamSource.commit ordering: the
+    in-memory promotion happened BEFORE the offsets file was durable, so
+    a crash between the two lost the batch from replay on restart (the
+    next poll saw the files as already-seen in memory but the restarted
+    process re-ingested them — or, worse, a torn offsets write dropped
+    the whole seen-set). Durable-then-promote through the atomic helper
+    closes it."""
+
+    def test_crash_during_offsets_write_keeps_batch_replayable(
+            self, tmp_path, monkeypatch):
+        d = tmp_path / "in"
+        d.mkdir()
+        ckpt = str(tmp_path / "ckpt")
+        _write(d / "a.bin", b"one")
+        src = FileStreamSource(str(d), checkpoint_dir=ckpt)
+        batch = src.read_batch()
+        assert batch is not None
+
+        import mmlspark_tpu.io.streaming as streaming_mod
+
+        def boom(path, text):
+            raise OSError("disk full mid-commit")
+        monkeypatch.setattr(streaming_mod, "atomic_write_text", boom)
+        with pytest.raises(OSError):
+            src.commit()
+        monkeypatch.undo()
+        # the failed commit must NOT have promoted in memory: the same
+        # batch is still pending and a retried commit succeeds
+        src.commit()
+        src2 = FileStreamSource(str(d), checkpoint_dir=ckpt)
+        assert src2.read_batch() is None   # durably seen -> no replay
+
+    def test_offsets_file_written_atomically(self, tmp_path):
+        d = tmp_path / "in"
+        d.mkdir()
+        ckpt = str(tmp_path / "ckpt")
+        _write(d / "a.bin", b"one")
+        src = FileStreamSource(str(d), checkpoint_dir=ckpt)
+        src.read_batch()
+        src.commit()
+        # no temp litter beside the offsets file (atomic rename), and a
+        # fresh source over the checkpoint sees the commit
+        litter = [n for n in os.listdir(ckpt) if n.endswith(".tmp")]
+        assert litter == []
+        assert FileStreamSource(str(d), checkpoint_dir=ckpt
+                                ).read_batch() is None
