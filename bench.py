@@ -158,6 +158,22 @@ def _emit(value, unit="rows*iter/s", extra=None, error=None,
             extra.setdefault("online_loop", _online)
     except Exception as e:  # noqa: BLE001
         extra.setdefault("online_loop_error", str(e)[:200])
+    # Production-day scorecard (ISSUE-20): the most recent full-day run
+    # (scripts/run_production_day.py) rides in the record — chip run
+    # preferred — carrying the machine-checked verdicts: per-phase SLO
+    # adherence, zero accepted-request loss, bundle-per-fault-class,
+    # exact chaos reconciliation, autoscaler cost proxy, and the
+    # master-seed fault-schedule digest (docs/SCENARIOS.md).
+    try:
+        for _fn in ("PRODUCTION_DAY_chip.json", "PRODUCTION_DAY.json"):
+            _lp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", _fn)
+            if os.path.exists(_lp):
+                with open(_lp) as _f:
+                    extra.setdefault("production_day", json.load(_f))
+                break
+    except Exception as e:  # noqa: BLE001
+        extra.setdefault("production_day_error", str(e)[:200])
     rec["extra"] = extra
     if error:
         rec["error"] = str(error)[:2000]

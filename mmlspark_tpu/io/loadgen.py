@@ -1,0 +1,843 @@
+"""Reusable serving-load harness: fleet setup, traffic, observability.
+
+Extracted from scripts/measure_serving_load.py (ISSUE 20 satellite): the
+sustained-load / hot-swap / autoscale legs were 1039 lines of
+copy-adjacent scenario code living in a script, so the production-day
+scenario engine (resilience/scenario.py + scripts/run_production_day.py)
+could only have composed them by duplicating fleet setup/teardown. This
+module is the importable library: spawn-context worker processes
+(`worker_main` stays module-level so RegistryModelSource pickles by
+module path), the keep-alive verifying client (`LoadClient`), the
+observability arm/harvest pair, the Prometheus scrape helpers, and the
+three measured legs (`run_load_variant`, `run_swap_variant`,
+`run_autoscale_variant`) byte-compatible with the script's historical
+`--scenario load|swap|autoscale` JSON output — the script is now a thin
+CLI over these functions and the old private names remain importable
+there.
+
+The legs' contracts (docs/SERVING.md):
+- load: >= 100k mixed-size row-requests/s through the gateway; chaos
+  variant adds 30% injected forward faults + one worker kill with ZERO
+  accepted (HTTP 200) requests carrying a wrong/missing payload.
+- swap: registry-backed fleet, mid-run canary -> promote rollout with
+  zero lost/shed accepted requests; chaos variant corrupts the target
+  artifact (digest gate must fail the swap) + kills a worker mid-rollout
+  + 30% forward faults — the rollout must auto-roll-back, zero loss.
+- autoscale: ramped load against a 2-worker base fleet; the Autoscaler
+  must grow 2 -> 4 under the ramp and retire back to 2 after it
+  (deregister -> drain -> stop), zero lost requests.
+"""
+
+import json
+import os
+import re
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+FEATURES = 16
+BATCH_MIX = (1, 8, 64, 256)
+DEADLINE_MS = 10_000
+SERVICE = "load"
+
+
+def ref_weights() -> np.ndarray:
+    return (np.arange(FEATURES, dtype=np.float32) + 1.0) / FEATURES
+
+
+def make_handler(w: np.ndarray, slow_ms: float = 0.0):
+    def handler(df):
+        if slow_ms:
+            # models a heavier per-batch device cost (the autoscale
+            # scenario needs queues to actually build under the ramp)
+            time.sleep(slow_ms / 1000.0)
+        x = np.asarray(df["features"], np.float32)
+        return df.with_column("prediction", (x @ w).astype(np.float32))
+    return handler
+
+
+def registry_loader(vdir: str, manifest: dict):
+    """Version loader for registry-backed workers: weights.bin -> linear
+    scorer (module-level so spawn-context worker processes can pickle a
+    RegistryModelSource built around it)."""
+    with open(os.path.join(vdir, "weights.bin"), "rb") as fh:
+        w = np.frombuffer(fh.read(), np.float32).copy()
+    slow_ms = float(manifest.get("extra", {}).get("slow_ms", 0.0))
+    return make_handler(w, slow_ms)
+
+
+def worker_main(coord_url: str, partition: int, ready, stop,
+                retire=None, registry_dir: str = None,
+                slow_ms: float = 0.0, max_batch_size: int = 1024) -> None:
+    """One serving worker in its own process (own GIL): numpy linear
+    scorer — the host-path cost model; the chip handler swaps in the
+    jitted booster (scripts/measure_serving_tpu.py). With `registry_dir`
+    the worker is registry-backed (serves CURRENT, hot-swaps on rollout
+    targets); with `retire` set it leaves via deregister -> drain -> stop
+    (the autoscaler's zero-loss scale-down)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mmlspark_tpu.io.distributed_serving import DistributedServingServer
+
+    kw = {}
+    if registry_dir is not None:
+        from mmlspark_tpu.io.registry import RegistryModelSource
+        handler = None
+        kw["model_source"] = RegistryModelSource(registry_dir,
+                                                 registry_loader)
+    else:
+        handler = make_handler(ref_weights(), slow_ms)
+
+    server = DistributedServingServer(
+        handler, coord_url, SERVICE, partition=partition,
+        machine=f"load-{partition}", port=0,
+        max_batch_size=max_batch_size, max_latency_ms=0.5,
+        heartbeat_interval_s=0.25, max_queue=4096, **kw).start()
+    ready.set()
+    while not stop.wait(0.1):
+        if retire is not None and retire.is_set():
+            server.retire(drain_timeout_s=30.0)
+            return
+    server.stop()
+
+
+class LoadClient(threading.Thread):
+    """Keep-alive HTTP/1.1 client hammering the gateway with binary
+    bodies of mixed row counts; verifies EVERY 200 payload exactly.
+    `expected_first` per body may be a tuple of acceptable values — the
+    swap scenario accepts BOTH versions' outputs for the whole run (any
+    other value is a torn/corrupt reply) and tallies which version
+    answered in `value_counts`."""
+
+    def __init__(self, host, port, path, bodies, expected, deadline_s,
+                 stop_ev):
+        super().__init__(daemon=True)
+        self.addr = (host, port)
+        self.path = path.encode()
+        # [(nrows, body, expected_first | (v1, v2, ...))] — normalized
+        self.bodies = [(n, b, e if isinstance(e, tuple) else (e,))
+                       for n, b, e in bodies]
+        self.deadline_s = deadline_s
+        self.stop_ev = stop_ev
+        self.expected = expected
+        self.sent = 0
+        self.ok_requests = 0
+        self.ok_rows = 0
+        self.shed = 0
+        self.expired = 0
+        self.errors = 0
+        self.bad_payload = 0
+        self.lost = 0
+        self.value_counts = {}        # matched expected index -> replies
+
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=30.0)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def run(self):
+        from mmlspark_tpu.io import rowcodec
+        sock = self._connect()
+        buf = b""
+        i = 0
+        head_tpl = (b"POST %s HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Type: application/octet-stream\r\n"
+                    b"X-Deadline-Ms: %d\r\n"
+                    b"Content-Length: %%d\r\n\r\n"
+                    % (self.path, DEADLINE_MS))
+        while not self.stop_ev.is_set():
+            nrows, body, exp_first = self.bodies[i % len(self.bodies)]
+            i += 1
+            try:
+                sock.sendall(head_tpl % len(body) + body)
+                self.sent += 1
+                # read one response
+                while b"\r\n\r\n" not in buf:
+                    chunk = sock.recv(262144)
+                    if not chunk:
+                        raise ConnectionError("closed")
+                    buf += chunk
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                status = int(head.split(b" ", 2)[1])
+                length = 0
+                for ln in head.split(b"\r\n"):
+                    if ln.lower().startswith(b"content-length:"):
+                        length = int(ln.split(b":", 1)[1])
+                while len(rest) < length:
+                    chunk = sock.recv(262144)
+                    if not chunk:
+                        raise ConnectionError("closed")
+                    rest += chunk
+                payload, buf = rest[:length], rest[length:]
+                if status == 200:
+                    _, preds = rowcodec.decode(payload)
+                    match = None
+                    if preds.shape[0] == nrows:
+                        for k, e in enumerate(exp_first):
+                            if abs(float(preds[0]) - e) <= 1e-4:
+                                match = k
+                                break
+                    if match is None:
+                        self.bad_payload += 1
+                    else:
+                        self.ok_requests += 1
+                        self.ok_rows += nrows
+                        self.value_counts[match] = \
+                            self.value_counts.get(match, 0) + 1
+                elif status == 503:
+                    self.shed += 1
+                elif status == 504:
+                    self.expired += 1
+                else:
+                    self.errors += 1
+            except Exception:
+                # connection died mid-request (gateway restart, teardown
+                # race): the in-flight request got NO reply
+                self.lost += 1
+                try:
+                    sock.close()
+                except Exception:
+                    pass
+                if self.stop_ev.is_set():
+                    return
+                try:
+                    sock = self._connect()
+                    buf = b""
+                except Exception:
+                    time.sleep(0.05)
+        try:
+            sock.close()
+        except Exception:
+            pass
+
+
+def make_bodies(weight_sets, rng_seed: int = 5):
+    """Binary bodies for the mixed-size schedule. `weight_sets`: one or
+    more weight vectors; each body's expected first value covers every
+    set (the swap legs accept both versions' outputs for the whole
+    run)."""
+    from mmlspark_tpu.io import rowcodec
+    rng = np.random.default_rng(rng_seed)
+    bodies = []
+    for nrows in BATCH_MIX:
+        x = rng.normal(size=(nrows, FEATURES)).astype(np.float32)
+        exp = tuple(float(x[0] @ w) for w in weight_sets)
+        bodies.append((nrows, rowcodec.encode("features", x),
+                       exp if len(exp) > 1 else exp[0]))
+    return bodies
+
+
+def scrape(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        return r.read().decode()
+
+
+# ------------------------------------------- fleet observability (PR 14)
+
+def arm_observability(coord, reg, injector=None, **recorder_kw):
+    """TraceCollector + FlightRecorder over one coordinator's fleet: the
+    collector drains every ring (gateway in-process, workers over
+    /trace), the recorder watches the anomaly triggers and dumps atomic
+    incident bundles. The chaos injector's decisions are bridged onto
+    the gateway ring so injections appear in bundles beside the failures
+    they caused. Extra kwargs reach the FlightRecorder (the
+    production-day run arms `chaos_bundles=True` this way)."""
+    import tempfile
+    from mmlspark_tpu.observability import FlightRecorder, TraceCollector
+
+    collector = TraceCollector.for_coordinator(coord, SERVICE,
+                                               registry=reg).start(0.5)
+    inc_dir = recorder_kw.pop("out_dir", None) \
+        or tempfile.mkdtemp(prefix="mmlspark_incidents_")
+    recorder_kw.setdefault("window_s", 30.0)
+    recorder_kw.setdefault("cooldown_s", 10.0)
+    recorder_kw.setdefault("shed_spike", 500.0)
+    recorder_kw.setdefault("slowest_k", 8)
+    recorder_kw.setdefault("failed_k", 20)
+    recorder = FlightRecorder.for_coordinator(
+        coord, collector, inc_dir, SERVICE, registry=reg,
+        **recorder_kw).start(1.0)
+    if injector is not None:
+        injector.event_log = coord.events
+    return collector, recorder
+
+
+def harvest_observability(summary, coord, collector, recorder):
+    """Final drain + fleet snapshot INTO the summary (workers must still
+    be up: the bundle's /health walk and the fleet snapshot need them)."""
+    if collector is None:
+        return
+    recorder.stop()
+    collector.stop()
+    try:
+        recorder.tick()   # one synchronous final pass
+    except Exception:
+        pass
+    try:
+        scripts_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "scripts")
+        sys.path.insert(0, scripts_dir)
+        from fleet_status import collect_fleet
+        summary["fleet"] = collect_fleet(coord.url)
+    except Exception as e:  # noqa: BLE001 - snapshot must not fail the run
+        summary["fleet_error"] = str(e)[:200]
+    bundles, seen = [], set()
+    for p in recorder.incidents:
+        try:
+            with open(p) as f:
+                b = json.load(f)
+        except Exception:  # noqa: BLE001
+            continue
+        # embed the FIRST bundle of each distinct reason (bundles carry
+        # full registry snapshots — a flat cap could crowd the rollback
+        # bundle out behind repeated SLO/p99 firings)
+        if b["reason"] in seen:
+            continue
+        seen.add(b["reason"])
+        bundles.append(b)
+        if len(bundles) >= 5:
+            break
+    summary["incidents"] = bundles
+    summary["incident_paths"] = list(recorder.incidents)
+
+
+def prom_value(text: str, name: str) -> float:
+    total = 0.0
+    for m in re.finditer(rf"^{name}(?:{{[^}}]*}})? ([0-9.e+-]+)$", text,
+                         re.M):
+        total += float(m.group(1))
+    return total
+
+
+def prom_by_label(text: str, name: str, label: str) -> dict:
+    """Sum a counter family per value of one label."""
+    out = {}
+    for m in re.finditer(rf'^{name}{{([^}}]*)}} ([0-9.e+-]+)$', text, re.M):
+        lm = re.search(rf'{label}="([^"]*)"', m.group(1))
+        if lm:
+            out[lm.group(1)] = out.get(lm.group(1), 0.0) + float(m.group(2))
+    return out
+
+
+def spawn_workers(ctx, coord_url, n, registry_dir=None, slow_ms=0.0,
+                  max_batch_size=1024, first_partition=0):
+    """Each worker gets its OWN stop/retire events: terminate()-ing a
+    worker that shares an Event can kill it while it holds the event's
+    internal lock, deadlocking the parent's later set() (observed on the
+    chaos path)."""
+    procs, readies, stops, retires = [], [], [], []
+    for p in range(first_partition, first_partition + n):
+        ready = ctx.Event()
+        stop = ctx.Event()
+        retire = ctx.Event()
+        proc = ctx.Process(target=worker_main,
+                           args=(coord_url, p, ready, stop, retire,
+                                 registry_dir, slow_ms, max_batch_size),
+                           daemon=True)
+        proc.start()
+        procs.append(proc)
+        readies.append(ready)
+        stops.append(stop)
+        retires.append(retire)
+    for r in readies:
+        if not r.wait(60):
+            raise RuntimeError("worker failed to start/register")
+    return procs, stops, retires
+
+
+def stop_workers(procs, stops):
+    """Signal stops, join, terminate stragglers — the shared teardown."""
+    for p, st in zip(procs, stops):
+        if p.is_alive():
+            st.set()
+    for p in procs:
+        p.join(10.0)
+        if p.is_alive():
+            p.terminate()
+
+
+def client_tallies(clients, wall) -> dict:
+    sent = sum(c.sent for c in clients)
+    ok_rows = sum(c.ok_rows for c in clients)
+    values = {}
+    for c in clients:
+        for k, v in c.value_counts.items():
+            values[k] = values.get(k, 0) + v
+    return {
+        "client_requests": sent,
+        "ok_requests": sum(c.ok_requests for c in clients),
+        "ok_rows": ok_rows,
+        "row_requests_per_s": round(ok_rows / wall, 1),
+        "shed": sum(c.shed for c in clients),
+        "expired": sum(c.expired for c in clients),
+        "errors": sum(c.errors for c in clients),
+        "bad_payload_on_200": sum(c.bad_payload for c in clients),
+        "no_reply_lost": sum(c.lost for c in clients),
+        "replies_by_version_index": values,
+    }
+
+
+# ------------------------------------------------------------ the legs
+
+def run_load_variant(chaos: bool, duration_s: float, n_workers: int,
+                     n_clients: int, collect: bool = True) -> dict:
+    import multiprocessing as mp
+    import urllib.parse
+    from mmlspark_tpu.io.distributed_serving import ServingCoordinator
+    from mmlspark_tpu.io.http import KeepAliveTransport
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+    from mmlspark_tpu.resilience import FaultInjector
+
+    # fresh process-global registry per variant: worker processes have
+    # their own; the gateway's series live here
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    injector = None
+    transport = None
+    if chaos:
+        transport = KeepAliveTransport()
+        injector = FaultInjector(seed=12, error_rate=0.3)
+    coord = ServingCoordinator(
+        heartbeat_timeout_s=2.0, registry=reg,
+        forward_transport=(injector.wrap(transport) if chaos else None),
+        coalesce_max=8).start()
+    ctx = mp.get_context("spawn")
+    procs, worker_stops, _ = spawn_workers(ctx, coord.url, n_workers)
+    collector = recorder = None
+    if collect:
+        collector, recorder = arm_observability(coord, reg, injector)
+
+    w = ref_weights()
+    bodies = make_bodies([w])
+
+    stop_clients = threading.Event()
+    parsed = urllib.parse.urlsplit(coord.url)
+    clients = [LoadClient(parsed.hostname, parsed.port,
+                          f"/gateway/{SERVICE}", bodies, w,
+                          DEADLINE_MS / 1000.0, stop_clients)
+               for _ in range(n_clients)]
+    t0 = time.perf_counter()
+    for c in clients:
+        c.start()
+    killed_at = None
+    if chaos:
+        # kill one worker a third of the way in: it must be evicted and
+        # the fleet rebalanced with zero accepted-request loss
+        time.sleep(max(duration_s / 3.0, 1.0))
+        if recorder is not None:
+            # the p99-breach trigger compares against the healthy phase
+            recorder.arm_baseline()
+        procs[0].terminate()
+        killed_at = time.perf_counter() - t0
+        time.sleep(max(duration_s * 2.0 / 3.0, 1.0))
+    else:
+        time.sleep(duration_s)
+    stop_clients.set()
+    for c in clients:
+        c.join(15.0)
+    wall = time.perf_counter() - t0
+
+    # worker-side scrape BEFORE teardown: batch fill + request accounting
+    worker_stats = []
+    for s in coord.routes(SERVICE):
+        try:
+            text = scrape(f"http://{s.host}:{s.port}/metrics")
+            cnt = prom_value(text, "serving_batch_rows_count")
+            tot = prom_value(text, "serving_batch_rows_sum")
+            worker_stats.append({
+                "worker": f"{s.machine}:{s.partition}",
+                "batches": cnt,
+                "mean_batch_rows": round(tot / cnt, 2) if cnt else 0.0,
+                "requests": prom_value(text, "serving_requests_total"),
+                "shed": prom_value(text, "serving_shed_total"),
+                "coalesced_packs": prom_value(
+                    text, "serving_coalesced_packs_total"),
+            })
+        except Exception as e:
+            worker_stats.append({"worker": f"{s.machine}:{s.partition}",
+                                 "scrape_error": str(e)[:100]})
+
+    # trace exemplars: a few gateway traces with their per-attempt spans
+    exemplars = []
+    seen = set()
+    for ev in list(coord.events.events())[-400:]:
+        tid = ev.get("trace_id")
+        if tid and tid not in seen:
+            seen.add(tid)
+            spans = [{k: v for k, v in e.items() if k != "trace_id"}
+                     for e in coord.events.events(tid)]
+            exemplars.append({"trace_id": tid, "spans": spans[:8]})
+        if len(exemplars) >= 3:
+            break
+
+    lbl = {"instance": coord.metrics_label}
+    p50 = reg.quantile("gateway_request_latency_seconds", 0.5, lbl)
+    p99 = reg.quantile("gateway_request_latency_seconds", 0.99, lbl)
+    sent = sum(c.sent for c in clients)
+    ok_req = sum(c.ok_requests for c in clients)
+    ok_rows = sum(c.ok_rows for c in clients)
+    shed = sum(c.shed for c in clients)
+    expired = sum(c.expired for c in clients)
+    errors = sum(c.errors for c in clients)
+    bad = sum(c.bad_payload for c in clients)
+    lost = sum(c.lost for c in clients)
+    mean_fill_rows = [ws["mean_batch_rows"] for ws in worker_stats
+                      if ws.get("batches")]
+    summary = {
+        "variant": "chaos" if chaos else "baseline",
+        "duration_s": round(wall, 1),
+        "workers": n_workers,
+        "clients": n_clients,
+        "batch_mix_rows": list(BATCH_MIX),
+        "client_requests": sent,
+        "ok_requests": ok_req,
+        "ok_rows": ok_rows,
+        "row_requests_per_s": round(ok_rows / wall, 1),
+        "client_requests_per_s": round(sent / wall, 1),
+        "shed": shed,
+        "expired": expired,
+        "errors": errors,
+        "bad_payload_on_200": bad,
+        "no_reply_lost": lost,
+        "shed_rate": round(shed / sent, 5) if sent else 0.0,
+        "gateway_p50_ms": round(p50 * 1e3, 3) if p50 else None,
+        "gateway_p99_ms": round(p99 * 1e3, 3) if p99 else None,
+        "coalesced_forwards": reg.total("gateway_coalesced_forwards_total"),
+        "coalesced_requests": reg.total("gateway_coalesced_requests_total"),
+        "route_decisions": reg.total("gateway_route_decisions_total"),
+        "forward_failures": reg.total("gateway_forward_failures_total"),
+        "evictions": reg.total("gateway_evictions_total"),
+        "worker_stats": worker_stats,
+        "mean_batch_rows": (round(float(np.mean(mean_fill_rows)), 1)
+                            if mean_fill_rows else 0.0),
+        "trace_exemplars": exemplars,
+    }
+    if chaos:
+        summary["injected"] = dict(injector.counts)
+        summary["worker_killed_at_s"] = round(killed_at, 1)
+    summary["collect"] = bool(collect)
+    harvest_observability(summary, coord, collector, recorder)
+
+    stop_workers(procs, worker_stops)
+    coord.stop()
+    set_registry(prev)
+    return summary
+
+
+def run_swap_variant(chaos: bool, duration_s: float, n_workers: int,
+                     n_clients: int, collect: bool = True) -> dict:
+    """Sustained load with a mid-run version rollout. Baseline: canary ->
+    promote to v2 completes with zero lost/shed accepted requests, every
+    200 payload exact against {v1, v2}. Chaos: the target version's
+    artifact is CORRUPT (digest gate must fail the swap), a worker is
+    killed mid-rollout, and 30% of gateway forwards fail — the rollout
+    must auto-roll-back with zero accepted-request loss."""
+    import multiprocessing as mp
+    import tempfile
+    import urllib.parse
+    from mmlspark_tpu.io import rowcodec
+    from mmlspark_tpu.io.distributed_serving import ServingCoordinator
+    from mmlspark_tpu.io.http import KeepAliveTransport
+    from mmlspark_tpu.io.registry import ModelRegistry, golden_reply_digest
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+    from mmlspark_tpu.resilience import FaultInjector
+    from mmlspark_tpu.resilience.chaos import TrainingFaultInjector
+
+    w1 = ref_weights()
+    w2 = (w1 * 1.5).astype(np.float32)
+    rdir = tempfile.mkdtemp(prefix="model_registry_")
+    registry = ModelRegistry(rdir, keep_last=4)
+    golden = rowcodec.encode("features",
+                             np.ones((1, FEATURES), np.float32))
+    v1 = registry.publish(
+        {"weights.bin": w1.tobytes()}, golden_body=golden,
+        golden_reply_sha256=golden_reply_digest(make_handler(w1), golden),
+        set_current=True)
+    v2 = registry.publish(
+        {"weights.bin": w2.tobytes()}, golden_body=golden,
+        golden_reply_sha256=golden_reply_digest(make_handler(w2), golden))
+    target = v2
+    if chaos:
+        # the corrupt-artifact swap fault: the digest gate must fail the
+        # canary's swap and the rollout must roll back automatically
+        v3 = registry.publish({"weights.bin": w2.tobytes()},
+                              golden_body=golden)
+        TrainingFaultInjector.corrupt_version_payload(registry, v3)
+        target = v3
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    injector = None
+    transport = None
+    if chaos:
+        transport = KeepAliveTransport()
+        injector = FaultInjector(seed=12, error_rate=0.3)
+    coord = ServingCoordinator(
+        heartbeat_timeout_s=2.0, registry=reg,
+        forward_transport=(injector.wrap(transport) if chaos else None),
+        coalesce_max=8, canary_beats=2,
+        rollout_timeout_s=max(10.0, duration_s / 3.0)).start()
+    ctx = mp.get_context("spawn")
+    procs, worker_stops, _ = spawn_workers(ctx, coord.url, n_workers,
+                                           registry_dir=rdir)
+    collector = recorder = None
+    if collect:
+        collector, recorder = arm_observability(coord, reg, injector)
+
+    bodies = make_bodies([w1, w2])
+
+    stop_clients = threading.Event()
+    parsed = urllib.parse.urlsplit(coord.url)
+    clients = [LoadClient(parsed.hostname, parsed.port,
+                          f"/gateway/{SERVICE}", bodies, None,
+                          DEADLINE_MS / 1000.0, stop_clients)
+               for _ in range(n_clients)]
+    t0 = time.perf_counter()
+    for c in clients:
+        c.start()
+
+    # phase 1: steady pre-swap traffic (beats deliver model_version
+    # reports, baselines settle)
+    time.sleep(max(duration_s / 3.0, 2.0))
+    if recorder is not None:
+        recorder.arm_baseline()  # p99 judged against pre-swap steady
+    # under chaos the routing table can be transiently EMPTY (an injected
+    # forward fault just evicted everyone; heartbeats re-register within
+    # a beat) — retry like an operator would
+    ro = None
+    for _ in range(100):
+        try:
+            ro = coord.start_rollout(SERVICE, target, previous=v1)
+            break
+        except ValueError:
+            time.sleep(0.1)
+    if ro is None:
+        raise RuntimeError("could not start rollout: no workers stayed "
+                           "registered")
+    rollout_started_at = time.perf_counter() - t0
+    print(f"  rollout -> v{target} started at {rollout_started_at:.1f}s "
+          f"(canary {ro['canary'][0]}:{ro['canary'][1]})", flush=True)
+    killed_at = None
+    if chaos:
+        # worker kill mid-swap: terminate a NON-canary worker while the
+        # rollout is in flight; it must be evicted with zero accepted loss
+        time.sleep(0.5)
+        procs[-1].terminate()
+        killed_at = time.perf_counter() - t0
+    # wait for the state machine to resolve, under full load throughout
+    state = None
+    t_resolve = None
+    deadline = time.time() + max(duration_s, 30.0)
+    while time.time() < deadline:
+        state = (coord.rollout_status(SERVICE) or {}).get("state")
+        if state in ("done", "rolled_back"):
+            if t_resolve is None:
+                t_resolve = time.perf_counter() - t0
+            break
+        time.sleep(0.1)
+    # phase 3: steady post-swap traffic (post-flip payloads verified)
+    time.sleep(max(duration_s / 3.0, 2.0))
+    stop_clients.set()
+    for c in clients:
+        c.join(15.0)
+    wall = time.perf_counter() - t0
+
+    # per-worker swap telemetry before teardown
+    worker_swaps = []
+    for s in coord.routes(SERVICE):
+        try:
+            text = scrape(f"http://{s.host}:{s.port}/metrics")
+            worker_swaps.append({
+                "worker": f"{s.machine}:{s.partition}",
+                "model_version": prom_value(text, "serving_model_version"),
+                "swap_events": prom_by_label(
+                    text, "serving_swap_events_total", "outcome"),
+            })
+        except Exception as e:
+            worker_swaps.append({"worker": f"{s.machine}:{s.partition}",
+                                 "scrape_error": str(e)[:100]})
+
+    lbl = {"instance": coord.metrics_label}
+    p50 = reg.quantile("gateway_request_latency_seconds", 0.5, lbl)
+    p99 = reg.quantile("gateway_request_latency_seconds", 0.99, lbl)
+    summary = {
+        "variant": "swap_chaos" if chaos else "swap",
+        "duration_s": round(wall, 1),
+        "workers": n_workers,
+        "clients": n_clients,
+        "batch_mix_rows": list(BATCH_MIX),
+        "versions": {"previous": v1, "target": target,
+                     "target_corrupt": bool(chaos)},
+        "rollout_started_at_s": round(rollout_started_at, 1),
+        "rollout_resolved_at_s": (round(t_resolve, 1)
+                                  if t_resolve else None),
+        "rollout_final_state": state,
+        "rollout": {k: v for k, v in
+                    (coord.rollout_status(SERVICE) or {}).items()
+                    if k != "baseline"},
+        "worker_killed_at_s": (round(killed_at, 1)
+                               if killed_at is not None else None),
+        "gateway_p50_ms": round(p50 * 1e3, 3) if p50 else None,
+        "gateway_p99_ms": round(p99 * 1e3, 3) if p99 else None,
+        "evictions": reg.total("gateway_evictions_total"),
+        "forward_failures": reg.total("gateway_forward_failures_total"),
+        "worker_swaps": worker_swaps,
+        **client_tallies(clients, wall),
+    }
+    if chaos:
+        summary["injected"] = dict(injector.counts)
+    summary["collect"] = bool(collect)
+    harvest_observability(summary, coord, collector, recorder)
+
+    stop_workers(procs, worker_stops)
+    coord.stop()
+    set_registry(prev)
+    return summary
+
+
+def run_autoscale_variant(duration_s: float, n_clients: int,
+                          collect: bool = True) -> dict:
+    """Ramped load against a 2-worker base fleet with the Autoscaler
+    acting on heartbeat queue-depth signals: grow 2 -> 4 under the ramp,
+    retire back to 2 after it (deregister -> drain -> stop), zero lost
+    requests throughout."""
+    import multiprocessing as mp
+    import urllib.parse
+    from mmlspark_tpu.io.autoscale import Autoscaler
+    from mmlspark_tpu.io.distributed_serving import ServingCoordinator
+    from mmlspark_tpu.observability import MetricsRegistry, set_registry
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    coord = ServingCoordinator(heartbeat_timeout_s=2.0, registry=reg,
+                               coalesce_max=8).start()
+    ctx = mp.get_context("spawn")
+    # deliberately heavier per-batch cost + smaller batches so the ramp
+    # creates a genuine 2-worker capacity DEFICIT (queues grow until the
+    # fleet scales) that 4 workers clear — the autoscaler's signal
+    worker_kw = dict(slow_ms=float(os.environ.get("MEASURE_AS_SLOW_MS",
+                                                  "7")),
+                     max_batch_size=64)
+    base_procs, base_stops, _ = spawn_workers(ctx, coord.url, 2,
+                                              **worker_kw)
+    collector = recorder = None
+    if collect:
+        collector, recorder = arm_observability(coord, reg)
+    next_partition = [2]
+    spawned = []   # (proc, stop, retire) the autoscaler manages
+
+    def spawn():
+        procs, stops, retires = spawn_workers(
+            ctx, coord.url, 1, first_partition=next_partition[0],
+            **worker_kw)
+        next_partition[0] += 1
+        handle = (procs[0], stops[0], retires[0])
+        spawned.append(handle)
+        return handle
+
+    def retire(handle):
+        proc, stop, retire_ev = handle
+        retire_ev.set()       # worker: deregister -> drain -> stop -> exit
+        proc.join(30.0)
+        if proc.is_alive():
+            proc.terminate()
+
+    scaler = Autoscaler.for_service(
+        coord, SERVICE, spawn, retire,
+        min_workers=2, max_workers=4,
+        high_queue_depth=float(os.environ.get("MEASURE_AS_HIGH", "6")),
+        low_queue_depth=float(os.environ.get("MEASURE_AS_LOW", "1")),
+        up_after=2, down_after=8,
+        cooldown_s=max(3.0, duration_s / 15.0), interval_s=0.25,
+        registry=reg).start()
+
+    w = ref_weights()
+    bodies = make_bodies([w])
+    parsed = urllib.parse.urlsplit(coord.url)
+
+    def mk_clients(n, stop_ev):
+        cs = [LoadClient(parsed.hostname, parsed.port,
+                         f"/gateway/{SERVICE}", bodies, None,
+                         DEADLINE_MS / 1000.0, stop_ev)
+              for _ in range(n)]
+        for c in cs:
+            c.start()
+        return cs
+
+    # load trace: light -> ramp (all clients) -> light again
+    t0 = time.perf_counter()
+    m0 = time.monotonic()   # the Autoscaler's action clock origin
+    stop_all = threading.Event()
+    stop_ramp = threading.Event()
+    light = mk_clients(max(2, n_clients // 8), stop_all)
+    fleet_series = []
+
+    def sample_fleet():
+        fleet_series.append(
+            {"t": round(time.perf_counter() - t0, 1),
+             "workers": len(coord.routes(SERVICE)),
+             "mean_queue_depth": round(float(np.mean(
+                 [v["queue_depth"] for v in
+                  coord.worker_loads(SERVICE).values()] or [0.0])), 2)})
+
+    phase = max(duration_s / 3.0, 4.0)
+    end1 = time.perf_counter() + phase
+    while time.perf_counter() < end1:
+        sample_fleet()
+        time.sleep(0.5)
+    ramp = mk_clients(n_clients, stop_ramp)
+    peak_workers = 0
+    end2 = time.perf_counter() + phase
+    while time.perf_counter() < end2:
+        sample_fleet()
+        peak_workers = max(peak_workers, len(coord.routes(SERVICE)))
+        time.sleep(0.5)
+    stop_ramp.set()
+    for c in ramp:
+        c.join(15.0)
+    end3 = time.perf_counter() + phase
+    while time.perf_counter() < end3:
+        sample_fleet()
+        time.sleep(0.5)
+    stop_all.set()
+    for c in light:
+        c.join(15.0)
+    wall = time.perf_counter() - t0
+    final_workers = len(coord.routes(SERVICE))
+
+    clients = light + ramp
+    lbl = {"instance": coord.metrics_label}
+    p50 = reg.quantile("gateway_request_latency_seconds", 0.5, lbl)
+    p99 = reg.quantile("gateway_request_latency_seconds", 0.99, lbl)
+    summary = {
+        "variant": "autoscale",
+        "duration_s": round(wall, 1),
+        "base_workers": 2,
+        "clients_light": len(light), "clients_ramp": len(ramp),
+        "batch_mix_rows": list(BATCH_MIX),
+        "peak_workers": peak_workers,
+        "final_workers": final_workers,
+        "actions": [{**a, "t": round(a["t"] - m0, 1)}
+                    for a in scaler.actions],
+        "scale_ups": sum(1 for a in scaler.actions
+                         if a["action"] == "scale_up"),
+        "scale_downs": sum(1 for a in scaler.actions
+                           if a["action"] == "scale_down"),
+        "fleet_series": fleet_series,
+        "gateway_p50_ms": round(p50 * 1e3, 3) if p50 else None,
+        "gateway_p99_ms": round(p99 * 1e3, 3) if p99 else None,
+        "evictions": reg.total("gateway_evictions_total"),
+        **client_tallies(clients, wall),
+    }
+    summary["collect"] = bool(collect)
+    harvest_observability(summary, coord, collector, recorder)
+
+    scaler.stop(retire_spawned=True)
+    stop_workers(base_procs, base_stops)
+    coord.stop()
+    set_registry(prev)
+    return summary

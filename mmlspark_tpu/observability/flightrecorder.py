@@ -66,6 +66,7 @@ class FlightRecorder:
                  = None,
                  fetch: Callable[[str], Dict[str, Any]] = _http_json,
                  slo: Optional[SLOMonitor] = None,
+                 chaos_bundles: bool = False,
                  metrics_label: str = "flightrecorder"):
         self.collector = collector
         self.out_dir = out_dir
@@ -85,6 +86,13 @@ class FlightRecorder:
         self.workers_fn = workers_fn
         self.fetch = fetch
         self.slo = slo
+        #: ISSUE 20 — the production-day scorecard demands one incident
+        #: bundle PER INJECTED FAULT CLASS, so a scenario run arms this
+        #: to turn every `chaos` system event into a `chaos_<kind>`
+        #: trigger (the per-reason cooldown still bounds disk churn).
+        #: Dark by default: ordinary fleets bundle the chaos AFTERMATH
+        #: (rollback, shed spike, SLO breach), not the injection itself.
+        self.chaos_bundles = bool(chaos_bundles)
         self._lbl = {"instance": metrics_label}
         self._m_bundles: Dict[str, Any] = {}
         self._system: List[Dict[str, Any]] = []
@@ -201,6 +209,12 @@ class FlightRecorder:
                               f"{ev.get('slo')}: fast "
                               f"{ev.get('burn_fast')} slow "
                               f"{ev.get('burn_slow')}"))
+            # 6. (armed runs only) chaos injection itself — the
+            # production-day scorecard's bundle-per-fault-class check
+            elif self.chaos_bundles and ev.get("span") == "chaos":
+                fired.append((f"chaos_{ev.get('kind', 'unknown')}",
+                              f"injected {ev.get('kind')} "
+                              f"(seed {ev.get('seed')})"))
         # 3. shed spike over the window
         with self._lock:
             if len(self._shed_samples) >= 2:

@@ -11,19 +11,26 @@ that no other module defines its own backoff loop.
 from .policy import (Attempt, Deadline, DeadlineExceeded, RetryError,
                      RetryPolicy, parse_retry_after)
 from .chaos import (FaultInjector, InjectedDrop, InjectedFault, InjectedKill,
-                    TrainingFaultInjector)
+                    RewardFaultInjector, TrainingFaultInjector, derive_seed)
 from .bringup import backend_bringup
 from .rewardjoin import RewardJoiner, REFUSAL_REASONS
 from .elastic import (CheckpointStore, Preempted, PreemptionDrain,
                       atomic_write_bytes, atomic_write_text)
+from .scenario import (Phase, ScenarioChaos, ScenarioEngine,
+                       ScenarioTimeline, Scorecard, build_scorecard,
+                       cost_proxy, diurnal_phases, judge_slo,
+                       reconcile_chaos)
 
 __all__ = [
     "Attempt", "Deadline", "DeadlineExceeded", "RetryError", "RetryPolicy",
     "parse_retry_after",
     "FaultInjector", "InjectedDrop", "InjectedFault", "InjectedKill",
-    "TrainingFaultInjector",
+    "RewardFaultInjector", "TrainingFaultInjector", "derive_seed",
     "backend_bringup",
     "RewardJoiner", "REFUSAL_REASONS",
     "CheckpointStore", "Preempted", "PreemptionDrain",
     "atomic_write_bytes", "atomic_write_text",
+    "Phase", "ScenarioChaos", "ScenarioEngine", "ScenarioTimeline",
+    "Scorecard", "build_scorecard", "cost_proxy", "diurnal_phases",
+    "judge_slo", "reconcile_chaos",
 ]

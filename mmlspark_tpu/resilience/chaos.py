@@ -45,10 +45,26 @@ refusals, drops == `reward_timeout` evictions).
 
 from __future__ import annotations
 
+import hashlib
 import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+
+def derive_seed(master_seed: int, injector_name: str) -> int:
+    """One scenario seed -> every sub-injector's seed (ISSUE 20).
+
+    `hash(seed, injector_name)` via sha256 — NOT Python's builtin
+    `hash()`, which is salted per process and would break the replay
+    contract across runs. The derivation is a pure function of its two
+    arguments, so a multi-injector chaos run (transport + training +
+    reward planes at once) replays from a single number: same master
+    seed => every sub-injector draws the identical fault schedule
+    (docs/RESILIENCE.md, "Determinism contract")."""
+    h = hashlib.sha256(
+        f"{int(master_seed)}:{injector_name}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
 
 
 class InjectedFault(ConnectionError):
@@ -93,6 +109,16 @@ class FaultInjector:
         #: gateway's log so the fleet trace collector sees the injections
         #: beside the forward failures they caused (incident bundles)
         self.event_log = event_log
+
+    @classmethod
+    def from_master(cls, master_seed: int, injector_name: str,
+                    **kw) -> "FaultInjector":
+        """Sub-injector keyed off one scenario master seed: the seed is
+        `derive_seed(master_seed, injector_name)` — the multi-injector
+        replay contract (same master seed => same schedule per name)."""
+        inj = cls(seed=derive_seed(master_seed, injector_name), **kw)
+        inj.injector_name = injector_name
+        return inj
 
     def _classify(self, u: float) -> str:
         if u < self.error_rate:
@@ -190,6 +216,17 @@ class TrainingFaultInjector:
         self.counts: Dict[str, int] = {"boundaries": 0, "kills": 0}
         if kill_host is not None:
             self.counts["spared"] = 0
+
+    @classmethod
+    def from_master(cls, master_seed: int, injector_name: str,
+                    **kw) -> "TrainingFaultInjector":
+        """Sub-injector keyed off one scenario master seed (same
+        derivation as `FaultInjector.from_master`): with no pinned
+        `kill_at_chunk` the kill boundary is drawn from the DERIVED seed,
+        so the whole training-fault plan replays from the master."""
+        inj = cls(seed=derive_seed(master_seed, injector_name), **kw)
+        inj.injector_name = injector_name
+        return inj
 
     def _process_index(self) -> int:
         if self._process_index_fn is not None:
@@ -362,6 +399,15 @@ class RewardFaultInjector:
         self.counts: Dict[str, int] = {
             "rewards": 0, "duplicate_reward": 0, "delay_reward": 0,
             "drop_reward": 0, "ok": 0}
+
+    @classmethod
+    def from_master(cls, master_seed: int, injector_name: str,
+                    **kw) -> "RewardFaultInjector":
+        """Sub-injector keyed off one scenario master seed (same
+        derivation as `FaultInjector.from_master`)."""
+        inj = cls(seed=derive_seed(master_seed, injector_name), **kw)
+        inj.injector_name = injector_name
+        return inj
 
     def _classify(self, u: float) -> str:
         if u < self.duplicate_rate:

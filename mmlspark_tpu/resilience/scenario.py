@@ -1,0 +1,487 @@
+"""Production-day scenario engine (ISSUE 20, ROADMAP item 6).
+
+Every resilience subsystem was proved one fault at a time (load chaos,
+swap/autoscale, host loss, reward storms); this module is the
+COMPOSITION: a replayable "production day" — seeded diurnal traffic
+(ramp -> peak -> burst -> trough), a scripted event timeline on ONE
+injected clock (canary rollout at peak, worker kill mid-rollout, corrupt
+artifact publish, autoscale-down in the trough, online-learner
+preemption via the PR 19 loop), and a machine-checkable scorecard. The
+same engine drives both the tier-1 mini run (injected clock, in-process
+fakes, compressed timeline — tests/test_production_day.py) and the
+full-length fleet run (scripts/run_production_day.py composing the
+io/loadgen.py legs), so the scorecard logic is proved once and reused.
+
+The pieces:
+
+- `diurnal_phases(total_s)` — the canonical four-phase day with per-phase
+  traffic levels; `burst` is judged for SLO adherence but exempt from
+  gating (a flash crowd MAY shed within the error budget).
+- `ScenarioTimeline` — scripted actions at scenario-time offsets, fired
+  once by `poll(now_s)` in order; an action's exception is recorded, not
+  propagated (the day continues, the scorecard judges).
+- `ScenarioChaos` — one master seed derives every sub-injector via
+  `chaos.derive_seed(seed, name)` (the replay contract), and scripted
+  faults (worker kill, corrupt artifact, learner preemption) are
+  recorded at their DESIGNATED commit points: independent ground-truth
+  counts + `scenario_injected_faults_total{kind}` + a `chaos` system
+  event on the fleet ring (so the flight recorder's chaos trigger dumps
+  one forensics bundle per fault class).
+- `Scorecard` — named checks counted into
+  `scenario_scorecard_checks_total{check,outcome}`; `exempt` checks are
+  judged and recorded but do not gate `passed`.
+- `ScenarioEngine` — the phase loop on an injected (clock, sleep) pair:
+  publishes the `scenario_phase` gauge at phase transitions (a
+  designated commit point, never the hot traffic path), fires due
+  timeline actions, and calls the per-tick sampler.
+- `build_scorecard(...)` — the one shared judgment: per-phase SLO
+  adherence from the PR 14 monitors, zero accepted-request loss, one
+  incident bundle per injected fault class, EXACT chaos reconciliation
+  against injector ground truth, the worker-seconds cost proxy vs the
+  no-autoscaler baseline leg, and fault-schedule determinism (the
+  re-derived schedule digest must match).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .chaos import (FaultInjector, RewardFaultInjector,
+                    TrainingFaultInjector, derive_seed)
+
+__all__ = [
+    "PHASE_ORDER", "Phase", "diurnal_phases", "ScenarioTimeline",
+    "ScenarioChaos", "Scorecard", "ScenarioEngine", "judge_slo",
+    "worker_seconds", "cost_proxy", "reconcile_chaos", "build_scorecard",
+]
+
+PHASE_ORDER = ("ramp", "peak", "burst", "trough")
+
+
+@dataclass
+class Phase:
+    """One diurnal phase: a traffic level held for a duration."""
+    name: str
+    duration_s: float
+    traffic: float                 # fraction of peak traffic (1.0 = peak)
+    slo_required: bool = True      # False = judged but not gating (burst)
+    start_s: float = 0.0           # filled by diurnal_phases
+
+    @property
+    def end_s(self) -> float:
+        return self.start_s + self.duration_s
+
+
+def diurnal_phases(total_s: float,
+                   burst_factor: float = 1.25) -> List[Phase]:
+    """The canonical production day, scaled to `total_s`: ramp (40% of
+    peak traffic, 25% of the day) -> peak (100%, 30%) -> burst (the
+    flash crowd riding on top of peak, 15%) -> trough (15% traffic, 30%).
+    Burst is judged for SLO adherence but exempt from gating: shedding
+    inside the error budget is the DESIGNED response to a flash crowd."""
+    fracs = {"ramp": 0.25, "peak": 0.30, "burst": 0.15, "trough": 0.30}
+    traffic = {"ramp": 0.4, "peak": 1.0, "burst": float(burst_factor),
+               "trough": 0.15}
+    phases: List[Phase] = []
+    t = 0.0
+    for name in PHASE_ORDER:
+        p = Phase(name, total_s * fracs[name], traffic[name],
+                  slo_required=(name != "burst"), start_s=t)
+        t += p.duration_s
+        phases.append(p)
+    return phases
+
+
+class ScenarioTimeline:
+    """Scripted actions at scenario-time offsets, fired once, in order.
+
+    `poll(now_s)` fires every not-yet-fired action whose offset has
+    passed. An action that raises is RECORDED (the `error` field) and
+    the day continues — a production day does not stop because one
+    scripted event misfired; the scorecard judges the aftermath."""
+
+    def __init__(self):
+        self._actions: List[Dict[str, Any]] = []
+        self.fired: List[Dict[str, Any]] = []
+
+    def at(self, at_s: float, name: str,
+           fn: Callable[[], Any]) -> "ScenarioTimeline":
+        self._actions.append({"at_s": float(at_s), "name": name,
+                              "fn": fn, "fired": False})
+        self._actions.sort(key=lambda a: a["at_s"])
+        return self
+
+    def poll(self, now_s: float) -> List[str]:
+        fired_now: List[str] = []
+        for a in self._actions:
+            if a["fired"] or a["at_s"] > now_s:
+                continue
+            a["fired"] = True
+            rec = {"name": a["name"], "at_s": round(a["at_s"], 2),
+                   "fired_s": round(now_s, 2), "error": None}
+            try:
+                a["fn"]()
+            except Exception as e:  # noqa: BLE001 - the day continues
+                rec["error"] = f"{type(e).__name__}: {e}"[:200]
+            self.fired.append(rec)
+            fired_now.append(a["name"])
+        return fired_now
+
+    @property
+    def pending(self) -> List[str]:
+        return [a["name"] for a in self._actions if not a["fired"]]
+
+
+class ScenarioChaos:
+    """One master seed -> every sub-injector + scripted-fault ground truth.
+
+    Sub-injectors come from the `from_master` constructors (seed =
+    `derive_seed(master_seed, name)`), so the whole multi-plane fault
+    schedule replays from a single number. Scripted faults (worker kill,
+    corrupt artifact, learner preemption — events the timeline fires, not
+    probability draws) are recorded through `record_scripted`, the
+    designated commit point: the independent `scripted` tally, the
+    `scenario_injected_faults_total{kind}` counter, and a `chaos` system
+    event on the fleet ring (the flight recorder's chaos trigger turns
+    it into a per-fault-class incident bundle)."""
+
+    def __init__(self, master_seed: int, registry=None, event_log=None):
+        self.master_seed = int(master_seed)
+        self.registry = registry
+        self.event_log = event_log
+        self.injectors: Dict[str, Any] = {}
+        self.scripted: Dict[str, int] = {}
+
+    # ------------------------------------------------------- sub-injectors
+    def fault_injector(self, name: str, **kw) -> FaultInjector:
+        inj = FaultInjector.from_master(self.master_seed, name, **kw)
+        self.injectors[name] = inj
+        return inj
+
+    def training_injector(self, name: str, **kw) -> TrainingFaultInjector:
+        inj = TrainingFaultInjector.from_master(self.master_seed, name,
+                                                **kw)
+        self.injectors[name] = inj
+        return inj
+
+    def reward_injector(self, name: str, **kw) -> RewardFaultInjector:
+        inj = RewardFaultInjector.from_master(self.master_seed, name, **kw)
+        self.injectors[name] = inj
+        return inj
+
+    # ------------------------------------------------------ scripted faults
+    def record_scripted(self, kind: str, **detail) -> None:
+        self.scripted[kind] = self.scripted.get(kind, 0) + 1
+        if self.registry is not None:
+            try:
+                self.registry.counter(
+                    "scenario_injected_faults_total",
+                    "scripted production-day faults by kind",
+                    labels={"kind": kind}).inc()
+            except Exception:  # noqa: BLE001 - telemetry must not alter chaos
+                pass
+        if self.event_log is not None:
+            try:
+                self.event_log.append("chaos", kind=kind,
+                                      seed=self.master_seed, scripted=True,
+                                      **detail)
+            except Exception:  # noqa: BLE001 - tracing must not alter chaos
+                pass
+
+    # -------------------------------------------------------- replay proof
+    def schedule(self, n: int = 32) -> Dict[str, Any]:
+        """The whole run's fault plan as data: per-injector derived seed +
+        schedule preview (probability injectors) or kill boundary
+        (training injectors). A pure function of (master_seed, the
+        injector names and rates) — the replay contract."""
+        out: Dict[str, Any] = {"master_seed": self.master_seed,
+                               "injectors": {}}
+        for name, inj in sorted(self.injectors.items()):
+            rec: Dict[str, Any] = {
+                "seed": derive_seed(self.master_seed, name)}
+            if isinstance(inj, TrainingFaultInjector):
+                rec["kill_at_chunk"] = inj.kill_at_chunk
+            else:
+                rec["schedule"] = inj.schedule(n)
+            out["injectors"][name] = rec
+        return out
+
+    def schedule_digest(self, n: int = 32) -> str:
+        payload = json.dumps(self.schedule(n), sort_keys=True,
+                             separators=(",", ":")).encode()
+        return "sha256:" + hashlib.sha256(payload).hexdigest()
+
+
+class Scorecard:
+    """Named machine-checkable verdicts, counted into
+    `scenario_scorecard_checks_total{check,outcome}` at the single
+    designated commit point (`check()`). `exempt` checks are judged and
+    recorded but excluded from `passed` — the burst phase's SLO verdict
+    is information, not a gate."""
+
+    def __init__(self, registry=None):
+        self.registry = registry
+        self.checks: List[Dict[str, Any]] = []
+
+    def check(self, name: str, ok: bool, detail: str = "",
+              exempt: bool = False) -> bool:
+        ok = bool(ok)
+        self.checks.append({"check": name, "ok": ok,
+                            "detail": str(detail)[:300],
+                            "exempt": bool(exempt)})
+        if self.registry is not None:
+            try:
+                self.registry.counter(
+                    "scenario_scorecard_checks_total",
+                    "scorecard checks by outcome",
+                    labels={"check": name,
+                            "outcome": "pass" if ok else "fail"}).inc()
+            except Exception:  # noqa: BLE001 - telemetry never fails a check
+                pass
+        return ok
+
+    @property
+    def passed(self) -> bool:
+        return all(c["ok"] for c in self.checks if not c["exempt"])
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"passed": self.passed,
+                "checks_total": len(self.checks),
+                "checks_failed": sum(1 for c in self.checks
+                                     if not c["ok"] and not c["exempt"]),
+                "checks": list(self.checks)}
+
+
+class ScenarioEngine:
+    """The phase loop on one injected (clock, sleep) pair.
+
+    Per phase: publish the `scenario_phase` gauge (phase transition — a
+    designated commit point), call `on_phase` (the traffic controller),
+    then tick until the phase's scenario-time budget is spent, firing due
+    timeline actions and the per-tick sampler. The mini run passes a
+    fake clock whose `sleep` advances it (compressed timeline, zero real
+    waiting); the full run passes `time.monotonic`/`time.sleep`."""
+
+    def __init__(self, phases: Sequence[Phase],
+                 timeline: Optional[ScenarioTimeline] = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 tick_s: float = 0.5, registry=None,
+                 on_phase: Optional[Callable[[Phase], None]] = None,
+                 on_tick: Optional[Callable[[Phase], None]] = None):
+        self.phases = list(phases)
+        self.timeline = timeline if timeline is not None \
+            else ScenarioTimeline()
+        self.clock = clock
+        self.sleep = sleep
+        self.tick_s = float(tick_s)
+        self.registry = registry
+        self.on_phase = on_phase
+        self.on_tick = on_tick
+        self.phase_log: List[Dict[str, Any]] = []
+        self._t0: Optional[float] = None
+
+    def now(self) -> float:
+        """Scenario time (seconds since run() started)."""
+        if self._t0 is None:
+            return 0.0
+        return self.clock() - self._t0
+
+    def run(self) -> List[Dict[str, Any]]:
+        self._t0 = self.clock()
+        gauge = None
+        if self.registry is not None:
+            gauge = self.registry.gauge(
+                "scenario_phase",
+                "active production-day phase index (0-based)")
+        for i, phase in enumerate(self.phases):
+            if gauge is not None:
+                gauge.set(i)    # the phase-transition commit point
+            if self.on_phase is not None:
+                self.on_phase(phase)
+            self.phase_log.append({"phase": phase.name, "index": i,
+                                   "started_s": round(self.now(), 2)})
+            while self.now() < phase.end_s - 1e-9:
+                self.timeline.poll(self.now())
+                if self.on_tick is not None:
+                    self.on_tick(phase)
+                self.sleep(self.tick_s)
+            self.phase_log[-1]["ended_s"] = round(self.now(), 2)
+        self.timeline.poll(self.now())   # trailing actions fire at day end
+        return self.phase_log
+
+
+# --------------------------------------------------------------- judgments
+
+def judge_slo(samples: Sequence[Optional[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Phase SLO adherence from SLOMonitor.status() samples collected
+    during the phase: adherent iff no sample showed a breached SLO.
+    Warm-up samples (burn None) count as adherent — the monitor refuses
+    to judge a window it has not seen half of."""
+    breached: set = set()
+    n = 0
+    for s in samples or ():
+        if not s:
+            continue
+        n += 1
+        for slo_name, st in s.items():
+            if st.get("breached"):
+                breached.add(str(slo_name))
+    return {"samples": n, "breached_slos": sorted(breached),
+            "adherent": not breached}
+
+
+def worker_seconds(series: Sequence[Dict[str, Any]],
+                   end_s: float) -> float:
+    """Step-integral of fleet size over scenario time. `series`:
+    [{"t": scenario_s, "workers": n}, ...] in time order; each sample's
+    size is held until the next sample (the last until `end_s`)."""
+    total = 0.0
+    pts = [s for s in series if "t" in s and "workers" in s]
+    for i, s in enumerate(pts):
+        t_next = pts[i + 1]["t"] if i + 1 < len(pts) else float(end_s)
+        total += max(0.0, t_next - s["t"]) * s["workers"]
+    return total
+
+
+def cost_proxy(series: Sequence[Dict[str, Any]], end_s: float,
+               baseline_workers: int) -> Dict[str, Any]:
+    """Worker-seconds with the autoscaler vs the no-autoscaler baseline
+    leg: static provisioning must hold the PEAK fleet all day (that is
+    what "no autoscaler" costs — you provision for the worst phase)."""
+    ws = worker_seconds(series, end_s)
+    baseline = float(baseline_workers) * float(end_s)
+    return {
+        "worker_seconds": round(ws, 1),
+        "baseline_workers": int(baseline_workers),
+        "baseline_worker_seconds": round(baseline, 1),
+        "saved_worker_seconds": round(baseline - ws, 1),
+        "saved_frac": round((baseline - ws) / baseline, 4) if baseline
+        else 0.0,
+    }
+
+
+def reconcile_chaos(chaos: ScenarioChaos, registry) -> Dict[str, Any]:
+    """EXACT reconciliation of telemetry counters against ground truth
+    that does not share the registry's code path: per fault kind, the
+    registry's `chaos_injected_total{kind}` (probability injectors,
+    train kills) or `scenario_injected_faults_total{kind}` (scripted
+    faults) must equal the injector's own tally. Inexact is a FINDING
+    (lost or double-counted fault), never a rounding allowance."""
+    rows: List[Dict[str, Any]] = []
+
+    def reg_value(family: str, kind: str) -> float:
+        return registry.counter(family, labels={"kind": kind}).value
+
+    for name, inj in sorted(chaos.injectors.items()):
+        if isinstance(inj, FaultInjector):
+            kinds = [("error", inj.error_rate), ("drop", inj.drop_rate),
+                     ("delay", inj.delay_rate)]
+            for kind, rate in kinds:
+                if rate <= 0.0:
+                    continue
+                truth = inj.counts[kind]
+                seen = reg_value("chaos_injected_total", kind)
+                rows.append({"injector": name, "kind": kind,
+                             "ground_truth": truth, "registry": seen,
+                             "exact": seen == truth})
+        elif isinstance(inj, TrainingFaultInjector):
+            truth = inj.counts["kills"]
+            seen = reg_value("chaos_injected_total", "train_kill")
+            rows.append({"injector": name, "kind": "train_kill",
+                         "ground_truth": truth, "registry": seen,
+                         "exact": seen == truth})
+        elif isinstance(inj, RewardFaultInjector):
+            for kind in ("duplicate_reward", "delay_reward",
+                         "drop_reward"):
+                truth = inj.counts[kind]
+                if truth == 0 and getattr(
+                        inj, kind.split("_")[0] + "_rate", 0.0) <= 0.0:
+                    continue
+                seen = reg_value("chaos_injected_total", kind)
+                rows.append({"injector": name, "kind": kind,
+                             "ground_truth": truth, "registry": seen,
+                             "exact": seen == truth})
+    for kind, truth in sorted(chaos.scripted.items()):
+        seen = reg_value("scenario_injected_faults_total", kind)
+        rows.append({"injector": "scripted", "kind": kind,
+                     "ground_truth": truth, "registry": seen,
+                     "exact": seen == truth})
+    return {"rows": rows, "exact": all(r["exact"] for r in rows)}
+
+
+def fault_classes(chaos: ScenarioChaos) -> List[str]:
+    """Every fault class actually injected this run (count > 0): the
+    scripted kinds plus each probability injector's fired kinds. Each
+    must have produced its `chaos_<kind>` flight-recorder bundle."""
+    kinds = {k for k, v in chaos.scripted.items() if v > 0}
+    for inj in chaos.injectors.values():
+        if isinstance(inj, FaultInjector):
+            for kind in ("error", "drop", "delay"):
+                if inj.counts[kind] > 0:
+                    kinds.add(kind)
+        elif isinstance(inj, RewardFaultInjector):
+            for kind in ("duplicate_reward", "delay_reward",
+                         "drop_reward"):
+                if inj.counts[kind] > 0:
+                    kinds.add(kind)
+    return sorted(kinds)
+
+
+def build_scorecard(*, registry, phases: Sequence[Phase],
+                    phase_slo: Dict[str, Dict[str, Any]],
+                    tallies: Dict[str, Any],
+                    incident_reasons: Sequence[str],
+                    chaos: ScenarioChaos,
+                    cost: Dict[str, Any],
+                    schedule_digest: str) -> Scorecard:
+    """The one shared judgment, identical between the tier-1 mini run and
+    the full fleet run (the acceptance contract in ISSUE 20):
+
+    1. every phase's SLO adherence judged (burst exempt from gating),
+    2. zero accepted-request loss across all injected faults,
+    3. >= 1 flight-recorder incident bundle per injected fault class,
+    4. chaos counters reconciled EXACTLY against injector ground truth,
+    5. the worker-seconds cost proxy beats the no-autoscaler baseline,
+    6. the fault schedule replays from the master seed (digest match).
+    """
+    sc = Scorecard(registry)
+    for ph in phases:
+        rep = phase_slo.get(ph.name) or {"samples": 0, "breached_slos": [],
+                                         "adherent": False}
+        sc.check(f"slo_phase_{ph.name}", rep["adherent"],
+                 detail=(f"{rep['samples']} samples"
+                         + (f", breached: {rep['breached_slos']}"
+                            if rep["breached_slos"] else "")),
+                 exempt=not ph.slo_required)
+    bad = int(tallies.get("bad_payload_on_200", 0))
+    lost = int(tallies.get("no_reply_lost", 0))
+    sc.check("zero_accepted_loss", bad == 0 and lost == 0,
+             detail=f"bad_payload_on_200={bad} no_reply_lost={lost} over "
+                    f"{tallies.get('client_requests', 0)} requests")
+    reasons = set(incident_reasons)
+    for kind in fault_classes(chaos):
+        sc.check(f"bundle_{kind}", f"chaos_{kind}" in reasons,
+                 detail=f"flight-recorder bundle chaos_{kind} "
+                        + ("present" if f"chaos_{kind}" in reasons
+                           else f"MISSING (have {sorted(reasons)})"))
+    rec = reconcile_chaos(chaos, registry)
+    for row in rec["rows"]:
+        sc.check(f"chaos_reconcile_{row['kind']}", row["exact"],
+                 detail=f"{row['injector']}: ground truth "
+                        f"{row['ground_truth']} vs registry "
+                        f"{row['registry']:.0f}")
+    sc.check("cost_beats_no_autoscaler_baseline",
+             cost["worker_seconds"] < cost["baseline_worker_seconds"],
+             detail=f"{cost['worker_seconds']} worker-s with autoscaler vs "
+                    f"{cost['baseline_worker_seconds']} static at peak "
+                    f"({cost['baseline_workers']} workers)")
+    sc.check("fault_schedule_deterministic",
+             chaos.schedule_digest() == schedule_digest,
+             detail=f"re-derived {chaos.schedule_digest()[:23]}... vs "
+                    f"planned {schedule_digest[:23]}...")
+    return sc

@@ -13,9 +13,15 @@ scripts/measure_serving_load.py snapshots the fleet at the end of every
 run and bench.py lifts it into the emitted record (`extra.fleet`), so the
 armed chip window captures fleet forensics for free.
 
+`--assert-healthy` (ISSUE 20) turns the snapshot into a GATE: exit
+non-zero when any fleet member is unreachable, any SLO is breached, or
+a swap/rollout has been stuck in a non-terminal state longer than
+`--stuck-after` seconds — so CI and the production-day scorecard can
+use one flag instead of parsing the JSON by hand.
+
 Usage:
     python scripts/fleet_status.py --coordinator http://127.0.0.1:8000 \
-        [--out fleet.json] [--full-metrics]
+        [--out fleet.json] [--full-metrics] [--assert-healthy]
 """
 
 import argparse
@@ -94,6 +100,53 @@ def collect_fleet(coordinator_url: str, full_metrics: bool = False,
     return snap
 
 
+def assert_healthy(snap: dict, stuck_after_s: float = 120.0,
+                   now_monotonic=None) -> list:
+    """The `--assert-healthy` predicate: a list of problem strings
+    (empty == healthy). Problems, per the ISSUE 20 gate contract:
+
+    - unreachable member: the coordinator or any routed worker whose
+      /health fetch failed;
+    - SLO breach: any SLO in the coordinator's health block with
+      `breached` true;
+    - stuck swap/rollout: a rollout sitting in a NON-terminal state
+      (canary/promoting) longer than `stuck_after_s` — the record's
+      `started_s` is a time.monotonic stamp, so the caller on the same
+      host passes `now_monotonic` (defaults to time.monotonic())."""
+    problems = []
+    coord = snap.get("coordinator") or {}
+    if "health" not in coord:
+        problems.append("coordinator unreachable: "
+                        + str(coord.get("health_error", "no health")))
+        return problems   # nothing below is trustworthy without it
+    health = coord["health"] or {}
+    for service, members in (snap.get("workers") or {}).items():
+        if "routes_error" in members:
+            problems.append(f"{service}: routes unreachable: "
+                            f"{members['routes_error']}")
+            continue
+        for key, member in members.items():
+            if "health" not in member:
+                problems.append(
+                    f"{service}/{key} unreachable: "
+                    f"{member.get('health_error', 'no health')}")
+    for slo_name, st in (health.get("slo") or {}).items():
+        if st.get("breached"):
+            problems.append(
+                f"SLO {slo_name} breached (burn fast "
+                f"{st.get('burn_fast')} slow {st.get('burn_slow')})")
+    now = time.monotonic() if now_monotonic is None else now_monotonic
+    for service, ro in (health.get("rollouts") or {}).items():
+        state = ro.get("state")
+        if state in ("canary", "promoting"):
+            age = now - float(ro.get("started_s", now))
+            if age > stuck_after_s:
+                problems.append(
+                    f"rollout {service} stuck in {state!r} for "
+                    f"{age:.0f}s (> {stuck_after_s:.0f}s)")
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--coordinator", required=True,
@@ -103,6 +156,12 @@ def main() -> int:
     ap.add_argument("--full-metrics", action="store_true",
                     help="embed raw Prometheus text per member, not just "
                          "family totals")
+    ap.add_argument("--assert-healthy", action="store_true",
+                    help="exit non-zero on any unreachable member, SLO "
+                         "breach, or stuck swap/rollout state")
+    ap.add_argument("--stuck-after", type=float, default=120.0,
+                    help="seconds before a non-terminal rollout state "
+                         "counts as stuck (with --assert-healthy)")
     args = ap.parse_args()
     snap = collect_fleet(args.coordinator, full_metrics=args.full_metrics)
     payload = json.dumps(snap, indent=1)
@@ -112,6 +171,14 @@ def main() -> int:
         print(f"wrote {args.out}")
     else:
         print(payload)
+    if args.assert_healthy:
+        problems = assert_healthy(snap, stuck_after_s=args.stuck_after)
+        for p in problems:
+            print(f"UNHEALTHY: {p}", file=sys.stderr)
+        if problems:
+            return 2
+        print("fleet healthy", file=sys.stderr)
+        return 0
     # a snapshot that could not even reach the coordinator is a failure;
     # partial worker scrape errors are data, not failures
     return 0 if "health" in snap["coordinator"] else 1

@@ -81,6 +81,13 @@ EOF
     # offline replay, and exact reward reconciliation (docs/ONLINE.md)
     run python -u scripts/measure_online_loop.py --out docs/ONLINE_loop_chip.json
     run python -u scripts/measure_online_loop.py --scenario chaos --out docs/ONLINE_chaos_chip.json
+    echo "== production day: diurnal traffic + scripted fault timeline + scorecard (round-20 tentpole) $(date -u +%FT%TZ)"
+    # ONE command replays the whole day from one master seed: ramp ->
+    # peak (canary rollout + worker kill) -> burst (corrupt artifact) ->
+    # trough (autoscale-down + learner preemption); exits non-zero
+    # unless the machine-checked scorecard passes (docs/SCENARIOS.md);
+    # bench.py lifts the JSON into extra.production_day
+    run python -u scripts/run_production_day.py --out docs/PRODUCTION_DAY_chip.json
     echo "== cold start: compile cache + AOT (round-11 tentpole) $(date -u +%FT%TZ)"
     run python -u scripts/measure_cold_start.py --out docs/COLD_START_chip.json
     echo "== bench (validates binning fast path on chip) $(date -u +%FT%TZ)"

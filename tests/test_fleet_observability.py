@@ -743,7 +743,7 @@ class TestMetricsNamingLint:
     AREAS = {"serving", "gateway", "autoscaler", "chaos", "bringup",
              "checkpoint", "compile", "gbdt", "fit", "http", "model",
              "tracing", "slo", "collector", "incident", "multihost", "vw",
-             "ingest", "online"}
+             "ingest", "online", "scenario"}
     NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
     HIST_UNITS = ("_seconds", "_rows", "_bytes")
     #: call sites building the family name dynamically (f-strings) —

@@ -1,0 +1,386 @@
+"""Production-day scenario engine (ISSUE 20): the seeded diurnal
+timeline, the master-seed chaos derivation, the scorecard arithmetic —
+and the tier-1 mini production day itself: the SAME `build_scorecard`
+the full run ships through, driven end-to-end on an injected clock in a
+few real seconds. The full subprocess day is `@slow`."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mmlspark_tpu.observability import (FlightRecorder, MetricsRegistry,
+                                        TraceCollector)
+from mmlspark_tpu.resilience.chaos import (FaultInjector,
+                                           TrainingFaultInjector,
+                                           derive_seed)
+from mmlspark_tpu.resilience.scenario import (PHASE_ORDER, Phase,
+                                              ScenarioChaos,
+                                              ScenarioEngine,
+                                              ScenarioTimeline, Scorecard,
+                                              build_scorecard, cost_proxy,
+                                              diurnal_phases, fault_classes,
+                                              judge_slo, reconcile_chaos,
+                                              worker_seconds)
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+sys.path.insert(0, _SCRIPTS)
+
+import run_production_day  # noqa: E402
+from fleet_status import assert_healthy  # noqa: E402
+
+
+class TestDeriveSeed:
+    def test_deterministic_and_name_scoped(self):
+        assert derive_seed(20, "gateway") == derive_seed(20, "gateway")
+        assert derive_seed(20, "gateway") != derive_seed(20, "learner")
+        assert derive_seed(20, "gateway") != derive_seed(21, "gateway")
+
+    def test_from_master_matches_explicit_seed(self):
+        a = FaultInjector.from_master(20, "gw", error_rate=0.3)
+        b = FaultInjector(seed=derive_seed(20, "gw"), error_rate=0.3)
+        assert a.injector_name == "gw"
+        assert a.schedule(32) == b.schedule(32)
+
+    def test_training_injector_kill_chunk_derived(self):
+        a = TrainingFaultInjector.from_master(20, "learner")
+        b = TrainingFaultInjector.from_master(20, "learner")
+        assert a.kill_at_chunk == b.kill_at_chunk
+
+
+class TestScenarioChaos:
+    def test_same_master_seed_same_digest(self):
+        mk = lambda: run_production_day._build_chaos(20, 0.12)  # noqa: E731
+        assert mk().schedule_digest() == mk().schedule_digest()
+
+    def test_different_seed_different_digest(self):
+        a = run_production_day._build_chaos(20, 0.12)
+        b = run_production_day._build_chaos(21, 0.12)
+        assert a.schedule_digest() != b.schedule_digest()
+
+    def test_scripted_faults_counted_and_published(self):
+        reg = MetricsRegistry()
+        events = []
+
+        class Ring(list):
+            def append(self, span, **kw):  # event-log duck type
+                super().append({"span": span, **kw})
+        ring = Ring()
+        chaos = ScenarioChaos(7, registry=reg, event_log=ring)
+        chaos.record_scripted("worker_kill", phase="peak")
+        chaos.record_scripted("worker_kill", phase="peak")
+        assert chaos.scripted["worker_kill"] == 2
+        assert reg.counter("scenario_injected_faults_total",
+                           labels={"kind": "worker_kill"}).value == 2
+        assert ring[0]["span"] == "chaos" and ring[0]["scripted"] is True
+        assert events == []
+
+    def test_fault_classes_only_fired_kinds(self):
+        chaos = ScenarioChaos(7)
+        chaos.fault_injector("gw", error_rate=1.0)
+        chaos.injectors["gw"].next_fault()
+        chaos.record_scripted("worker_kill")
+        assert fault_classes(chaos) == ["error", "worker_kill"]
+
+
+class TestScenarioTimeline:
+    def test_fires_once_in_order_past_due(self):
+        fired = []
+        tl = ScenarioTimeline()
+        tl.at(5.0, "b", lambda: fired.append("b"))
+        tl.at(1.0, "a", lambda: fired.append("a"))
+        assert tl.poll(0.5) == []
+        assert tl.poll(10.0) == ["a", "b"]   # both due: at_s order
+        assert fired == ["a", "b"]
+        assert tl.poll(11.0) == [] and fired == ["a", "b"]
+        assert tl.pending == []
+
+    def test_action_error_captured_not_raised(self):
+        tl = ScenarioTimeline()
+        tl.at(1.0, "boom", lambda: 1 / 0)
+        assert tl.poll(2.0) == ["boom"]
+        assert tl.fired[0]["name"] == "boom"
+        assert "division" in tl.fired[0]["error"]
+
+
+class TestDiurnalPhases:
+    def test_shape_and_contiguity(self):
+        phases = diurnal_phases(200.0)
+        assert tuple(p.name for p in phases) == PHASE_ORDER
+        assert abs(sum(p.duration_s for p in phases) - 200.0) < 1e-9
+        for prev, cur in zip(phases, phases[1:]):
+            assert abs(prev.end_s - cur.start_s) < 1e-9
+        by = {p.name: p for p in phases}
+        assert by["peak"].traffic == 1.0
+        assert by["burst"].traffic > 1.0       # the flash crowd
+        assert by["burst"].slo_required is False
+        assert by["trough"].traffic < by["ramp"].traffic
+
+    def test_engine_runs_phases_on_injected_clock(self):
+        clock = run_production_day._FakeClock()
+        seen = []
+        reg = MetricsRegistry()
+        eng = ScenarioEngine(diurnal_phases(40.0), ScenarioTimeline(),
+                             clock=clock, sleep=clock.sleep, tick_s=1.0,
+                             registry=reg,
+                             on_phase=lambda p: seen.append(p.name))
+        eng.run()
+        assert seen == list(PHASE_ORDER)
+        assert len(eng.phase_log) == 4
+        # the scenario_phase gauge parked on the last phase index
+        assert reg.gauge("scenario_phase").value == 3
+
+
+class TestScorecard:
+    def test_exempt_failure_does_not_gate(self):
+        reg = MetricsRegistry()
+        sc = Scorecard(registry=reg)
+        sc.check("a", True)
+        sc.check("burst", False, exempt=True)
+        assert sc.passed
+        sc.check("b", False)
+        assert not sc.passed
+        d = sc.as_dict()
+        assert d["checks_total"] == 3 and d["checks_failed"] == 1
+        assert reg.counter("scenario_scorecard_checks_total",
+                           labels={"check": "b",
+                                   "outcome": "fail"}).value == 1
+        assert reg.counter("scenario_scorecard_checks_total",
+                           labels={"check": "a",
+                                   "outcome": "pass"}).value == 1
+
+
+class TestCostProxy:
+    def test_worker_seconds_step_integral(self):
+        series = [{"t": 0.0, "workers": 2}, {"t": 10.0, "workers": 4},
+                  {"t": 30.0, "workers": 1}]
+        # 2*10 + 4*20 + 1*10
+        assert worker_seconds(series, 40.0) == 110.0
+        assert worker_seconds([], 40.0) == 0.0
+
+    def test_cost_proxy_vs_static_baseline(self):
+        series = [{"t": 0.0, "workers": 2}, {"t": 10.0, "workers": 4},
+                  {"t": 30.0, "workers": 1}]
+        cost = cost_proxy(series, 40.0, baseline_workers=4)
+        assert cost["worker_seconds"] == 110.0
+        assert cost["baseline_worker_seconds"] == 160.0
+        assert cost["saved_worker_seconds"] == 50.0
+        assert 0.0 < cost["saved_frac"] < 1.0
+
+
+class TestJudgeSlo:
+    def test_adherent_and_breached(self):
+        ok = {"availability": {"breached": False}}
+        bad = {"availability": {"breached": True},
+               "latency_p99": {"breached": False}}
+        good = judge_slo([ok, ok])
+        assert good["adherent"] and good["samples"] == 2
+        j = judge_slo([ok, bad])
+        assert not j["adherent"]
+        assert j["breached_slos"] == ["availability"]
+        assert judge_slo([None, {}])["adherent"]   # warm-up gaps skipped
+
+
+class TestReconcileChaos:
+    def test_exact_match_and_detected_drift(self):
+        reg = MetricsRegistry()
+        from mmlspark_tpu.observability import set_registry
+        prev = set_registry(reg)
+        try:
+            chaos = ScenarioChaos(7, registry=reg)
+            chaos.fault_injector("gw", error_rate=1.0)
+            for _ in range(3):
+                chaos.injectors["gw"].next_fault()
+            chaos.record_scripted("worker_kill")
+            rec = reconcile_chaos(chaos, reg)
+            assert rec["exact"]
+            kinds = {r["kind"] for r in rec["rows"]}
+            assert {"error", "worker_kill"} <= kinds
+            # drift the registry: reconciliation must catch it EXACTLY
+            reg.counter("chaos_injected_total",
+                        labels={"kind": "error"}).inc()
+            rec2 = reconcile_chaos(chaos, reg)
+            assert not rec2["exact"]
+            bad = [r for r in rec2["rows"] if r["kind"] == "error"][0]
+            assert not bad["exact"]
+        finally:
+            set_registry(prev)
+
+
+class TestBuildScorecard:
+    def _chaos(self, reg):
+        chaos = ScenarioChaos(7, registry=reg)
+        chaos.record_scripted("worker_kill")
+        return chaos
+
+    def test_full_pass_and_missing_bundle_fails(self):
+        reg = MetricsRegistry()
+        phases = [Phase("peak", 10.0, 1.0),
+                  Phase("burst", 5.0, 1.25, slo_required=False,
+                        start_s=10.0)]
+        phase_slo = {"peak": judge_slo([{"a": {"breached": False}}]),
+                     "burst": judge_slo([{"a": {"breached": True}}])}
+        tallies = {"bad_payload_on_200": 0, "no_reply_lost": 0,
+                   "client_requests": 10}
+        chaos = self._chaos(reg)
+        cost = cost_proxy([{"t": 0.0, "workers": 1}], 15.0, 2)
+        digest = chaos.schedule_digest()
+        sc = build_scorecard(
+            registry=reg, phases=phases, phase_slo=phase_slo,
+            tallies=tallies, incident_reasons=["chaos_worker_kill"],
+            chaos=chaos, cost=cost, schedule_digest=digest)
+        assert sc.passed, sc.as_dict()
+        # burst breached but exempt
+        burst = [c for c in sc.as_dict()["checks"]
+                 if c["check"] == "slo_phase_burst"][0]
+        assert not burst["ok"] and burst["exempt"]
+        # without the bundle the card gates
+        sc2 = build_scorecard(
+            registry=reg, phases=phases, phase_slo=phase_slo,
+            tallies=tallies, incident_reasons=[],
+            chaos=chaos, cost=cost, schedule_digest=digest)
+        assert not sc2.passed
+
+    def test_lost_request_or_wrong_digest_fails(self):
+        reg = MetricsRegistry()
+        phases = [Phase("peak", 10.0, 1.0)]
+        phase_slo = {"peak": judge_slo([])}
+        chaos = self._chaos(reg)
+        cost = cost_proxy([{"t": 0.0, "workers": 1}], 15.0, 2)
+        sc = build_scorecard(
+            registry=reg, phases=phases, phase_slo=phase_slo,
+            tallies={"bad_payload_on_200": 0, "no_reply_lost": 1},
+            incident_reasons=["chaos_worker_kill"], chaos=chaos,
+            cost=cost, schedule_digest=chaos.schedule_digest())
+        assert not sc.passed
+        sc2 = build_scorecard(
+            registry=reg, phases=phases, phase_slo=phase_slo,
+            tallies={"bad_payload_on_200": 0, "no_reply_lost": 0},
+            incident_reasons=["chaos_worker_kill"], chaos=chaos,
+            cost=cost, schedule_digest="sha256:not-the-plan")
+        assert not sc2.passed
+
+
+class TestAssertHealthy:
+    def _snap(self, **health):
+        return {"coordinator": {"health": {"services": {"svc": 1},
+                                           **health}},
+                "workers": {"svc": {"m:0": {"health": {"ok": True}}}}}
+
+    def test_healthy_fleet_clean(self):
+        assert assert_healthy(self._snap()) == []
+
+    def test_unreachable_coordinator_short_circuits(self):
+        problems = assert_healthy(
+            {"coordinator": {"health_error": "refused"}, "workers": {}})
+        assert len(problems) == 1 and "coordinator" in problems[0]
+
+    def test_unreachable_worker(self):
+        snap = self._snap()
+        snap["workers"]["svc"]["m:1"] = {"health_error": "timeout"}
+        assert any("m:1 unreachable" in p for p in assert_healthy(snap))
+
+    def test_slo_breach(self):
+        snap = self._snap(slo={"availability": {"breached": True,
+                                                "burn_fast": 2.0}})
+        assert any("SLO availability breached" in p
+                   for p in assert_healthy(snap))
+
+    def test_stuck_rollout_needs_age(self):
+        snap = self._snap(rollouts={"svc": {"state": "canary",
+                                            "started_s": 100.0}})
+        assert assert_healthy(snap, stuck_after_s=120.0,
+                              now_monotonic=150.0) == []
+        stuck = assert_healthy(snap, stuck_after_s=120.0,
+                               now_monotonic=400.0)
+        assert any("stuck in 'canary'" in p for p in stuck)
+
+
+class TestChaosBundleTrigger:
+    def test_armed_recorder_fires_per_kind_default_dark(self, tmp_path):
+        reg = MetricsRegistry()
+        col = TraceCollector(registry=reg)
+        ev = {"span": "chaos", "kind": "worker_kill", "seed": 20}
+        armed = FlightRecorder(col, str(tmp_path / "a"), registry=reg,
+                               chaos_bundles=True)
+        assert [r for r, _ in armed._triggers(0.0, [ev])] == \
+            ["chaos_worker_kill"]
+        dark = FlightRecorder(col, str(tmp_path / "b"), registry=reg)
+        assert dark._triggers(0.0, [ev]) == []
+
+
+class TestMiniProductionDay:
+    """The tier-1 production day: the real engine, gateway, autoscaler,
+    flight recorder, and learner loop on one injected clock — the same
+    scorecard logic the full run ships through."""
+
+    def test_mini_day_scorecard_passes(self, tmp_path):
+        summary = run_production_day.run_mini(
+            seed=20, total_s=120.0, work_dir=str(tmp_path))
+        sc = summary["scorecard"]
+        assert sc["passed"], json.dumps(sc, indent=1)
+
+        # one incident bundle per injected fault class
+        reasons = {i["reason"] for i in summary["incidents"]}
+        for kind in ("worker_kill", "corrupt_artifact", "learner_preempt"):
+            assert summary["chaos"]["scripted"][kind] == 1
+            assert f"chaos_{kind}" in reasons
+        assert summary["chaos"]["injected"]["gateway_forward"]["error"] > 0
+        assert "chaos_error" in reasons
+
+        # zero accepted-request loss under all of it
+        t = summary["traffic"]
+        assert t["bad_payload_on_200"] == 0 and t["no_reply_lost"] == 0
+        assert t["client_requests"] > 50
+
+        # every scripted event fired without error
+        assert [f["name"] for f in summary["timeline"]] == \
+            ["canary_rollout", "worker_kill", "corrupt_artifact",
+             "learner_preempt"]
+        assert all(f["error"] is None for f in summary["timeline"])
+        assert summary["swap_outcomes"]["corrupt_artifact"] == \
+            "rollback_load"
+
+        # the learner preemption resumed exactly-once
+        assert summary["learner"]["killed"]
+        assert summary["learner"]["resumes"] == 1
+        assert summary["learner"]["digest_matches_offline_replay"]
+
+        # autoscaler grew in the burst and shrank in the trough
+        acts = [a["action"] for a in summary["autoscaler_actions"]]
+        assert "scale_up" in acts and "scale_down" in acts
+        assert summary["cost_proxy"]["saved_worker_seconds"] > 0
+
+        # chaos reconciliation is exact and the schedule replayed
+        assert summary["reconciliation"]["exact"]
+        assert summary["chaos"]["schedule_digest"] == \
+            summary["chaos"]["planned_digest"]
+
+    def test_same_seed_same_day_different_seed_different(self, tmp_path):
+        d1 = run_production_day._build_chaos(
+            20, run_production_day.MINI_ERROR_RATE).schedule_digest()
+        summary = run_production_day.run_mini(
+            seed=20, total_s=60.0, work_dir=str(tmp_path))
+        assert summary["chaos"]["schedule_digest"] == d1
+        assert run_production_day._build_chaos(
+            99, run_production_day.MINI_ERROR_RATE).schedule_digest() != d1
+
+
+@pytest.mark.slow
+class TestFullProductionDay:
+    def test_full_day_subprocess(self, tmp_path):
+        out = tmp_path / "day.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PRODUCTION_DAY_S="60",
+                   PRODUCTION_DAY_CLIENTS="8")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_SCRIPTS,
+                                          "run_production_day.py"),
+             "--mode", "full", "--out", str(out)],
+            env=env, capture_output=True, text=True, timeout=400)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        summary = json.loads(out.read_text())
+        assert summary["scorecard"]["passed"]
+        assert summary["no_reply_lost"] == 0
